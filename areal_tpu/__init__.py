@@ -1,0 +1,27 @@
+"""areal_tpu: a TPU-native distributed RL/RLHF training framework for LLMs.
+
+Built from scratch for TPU (JAX/XLA/Pallas/pjit), with the capability surface of
+AReaL (ReaLHF): RL algorithms expressed as dataflow graphs of model function
+calls (generate / inference / train_step) over named models (actor, critic,
+ref, reward), executed by a master/worker runtime with per-call parallel
+layouts realized as `jax.sharding` meshes instead of NCCL process-group
+surgery.
+
+Package layout:
+    base/        low-level utilities: name-resolve KV, mesh topology, FFD
+                 packing, frequency control, logging, cluster spec
+    api/         declarative core: config dataclasses, dataflow graph (DFG),
+                 SequenceSample packed batches, engine/interface registries
+    models/      JAX transformer (packed varlen, rotary, RMSNorm, MoE) +
+                 HuggingFace checkpoint conversion (llama/qwen2 families)
+    ops/         numerics: flash attention (Pallas), GAE scan, sampling
+    parallel/    sharding rules, ring attention (context parallel), pipeline
+    engines/     train (optax+FSDP), inference, generator (continuous
+                 batching), mock (CPU tests)
+    interfaces/  algorithms: SFT, PPO/GRPO actor+critic, reward verification
+    data/        datasets (jsonl prompt / math-code), tokenizer utils
+    system/      master/worker runtime, asyncio executor, buffers, streams
+    scheduler/   job launch: local subprocess, TPU pod
+"""
+
+__version__ = "0.1.0"

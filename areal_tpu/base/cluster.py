@@ -1,0 +1,61 @@
+"""TPU cluster specification (capability parity: realhf/base/cluster.py).
+
+The reference loads a JSON ClusterSpec (fileroot, gpu_type, node counts).
+Here the spec describes a TPU deployment: hosts × chips-per-host, generation,
+and the shared fileroot used for checkpoints, logs, and the file-based
+name-resolve store.
+"""
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    cluster_name: str = "local"
+    fileroot: str = "/tmp/areal_tpu"
+    n_hosts: int = 1
+    chips_per_host: int = 1
+    tpu_generation: str = "v5p"  # informational; drives cost models later
+    # Interconnect bandwidths (GB/s per link, unidirectional), used by the
+    # allocation search cost model.
+    ici_bandwidth_gbps: float = 450.0
+    dcn_bandwidth_gbps: float = 25.0
+
+    @property
+    def n_chips(self) -> int:
+        return self.n_hosts * self.chips_per_host
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> "ClusterSpec":
+        path = path or os.environ.get("AREAL_CLUSTER_SPEC_PATH", "")
+        if not path or not os.path.exists(path):
+            return cls()
+        with open(path) as f:
+            raw = json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    def save(self, path: str) -> None:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(dataclasses.asdict(self), f, indent=2)
+
+
+_spec: Optional[ClusterSpec] = None
+
+
+def spec() -> ClusterSpec:
+    global _spec
+    if _spec is None:
+        _spec = ClusterSpec.load()
+    return _spec
+
+
+def set_spec(s: ClusterSpec) -> None:
+    global _spec
+    _spec = s

@@ -1,0 +1,128 @@
+"""Sequence packing / partitioning algorithms.
+
+Capability parity: realhf/base/datapack.py — `ffd_allocate` (first-fit
+decreasing micro-batch packing under a token budget, :153-191),
+`partition_balanced` (:18), `flat2d`.  These drive micro-batch splitting and
+DP-balanced dispatch throughout the system.
+"""
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def flat2d(xs: Sequence[Sequence]) -> List:
+    """Flatten one nesting level."""
+    return [x for sub in xs for x in sub]
+
+
+def ffd_allocate(
+    sizes: Sequence[int], capacity: int, min_groups: int = 1
+) -> List[List[int]]:
+    """First-fit-decreasing bin packing of item `sizes` under `capacity`.
+
+    Returns groups of original indices; every group's total size is <= capacity
+    (items larger than capacity get their own group).  At least `min_groups`
+    groups are returned (padding with empty splits is never done — instead the
+    largest groups are split further by moving items).
+    """
+    order = np.argsort(-np.asarray(sizes, dtype=np.int64), kind="stable")
+    groups: List[List[int]] = []
+    loads: List[int] = []
+    for idx in order:
+        size = int(sizes[idx])
+        placed = False
+        for g in range(len(groups)):
+            if loads[g] + size <= capacity:
+                groups[g].append(int(idx))
+                loads[g] += size
+                placed = True
+                break
+        if not placed:
+            groups.append([int(idx)])
+            loads.append(size)
+    while len(groups) < min_groups:
+        # Split the heaviest multi-item group.
+        cand = sorted(
+            (g for g in range(len(groups)) if len(groups[g]) > 1),
+            key=lambda g: -loads[g],
+        )
+        if not cand:
+            break
+        g = cand[0]
+        items = sorted(groups[g], key=lambda i: -sizes[i])
+        keep, move = items[::2], items[1::2]
+        groups[g] = keep
+        loads[g] = sum(int(sizes[i]) for i in keep)
+        groups.append(move)
+        loads.append(sum(int(sizes[i]) for i in move))
+    # Deterministic order: by smallest contained index.
+    for g in groups:
+        g.sort()
+    groups.sort(key=lambda g: g[0] if g else 1 << 62)
+    return groups
+
+
+def partition_balanced(sizes: Sequence[int], k: int) -> List[List[int]]:
+    """Partition items into exactly k contiguous-free groups with near-equal
+    total size (greedy longest-processing-time heuristic).
+
+    Returns k lists of original indices (some possibly empty when
+    len(sizes) < k).  Matches the reference's use: balancing packed sequences
+    across data-parallel ranks.
+    """
+    k = int(k)
+    assert k >= 1
+    order = np.argsort(-np.asarray(sizes, dtype=np.int64), kind="stable")
+    groups: List[List[int]] = [[] for _ in range(k)]
+    loads = np.zeros(k, dtype=np.int64)
+    for idx in order:
+        g = int(np.argmin(loads))
+        groups[g].append(int(idx))
+        loads[g] += int(sizes[idx])
+    for g in groups:
+        g.sort()
+    return groups
+
+
+def min_abs_diff_partition(sizes: Sequence[int], k: int) -> List[List[int]]:
+    """Contiguous partition of `sizes` into k runs minimizing max run sum
+    (binary search + greedy check).  Used where order must be preserved."""
+    sizes = [int(s) for s in sizes]
+    n = len(sizes)
+    assert 1 <= k
+    if n == 0:
+        return [[] for _ in range(k)]
+
+    def feasible(cap: int) -> bool:
+        runs, cur = 1, 0
+        for s in sizes:
+            if s > cap:
+                return False
+            if cur + s > cap:
+                runs += 1
+                cur = 0
+            cur += s
+        return runs <= k
+
+    lo, hi = max(sizes), sum(sizes)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if feasible(mid):
+            hi = mid
+        else:
+            lo = mid + 1
+    cap = lo
+    out: List[List[int]] = []
+    cur: List[int] = []
+    load = 0
+    for i, s in enumerate(sizes):
+        if load + s > cap and cur:
+            out.append(cur)
+            cur, load = [], 0
+        cur.append(i)
+        load += s
+    out.append(cur)
+    while len(out) < k:
+        out.append([])
+    return out

@@ -1,0 +1,57 @@
+"""Named, colored loggers (capability parity: realhf/base/logging.py)."""
+
+import logging
+import os
+import sys
+from typing import Optional
+
+_FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(levelname)s: %(message)s"
+_DATE_FORMAT = "%Y%m%d-%H:%M:%S"
+
+_COLORS = {
+    "DEBUG": "\033[36m",  # cyan
+    "INFO": "\033[32m",  # green
+    "WARNING": "\033[33m",  # yellow
+    "ERROR": "\033[31m",  # red
+    "CRITICAL": "\033[35m",  # magenta
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelname, "")
+            if color:
+                return f"{color}{msg}{_RESET}"
+        return msg
+
+
+_configured = False
+
+
+def _configure_root():
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(_ColorFormatter(fmt=_FORMAT, datefmt=_DATE_FORMAT))
+    root = logging.getLogger("areal_tpu")
+    root.addHandler(handler)
+    root.setLevel(os.environ.get("AREAL_LOG_LEVEL", "INFO").upper())
+    root.propagate = False
+    _configured = True
+
+
+def getLogger(name: Optional[str] = None) -> logging.Logger:
+    _configure_root()
+    if name is None:
+        return logging.getLogger("areal_tpu")
+    return logging.getLogger(f"areal_tpu.{name}")
+
+
+# A dedicated logger for benchmark/throughput lines, mirroring the reference's
+# "benchmark" logger (realhf/base/logging.py).
+def getBenchmarkLogger() -> logging.Logger:
+    return getLogger("benchmark")

@@ -1,0 +1,47 @@
+"""Canonical name-resolve key paths (capability parity: realhf/base/names.py)."""
+
+USER_NAMESPACE = "areal_tpu"
+
+
+def trial_root(experiment_name: str, trial_name: str) -> str:
+    return f"{USER_NAMESPACE}/{experiment_name}/{trial_name}"
+
+
+def trial_registry(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/registry"
+
+
+def worker_status(experiment_name: str, trial_name: str, worker_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/status/{worker_name}"
+
+
+def worker_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/workers"
+
+
+def worker(experiment_name: str, trial_name: str, worker_name: str) -> str:
+    return f"{worker_root(experiment_name, trial_name)}/{worker_name}"
+
+
+def request_reply_stream(experiment_name: str, trial_name: str, stream_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/streams/{stream_name}"
+
+
+def distributed_peer(experiment_name: str, trial_name: str, peer_index: int) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/peers/{peer_index:06d}"
+
+
+def distributed_master(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/distributed_master"
+
+
+def model_version(experiment_name: str, trial_name: str, model_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/model_version/{model_name}"
+
+
+def experiment_status(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/experiment_status"
+
+
+def worker_key(experiment_name: str, trial_name: str, key: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/worker_key/{key}"

@@ -1,0 +1,35 @@
+"""Host networking helpers (capability parity: realhf/base/network.py)."""
+
+import random
+import socket
+
+
+def find_free_port(low: int = 1, high: int = 65536) -> int:
+    """A free TCP port; honors [low, high) when a restricted range is given."""
+    if low <= 1 and high >= 65536:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.bind(("", 0))
+            return s.getsockname()[1]
+    ports = list(range(max(low, 1024), high))
+    random.shuffle(ports)
+    for port in ports:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            try:
+                s.bind(("", port))
+                return port
+            except OSError:
+                continue
+    raise OSError(f"no free port in [{low}, {high})")
+
+
+def gethostname() -> str:
+    return socket.gethostname()
+
+
+def gethostip() -> str:
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"

@@ -1,0 +1,70 @@
+"""Fault-tolerance bookkeeping (capability parity: realhf/base/recover.py).
+
+`RecoverInfo` captures everything the master needs to resume a trial:
+step/epoch counters, frequency-control states, and hashes of already-consumed
+data (so restarted trials skip samples they already trained on).
+"""
+
+import dataclasses
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("recover")
+
+RECOVER_FILE = "recover_info.pkl"
+
+
+@dataclasses.dataclass
+class StepInfo:
+    epoch: int = 0
+    epoch_step: int = 0
+    global_step: int = 0
+
+    def next(self, steps_per_epoch: int) -> "StepInfo":
+        ep, es = self.epoch, self.epoch_step + 1
+        if es >= steps_per_epoch:
+            ep, es = ep + 1, 0
+        return StepInfo(epoch=ep, epoch_step=es, global_step=self.global_step + 1)
+
+
+@dataclasses.dataclass
+class RecoverInfo:
+    last_step_info: StepInfo = dataclasses.field(default_factory=StepInfo)
+    save_ctl_states: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    used_data_ids: List[str] = dataclasses.field(default_factory=list)
+    model_versions: Dict[str, int] = dataclasses.field(default_factory=dict)
+    hash_vals_to_ignore: List[int] = dataclasses.field(default_factory=list)
+
+
+def recover_root(fileroot: str, experiment_name: str, trial_name: str) -> str:
+    return os.path.join(fileroot, "recover", experiment_name, trial_name)
+
+
+def dump(info: RecoverInfo, root: str) -> str:
+    os.makedirs(root, exist_ok=True)
+    path = os.path.join(root, RECOVER_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(info, f)
+    os.replace(tmp, path)
+    return path
+
+
+def load(root: str) -> Optional[RecoverInfo]:
+    path = os.path.join(root, RECOVER_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def discover_ckpt(ckpt_root: str) -> Optional[str]:
+    """Latest recover checkpoint dir under ckpt_root, if any
+    (reference: base/recover.py:85)."""
+    link = os.path.join(ckpt_root, "recover_checkpoint")
+    if os.path.isdir(link):
+        return os.path.realpath(link)
+    return None

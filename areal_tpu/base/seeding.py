@@ -1,0 +1,31 @@
+"""Deterministic seeding (capability parity: realhf/base/seeding.py).
+
+On TPU/JAX randomness is explicit via PRNG keys; this module seeds the
+host-side libraries (numpy, random) and hands out a root jax PRNG key derived
+from (base_seed, worker_index).
+"""
+
+import random
+
+import jax
+import numpy as np
+
+_base_seed = 0
+_worker_index = 0
+
+
+def set_random_seed(base_seed: int, worker_index: int = 0) -> None:
+    global _base_seed, _worker_index
+    _base_seed, _worker_index = base_seed, worker_index
+    seed = base_seed + worker_index
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+
+
+def root_key() -> jax.Array:
+    """Root PRNG key for this worker, derived from the configured seed."""
+    return jax.random.fold_in(jax.random.PRNGKey(_base_seed), _worker_index)
+
+
+def get_seed() -> int:
+    return _base_seed + _worker_index

@@ -1,0 +1,68 @@
+"""Scalar statistics aggregation across steps and workers.
+
+Capability parity: realhf/base/stats_tracker usage — interfaces record
+denominator-weighted scalar stats (loss, KL, reward, grad-norm) and the
+master logs merged values per step.
+"""
+
+import dataclasses
+from collections import defaultdict
+from typing import Dict, List
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class _Acc:
+    total: float = 0.0
+    count: float = 0.0
+    vmin: float = float("inf")
+    vmax: float = float("-inf")
+
+    def add(self, value: float, weight: float = 1.0):
+        self.total += float(value) * float(weight)
+        self.count += float(weight)
+        self.vmin = min(self.vmin, float(value))
+        self.vmax = max(self.vmax, float(value))
+
+
+class StatsTracker:
+    def __init__(self):
+        self._acc: Dict[str, _Acc] = defaultdict(_Acc)
+
+    def scalar(self, **kwargs: float) -> None:
+        for k, v in kwargs.items():
+            self._acc[k].add(v)
+
+    def weighted(self, key: str, value: float, weight: float) -> None:
+        self._acc[key].add(value, weight)
+
+    def denominator(self, key: str, mask: np.ndarray) -> None:
+        self._acc[key].add(float(np.sum(mask)), 1.0)
+
+    def export(self, reset: bool = True) -> Dict[str, float]:
+        out = {}
+        for k, a in self._acc.items():
+            if a.count > 0:
+                out[k] = a.total / a.count
+        if reset:
+            self._acc = defaultdict(_Acc)
+        return out
+
+    def export_full(self, reset: bool = True) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for k, a in self._acc.items():
+            if a.count > 0:
+                out[k] = {"mean": a.total / a.count, "min": a.vmin, "max": a.vmax}
+        if reset:
+            self._acc = defaultdict(_Acc)
+        return out
+
+
+def merge_stats(stats: List[Dict[str, float]]) -> Dict[str, float]:
+    """Unweighted mean-merge of per-shard stat dicts (DP-head gather)."""
+    merged: Dict[str, List[float]] = defaultdict(list)
+    for s in stats:
+        for k, v in s.items():
+            merged[k].append(float(v))
+    return {k: float(np.mean(v)) for k, v in merged.items()}

@@ -1,0 +1,189 @@
+"""Parallel topology → `jax.sharding.Mesh` helpers.
+
+Capability parity: realhf/base/topology.py (`ProcessTopology`,
+`PipeDataModelParallelTopology`, `ParallelGrid`).  The reference builds NCCL
+subgroups for every (pipe, data, model) axis combination; on TPU the same
+role is played by a named `jax.sharding.Mesh` — XLA derives every collective
+from sharding annotations, so there are no groups to manage.  What remains is
+the *arithmetic*: mapping a flat worker/device index to named-axis
+coordinates and building meshes over subsets of devices.
+
+Axis naming (a superset of the reference's pipe/data/model):
+
+    pipe   — pipeline-parallel stages (shard_map + ppermute)
+    data   — pure data parallel (params replicated)
+    fsdp   — ZeRO-style parameter/optimizer sharding (params sharded, batch
+             sharded jointly with `data`)
+    seq    — context parallelism over sequence length (ring attention)
+    model  — tensor parallelism (Megatron-style column/row sharding)
+
+Expert parallelism shards the expert dimension of MoE layers over
+(`data`, `fsdp`) via sharding rules — see areal_tpu/parallel/sharding.py —
+so it needs no dedicated mesh axis.
+"""
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import Mesh
+
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+
+# Canonical mesh axis order, outermost (slowest-varying over devices) first.
+# `model` innermost: TP collectives are the most latency-sensitive and must
+# ride neighbouring ICI links; `pipe` outermost: stage p2p tolerates DCN.
+AXIS_ORDER: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, FSDP_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+# Axes along which the global batch is split.
+BATCH_AXES: Tuple[str, ...] = (DATA_AXIS, FSDP_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Degrees of parallelism for one model's layout.
+
+    Mirrors the reference's ParallelismConfig (realhf/api/cli_args.py:131)
+    with TPU-native extensions (fsdp, seq/context parallel).  Megatron-style
+    sequence parallelism needs no flag here: under GSPMD, activations are
+    sharded along `model` automatically wherever profitable.
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    model: int = 1
+    pipe: int = 1
+    seq: int = 1
+
+    def __post_init__(self):
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if not (isinstance(v, int) and v >= 1):
+                raise ValueError(f"ParallelConfig.{f.name} must be a positive int, got {v!r}")
+
+    @property
+    def world_size(self) -> int:
+        return self.data * self.fsdp * self.model * self.pipe * self.seq
+
+    @property
+    def dp_size(self) -> int:
+        """Total batch-sharding degree (data * fsdp)."""
+        return self.data * self.fsdp
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {
+            PIPE_AXIS: self.pipe,
+            DATA_AXIS: self.data,
+            FSDP_AXIS: self.fsdp,
+            SEQ_AXIS: self.seq,
+            MODEL_AXIS: self.model,
+        }
+
+    # -- allocation-mode strings ------------------------------------------
+    # The reference parses strings like "d64p1m1" (AllocationMode.from_str,
+    # realhf/experiments/common/utils.py:245).  We accept the same letters
+    # plus f (fsdp) and s (seq):  e.g. "d4f2m2", "d2p2m2s2".
+    _TOKEN = re.compile(r"([dfmps])(\d+)")
+    _LETTER = {
+        "d": "data",
+        "f": "fsdp",
+        "m": "model",
+        "p": "pipe",
+        "s": "seq",
+    }
+
+    @classmethod
+    def from_str(cls, s: str) -> "ParallelConfig":
+        s = s.strip().lower()
+        kwargs: Dict[str, int] = {}
+        pos = 0
+        for m in cls._TOKEN.finditer(s):
+            if m.start() != pos:
+                raise ValueError(f"cannot parse allocation string {s!r}")
+            pos = m.end()
+            field = cls._LETTER[m.group(1)]
+            if field in kwargs:
+                raise ValueError(f"duplicate axis {m.group(1)!r} in {s!r}")
+            kwargs[field] = int(m.group(2))
+        if pos != len(s) or not kwargs:
+            raise ValueError(f"cannot parse allocation string {s!r}")
+        return cls(**kwargs)
+
+    def to_str(self) -> str:
+        parts = []
+        for letter, field in self._LETTER.items():
+            v = getattr(self, field)
+            if v != 1 or letter == "d":
+                parts.append(f"{letter}{v}")
+        return "".join(parts)
+
+
+def make_mesh(
+    parallel: ParallelConfig,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a named Mesh realizing `parallel` over `devices`.
+
+    `devices` defaults to all local+addressable devices (jax.devices()).  The
+    device list is reshaped in AXIS_ORDER, so consecutive devices land on the
+    `model` axis first — on a TPU slice, consecutive device ids are physical
+    ICI neighbours, giving TP the fastest links.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if len(devices) != parallel.world_size:
+        raise ValueError(
+            f"parallel config {parallel.to_str()} needs {parallel.world_size} "
+            f"devices, got {len(devices)}"
+        )
+    sizes = parallel.axis_sizes()
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    dev_array = np.asarray(devices, dtype=object).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def coords_of_rank(parallel: ParallelConfig, rank: int) -> Dict[str, int]:
+    """Named-axis coordinates of a flat device/worker rank (row-major over
+    AXIS_ORDER).  The ProcessTopology.get_coord equivalent."""
+    sizes = parallel.axis_sizes()
+    coords: Dict[str, int] = {}
+    rem = rank
+    for a in reversed(AXIS_ORDER):
+        coords[a] = rem % sizes[a]
+        rem //= sizes[a]
+    if rem:
+        raise ValueError(f"rank {rank} out of range for {parallel.to_str()}")
+    return coords
+
+
+def rank_of_coords(parallel: ParallelConfig, **coords: int) -> int:
+    """Inverse of coords_of_rank; unspecified axes default to 0."""
+    sizes = parallel.axis_sizes()
+    rank = 0
+    for a in AXIS_ORDER:
+        c = coords.get(a, 0)
+        if not 0 <= c < sizes[a]:
+            raise ValueError(f"coord {a}={c} out of range (size {sizes[a]})")
+        rank = rank * sizes[a] + c
+    return rank
+
+
+def ranks_on_axis(parallel: ParallelConfig, axis: str, **fixed: int) -> List[int]:
+    """All flat ranks sweeping `axis` with other coords fixed (default 0) —
+    the equivalent of one NCCL subgroup's rank list."""
+    sizes = parallel.axis_sizes()
+    return [
+        rank_of_coords(parallel, **{**fixed, axis: i}) for i in range(sizes[axis])
+    ]
+
+
+def batch_sharding_degree(mesh: Mesh) -> int:
+    return int(np.prod([mesh.shape[a] for a in BATCH_AXES]))

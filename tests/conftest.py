@@ -1,0 +1,41 @@
+"""Test harness: fake 8-device CPU cluster.
+
+Mirrors the reference's CPU/multi-process testing strategy
+(realhf/base/testing.py: LocalMultiProcessTest with gloo) the JAX way — a
+single process sees 8 virtual CPU devices via
+--xla_force_host_platform_device_count, so every sharding/mesh code path is
+exercised without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# Site plugins (e.g. a TPU PJRT plugin registered via sitecustomize) may have
+# programmatically overridden jax_platforms; force CPU for the fake cluster.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_name_resolve():
+    from areal_tpu.base import name_resolve
+
+    name_resolve.set_default(name_resolve.MemoryNameResolveRepository())
+    yield
+    name_resolve.reset()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
