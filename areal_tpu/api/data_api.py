@@ -1,0 +1,349 @@
+"""Packed sequence batches — the universal data currency of the framework.
+
+Capability parity: realhf/api/core/data_api.py (`SequenceSample`,
+`MicroBatchSpec`, dataset registry).  Semantics match the reference:
+
+- A batch holds several *keys* (packed_input_ids, rewards, logprobs, ...).
+- Per key, each batch element owns one or more variable-length sequences;
+  all sequences for a key are concatenated into one flat array (np.ndarray
+  host-side; engines convert to jax on device entry).
+- Metadata-only samples (data=None) circulate through the master worker;
+  full samples live on the workers.
+
+Design difference from the reference: arrays are numpy (host) rather than
+torch tensors — device placement is the engines' job, where `jax.device_put`
+with a NamedSharding moves a whole pytree in one call.
+"""
+
+import dataclasses
+import itertools
+from typing import Any, Dict, Hashable, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from areal_tpu.base import datapack
+
+
+@dataclasses.dataclass(frozen=True)
+class MicroBatchSpec:
+    """How to split a batch into micro-batches (reference: cli_args.py:13).
+
+    `n_mbs` is the minimum number of micro-batches; `max_tokens_per_mb` caps
+    tokens per micro-batch (None = no cap).
+    """
+
+    n_mbs: int = 1
+    max_tokens_per_mb: Optional[int] = None
+
+    @classmethod
+    def new(cls, other: "MicroBatchSpec", **kwargs) -> "MicroBatchSpec":
+        return cls(**{**dataclasses.asdict(other), **kwargs})
+
+
+def _as_np(x) -> np.ndarray:
+    if isinstance(x, np.ndarray):
+        return x
+    return np.asarray(x)
+
+
+@dataclasses.dataclass
+class SequenceSample:
+    """A packed, variable-length batch (see module docstring).
+
+    seqlens[key][i] is the list of sequence lengths that batch element i owns
+    under `key`; data[key] is the concatenation of all those sequences along
+    axis 0 (trailing dims allowed, e.g. logits).
+    """
+
+    keys: Set[str]
+    ids: List[Hashable]
+    seqlens: Dict[str, List[List[int]]]
+    data: Optional[Dict[str, Optional[np.ndarray]]] = None
+    metadata: Dict[str, List[Any]] = dataclasses.field(default_factory=dict)
+    dtypes: Dict[str, Optional[np.dtype]] = dataclasses.field(default_factory=dict)
+    trailing_shapes: Dict[str, Optional[Tuple[int, ...]]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def __post_init__(self):
+        self.keys = set(self.keys)
+        if len(self.ids) != len(set(self.ids)):
+            raise ValueError(f"duplicate ids: {self.ids}")
+        for k in self.keys:
+            if k not in self.seqlens:
+                raise ValueError(f"missing seqlens for key {k!r}")
+            if len(self.seqlens[k]) != self.bs:
+                raise ValueError(
+                    f"seqlens[{k!r}] has {len(self.seqlens[k])} entries, "
+                    f"batch size is {self.bs}"
+                )
+        if self.data is not None:
+            for k in self.keys:
+                v = self.data.get(k)
+                if v is None:
+                    continue
+                v = _as_np(v)
+                self.data[k] = v
+                want = sum(sum(s) for s in self.seqlens[k])
+                if v.shape[0] != want:
+                    raise ValueError(
+                        f"data[{k!r}] axis-0 is {v.shape[0]}, seqlens sum to {want}"
+                    )
+                self.dtypes.setdefault(k, v.dtype)
+                self.trailing_shapes.setdefault(k, tuple(v.shape[1:]))
+        for k, v in self.metadata.items():
+            if not isinstance(v, list) or len(v) != self.bs:
+                raise ValueError(
+                    f"metadata[{k!r}] must be a list of length bs={self.bs}"
+                )
+
+    # ---------------- constructors ----------------
+
+    @classmethod
+    def from_default(
+        cls,
+        ids: List[Hashable],
+        seqlens: List[int],
+        data: Dict[str, Optional[np.ndarray]],
+        metadata: Optional[Dict[str, List[Any]]] = None,
+    ) -> "SequenceSample":
+        """Common case: every key shares one sequence per element with a
+        shared length (e.g. a packed prompt dataset)."""
+        sls = [[int(s)] for s in seqlens]
+        return cls(
+            keys=set(data.keys()),
+            ids=list(ids),
+            seqlens={k: [list(s) for s in sls] for k in data},
+            data=dict(data),
+            metadata=dict(metadata or {}),
+        )
+
+    @classmethod
+    def gather(cls, samples: Sequence["SequenceSample"]) -> "SequenceSample":
+        """Concatenate samples (inverse of unpack/split)."""
+        samples = list(samples)
+        if not samples:
+            raise ValueError("cannot gather zero samples")
+        keys = samples[0].keys
+        for s in samples[1:]:
+            if s.keys != keys:
+                raise ValueError(f"key mismatch in gather: {s.keys} vs {keys}")
+        ids = datapack.flat2d([s.ids for s in samples])
+        seqlens = {
+            k: datapack.flat2d([s.seqlens[k] for s in samples]) for k in keys
+        }
+        has_data = samples[0].data is not None
+        data = None
+        if has_data:
+            data = {}
+            for k in keys:
+                vals = [s.data[k] for s in samples]
+                if any(v is None for v in vals):
+                    data[k] = None
+                else:
+                    data[k] = np.concatenate([_as_np(v) for v in vals], axis=0)
+        metadata = {}
+        for k in samples[0].metadata:
+            metadata[k] = datapack.flat2d([s.metadata.get(k, []) for s in samples])
+        return cls(
+            keys=keys, ids=ids, seqlens=seqlens, data=data, metadata=metadata
+        )
+
+    # ---------------- views / basic props ----------------
+
+    @property
+    def bs(self) -> int:
+        return len(self.ids)
+
+    def total_len(self, key: str) -> int:
+        return sum(sum(s) for s in self.seqlens[key])
+
+    def seqlens_of(self, key: str) -> List[int]:
+        """Flat per-sequence lengths for a key."""
+        return datapack.flat2d(self.seqlens[key])
+
+    def cu_seqlens(self, key: str) -> np.ndarray:
+        """Cumulative sequence boundaries [0, l0, l0+l1, ...] (int32)."""
+        return np.cumsum([0] + self.seqlens_of(key)).astype(np.int32)
+
+    def main_key(self) -> str:
+        """The key that carries token accounting for splitting: the one with
+        the largest total length (ties broken lexicographically)."""
+        return max(sorted(self.keys), key=self.total_len)
+
+    # ---------------- transforms ----------------
+
+    def meta(self) -> "SequenceSample":
+        """Metadata-only copy (master-worker currency)."""
+        return SequenceSample(
+            keys=set(self.keys),
+            ids=list(self.ids),
+            seqlens={k: [list(s) for s in v] for k, v in self.seqlens.items()},
+            data=None,
+            metadata={k: list(v) for k, v in self.metadata.items()},
+            dtypes=dict(self.dtypes),
+            trailing_shapes=dict(self.trailing_shapes),
+        )
+
+    def select_idx(self, indices: Sequence[int]) -> "SequenceSample":
+        """New sample containing the given batch elements, in order."""
+        indices = list(indices)
+        seqlens = {k: [self.seqlens[k][i] for i in indices] for k in self.keys}
+        data = None
+        if self.data is not None:
+            data = {}
+            for k in self.keys:
+                v = self.data.get(k)
+                if v is None:
+                    data[k] = None
+                    continue
+                bounds = np.cumsum(
+                    [0] + [sum(s) for s in self.seqlens[k]]
+                )
+                parts = [v[bounds[i] : bounds[i + 1]] for i in indices]
+                data[k] = (
+                    np.concatenate(parts, axis=0)
+                    if parts
+                    else v[:0]
+                )
+        metadata = {
+            k: [v[i] for i in indices] for k, v in self.metadata.items()
+        }
+        return SequenceSample(
+            keys=set(self.keys),
+            ids=[self.ids[i] for i in indices],
+            seqlens=seqlens,
+            data=data,
+            metadata=metadata,
+            dtypes=dict(self.dtypes),
+            trailing_shapes=dict(self.trailing_shapes),
+        )
+
+    def select_keys(self, keys: Sequence[str]) -> "SequenceSample":
+        keys = set(keys)
+        missing = keys - self.keys
+        if missing:
+            raise KeyError(f"keys not in sample: {missing}")
+        return SequenceSample(
+            keys=keys,
+            ids=list(self.ids),
+            seqlens={k: self.seqlens[k] for k in keys},
+            data=None if self.data is None else {k: self.data[k] for k in keys},
+            metadata={k: list(v) for k, v in self.metadata.items()},
+            dtypes={k: self.dtypes.get(k) for k in keys},
+            trailing_shapes={k: self.trailing_shapes.get(k) for k in keys},
+        )
+
+    def unpack(self) -> List["SequenceSample"]:
+        return [self.select_idx([i]) for i in range(self.bs)]
+
+    def update_(self, other: "SequenceSample") -> None:
+        """Merge keys from `other` (same ids, same order) into self."""
+        if other.ids != self.ids:
+            raise ValueError("update_ requires identical ids in identical order")
+        self.keys |= other.keys
+        self.seqlens.update(other.seqlens)
+        if other.data is not None:
+            if self.data is None:
+                self.data = {}
+            self.data.update(other.data)
+        self.metadata.update(other.metadata)
+        self.dtypes.update(other.dtypes)
+        self.trailing_shapes.update(other.trailing_shapes)
+
+    def remap_keys_(self, mapping: Dict[str, str]) -> None:
+        """Rename keys in place (DFG input/output key remapping)."""
+        for old, new in mapping.items():
+            if old not in self.keys:
+                continue
+            self.keys.discard(old)
+            self.keys.add(new)
+            self.seqlens[new] = self.seqlens.pop(old)
+            if self.data is not None and old in self.data:
+                self.data[new] = self.data.pop(old)
+            if old in self.dtypes:
+                self.dtypes[new] = self.dtypes.pop(old)
+            if old in self.trailing_shapes:
+                self.trailing_shapes[new] = self.trailing_shapes.pop(old)
+
+    # ---------------- splitting ----------------
+
+    def split_groups(self, mb_spec: MicroBatchSpec) -> List[List[int]]:
+        """Index groups for micro-batching: FFD under max_tokens_per_mb,
+        at least n_mbs groups (reference: data_api.py:387)."""
+        lens = [sum(self.seqlens[self.main_key()][i]) for i in range(self.bs)]
+        cap = mb_spec.max_tokens_per_mb or (sum(lens) + 1)
+        return datapack.ffd_allocate(lens, capacity=cap, min_groups=mb_spec.n_mbs)
+
+    def split(self, mb_spec: MicroBatchSpec) -> List["SequenceSample"]:
+        return [self.select_idx(g) for g in self.split_groups(mb_spec) if g]
+
+    def split_balanced(self, k: int) -> List["SequenceSample"]:
+        """Exactly-k token-balanced split for DP dispatch.  Every part must be
+        non-empty (bs >= k required)."""
+        if self.bs < k:
+            raise ValueError(f"cannot split bs={self.bs} into {k} parts")
+        lens = [sum(self.seqlens[self.main_key()][i]) for i in range(self.bs)]
+        groups = datapack.partition_balanced(lens, k)
+        return [self.select_idx(g) for g in groups]
+
+    def __repr__(self):
+        kind = "meta" if self.data is None else "data"
+        return (
+            f"SequenceSample({kind}, bs={self.bs}, keys={sorted(self.keys)}, "
+            f"tokens={ {k: self.total_len(k) for k in sorted(self.keys)} })"
+        )
+
+
+# ---------------- dataset registry ----------------
+
+
+@dataclasses.dataclass
+class DatasetAbstraction:
+    """String-keyed dataset factory spec (reference: api/core/config.py)."""
+
+    type_: str
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+ALL_DATASET_CLASSES: Dict[str, Any] = {}
+
+
+def register_dataset(name: str, cls) -> None:
+    if name in ALL_DATASET_CLASSES:
+        raise ValueError(f"dataset {name!r} already registered")
+    ALL_DATASET_CLASSES[name] = cls
+
+
+def make_dataset(spec: DatasetAbstraction, seed: int, dp_rank: int, world_size: int, tokenizer=None):
+    if isinstance(spec, str):
+        spec = DatasetAbstraction(type_=spec)
+    cls = ALL_DATASET_CLASSES[spec.type_]
+    return cls(
+        seed=seed,
+        dp_rank=dp_rank,
+        world_size=world_size,
+        tokenizer=tokenizer,
+        **spec.args,
+    )
+
+
+def load_shuffle_split_dataset(
+    path: str, seed: int, dp_rank: int, world_size: int
+) -> List[Dict[str, Any]]:
+    """Load a jsonl dataset, shuffle deterministically by seed, and return
+    this dp_rank's contiguous shard (reference: data_api.py:691)."""
+    import json
+
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(rows))
+    shard = np.array_split(order, world_size)[dp_rank]
+    return [rows[i] for i in shard]
+
+
+def gather_stat(stats: List[Dict[str, float]]) -> Dict[str, float]:
+    from areal_tpu.base.stats import merge_stats
+
+    return merge_stats(stats)

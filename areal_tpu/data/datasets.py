@@ -1,0 +1,252 @@
+"""Datasets producing SequenceSamples.
+
+Capability parity: realhf/impl/dataset/ — `prompt_dataset.py` (RL prompts),
+`prompt_answer_dataset.py` (SFT), `math_code_dataset.py`
+(`MATHCodePromptDataset` with query_id/solutions metadata and dynamic
+difficulty filtering).  Same jsonl contracts as the reference:
+
+- SFT rows:        {"id", "prompt", "answer"}
+- RL prompt rows:  {"query_id" | "id", "prompt"}
+- math/code rows:  {"query_id", "prompt", "task": "math"|"code",
+                    "solutions": [...]} (+ "input_output" for code)
+"""
+
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api import data_api
+from areal_tpu.api.data_api import SequenceSample
+from areal_tpu.base import logging
+
+logger = logging.getLogger("datasets")
+
+
+class _DatasetBase:
+    """Map-style dataset over jsonl rows; each item is a bs=1 SequenceSample."""
+
+    def __init__(self, seed: int, dp_rank: int, world_size: int, tokenizer=None):
+        self.seed = seed
+        self.dp_rank = dp_rank
+        self.world_size = world_size
+        self.tokenizer = tokenizer
+
+    def _load_rows(
+        self,
+        dataset_path: Optional[str],
+        dataset_builder: Optional[Callable[[], List[Dict]]],
+    ) -> List[Dict[str, Any]]:
+        if dataset_path is not None:
+            return data_api.load_shuffle_split_dataset(
+                dataset_path, self.seed, self.dp_rank, self.world_size
+            )
+        assert dataset_builder is not None, "need dataset_path or dataset_builder"
+        rows = dataset_builder()
+        rng = np.random.default_rng(self.seed)
+        order = rng.permutation(len(rows))
+        shard = np.array_split(order, self.world_size)[self.dp_rank]
+        return [rows[i] for i in shard]
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx: int) -> SequenceSample:
+        raise NotImplementedError
+
+    def filter(self, to_remove_ids) -> None:
+        """Drop samples by id (dynamic difficulty filtering hook; reference
+        math_code_dataset.py:83-198).  Default: no-op for static datasets."""
+
+
+class PromptAnswerDataset(_DatasetBase):
+    """SFT dataset: packed prompt+answer tokens plus a prompt mask.
+
+    Emits keys `packed_input_ids` (int32 tokens) and `prompt_mask`
+    (bool, True on prompt positions — excluded from the LM loss).
+    """
+
+    def __init__(
+        self,
+        seed: int,
+        dp_rank: int,
+        world_size: int,
+        tokenizer,
+        max_length: int = 1024,
+        dataset_path: Optional[str] = None,
+        dataset_builder: Optional[Callable[[], List[Dict]]] = None,
+    ):
+        super().__init__(seed, dp_rank, world_size, tokenizer)
+        rows = self._load_rows(dataset_path, dataset_builder)
+        self.ids: List[str] = []
+        self.tokens: List[np.ndarray] = []
+        self.prompt_masks: List[np.ndarray] = []
+        eos = tokenizer.eos_token_id
+        for x in rows:
+            prompt_ids = tokenizer.encode(x["prompt"])
+            full_ids = tokenizer.encode(x["prompt"] + x["answer"])
+            full_ids = list(full_ids) + [eos]
+            full_ids = full_ids[:max_length]
+            n_prompt = min(len(prompt_ids), len(full_ids))
+            mask = np.zeros(len(full_ids), dtype=bool)
+            mask[:n_prompt] = True
+            self.ids.append(str(x["id"]))
+            self.tokens.append(np.asarray(full_ids, dtype=np.int32))
+            self.prompt_masks.append(mask)
+        logger.info(
+            f"PromptAnswerDataset: {len(self.ids)} seqs on dp_rank "
+            f"{dp_rank}/{world_size}"
+        )
+
+    def __len__(self):
+        return len(self.ids)
+
+    def __getitem__(self, idx: int) -> SequenceSample:
+        toks, mask = self.tokens[idx], self.prompt_masks[idx]
+        return SequenceSample(
+            keys={"packed_input_ids", "prompt_mask"},
+            ids=[self.ids[idx]],
+            seqlens={
+                "packed_input_ids": [[len(toks)]],
+                "prompt_mask": [[len(toks)]],
+            },
+            data={"packed_input_ids": toks, "prompt_mask": mask},
+        )
+
+
+class PromptDataset(_DatasetBase):
+    """RL prompt dataset: emits key `packed_prompts`."""
+
+    def __init__(
+        self,
+        seed: int,
+        dp_rank: int,
+        world_size: int,
+        tokenizer,
+        max_length: int = 1024,
+        dataset_path: Optional[str] = None,
+        dataset_builder: Optional[Callable[[], List[Dict]]] = None,
+    ):
+        super().__init__(seed, dp_rank, world_size, tokenizer)
+        rows = self._load_rows(dataset_path, dataset_builder)
+        self.ids = []
+        self.prompts = []
+        self.metadata_rows = []
+        for x in rows:
+            qid = str(x.get("query_id", x.get("id")))
+            ids = tokenizer.encode(x["prompt"])[:max_length]
+            if not ids:
+                continue
+            self.ids.append(qid)
+            self.prompts.append(np.asarray(ids, dtype=np.int32))
+            self.metadata_rows.append(x)
+
+    def __len__(self):
+        return len(self.ids)
+
+    def __getitem__(self, idx: int) -> SequenceSample:
+        p = self.prompts[idx]
+        return SequenceSample(
+            keys={"packed_prompts"},
+            ids=[self.ids[idx]],
+            seqlens={"packed_prompts": [[len(p)]]},
+            data={"packed_prompts": p},
+        )
+
+
+class MathCodePromptDataset(PromptDataset):
+    """RL math/code dataset with verification metadata and dynamic difficulty
+    filtering (reference: MATHCodePromptDataset).
+
+    Rows must carry query_id/prompt and, per task, solutions (math) or
+    input_output (code).  `filter()` drops query_ids whose recent accuracy
+    makes them useless for training (too easy/too hard).
+    """
+
+    def __init__(self, *args, filter_threshold: float = 1e4, max_filter_percentage: float = 0.0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.filter_threshold = filter_threshold
+        self.max_filter_percentage = max_filter_percentage
+        self.id2info: Dict[str, Dict] = {}
+        keep = []
+        for i, row in enumerate(self.metadata_rows):
+            task = row.get("task", "math")
+            try:
+                if task == "math":
+                    assert isinstance(row.get("solutions", None), list)
+                elif task == "code":
+                    io = json.loads(row["input_output"])
+                    assert len(io["inputs"]) == len(io["outputs"])
+                else:
+                    raise ValueError(f"unknown task {task}")
+            except Exception:
+                logger.warning(f"dropping invalid row query_id={self.ids[i]}")
+                continue
+            row = dict(row)
+            row["task"] = task
+            self.id2info[self.ids[i]] = row
+            keep.append(i)
+        self.ids = [self.ids[i] for i in keep]
+        self.prompts = [self.prompts[i] for i in keep]
+        self.metadata_rows = [self.metadata_rows[i] for i in keep]
+
+    def __getitem__(self, idx: int) -> SequenceSample:
+        s = super().__getitem__(idx)
+        row = self.id2info[self.ids[idx]]
+        s.metadata = {"task": [row["task"]]}
+        return s
+
+    def filter(self, to_remove_ids) -> None:
+        to_remove = set(map(str, to_remove_ids))
+        if not to_remove:
+            return
+        n_max = int(len(self.ids) * self.max_filter_percentage)
+        removed = 0
+        keep = []
+        for i, qid in enumerate(self.ids):
+            if qid in to_remove and removed < n_max:
+                removed += 1
+                continue
+            keep.append(i)
+        self.ids = [self.ids[i] for i in keep]
+        self.prompts = [self.prompts[i] for i in keep]
+        self.metadata_rows = [self.metadata_rows[i] for i in keep]
+        logger.info(f"filtered {removed} prompts; {len(self.ids)} remain")
+
+
+class PackedDataLoader:
+    """Deterministic shuffling batch iterator over a SequenceSample dataset.
+
+    Groups dataset items into batches of `batch_size` samples (or under a
+    token budget) and gathers them into one SequenceSample per batch.
+    Replaces the reference's torch DataLoader usage.
+    """
+
+    def __init__(self, dataset, batch_size: int, seed: int = 0, drop_last: bool = False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+
+    def __len__(self):
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        n = len(self.dataset)
+        rng = np.random.default_rng(self.seed + self._epoch)
+        order = rng.permutation(n)
+        self._epoch += 1
+        for i in range(0, n, self.batch_size):
+            idx = order[i : i + self.batch_size]
+            if self.drop_last and len(idx) < self.batch_size:
+                return
+            yield SequenceSample.gather([self.dataset[int(j)] for j in idx])
+
+
+data_api.register_dataset("prompt_answer", PromptAnswerDataset)
+data_api.register_dataset("prompt", PromptDataset)
+data_api.register_dataset("math_code_prompt", MathCodePromptDataset)

@@ -1,0 +1,55 @@
+"""Tokenizer loading.
+
+Capability parity: realhf/api/core/data_api.py `load_hf_tokenizer`.  Also
+provides a hermetic character-level tokenizer for tests/benchmarks (the
+reference trains a WordPiece tokenizer on random sentences in
+tests/fixtures.py; a char tokenizer gives the same hermeticity with zero
+deps).
+"""
+
+from typing import List, Optional
+
+
+def load_hf_tokenizer(path: str, fast: bool = True):
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(path, use_fast=fast)
+    if tok.pad_token_id is None:
+        tok.pad_token = tok.eos_token
+    return tok
+
+
+class CharTokenizer:
+    """Minimal hermetic tokenizer implementing the protocol the framework
+    needs: encode/decode, eos/pad ids, vocab_size.  Byte-level over UTF-8."""
+
+    def __init__(self, vocab_size: int = 512):
+        # 0..255 bytes, then specials.
+        self._byte_vocab = 256
+        self.pad_token_id = 256
+        self.eos_token_id = 257
+        self.bos_token_id = 258
+        self.vocab_size = max(vocab_size, 259)
+        self.eos_token = "<eos>"
+        self.pad_token = "<pad>"
+
+    def encode(self, text: str, add_eos: bool = False) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        if add_eos:
+            ids.append(self.eos_token_id)
+        return ids
+
+    def decode(self, ids, skip_special_tokens: bool = True) -> str:
+        bs = bytes(i for i in ids if 0 <= int(i) < self._byte_vocab)
+        return bs.decode("utf-8", errors="replace")
+
+    def __call__(self, texts, truncation=False, max_length=None, **kw):
+        if isinstance(texts, str):
+            texts = [texts]
+        out = []
+        for t in texts:
+            ids = self.encode(t)
+            if truncation and max_length is not None:
+                ids = ids[:max_length]
+            out.append(ids)
+        return {"input_ids": out, "length": [len(x) for x in out]}

@@ -1,0 +1,67 @@
+"""Hermetic test fixtures: tiny tokenizer + synthetic datasets.
+
+Mirrors the reference's tests/fixtures.py (random-sentence WordPiece
+tokenizer + random dataset builders) with a char tokenizer.
+"""
+
+import random
+from typing import Dict, List
+
+import numpy as np
+
+from areal_tpu.data.tokenizer import CharTokenizer
+
+_WORDS = (
+    "the quick brown fox jumps over lazy dog math proof integer prime sum "
+    "let x y z be find compute answer is boxed"
+).split()
+
+
+def make_tokenizer() -> CharTokenizer:
+    return CharTokenizer(vocab_size=512)
+
+
+def random_sentence(rng: random.Random, lo=3, hi=12) -> str:
+    return " ".join(rng.choices(_WORDS, k=rng.randint(lo, hi)))
+
+
+def build_sft_rows(n: int = 32, seed: int = 0) -> List[Dict]:
+    rng = random.Random(seed)
+    return [
+        {
+            "id": f"sft-{i}",
+            "prompt": random_sentence(rng) + "? ",
+            "answer": random_sentence(rng),
+        }
+        for i in range(n)
+    ]
+
+
+def build_math_rows(n: int = 16, seed: int = 0) -> List[Dict]:
+    rng = random.Random(seed)
+    rows = []
+    for i in range(n):
+        a, b = rng.randint(1, 50), rng.randint(1, 50)
+        rows.append(
+            {
+                "query_id": f"math-{i}",
+                "prompt": f"Compute {a} + {b}. ",
+                "task": "math",
+                "solutions": [f"\\boxed{{{a + b}}}"],
+            }
+        )
+    return rows
+
+
+def random_sample(rng: np.random.Generator, ids, keys=("packed_input_ids",), max_len=20):
+    """A random SequenceSample with the given ids/keys."""
+    from areal_tpu.api.data_api import SequenceSample
+
+    seqlens = {
+        k: [[int(rng.integers(1, max_len))] for _ in ids] for k in keys
+    }
+    data = {
+        k: rng.integers(0, 100, size=sum(s[0] for s in seqlens[k])).astype(np.int32)
+        for k in keys
+    }
+    return SequenceSample(keys=set(keys), ids=list(ids), seqlens=seqlens, data=data)

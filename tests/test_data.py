@@ -1,0 +1,182 @@
+"""SequenceSample + dataset tests.
+
+Models the reference's tests/data/test_sequence_gather_split.py invariants:
+gather∘unpack == identity, split preserves tokens, FFD caps respected,
+update_/remap round-trips.
+"""
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from tests import fixtures
+
+
+@pytest.fixture
+def sample(rng):
+    return fixtures.random_sample(rng, ids=[f"s{i}" for i in range(10)])
+
+
+class TestSequenceSample:
+    def test_gather_unpack_roundtrip(self, sample):
+        parts = sample.unpack()
+        assert all(p.bs == 1 for p in parts)
+        re = SequenceSample.gather(parts)
+        assert re.ids == sample.ids
+        assert re.seqlens == sample.seqlens
+        np.testing.assert_array_equal(
+            re.data["packed_input_ids"], sample.data["packed_input_ids"]
+        )
+
+    def test_select_idx_slices_data(self, sample):
+        sub = sample.select_idx([2, 5])
+        assert sub.ids == ["s2", "s5"]
+        bounds = np.cumsum([0] + [sum(s) for s in sample.seqlens["packed_input_ids"]])
+        expect = np.concatenate(
+            [
+                sample.data["packed_input_ids"][bounds[2] : bounds[3]],
+                sample.data["packed_input_ids"][bounds[5] : bounds[6]],
+            ]
+        )
+        np.testing.assert_array_equal(sub.data["packed_input_ids"], expect)
+
+    def test_split_respects_token_cap(self, sample):
+        mbs = sample.split(MicroBatchSpec(max_tokens_per_mb=30))
+        all_ids = sorted(i for m in mbs for i in m.ids)
+        assert all_ids == sorted(sample.ids)
+        for m in mbs:
+            assert m.total_len("packed_input_ids") <= 30 or m.bs == 1
+
+    def test_split_min_n_mbs(self, sample):
+        mbs = sample.split(MicroBatchSpec(n_mbs=4))
+        assert len(mbs) >= 4
+
+    def test_split_balanced(self, sample):
+        parts = sample.split_balanced(3)
+        assert len(parts) == 3
+        assert sorted(i for p in parts for i in p.ids) == sorted(sample.ids)
+        loads = [p.total_len("packed_input_ids") for p in parts]
+        assert max(loads) - min(loads) <= 20
+
+    def test_meta_drops_data(self, sample):
+        m = sample.meta()
+        assert m.data is None
+        assert m.seqlens == sample.seqlens
+        assert m.dtypes["packed_input_ids"] == np.int32
+
+    def test_update_and_remap(self, sample, rng):
+        other = fixtures.random_sample(rng, ids=sample.ids, keys=("rewards",))
+        sample.update_(other)
+        assert "rewards" in sample.keys
+        sample.remap_keys_({"rewards": "scores"})
+        assert "scores" in sample.keys and "rewards" not in sample.keys
+        assert sample.total_len("scores") == other.total_len("rewards")
+
+    def test_update_rejects_id_mismatch(self, sample, rng):
+        other = fixtures.random_sample(rng, ids=["x1"], keys=("rewards",))
+        with pytest.raises(ValueError):
+            sample.update_(other)
+
+    def test_validation_rejects_bad_lengths(self):
+        with pytest.raises(ValueError):
+            SequenceSample(
+                keys={"a"},
+                ids=["1"],
+                seqlens={"a": [[5]]},
+                data={"a": np.zeros(3, dtype=np.int32)},
+            )
+
+    def test_cu_seqlens(self, sample):
+        cs = sample.cu_seqlens("packed_input_ids")
+        assert cs[0] == 0
+        assert cs[-1] == sample.total_len("packed_input_ids")
+        assert cs.dtype == np.int32
+
+    def test_multi_seq_per_key(self):
+        # PPO shape: 2 prompts, group of 3 responses each.
+        s = SequenceSample(
+            keys={"resp"},
+            ids=["a", "b"],
+            seqlens={"resp": [[2, 3, 4], [1, 1, 2]]},
+            data={"resp": np.arange(13, dtype=np.int32)},
+        )
+        one = s.select_idx([1])
+        assert one.seqlens["resp"] == [[1, 1, 2]]
+        np.testing.assert_array_equal(one.data["resp"], np.arange(9, 13))
+
+
+class TestDatasets:
+    def test_sft_dataset(self):
+        from areal_tpu.data.datasets import PromptAnswerDataset
+
+        tok = fixtures.make_tokenizer()
+        ds = PromptAnswerDataset(
+            seed=1,
+            dp_rank=0,
+            world_size=1,
+            tokenizer=tok,
+            max_length=256,
+            dataset_builder=lambda: fixtures.build_sft_rows(16),
+        )
+        assert len(ds) == 16
+        s = ds[0]
+        assert s.keys == {"packed_input_ids", "prompt_mask"}
+        (sl,) = s.seqlens["packed_input_ids"]
+        assert sl[0] <= 256
+        mask = s.data["prompt_mask"]
+        # Prompt is a strict prefix.
+        assert mask[0] and not mask[-1]
+
+    def test_dataset_dp_sharding_disjoint(self):
+        from areal_tpu.data.datasets import PromptDataset
+
+        tok = fixtures.make_tokenizer()
+        shards = [
+            PromptDataset(
+                seed=7,
+                dp_rank=r,
+                world_size=2,
+                tokenizer=tok,
+                dataset_builder=lambda: fixtures.build_math_rows(10),
+            )
+            for r in range(2)
+        ]
+        ids0, ids1 = set(shards[0].ids), set(shards[1].ids)
+        assert not (ids0 & ids1)
+        assert len(ids0 | ids1) == 10
+
+    def test_math_dataset_filter(self):
+        from areal_tpu.data.datasets import MathCodePromptDataset
+
+        tok = fixtures.make_tokenizer()
+        ds = MathCodePromptDataset(
+            seed=1,
+            dp_rank=0,
+            world_size=1,
+            tokenizer=tok,
+            dataset_builder=lambda: fixtures.build_math_rows(10),
+            max_filter_percentage=0.5,
+        )
+        n0 = len(ds)
+        ds.filter(list(ds.ids))  # try to remove everything; capped at 50%
+        assert len(ds) == n0 - int(n0 * 0.5)
+        s = ds[0]
+        assert s.metadata["task"] == ["math"]
+
+    def test_dataloader_epochs_differ(self):
+        from areal_tpu.data.datasets import PackedDataLoader, PromptDataset
+
+        tok = fixtures.make_tokenizer()
+        ds = PromptDataset(
+            seed=3,
+            dp_rank=0,
+            world_size=1,
+            tokenizer=tok,
+            dataset_builder=lambda: fixtures.build_math_rows(12),
+        )
+        dl = PackedDataLoader(ds, batch_size=5)
+        e1 = [b.ids for b in dl]
+        e2 = [b.ids for b in dl]
+        assert sorted(sum(e1, [])) == sorted(sum(e2, []))
+        assert e1 != e2  # reshuffled
+        assert [len(i) for i in e1] == [5, 5, 2]
