@@ -1,0 +1,250 @@
+"""HuggingFace checkpoint conversion registry.
+
+Capability parity: realhf/api/from_hf/* + realhf/impl/model/conversion/
+hf_registry.py — config⇄config and state-dict⇄state-dict converters per model
+family, used for loading pretrained checkpoints and saving HF-format outputs
+(so downstream eval harnesses can consume them directly).
+
+Families here: llama, qwen2 (identical tensor naming; qwen2 adds qkv bias).
+The reference additionally registers gpt2/gemma/mistral/mixtral — same
+registry mechanism, added as needed.
+"""
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from areal_tpu.base import logging
+from areal_tpu.models.config import ModelConfig
+
+logger = logging.getLogger("hf_registry")
+
+
+class HFFamily:
+    def __init__(
+        self,
+        name: str,
+        config_from_hf: Callable[[dict], ModelConfig],
+        config_to_hf: Callable[[ModelConfig], dict],
+    ):
+        self.name = name
+        self.config_from_hf = config_from_hf
+        self.config_to_hf = config_to_hf
+
+
+HF_FAMILIES: Dict[str, HFFamily] = {}
+
+
+def register_hf_family(family: HFFamily) -> None:
+    HF_FAMILIES[family.name] = family
+
+
+# ---------------- llama / qwen2 ----------------
+
+
+def _llama_like_config_from_hf(hf: dict) -> ModelConfig:
+    head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
+    return ModelConfig(
+        n_layers=hf["num_hidden_layers"],
+        hidden_dim=hf["hidden_size"],
+        n_q_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=head_dim,
+        intermediate_dim=hf["intermediate_size"],
+        vocab_size=hf["vocab_size"],
+        max_position_embeddings=hf.get("max_position_embeddings", 32768),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+        qkv_bias=hf["model_type"] == "qwen2",
+        tied_embeddings=hf.get("tie_word_embeddings", False),
+    )
+
+
+def _llama_like_config_to_hf(cfg: ModelConfig, model_type: str) -> dict:
+    return {
+        "model_type": model_type,
+        "num_hidden_layers": cfg.n_layers,
+        "hidden_size": cfg.hidden_dim,
+        "num_attention_heads": cfg.n_q_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "head_dim": cfg.head_dim,
+        "intermediate_size": cfg.intermediate_dim,
+        "vocab_size": cfg.vocab_size,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "tie_word_embeddings": cfg.tied_embeddings,
+        "torch_dtype": "bfloat16",
+        "architectures": [
+            "LlamaForCausalLM" if model_type == "llama" else "Qwen2ForCausalLM"
+        ],
+    }
+
+
+register_hf_family(
+    HFFamily(
+        "llama",
+        _llama_like_config_from_hf,
+        lambda cfg: _llama_like_config_to_hf(cfg, "llama"),
+    )
+)
+register_hf_family(
+    HFFamily(
+        "qwen2",
+        _llama_like_config_from_hf,
+        lambda cfg: _llama_like_config_to_hf(cfg, "qwen2"),
+    )
+)
+
+
+# ---------------- state dict conversion (llama-like naming) ----------------
+
+
+def params_from_hf_state_dict(
+    cfg: ModelConfig, sd: Dict[str, np.ndarray], dtype=None
+) -> Dict[str, Any]:
+    """HF tensors -> layer-stacked pytree.  HF linears are [out, in]; ours
+    are [in, out], so weights transpose."""
+    import jax.numpy as jnp
+
+    dtype = dtype or cfg.dtype
+
+    def get(name):
+        if name not in sd:
+            raise KeyError(f"missing tensor {name!r} in checkpoint")
+        return np.asarray(sd[name])
+
+    def stack(fmt, transpose=False):
+        ts = [get(fmt.format(i)) for i in range(cfg.n_layers)]
+        arr = np.stack(
+            [t.T if transpose else t for t in ts], axis=0
+        )
+        return jnp.asarray(arr, dtype=dtype)
+
+    blocks = {
+        "ln1": stack("model.layers.{}.input_layernorm.weight"),
+        "wq": stack("model.layers.{}.self_attn.q_proj.weight", transpose=True),
+        "wk": stack("model.layers.{}.self_attn.k_proj.weight", transpose=True),
+        "wv": stack("model.layers.{}.self_attn.v_proj.weight", transpose=True),
+        "wo": stack("model.layers.{}.self_attn.o_proj.weight", transpose=True),
+        "ln2": stack("model.layers.{}.post_attention_layernorm.weight"),
+        "wg": stack("model.layers.{}.mlp.gate_proj.weight", transpose=True),
+        "wu": stack("model.layers.{}.mlp.up_proj.weight", transpose=True),
+        "wd": stack("model.layers.{}.mlp.down_proj.weight", transpose=True),
+    }
+    if cfg.qkv_bias:
+        blocks["bq"] = stack("model.layers.{}.self_attn.q_proj.bias")
+        blocks["bk"] = stack("model.layers.{}.self_attn.k_proj.bias")
+        blocks["bv"] = stack("model.layers.{}.self_attn.v_proj.bias")
+    import jax.numpy as jnp
+
+    params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype=dtype),
+        "blocks": blocks,
+        "final_ln": jnp.asarray(get("model.norm.weight"), dtype=dtype),
+    }
+    if cfg.is_critic:
+        # Critic-from-actor init: fresh value head (reference:
+        # conversion/hf_registry.py critic init path).
+        import jax
+
+        params["value_head"] = jnp.zeros((cfg.hidden_dim, 1), dtype=dtype)
+    elif not cfg.tied_embeddings:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype=dtype)
+    return params
+
+
+def params_to_hf_state_dict(
+    cfg: ModelConfig, params: Dict[str, Any]
+) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(params["embed"], np.float32)
+    out["model.norm.weight"] = np.asarray(params["final_ln"], np.float32)
+    if not cfg.is_critic and not cfg.tied_embeddings:
+        out["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T
+    blocks = params["blocks"]
+
+    def unstack(name, arr, transpose=False):
+        arr = np.asarray(arr, np.float32)
+        for i in range(cfg.n_layers):
+            t = arr[i]
+            out[name.format(i)] = t.T if transpose else t
+
+    unstack("model.layers.{}.input_layernorm.weight", blocks["ln1"])
+    unstack("model.layers.{}.self_attn.q_proj.weight", blocks["wq"], True)
+    unstack("model.layers.{}.self_attn.k_proj.weight", blocks["wk"], True)
+    unstack("model.layers.{}.self_attn.v_proj.weight", blocks["wv"], True)
+    unstack("model.layers.{}.self_attn.o_proj.weight", blocks["wo"], True)
+    unstack("model.layers.{}.post_attention_layernorm.weight", blocks["ln2"])
+    unstack("model.layers.{}.mlp.gate_proj.weight", blocks["wg"], True)
+    unstack("model.layers.{}.mlp.up_proj.weight", blocks["wu"], True)
+    unstack("model.layers.{}.mlp.down_proj.weight", blocks["wd"], True)
+    if cfg.qkv_bias:
+        unstack("model.layers.{}.self_attn.q_proj.bias", blocks["bq"])
+        unstack("model.layers.{}.self_attn.k_proj.bias", blocks["bk"])
+        unstack("model.layers.{}.self_attn.v_proj.bias", blocks["bv"])
+    return out
+
+
+# ---------------- checkpoint IO ----------------
+
+
+def load_hf_config(path: str) -> dict:
+    with open(os.path.join(path, "config.json")) as f:
+        return json.load(f)
+
+
+def load_hf_checkpoint(
+    path: str, is_critic: bool = False, dtype=None
+) -> "tuple[ModelConfig, Dict[str, Any]]":
+    """Load an HF checkpoint dir (safetensors or torch .bin shards)."""
+    hf_cfg = load_hf_config(path)
+    family = HF_FAMILIES[hf_cfg["model_type"]]
+    cfg = family.config_from_hf(hf_cfg)
+    if is_critic:
+        cfg = cfg.as_critic()
+    sd: Dict[str, np.ndarray] = {}
+    st_files = sorted(
+        f for f in os.listdir(path) if f.endswith(".safetensors")
+    )
+    if st_files:
+        from safetensors.numpy import load_file
+
+        for f in st_files:
+            sd.update(load_file(os.path.join(path, f)))
+    else:
+        import torch
+
+        bins = sorted(f for f in os.listdir(path) if f.endswith(".bin"))
+        if not bins:
+            raise FileNotFoundError(f"no safetensors/bin shards in {path}")
+        for f in bins:
+            t = torch.load(
+                os.path.join(path, f), map_location="cpu", weights_only=True
+            )
+            sd.update({k: v.float().numpy() for k, v in t.items()})
+    params = params_from_hf_state_dict(cfg, sd, dtype=dtype)
+    logger.info(f"loaded HF checkpoint from {path} ({hf_cfg['model_type']})")
+    return cfg, params
+
+
+def save_hf_checkpoint(
+    path: str,
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    model_type: str = "qwen2",
+    tokenizer=None,
+) -> None:
+    """Write an HF-format checkpoint dir (safetensors + config.json) so the
+    reference's eval tooling / vLLM / SGLang can consume our outputs."""
+    os.makedirs(path, exist_ok=True)
+    sd = params_to_hf_state_dict(cfg, params)
+    from safetensors.numpy import save_file
+
+    save_file(sd, os.path.join(path, "model.safetensors"))
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(HF_FAMILIES[model_type].config_to_hf(cfg), f, indent=2)
+    if tokenizer is not None and hasattr(tokenizer, "save_pretrained"):
+        tokenizer.save_pretrained(path)

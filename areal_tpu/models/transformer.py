@@ -1,0 +1,366 @@
+"""The TPU-native transformer: pure-functional, scan-over-layers, packed rows.
+
+Capability parity: realhf/impl/model/nn/real_llm_api.py (`ReaLModel`) +
+real_llm_base.py (blocks, heads) — re-designed for XLA:
+
+- Parameters are a plain pytree with per-layer tensors STACKED on a leading
+  axis, so the forward pass is one `lax.scan` over layers: O(1) compile time
+  in depth, and the natural substrate for pipeline stages.
+- Batches are packed rows [B, S]: each row concatenates sequences, delimited
+  by `segment_ids` (0 = pad).  Static shapes; attention is causal-within-
+  segment (see areal_tpu/ops/attention.py).
+- No device/layout logic here: sharding is applied by the engines via
+  `jax.sharding` rules over this pytree (areal_tpu/parallel/sharding.py).
+- `is_critic` swaps the LM head for a scalar value head
+  (reference: real_llm_base.py:358-453).
+
+Functions:
+    init_params(cfg, key)                                  -> params
+    forward(params, cfg, tokens, segment_ids[, positions]) -> logits/values
+    init_kv_cache(cfg, b, s_max)                           -> cache
+    prefill(params, cfg, tokens, segment_ids, positions, cache, cache_offset)
+    decode_step(params, cfg, tokens, positions, cache, cache_len)
+"""
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.models.config import ModelConfig
+from areal_tpu.ops.attention import (
+    decode_attention_reference,
+    packed_attention,
+    repeat_kv,
+)
+from areal_tpu.ops.norms import apply_rotary, rms_norm, rope_cos_sin
+
+Params = Dict[str, Any]
+
+
+# --------------------------------------------------------------------------
+# Init
+# --------------------------------------------------------------------------
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Random init (truncated-normal fan-in scaling), layer-stacked."""
+    dtype = cfg.dtype
+    k_embed, k_blocks, k_head = jax.random.split(key, 3)
+
+    def dense(key, shape, fan_in):
+        return (
+            jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+            * (fan_in**-0.5)
+        ).astype(dtype)
+
+    L, D, F = cfg.n_layers, cfg.hidden_dim, cfg.intermediate_dim
+    ks = jax.random.split(k_blocks, 8)
+    blocks = {
+        "ln1": jnp.ones((L, D), dtype),
+        "wq": dense(ks[0], (L, D, cfg.q_dim), D),
+        "wk": dense(ks[1], (L, D, cfg.kv_dim), D),
+        "wv": dense(ks[2], (L, D, cfg.kv_dim), D),
+        "wo": dense(ks[3], (L, cfg.q_dim, D), cfg.q_dim),
+        "ln2": jnp.ones((L, D), dtype),
+    }
+    if cfg.qkv_bias:
+        blocks["bq"] = jnp.zeros((L, cfg.q_dim), dtype)
+        blocks["bk"] = jnp.zeros((L, cfg.kv_dim), dtype)
+        blocks["bv"] = jnp.zeros((L, cfg.kv_dim), dtype)
+    if cfg.is_moe:
+        E, FM = cfg.n_experts, cfg.moe_intermediate_dim
+        km = jax.random.split(ks[4], 4)
+        blocks["router"] = dense(km[0], (L, D, E), D)
+        blocks["wg"] = dense(km[1], (L, E, D, FM), D)
+        blocks["wu"] = dense(km[2], (L, E, D, FM), D)
+        blocks["wd"] = dense(km[3], (L, E, FM, D), FM)
+    else:
+        km = jax.random.split(ks[4], 3)
+        blocks["wg"] = dense(km[0], (L, D, F), D)
+        blocks["wu"] = dense(km[1], (L, D, F), D)
+        blocks["wd"] = dense(km[2], (L, F, D), F)
+
+    params: Params = {
+        "embed": dense(k_embed, (cfg.vocab_size, D), D),
+        "blocks": blocks,
+        "final_ln": jnp.ones((D,), dtype),
+    }
+    if cfg.is_critic:
+        params["value_head"] = dense(k_head, (D, 1), D)
+    elif not cfg.tied_embeddings:
+        params["lm_head"] = dense(k_head, (D, cfg.vocab_size), D)
+    return params
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+
+
+def positions_from_segments(segment_ids: jax.Array) -> jax.Array:
+    """Within-segment positions for packed rows.
+
+    Segments are contiguous runs in each row; position resets to 0 at every
+    segment boundary.  [B, S] int32.
+    """
+    s = segment_ids.shape[-1]
+    idx = jnp.arange(s, dtype=jnp.int32)
+    prev = jnp.pad(segment_ids[..., :-1], ((0, 0), (1, 0)), constant_values=-1)
+    is_start = segment_ids != prev
+    start_idx = jnp.where(is_start, idx, 0)
+    seg_start = jax.lax.associative_scan(jnp.maximum, start_idx, axis=-1)
+    return idx - seg_start
+
+
+def _mlp_dense(h: jax.Array, blk: Params) -> jax.Array:
+    gate = jax.nn.silu(h @ blk["wg"])
+    return (gate * (h @ blk["wu"])) @ blk["wd"]
+
+
+def _mlp_moe(h: jax.Array, blk: Params, cfg: ModelConfig) -> Tuple[jax.Array, jax.Array]:
+    """Token-choice top-k MoE with full expert compute + weight masking.
+
+    Every token runs through a dense einsum over ALL experts, then results
+    are combined with the (sparse) router weights.  On TPU this trades FLOPs
+    for perfectly static shapes and MXU-friendly batched matmuls; the expert
+    axis shards over the mesh (see sharding rules).  Returns (out, aux_loss).
+    Reference semantics: realhf/impl/model/modules/moe/ (router top-k with
+    aux load-balancing loss).
+    """
+    b, s, d = h.shape
+    x = h.reshape(-1, d)  # [T, D]
+    router_logits = (x.astype(jnp.float32)) @ blk["router"].astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    top_w, top_idx = jax.lax.top_k(probs, cfg.n_experts_per_tok)  # [T, k]
+    top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+    one_hot = jax.nn.one_hot(top_idx, cfg.n_experts, dtype=probs.dtype)  # [T,k,E]
+    comb = jnp.einsum("tk,tke->te", top_w, one_hot)  # [T, E]
+    # All-expert compute: [E, T, F] einsums.
+    gate = jax.nn.silu(jnp.einsum("td,edf->etf", x, blk["wg"]))
+    up = jnp.einsum("td,edf->etf", x, blk["wu"])
+    expert_out = jnp.einsum("etf,efd->etd", gate * up, blk["wd"])  # [E,T,D]
+    out = jnp.einsum("te,etd->td", comb.astype(expert_out.dtype), expert_out)
+    # Load-balancing aux loss (switch-style): E * sum_e f_e * P_e.
+    load = jnp.mean(one_hot.sum(axis=1), axis=0)  # fraction routed per expert
+    importance = jnp.mean(probs, axis=0)
+    aux = cfg.n_experts * jnp.sum(load * importance)
+    return out.reshape(b, s, d), aux
+
+
+def _block_forward(
+    x: jax.Array,
+    blk: Params,
+    cfg: ModelConfig,
+    segment_ids: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    b, s, d = x.shape
+    h = rms_norm(x, blk["ln1"], cfg.rms_norm_eps)
+    q = h @ blk["wq"]
+    k = h @ blk["wk"]
+    v = h @ blk["wv"]
+    if cfg.qkv_bias:
+        q, k, v = q + blk["bq"], k + blk["bk"], v + blk["bv"]
+    q = q.reshape(b, s, cfg.n_q_heads, cfg.head_dim)
+    k = k.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q, k = apply_rotary(q, k, cos, sin)
+    attn = packed_attention(q, k, v, segment_ids, causal=True)
+    x = x + attn.reshape(b, s, cfg.q_dim) @ blk["wo"]
+    h2 = rms_norm(x, blk["ln2"], cfg.rms_norm_eps)
+    if cfg.is_moe:
+        mlp_out, aux = _mlp_moe(h2, blk, cfg)
+    else:
+        mlp_out, aux = _mlp_dense(h2, blk), jnp.zeros((), jnp.float32)
+    return x + mlp_out, aux
+
+
+def _backbone(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,
+    segment_ids: jax.Array,
+    positions: jax.Array,
+    remat: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    x = jnp.take(params["embed"], tokens, axis=0)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(carry, blk):
+        y, aux = _block_forward(carry, blk, cfg, segment_ids, cos, sin)
+        return y, aux
+
+    if remat:
+        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+    x, auxes = jax.lax.scan(body, x, params["blocks"])
+    x = rms_norm(x, params["final_ln"], cfg.rms_norm_eps)
+    return x, jnp.sum(auxes)
+
+
+def _head(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
+    if cfg.is_critic:
+        v = jnp.einsum(
+            "bsd,dk->bsk", x, params["value_head"],
+            preferred_element_type=jnp.float32,
+        )
+        return v[..., 0]  # [B, S] fp32 values
+    head = params["embed"].T if cfg.tied_embeddings else params["lm_head"]
+    return jnp.einsum(
+        "bsd,dv->bsv", x, head, preferred_element_type=jnp.float32
+    )  # [B, S, V] fp32 logits
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [B, S] int32
+    segment_ids: jax.Array,  # [B, S] int32, 0 = pad
+    positions: Optional[jax.Array] = None,
+    remat: bool = False,
+) -> jax.Array:
+    """Full forward over packed rows -> fp32 logits [B,S,V] (or values [B,S]
+    for critics).  Also returns MoE aux loss via `forward_with_aux`."""
+    out, _ = forward_with_aux(params, cfg, tokens, segment_ids, positions, remat)
+    return out
+
+
+def forward_with_aux(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,
+    segment_ids: jax.Array,
+    positions: Optional[jax.Array] = None,
+    remat: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    if positions is None:
+        positions = positions_from_segments(segment_ids)
+    x, aux = _backbone(params, cfg, tokens, segment_ids, positions, remat)
+    return _head(params, cfg, x), aux
+
+
+# --------------------------------------------------------------------------
+# KV-cache generation path
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class KVCache:
+    """Dense per-layer KV cache: k/v [L, B, S_max, n_kv, head_dim]."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def s_max(self) -> int:
+        return self.k.shape[2]
+
+
+jax.tree_util.register_dataclass(KVCache, data_fields=["k", "v"], meta_fields=[])
+
+
+def init_kv_cache(
+    cfg: ModelConfig, batch: int, s_max: int, dtype=None
+) -> KVCache:
+    shape = (cfg.n_layers, batch, s_max, cfg.n_kv_heads, cfg.head_dim)
+    dtype = dtype or cfg.dtype
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def _block_kv(
+    h: jax.Array, blk: Params, cfg: ModelConfig, cos: jax.Array, sin: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    b, s, _ = h.shape
+    q = h @ blk["wq"]
+    k = h @ blk["wk"]
+    v = h @ blk["wv"]
+    if cfg.qkv_bias:
+        q, k, v = q + blk["bq"], k + blk["bk"], v + blk["bv"]
+    q = q.reshape(b, s, cfg.n_q_heads, cfg.head_dim)
+    k = k.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q, k = apply_rotary(q, k, cos, sin)
+    return q, k, v
+
+
+def prefill(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [B, S] one sequence per row (left-aligned)
+    segment_ids: jax.Array,  # [B, S] 1 where valid, 0 pad (single segment/row)
+    cache: KVCache,
+) -> Tuple[jax.Array, KVCache]:
+    """Run the prompt through the model, filling cache[:, :, :S] and
+    returning fp32 logits [B, S, V] (caller gathers the last valid one)."""
+    positions = positions_from_segments(segment_ids)
+    x = jnp.take(params["embed"], tokens, axis=0)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(carry, layer_in):
+        blk = layer_in
+        h = rms_norm(carry, blk["ln1"], cfg.rms_norm_eps)
+        q, k, v = _block_kv(h, blk, cfg, cos, sin)
+        attn = packed_attention(q, k, v, segment_ids, causal=True)
+        y = carry + attn.reshape(*carry.shape[:2], cfg.q_dim) @ blk["wo"]
+        h2 = rms_norm(y, blk["ln2"], cfg.rms_norm_eps)
+        y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk))
+        return y, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
+    s = tokens.shape[1]
+    new_cache = KVCache(
+        k=jax.lax.dynamic_update_slice(
+            cache.k, ks.astype(cache.k.dtype), (0, 0, 0, 0, 0)
+        ),
+        v=jax.lax.dynamic_update_slice(
+            cache.v, vs.astype(cache.v.dtype), (0, 0, 0, 0, 0)
+        ),
+    )
+    x = rms_norm(x, params["final_ln"], cfg.rms_norm_eps)
+    return _head(params, cfg, x), new_cache
+
+
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [B] int32 — current token per row
+    positions: jax.Array,  # [B] int32 — its position per row
+    cache: KVCache,
+    cache_len: jax.Array,  # [B] int32 — valid cache length AFTER inserting
+) -> Tuple[jax.Array, KVCache]:
+    """One decode step: insert token at cache slot positions, attend over
+    prefix, return fp32 logits [B, V] and the updated cache.
+
+    `cache_len` counts valid entries including the token being inserted; the
+    token's slot is cache_len - 1.
+    """
+    b = tokens.shape[0]
+    x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]  # [B,1,D]
+    cos, sin = rope_cos_sin(positions[:, None], cfg.head_dim, cfg.rope_theta)
+    slot = cache_len - 1  # [B]
+
+    def body(carry, layer_in):
+        blk, k_cache, v_cache = layer_in
+        h = rms_norm(carry, blk["ln1"], cfg.rms_norm_eps)
+        q, k, v = _block_kv(h, blk, cfg, cos, sin)  # q/k/v [B,1,h,d]
+        # Scatter the new k/v into each row's slot.
+        one_hot = jax.nn.one_hot(slot, k_cache.shape[1], dtype=k_cache.dtype)
+        k_cache = k_cache * (1 - one_hot[:, :, None, None]) + (
+            one_hot[:, :, None, None] * k[:, 0][:, None].astype(k_cache.dtype)
+        )
+        v_cache = v_cache * (1 - one_hot[:, :, None, None]) + (
+            one_hot[:, :, None, None] * v[:, 0][:, None].astype(v_cache.dtype)
+        )
+        attn = decode_attention_reference(q, k_cache, v_cache, cache_len)
+        y = carry + attn.reshape(b, 1, cfg.q_dim) @ blk["wo"]
+        h2 = rms_norm(y, blk["ln2"], cfg.rms_norm_eps)
+        y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk))
+        return y, (k_cache, v_cache)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x = rms_norm(x, params["final_ln"], cfg.rms_norm_eps)
+    logits = _head(params, cfg, x)[:, 0]  # [B, V]
+    return logits, KVCache(k=ks, v=vs)

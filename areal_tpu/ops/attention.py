@@ -1,0 +1,118 @@
+"""Packed variable-length attention.
+
+The framework's training/inference batches are *packed rows*: shape [B, S]
+where each row concatenates several sequences back-to-back, identified by
+`segment_ids` (0 = padding).  Attention is causal within a segment and never
+crosses segments — the TPU-native replacement for the reference's
+flash_attn_varlen_func over cu_seqlens (realhf/impl/model/modules/attn.py:24).
+
+Two implementations:
+- `packed_attention_reference`: dense masked softmax (jnp).  Used on CPU
+  tests and as the numerics oracle.
+- `packed_flash_attention`: Pallas TPU flash kernel (see
+  areal_tpu/ops/pallas/flash_attention.py), dispatched on TPU.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2.3819763e38  # close to bf16 min, the usual TPU mask value
+
+
+def make_packed_mask(segment_ids: jax.Array, causal: bool = True) -> jax.Array:
+    """[B, S] segment ids -> [B, 1, S, S] boolean mask (True = attend)."""
+    seg_q = segment_ids[:, :, None]
+    seg_k = segment_ids[:, None, :]
+    mask = (seg_q == seg_k) & (seg_q > 0)
+    if causal:
+        s = segment_ids.shape[-1]
+        idx = jnp.arange(s)
+        mask &= idx[:, None] >= idx[None, :]
+    return mask[:, None, :, :]
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, n_kv, d] -> [B, S, n_kv*n_rep, d] (GQA head expansion)."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (b, s, h, n_rep, d)
+    ).reshape(b, s, h * n_rep, d)
+
+
+def packed_attention_reference(
+    q: jax.Array,  # [B, S, n_q, d]
+    k: jax.Array,  # [B, S, n_kv, d]
+    v: jax.Array,  # [B, S, n_kv, d]
+    segment_ids: jax.Array,  # [B, S] int, 0 = pad
+    causal: bool = True,
+    logits_soft_cap: Optional[float] = None,
+) -> jax.Array:
+    n_q, n_kv = q.shape[2], k.shape[2]
+    k = repeat_kv(k, n_q // n_kv)
+    v = repeat_kv(v, n_q // n_kv)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if logits_soft_cap is not None:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+    mask = make_packed_mask(segment_ids, causal=causal)
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # Fully-masked (padding) rows produce uniform probs; zero them out.
+    probs = jnp.where(mask.any(axis=-1, keepdims=True), probs, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def decode_attention_reference(
+    q: jax.Array,  # [B, 1, n_q, d] — one new token per row
+    k_cache: jax.Array,  # [B, S_max, n_kv, d]
+    v_cache: jax.Array,  # [B, S_max, n_kv, d]
+    cache_len: jax.Array,  # [B] int — valid prefix length per row
+) -> jax.Array:
+    """Single-token decode attention over a dense KV cache."""
+    n_q, n_kv = q.shape[2], k_cache.shape[2]
+    k = repeat_kv(k_cache, n_q // n_kv)
+    v = repeat_kv(v_cache, n_q // n_kv)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    s_max = k_cache.shape[1]
+    valid = jnp.arange(s_max)[None, :] < cache_len[:, None]  # [B, S]
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal",))
+def _dispatch_ref(q, k, v, segment_ids, causal):
+    return packed_attention_reference(q, k, v, segment_ids, causal=causal)
+
+
+def packed_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    segment_ids: jax.Array,
+    causal: bool = True,
+    use_flash: Optional[bool] = None,
+) -> jax.Array:
+    """Dispatch: Pallas flash kernel on TPU, dense reference elsewhere."""
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash:
+        try:
+            from areal_tpu.ops.pallas.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, segment_ids, causal=causal)
+        except (ImportError, NotImplementedError):
+            pass
+    return packed_attention_reference(q, k, v, segment_ids, causal=causal)

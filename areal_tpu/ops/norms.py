@@ -1,0 +1,52 @@
+"""Normalization + rotary embedding numerics.
+
+Matches HF llama/qwen2 semantics exactly so converted checkpoints are
+bit-compatible (reference equivalents: realhf/impl/model/modules/rms.py,
+rotary.py).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm in fp32 accumulation, cast back to x.dtype."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * scale.astype(jnp.float32)).astype(dtype)
+
+
+def rope_cos_sin(positions: jax.Array, head_dim: int, theta: float) -> tuple:
+    """cos/sin tables for the given integer positions.
+
+    positions: int32 [...]; returns cos, sin of shape [..., head_dim] using
+    the HF convention: freqs repeated twice along the last dim
+    ([f0..f{d/2-1}, f0..f{d/2-1}]).
+    """
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., d/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [..., d]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary(
+    q: jax.Array, k: jax.Array, cos: jax.Array, sin: jax.Array
+) -> tuple:
+    """HF-style RoPE. q/k: [..., n_heads, head_dim]; cos/sin: [..., head_dim]
+    (broadcast over the heads axis)."""
+    cos = cos[..., None, :].astype(jnp.float32)
+    sin = sin[..., None, :].astype(jnp.float32)
+    qf, kf = q.astype(jnp.float32), k.astype(jnp.float32)
+    q_out = qf * cos + _rotate_half(qf) * sin
+    k_out = kf * cos + _rotate_half(kf) * sin
+    return q_out.astype(q.dtype), k_out.astype(k.dtype)
