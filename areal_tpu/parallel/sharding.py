@@ -1,0 +1,140 @@
+"""GSPMD sharding rules for the transformer pytree.
+
+Capability parity: realhf/impl/model/parallelism/ — but instead of
+Megatron-style explicit Column/RowParallelLinear modules with hand-written
+collectives, we annotate the SAME pure-functional model with
+`jax.sharding.PartitionSpec`s and let the XLA SPMD partitioner insert
+all-gathers / reduce-scatters / psums (sequence parallelism falls out
+automatically).  One rule table replaces ~2.5k LoC of TP modules.
+
+Conventions (mesh axes from areal_tpu/base/topology.py):
+- `model`  — tensor parallel: attention heads + MLP hidden + vocab.
+- `fsdp`   — ZeRO-style: remaining param dim sharded; batch also sharded.
+- `data`   — pure DP: params replicated, batch sharded.
+- `seq`    — context parallel: sequence dim of activations (ring attention).
+- `pipe`   — pipeline stages (layer-stacked leading axis).
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from areal_tpu.base.topology import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS
+
+BATCH = (DATA_AXIS, FSDP_AXIS)
+
+# Param rules: leaf name -> PartitionSpec (leading layer-stack axis included
+# for block params).
+_BLOCK_RULES: Dict[str, P] = {
+    "ln1": P(None, None),
+    "ln2": P(None, None),
+    "wq": P(None, FSDP_AXIS, MODEL_AXIS),
+    "wk": P(None, FSDP_AXIS, MODEL_AXIS),
+    "wv": P(None, FSDP_AXIS, MODEL_AXIS),
+    "bq": P(None, MODEL_AXIS),
+    "bk": P(None, MODEL_AXIS),
+    "bv": P(None, MODEL_AXIS),
+    "wo": P(None, MODEL_AXIS, FSDP_AXIS),
+    # Dense MLP
+    "wg": P(None, FSDP_AXIS, MODEL_AXIS),
+    "wu": P(None, FSDP_AXIS, MODEL_AXIS),
+    "wd": P(None, MODEL_AXIS, FSDP_AXIS),
+    # MoE: expert axis = expert parallelism over fsdp; hidden over model.
+    "router": P(None, FSDP_AXIS, None),
+    "moe_wg": P(None, FSDP_AXIS, None, MODEL_AXIS),
+    "moe_wu": P(None, FSDP_AXIS, None, MODEL_AXIS),
+    "moe_wd": P(None, FSDP_AXIS, MODEL_AXIS, None),
+}
+
+_TOP_RULES: Dict[str, P] = {
+    "embed": P(MODEL_AXIS, FSDP_AXIS),
+    "final_ln": P(None),
+    "lm_head": P(FSDP_AXIS, MODEL_AXIS),
+    "value_head": P(FSDP_AXIS, None),
+}
+
+
+def param_pspecs(params: Dict[str, Any]) -> Dict[str, Any]:
+    """PartitionSpec pytree matching the transformer params structure."""
+    out: Dict[str, Any] = {}
+    for k, v in params.items():
+        if k == "blocks":
+            blocks = {}
+            for bk, bv in v.items():
+                if bk in ("wg", "wu", "wd") and np.ndim(bv) == 4:
+                    blocks[bk] = _BLOCK_RULES["moe_" + bk]
+                else:
+                    blocks[bk] = _BLOCK_RULES[bk]
+            out[k] = blocks
+        else:
+            out[k] = _TOP_RULES[k]
+    return out
+
+
+def batch_pspec(with_seq: bool = True) -> P:
+    """Sharding for [B, S] token/segment arrays."""
+    return P(BATCH, SEQ_AXIS if with_seq else None)
+
+
+def act_pspec() -> P:
+    """Sharding for [B, S, D] activations."""
+    return P(BATCH, SEQ_AXIS, None)
+
+
+def logits_pspec() -> P:
+    return P(BATCH, SEQ_AXIS, MODEL_AXIS)
+
+
+def kv_cache_pspec() -> P:
+    """[L, B, S, n_kv, d] — batch over (data,fsdp), heads over model."""
+    return P(None, BATCH, None, MODEL_AXIS, None)
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def tree_named(mesh: Mesh, specs) -> Any:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
+    """Place a (host or device) param pytree onto the mesh per the rules."""
+    shardings = tree_named(mesh, param_pspecs(params))
+    return jax.device_put(params, shardings)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    return jax.device_put(tree, NamedSharding(mesh, P()))
+
+
+def check_divisibility(params: Dict[str, Any], mesh: Mesh) -> Optional[str]:
+    """Return an error string if any param dim doesn't divide by its mesh
+    axes (callers can fall back to replication or a smaller mesh)."""
+    specs = param_pspecs(params)
+
+    def _chk(path, leaf, spec):
+        for dim, axes in zip(np.shape(leaf), spec):
+            if axes is None:
+                continue
+            axes = (axes,) if isinstance(axes, str) else axes
+            total = int(np.prod([mesh.shape[a] for a in axes]))
+            if dim % total:
+                return f"{'/'.join(map(str, path))}: dim {dim} % {axes}={total}"
+        return None
+
+    flat_p, _ = jax.tree_util.tree_flatten_with_path(params)
+    flat_s = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    for (path, leaf), spec in zip(flat_p, flat_s):
+        err = _chk([getattr(k, "key", k) for k in path], leaf, spec)
+        if err:
+            return err
+    return None
