@@ -1,0 +1,190 @@
+"""Model/engine/interface contracts + registries.
+
+Capability parity: realhf/api/core/model_api.py — `PipelinableEngine`
+(:383-529), `Model` (:533), `ModelBackend` (:580), `ModelInterface`
+(:640-717), and the registries (:764-818).  TPU adaptation: an Engine wraps a
+(params pytree, mesh, config) instead of a torch module, and "backend
+initialization" builds jitted step functions instead of wrapping DDP.
+"""
+
+import abc
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.models.config import ModelConfig
+
+
+@dataclasses.dataclass
+class GenerationHyperparameters:
+    """Sampling config (reference: cli_args.py:452)."""
+
+    n: int = 1  # group size (responses per prompt)
+    max_new_tokens: int = 256
+    min_new_tokens: int = 0
+    greedy: bool = False
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    temperature: float = 1.0
+
+    def new(self, **kwargs):
+        return dataclasses.replace(self, **kwargs)
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    """Reference: cli_args.py:177."""
+
+    type: str = "adam"
+    lr: float = 2e-5
+    weight_decay: float = 0.05
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-5
+    min_lr_ratio: float = 0.0
+    lr_scheduler_type: str = "constant"  # constant | linear | cosine
+    warmup_steps_proportion: float = 0.02
+    gradient_clipping: float = 1.0
+
+
+@dataclasses.dataclass
+class FinetuneSpec:
+    """Reference: model_api.py:343."""
+
+    total_train_epochs: int = 1
+    dataset_size: int = 0
+    train_batch_size: int = 1
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return max(
+            1, (self.dataset_size + self.train_batch_size - 1) // self.train_batch_size
+        )
+
+    @property
+    def total_train_steps(self) -> int:
+        return self.total_train_epochs * self.steps_per_epoch
+
+
+class Engine(abc.ABC):
+    """The PipelinableEngine contract: packed-batch train/forward/generate.
+
+    `loss_fn(logits, batch) -> (scalar_loss, stats_dict)` must be jit-pure;
+    `batch` is the dense row-packed dict (see areal_tpu/engines/packing.py)
+    containing tokens/segment_ids/positions plus aligned extra keys.
+    """
+
+    @abc.abstractmethod
+    def train_batch(
+        self,
+        sample: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        loss_fn: Callable,
+        loss_weight_fn: Callable[[Dict[str, np.ndarray]], np.ndarray],
+        token_key: str = "packed_input_ids",
+        extra_keys: tuple = (),
+        version_steps: int = 0,
+    ) -> Dict[str, float]:
+        ...
+
+    @abc.abstractmethod
+    def forward(
+        self,
+        sample: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        post_fn: Callable,
+        output_key: str,
+        token_key: str = "packed_input_ids",
+        extra_keys: tuple = (),
+    ) -> SequenceSample:
+        ...
+
+    def generate(
+        self,
+        sample: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        gconfig: GenerationHyperparameters,
+        prompt_key: str = "packed_prompts",
+    ) -> SequenceSample:
+        raise NotImplementedError(f"{type(self).__name__} cannot generate")
+
+    # Checkpointing
+    def get_params(self):
+        raise NotImplementedError
+
+    def set_params(self, params) -> None:
+        raise NotImplementedError
+
+    def save_optimizer_state(self, path: str) -> None:
+        pass
+
+    def load_optimizer_state(self, path: str) -> None:
+        pass
+
+
+@dataclasses.dataclass
+class Model:
+    """A named model bundle living on a worker (reference: model_api.py:533)."""
+
+    name: str
+    engine: Engine
+    tokenizer: Any
+    config: ModelConfig
+    version: int = 0
+
+    def inc_version(self):
+        self.version += 1
+
+
+# ---------------- registries ----------------
+
+ALL_INTERFACES: Dict[str, type] = {}
+ALL_BACKENDS: Dict[str, Callable] = {}
+
+
+class ModelInterface(abc.ABC):
+    """An algorithm: maps (model, data) -> data or stats
+    (reference: model_api.py:640).  Subclasses override any subset."""
+
+    def generate(
+        self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        raise NotImplementedError
+
+    def inference(
+        self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        raise NotImplementedError
+
+    def train_step(
+        self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def evaluate(self, model: Model, eval_dataloader) -> Dict[str, float]:
+        return {}
+
+    def save(self, model: Model, save_dir: str) -> None:
+        pass
+
+
+def register_interface(name: str, cls: type) -> None:
+    if name in ALL_INTERFACES:
+        raise ValueError(f"interface {name!r} already registered")
+    ALL_INTERFACES[name] = cls
+
+
+def make_interface(name: str, **kwargs) -> ModelInterface:
+    return ALL_INTERFACES[name](**kwargs)
+
+
+def register_backend(name: str, factory: Callable) -> None:
+    if name in ALL_BACKENDS:
+        raise ValueError(f"backend {name!r} already registered")
+    ALL_BACKENDS[name] = factory
+
+
+def make_backend(name: str, **kwargs):
+    return ALL_BACKENDS[name](**kwargs)

@@ -1,0 +1,125 @@
+"""SequenceSample ⇄ dense packed rows.
+
+The engines' bridge between the host data plane (packed 1D numpy arrays with
+seqlens) and XLA-friendly dense [B, S] buffers: sequences are FFD-packed into
+rows, rows padded to a bucketed length (bounding the number of distinct
+compiled shapes), and outputs are scattered back into the original
+per-sequence packed order.
+
+This is the TPU answer to the reference's cu_seqlens/varlen plumbing
+(realhf/impl/model/utils/padding + flash_attn_varlen): instead of one long
+ragged buffer per micro-batch we build a static [B, S] grid with segment ids.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from areal_tpu.api.data_api import SequenceSample
+from areal_tpu.base import datapack
+
+# Pad row lengths to multiples of this (TPU lane width × a few sublanes).
+_BUCKET_QUANTUM = 128
+
+
+def bucket_len(n: int, quantum: int = _BUCKET_QUANTUM) -> int:
+    """Round up to a bucketed static length: next power of two below 1024,
+    then multiples of `quantum`· 8 — bounds distinct compile shapes."""
+    n = max(n, 1)
+    if n <= 128:
+        return 128
+    if n <= 1024:
+        p = 128
+        while p < n:
+            p *= 2
+        return p
+    step = quantum * 8  # 1024
+    return ((n + step - 1) // step) * step
+
+
+@dataclasses.dataclass
+class RowPack:
+    """Dense row layout + the mapping back to packed-1D order.
+
+    arrays: key -> [B, S, *trailing] dense array (tokens, segment_ids,
+    positions, plus aligned extras).
+    seq_map: per original sequence (in sample packed order):
+    (row, start, length).
+    """
+
+    arrays: Dict[str, np.ndarray]
+    seq_map: List[Tuple[int, int, int]]
+    n_rows: int
+    row_len: int
+
+    def unpack(self, dense: np.ndarray) -> np.ndarray:
+        """[B, S, ...] -> packed 1D [sum(lens), ...] in original order."""
+        parts = [dense[r, s : s + l] for (r, s, l) in self.seq_map]
+        return np.concatenate(parts, axis=0)
+
+
+def pack_sample(
+    sample: SequenceSample,
+    token_key: str,
+    extra_keys: Sequence[str] = (),
+    n_rows_multiple: int = 1,
+    max_tokens_per_row: Optional[int] = None,
+    row_len: Optional[int] = None,
+) -> RowPack:
+    """Pack every sequence of `sample[token_key]` into dense rows.
+
+    extra_keys must be token-aligned with token_key (same seqlens).  The
+    number of rows is padded to a multiple of `n_rows_multiple` (the mesh's
+    batch-sharding degree) with empty rows if needed.
+    """
+    lens = sample.seqlens_of(token_key)
+    for k in extra_keys:
+        if sample.seqlens_of(k) != lens:
+            raise ValueError(
+                f"extra key {k!r} is not token-aligned with {token_key!r}"
+            )
+    cap = max_tokens_per_row or max(lens, default=1)
+    cap = max(cap, max(lens, default=1))
+    groups = datapack.ffd_allocate(lens, capacity=cap)
+    # Pad row count up to a multiple.
+    while len(groups) % max(n_rows_multiple, 1):
+        groups.append([])
+    n_rows = len(groups)
+    s_pad = row_len or bucket_len(
+        max((sum(lens[i] for i in g) for g in groups), default=1)
+    )
+
+    tok_src = np.asarray(sample.data[token_key])
+    bounds = sample.cu_seqlens(token_key)
+    extra_src = {k: np.asarray(sample.data[k]) for k in extra_keys}
+    ex_bounds = {k: sample.cu_seqlens(k) for k in extra_keys}
+
+    def alloc(src):
+        shape = (n_rows, s_pad) + src.shape[1:]
+        return np.zeros(shape, dtype=src.dtype)
+
+    tokens = alloc(tok_src)
+    seg = np.zeros((n_rows, s_pad), np.int32)
+    pos = np.zeros((n_rows, s_pad), np.int32)
+    extras = {k: alloc(v) for k, v in extra_src.items()}
+
+    seq_map: List[Optional[Tuple[int, int, int]]] = [None] * len(lens)
+    for r, g in enumerate(groups):
+        off = 0
+        for seq_no, i in enumerate(g, start=1):
+            l = lens[i]
+            tokens[r, off : off + l] = tok_src[bounds[i] : bounds[i + 1]]
+            seg[r, off : off + l] = seq_no
+            pos[r, off : off + l] = np.arange(l)
+            for k in extra_keys:
+                eb = ex_bounds[k]
+                extras[k][r, off : off + l] = extra_src[k][eb[i] : eb[i + 1]]
+            seq_map[i] = (r, off, l)
+            off += l
+
+    arrays = {"tokens": tokens, "segment_ids": seg, "positions": pos}
+    arrays.update(extras)
+    return RowPack(
+        arrays=arrays, seq_map=seq_map, n_rows=n_rows, row_len=s_pad
+    )

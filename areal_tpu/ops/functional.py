@@ -1,0 +1,85 @@
+"""Loss/log-prob numerics over dense packed rows.
+
+Capability parity: realhf/impl/model/utils/functional.py
+(`gather_packed_shifted_log_probs`, `masked_normalization`) adapted to the
+[B, S] packed-row layout (segment_ids delimit sequences, 0 = pad).
+"""
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def shifted_label_mask(segment_ids: jax.Array) -> jax.Array:
+    """True at position t when (t, t+1) belong to the same segment — i.e.
+    position t predicts a real next token.  [B, S] bool."""
+    nxt = jnp.pad(
+        segment_ids[:, 1:], ((0, 0), (0, 1)), constant_values=0
+    )
+    return (segment_ids > 0) & (segment_ids == nxt)
+
+
+def next_token_logprobs(
+    logits: jax.Array, tokens: jax.Array, segment_ids: jax.Array
+) -> jax.Array:
+    """log p(tokens[t+1] | prefix) at each position t (0 where invalid).
+
+    [B, S] fp32.  The last position of every segment (and padding) is 0.
+    """
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    labels = jnp.pad(tokens[:, 1:], ((0, 0), (0, 1)), constant_values=0)
+    gathered = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return jnp.where(shifted_label_mask(segment_ids), gathered, 0.0)
+
+
+def masked_normalization(
+    x: jax.Array,
+    mask: jax.Array,
+    eps: float = 1e-5,
+    high_precision: bool = True,
+) -> jax.Array:
+    """Whiten x over masked entries (global mean/std), zeros elsewhere.
+    Reference: functional.py masked_normalization (used for advantages)."""
+    dtype = jnp.float64 if high_precision and jax.config.jax_enable_x64 else jnp.float32
+    xf = x.astype(dtype)
+    m = mask.astype(dtype)
+    n = jnp.maximum(m.sum(), 1.0)
+    mean = (xf * m).sum() / n
+    var = (jnp.square(xf - mean) * m).sum() / n
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return jnp.where(mask, out, 0.0).astype(jnp.float32)
+
+
+def sft_loss(logits: jax.Array, batch: Dict[str, jax.Array]) -> Tuple[jax.Array, Dict]:
+    """Sum of next-token NLL over answer tokens (prompt/pad excluded).
+
+    batch needs: tokens, segment_ids, prompt_mask (True on prompt tokens).
+    Positions whose LABEL (t+1) is a prompt token are excluded too.
+    Returns (nll_sum, stats) — pair with loss_weight_fn = n_label_tokens.
+    """
+    seg = batch["segment_ids"]
+    logp = next_token_logprobs(logits, batch["tokens"], seg)
+    label_is_prompt = jnp.pad(
+        batch["prompt_mask"][:, 1:], ((0, 0), (0, 1)), constant_values=True
+    )
+    mask = shifted_label_mask(seg) & (~label_is_prompt)
+    nll = -(logp * mask).sum()
+    n = jnp.maximum(mask.sum(), 1)
+    return nll, {
+        "nll_sum": nll,
+        "n_tokens": n.astype(jnp.float32),
+    }
+
+
+def sft_label_count(arrays: Dict) -> float:
+    """Host-side loss_weight_fn matching sft_loss's mask."""
+    import numpy as np
+
+    seg = arrays["segment_ids"]
+    nxt = np.pad(seg[:, 1:], ((0, 0), (0, 1)), constant_values=0)
+    shift_ok = (seg > 0) & (seg == nxt)
+    label_is_prompt = np.pad(
+        arrays["prompt_mask"][:, 1:], ((0, 0), (0, 1)), constant_values=True
+    )
+    return float((shift_ok & ~label_is_prompt).sum())
