@@ -1,0 +1,68 @@
+"""Token sampling: temperature / top-k / top-p warpers + categorical draw.
+
+Capability parity: realhf/impl/model/nn/real_llm_generate.py `genstep`
+(top-k/top-p logits warpers, unfinished-sequence masking) — implemented as
+static-shape jnp ops (sort/cumsum) so the whole decode loop jits.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e10
+
+
+def apply_top_k(logits: jax.Array, k: int) -> jax.Array:
+    """Keep the k highest logits per row; mask the rest.  k<=0 disables."""
+    if k <= 0:
+        return logits
+    k = min(k, logits.shape[-1])
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def apply_top_p(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filtering: keep the smallest prefix of sorted probs with
+    cumulative mass >= p.  p>=1 disables."""
+    if p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep tokens whose cumulative mass (exclusive) is < p.
+    keep_sorted = (cum - probs) < p
+    # Threshold logit = smallest kept logit.
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+def sample_token(
+    logits: jax.Array,  # [B, V] fp32
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    greedy: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (token [B] int32, logprob [B] fp32 of the chosen token under
+    the WARPED distribution's log_softmax of unwarped logits).
+
+    Note: the returned logprob is under the *unwarped* temperature-scaled
+    distribution — the convention PPO needs for importance ratios (the
+    behavior policy's density), matching the reference which recomputes
+    logprobs from raw logits.
+    """
+    if greedy:
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        scaled = logits / jnp.maximum(temperature, 1e-6)
+        warped = apply_top_p(apply_top_k(scaled, top_k), top_p)
+        tok = jax.random.categorical(key, warped, axis=-1).astype(jnp.int32)
+    logp_all = jax.nn.log_softmax(
+        logits / jnp.maximum(temperature, 1e-6), axis=-1
+    )
+    logp = jnp.take_along_axis(logp_all, tok[:, None], axis=-1)[:, 0]
+    return tok, logp
