@@ -1,0 +1,117 @@
+"""Forward-only inference engine (ref/reward logprob recomputation).
+
+Capability parity: realhf/impl/model/backend/inference.py
+(`PipelinableInferenceEngine`) — holds frozen params on a mesh, serves
+`forward` with the same packing/unpacking contract as TrainEngine, no
+optimizer state.
+"""
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model_api import Engine
+from areal_tpu.base.topology import batch_sharding_degree
+from areal_tpu.engines import packing
+from areal_tpu.models import transformer as tfm
+from areal_tpu.models.config import ModelConfig
+from areal_tpu.parallel import sharding
+
+
+class InferenceEngine(Engine):
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Dict[str, Any],
+        mesh: Mesh,
+        compute_dtype=jnp.bfloat16,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        if jax.default_backend() == "cpu":
+            compute_dtype = jnp.float32
+        self.compute_dtype = compute_dtype
+        self.batch_shard = batch_sharding_degree(mesh)
+        self._fwd_fns: Dict[Any, Callable] = {}
+        self.set_params(params)
+
+    def set_params(self, params) -> None:
+        cast = jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            params,
+        )
+        self.params = jax.device_put(
+            cast, sharding.tree_named(self.mesh, sharding.param_pspecs(cast))
+        )
+
+    def get_params(self):
+        return self.params
+
+    def train_batch(self, *a, **k):
+        raise NotImplementedError("InferenceEngine cannot train")
+
+    def forward(
+        self,
+        sample: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        post_fn: Callable,
+        output_key: str,
+        token_key: str = "packed_input_ids",
+        extra_keys: Sequence[str] = (),
+    ) -> SequenceSample:
+        mbs = sample.split(mb_spec)
+        fwd = self._get_fwd_fn(post_fn)
+        outs = []
+        for mb in mbs:
+            pk = packing.pack_sample(
+                mb,
+                token_key,
+                extra_keys=extra_keys,
+                n_rows_multiple=self.batch_shard,
+                max_tokens_per_row=mb_spec.max_tokens_per_mb,
+            )
+            batch = {
+                k: jax.device_put(
+                    v, sharding.named(self.mesh, sharding.batch_pspec())
+                )
+                for k, v in pk.arrays.items()
+            }
+            dense = np.asarray(fwd(self.params, batch))
+            outs.append(
+                SequenceSample(
+                    keys={output_key},
+                    ids=list(mb.ids),
+                    seqlens={
+                        output_key: [list(s) for s in mb.seqlens[token_key]]
+                    },
+                    data={output_key: pk.unpack(dense)},
+                )
+            )
+        result = SequenceSample.gather(outs)
+        order = {i: n for n, i in enumerate(result.ids)}
+        return result.select_idx([order[i] for i in sample.ids])
+
+    def _get_fwd_fn(self, post_fn):
+        if post_fn in self._fwd_fns:
+            return self._fwd_fns[post_fn]
+        cfg = self.cfg
+
+        @jax.jit
+        def fwd(params, batch):
+            out = tfm.forward(
+                params,
+                cfg,
+                batch["tokens"],
+                batch["segment_ids"],
+                positions=batch["positions"],
+            )
+            return post_fn(out, batch)
+
+        self._fwd_fns[post_fn] = fwd
+        return fwd

@@ -1,0 +1,107 @@
+"""Hermetic math answer verification.
+
+Capability parity: realhf/functioncall/math/verify.py + math_parser.py (the
+local verification path; the remote FaaS path is an HTTP wrapper around the
+same grading).  Grading: extract the last \\boxed{...} (or final-answer
+line) from the generated text and compare against any of the gold solutions
+after normalization — exact string, numeric, or fraction equivalence.
+"""
+
+import re
+from fractions import Fraction
+from typing import List, Optional
+
+
+def extract_boxed(text: str) -> Optional[str]:
+    """Last \\boxed{...} content, handling nested braces."""
+    idx = text.rfind("\\boxed{")
+    if idx == -1:
+        idx = text.rfind("\\fbox{")
+        if idx == -1:
+            return None
+        start = idx + len("\\fbox{")
+    else:
+        start = idx + len("\\boxed{")
+    depth = 1
+    out = []
+    for ch in text[start:]:
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                return "".join(out)
+        out.append(ch)
+    return None
+
+
+def extract_answer(text: str) -> Optional[str]:
+    boxed = extract_boxed(text)
+    if boxed is not None:
+        return boxed
+    # "The answer is X" fallback (reference parser has the same heuristic).
+    m = re.findall(
+        r"(?:answer is|answer:)\s*([^\n\.,]+)", text, flags=re.IGNORECASE
+    )
+    if m:
+        return m[-1].strip()
+    return None
+
+
+_STRIP_PATTERNS = [
+    (re.compile(r"\\left|\\right"), ""),
+    (re.compile(r"\\,|\\;|\\!|\\ |\s+"), ""),
+    (re.compile(r"\\text\{[^}]*\}"), ""),
+    (re.compile(r"\\mathrm\{[^}]*\}"), ""),
+    (re.compile(r"^\$+|\$+$"), ""),
+    (re.compile(r"\\%|%"), ""),
+    (re.compile(r"^\{(.*)\}$"), r"\1"),
+]
+
+
+def normalize(ans: str) -> str:
+    s = ans.strip()
+    for pat, rep in _STRIP_PATTERNS:
+        s = pat.sub(rep, s)
+    s = s.rstrip(".")
+    # \frac{a}{b} -> a/b
+    s = re.sub(r"\\d?frac\{([^{}]+)\}\{([^{}]+)\}", r"\1/\2", s)
+    s = re.sub(r"\\d?frac(\d)(\d)", r"\1/\2", s)
+    return s
+
+
+def _as_number(s: str) -> Optional[Fraction]:
+    s = s.replace(",", "")
+    try:
+        return Fraction(s)
+    except (ValueError, ZeroDivisionError):
+        pass
+    try:
+        return Fraction(float(s)).limit_denominator(10**9)
+    except (ValueError, OverflowError):
+        return None
+
+
+def answers_match(pred: str, gold: str) -> bool:
+    p, g = normalize(pred), normalize(gold)
+    if p == g:
+        return True
+    pn, gn = _as_number(p), _as_number(g)
+    if pn is not None and gn is not None:
+        return pn == gn
+    return False
+
+
+def verify_math(generated_text: str, solutions: List[str]) -> bool:
+    """True iff the generated answer matches any gold solution (each gold
+    may itself be a \\boxed{...} wrapper or a raw answer)."""
+    pred = extract_answer(generated_text)
+    if pred is None:
+        return False
+    for sol in solutions:
+        gold = extract_boxed(sol)
+        if gold is None:
+            gold = sol
+        if answers_match(pred, gold):
+            return True
+    return False

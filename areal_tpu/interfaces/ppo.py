@@ -1,0 +1,471 @@
+"""PPO / GRPO actor+critic algorithm interfaces.
+
+Capability parity: realhf/impl/model/interface/ppo_interface.py
+(`PPOActorInterface` :234-723, `PPOCriticInterface` :873) and
+utils/ppo_functional.py (clipped losses, `get_packed_rewards`, KL control):
+
+- generate: group sampling via the GeneratorEngine
+- inference: recompute token logprobs (actor) / values (critic)
+- train_step: KL rewards + terminal reward -> GAE (associative-scan kernel)
+  or GRPO group-normalized advantages (`disable_value`), advantage
+  normalization (global or per-group), minibatched clipped-PPO updates.
+
+Alignment convention (established by the generator): every per-token key is
+full-sequence-length aligned with packed_input_ids; index t carries the
+quantity for predicting token t+1 (entries at t = L-1 are unused).
+"""
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model_api import (
+    GenerationHyperparameters,
+    Model,
+    ModelInterface,
+    register_interface,
+)
+from areal_tpu.base import logging
+from areal_tpu.ops import functional as F
+from areal_tpu.ops.gae import gae_packed
+
+logger = logging.getLogger("ppo")
+
+
+# ---------------- jit loss fns (module-level: stable cache keys) ----------------
+
+
+def _ppo_actor_loss_factory(eps_clip: float):
+    def loss_fn(logits, batch):
+        new_logp = F.next_token_logprobs(
+            logits, batch["tokens"], batch["segment_ids"]
+        )
+        mask = batch["loss_mask"] > 0
+        old_logp = batch["old_logp"]
+        adv = batch["advantages"]
+        ratio = jnp.exp(jnp.where(mask, new_logp - old_logp, 0.0))
+        clipped = jnp.clip(ratio, 1.0 - eps_clip, 1.0 + eps_clip)
+        pg = -jnp.minimum(ratio * adv, clipped * adv)
+        loss = jnp.where(mask, pg, 0.0).sum()
+        n_clipped = (
+            jnp.where(mask, (ratio * adv > clipped * adv), False)
+        ).sum()
+        approx_kl = jnp.where(mask, old_logp - new_logp, 0.0).sum()
+        return loss, {
+            "actor_loss_sum": loss,
+            "importance_weight_sum": jnp.where(mask, ratio, 0.0).sum(),
+            "clip_ratio_sum": n_clipped.astype(jnp.float32),
+            "approx_kl_sum": approx_kl,
+        }
+
+    return loss_fn
+
+
+def _ppo_critic_loss_factory(value_eps_clip: float):
+    def loss_fn(values, batch):
+        # `values` comes from the critic head: [B, S] fp32.
+        mask = batch["loss_mask"] > 0
+        old_v = batch["old_values"]
+        ret = batch["returns"]
+        v_clip = old_v + jnp.clip(
+            values - old_v, -value_eps_clip, value_eps_clip
+        )
+        l1 = jnp.square(values - ret)
+        l2 = jnp.square(v_clip - ret)
+        loss = 0.5 * jnp.where(mask, jnp.maximum(l1, l2), 0.0).sum()
+        return loss, {
+            "value_loss_sum": loss,
+            "value_clip_ratio_sum": jnp.where(mask, l2 > l1, False)
+            .sum()
+            .astype(jnp.float32),
+        }
+
+    return loss_fn
+
+
+def _logprob_post(logits, batch):
+    return F.next_token_logprobs(logits, batch["tokens"], batch["segment_ids"])
+
+
+def _value_post(values, batch):
+    return jnp.where(batch["segment_ids"] > 0, values, 0.0)
+
+
+def _mask_count(arrays) -> float:
+    return float((arrays["loss_mask"] > 0).sum())
+
+
+# ---------------- shared host-side plumbing ----------------
+
+
+def _extract_layout(sample: SequenceSample):
+    """Per-sequence (start, L, prompt_len, group_idx) from the packed batch."""
+    lens = sample.seqlens_of("packed_input_ids")
+    bounds = sample.cu_seqlens("packed_input_ids")
+    pmask = np.asarray(sample.data["prompt_mask"])
+    layout = []
+    for i, L in enumerate(lens):
+        s = bounds[i]
+        pl = int(pmask[s : s + L].sum())
+        layout.append((int(s), int(L), pl))
+    # group index per sequence (batch element owning it)
+    group_of = []
+    for gi, group in enumerate(sample.seqlens["packed_input_ids"]):
+        group_of += [gi] * len(group)
+    return layout, group_of
+
+
+def _seq_align_minus1(sample: SequenceSample, key: str) -> np.ndarray:
+    """Re-align a (L-1)-per-seq key to full length L (trailing zero)."""
+    src = np.asarray(sample.data[key])
+    sb = sample.cu_seqlens(key)
+    lens = sample.seqlens_of("packed_input_ids")
+    out = np.zeros(sum(lens), np.float32)
+    off = 0
+    for i, L in enumerate(lens):
+        seg = src[sb[i] : sb[i + 1]]
+        out[off : off + len(seg)] = seg
+        off += L
+    return out
+
+
+def _add_aligned_keys(sample: SequenceSample, arrays: Dict[str, np.ndarray]):
+    seqlens = [list(s) for s in sample.seqlens["packed_input_ids"]]
+    add = SequenceSample(
+        keys=set(arrays.keys()),
+        ids=list(sample.ids),
+        seqlens={k: [list(s) for s in seqlens] for k in arrays},
+        data=dict(arrays),
+    )
+    sample.update_(add)
+
+
+@dataclasses.dataclass
+class PPOActorInterface(ModelInterface):
+    """Reference defaults follow blog/AReaL_v0_2.md:85-103."""
+
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters
+    )
+    n_minibatches: int = 4
+    eps_clip: float = 0.2
+    kl_ctl: float = 0.0
+    discount: float = 1.0
+    gae_lambda: float = 1.0
+    max_reward_clip: float = 5.0
+    reward_scaling: float = 1.0
+    reward_bias: float = 0.0
+    disable_value: bool = False  # GRPO mode
+    adv_norm: bool = True
+    group_adv_norm: bool = False
+    mask_no_eos_with_zero: bool = False
+
+    def generate(
+        self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        return model.engine.generate(
+            sample, mb_spec, self.gconfig, prompt_key="packed_prompts",
+            seed=model.version,
+        )
+
+    def inference(
+        self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        out = model.engine.forward(
+            sample, mb_spec, post_fn=_logprob_post, output_key="logprobs",
+            token_key="packed_input_ids",
+        )
+        return out
+
+    def train_step(
+        self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        layout, group_of = _extract_layout(sample)
+        total = sum(L for (_, L, _) in layout)
+        tokens_np = np.asarray(sample.data["packed_input_ids"])
+
+        # --- behavior logprobs, ref logprobs, values: full-length aligned
+        old_logp = _seq_align_minus1(sample, "packed_logprobs")
+        ref_logp = (
+            _seq_align_minus1(sample, "packed_ref_logprobs")
+            if "packed_ref_logprobs" in sample.keys
+            else None
+        )
+        values = (
+            np.asarray(sample.data["values"], np.float32)
+            if "values" in sample.keys
+            else np.zeros(total, np.float32)
+        )
+        scores = np.asarray(sample.data["rewards"], np.float32).copy()
+        scores = np.clip(
+            (scores + self.reward_bias) * self.reward_scaling,
+            -self.max_reward_clip,
+            self.max_reward_clip,
+        )
+        no_eos = np.asarray(sample.data["seq_no_eos_mask"], np.float32)
+        if self.mask_no_eos_with_zero:
+            scores = scores * (1.0 - no_eos)
+
+        # --- per-token rewards on predict positions t in [pl-1, L-2]
+        rewards = np.zeros(total, np.float32)
+        loss_mask = np.zeros(total, np.float32)
+        adv_full = np.zeros(total, np.float32)
+        if ref_logp is not None and self.kl_ctl != 0.0:
+            rewards -= self.kl_ctl * (old_logp - ref_logp)
+
+        seq_slices = []
+        for si, (s, L, pl) in enumerate(layout):
+            lo, hi = s + max(pl - 1, 0), s + L - 1  # predict positions
+            loss_mask[lo:hi] = 1.0
+            rewards[hi - 1] += scores[si] if hi > lo else 0.0
+            seq_slices.append((lo, hi))
+        rewards *= loss_mask
+
+        if self.disable_value:
+            # GRPO: group-normalized terminal score broadcast over response.
+            adv_seq = np.zeros(len(layout), np.float32)
+            groups: Dict[int, list] = {}
+            for si in range(len(layout)):
+                groups.setdefault(group_of[si], []).append(si)
+            for gi, sis in groups.items():
+                g_scores = scores[sis]
+                mean = g_scores.mean()
+                std = g_scores.std()
+                adv_seq[sis] = (g_scores - mean) / (std + 1e-5)
+            for si, (lo, hi) in enumerate(seq_slices):
+                adv_full[lo:hi] = adv_seq[si]
+                # KL penalty still contributes per-token if configured.
+            if ref_logp is not None and self.kl_ctl != 0.0:
+                adv_full += -self.kl_ctl * (old_logp - ref_logp) * loss_mask
+        else:
+            # Pack response-only windows for GAE.
+            r_parts, v_parts, seg_parts, boot_parts, lens_resp = (
+                [], [], [], [], []
+            )
+            for si, (lo, hi) in enumerate(seq_slices):
+                n = hi - lo
+                if n == 0:
+                    lens_resp.append(0)
+                    continue
+                r_parts.append(rewards[lo:hi])
+                v_parts.append(values[lo:hi])
+                seg_parts.append(np.full(n, si + 1, np.int32))
+                b = np.zeros(n, np.float32)
+                _, L, _ = layout[si]
+                b[-1] = no_eos[si] * values[layout[si][0] + L - 1]
+                boot_parts.append(b)
+                lens_resp.append(n)
+            if r_parts:
+                r1 = np.concatenate(r_parts)
+                adv1, ret1 = gae_packed(
+                    jnp.asarray(r1),
+                    jnp.asarray(np.concatenate(v_parts)),
+                    jnp.asarray(np.concatenate(seg_parts)),
+                    jnp.asarray(np.concatenate(boot_parts)),
+                    self.discount,
+                    self.gae_lambda,
+                )
+                adv1 = np.asarray(adv1)
+                off = 0
+                for si, (lo, hi) in enumerate(seq_slices):
+                    n = hi - lo
+                    adv_full[lo:hi] = adv1[off : off + n]
+                    off += n
+
+        if self.adv_norm:
+            m = loss_mask > 0
+            if self.group_adv_norm and not self.disable_value:
+                for gi in set(group_of):
+                    gm = np.zeros_like(m)
+                    for si, (lo, hi) in enumerate(seq_slices):
+                        if group_of[si] == gi:
+                            gm[lo:hi] = m[lo:hi]
+                    if gm.any():
+                        vals = adv_full[gm]
+                        adv_full[gm] = (vals - vals.mean()) / (
+                            vals.std() + 1e-5
+                        )
+            elif m.any():
+                vals = adv_full[m]
+                adv_full[m] = (vals - vals.mean()) / (vals.std() + 1e-5)
+
+        train_sample = sample.select_keys(
+            {"packed_input_ids", "prompt_mask"}
+        )
+        _add_aligned_keys(
+            train_sample,
+            {
+                "old_logp": old_logp,
+                "advantages": adv_full,
+                "loss_mask": loss_mask,
+            },
+        )
+
+        loss_fn = self._get_loss_fn()
+        all_stats = []
+        for mb in train_sample.split_balanced(
+            min(self.n_minibatches, train_sample.bs)
+        ):
+            stats = model.engine.train_batch(
+                mb,
+                mb_spec,
+                loss_fn=loss_fn,
+                loss_weight_fn=_mask_count,
+                token_key="packed_input_ids",
+                extra_keys=("old_logp", "advantages", "loss_mask"),
+                version_steps=model.version,
+            )
+            all_stats.append(stats)
+        model.inc_version()
+
+        out = {
+            k: float(np.mean([s[k] for s in all_stats]))
+            for k in all_stats[0]
+        }
+        out.update(
+            task_reward=float(scores.mean()),
+            no_eos_ratio=float(no_eos.mean()),
+            advantage_abs=float(np.abs(adv_full[loss_mask > 0]).mean())
+            if (loss_mask > 0).any()
+            else 0.0,
+            n_response_tokens=float(loss_mask.sum()),
+        )
+        return out
+
+    _loss_fn_cache = None
+
+    def _get_loss_fn(self):
+        if self._loss_fn_cache is None:
+            object.__setattr__(
+                self, "_loss_fn_cache", _ppo_actor_loss_factory(self.eps_clip)
+            )
+        return self._loss_fn_cache
+
+    def save(self, model: Model, save_dir: str) -> None:
+        from areal_tpu.interfaces.sft import SFTInterface
+
+        SFTInterface().save(model, save_dir)
+
+
+@dataclasses.dataclass
+class PPOCriticInterface(ModelInterface):
+    n_minibatches: int = 4
+    value_eps_clip: float = 0.2
+    discount: float = 1.0
+    gae_lambda: float = 1.0
+    max_reward_clip: float = 5.0
+    kl_ctl: float = 0.0
+
+    def inference(
+        self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        return model.engine.forward(
+            sample, mb_spec, post_fn=_value_post, output_key="values",
+            token_key="packed_input_ids",
+        )
+
+    def train_step(
+        self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        layout, _ = _extract_layout(sample)
+        total = sum(L for (_, L, _) in layout)
+        old_logp = _seq_align_minus1(sample, "packed_logprobs")
+        ref_logp = (
+            _seq_align_minus1(sample, "packed_ref_logprobs")
+            if "packed_ref_logprobs" in sample.keys
+            else None
+        )
+        values = np.asarray(sample.data["values"], np.float32)
+        scores = np.clip(
+            np.asarray(sample.data["rewards"], np.float32),
+            -self.max_reward_clip,
+            self.max_reward_clip,
+        )
+        no_eos = np.asarray(sample.data["seq_no_eos_mask"], np.float32)
+
+        rewards = np.zeros(total, np.float32)
+        loss_mask = np.zeros(total, np.float32)
+        returns_full = np.zeros(total, np.float32)
+        if ref_logp is not None and self.kl_ctl != 0.0:
+            rewards -= self.kl_ctl * (old_logp - ref_logp)
+        seq_slices = []
+        for si, (s, L, pl) in enumerate(layout):
+            lo, hi = s + max(pl - 1, 0), s + L - 1
+            loss_mask[lo:hi] = 1.0
+            if hi > lo:
+                rewards[hi - 1] += scores[si]
+            seq_slices.append((lo, hi))
+        rewards *= loss_mask
+
+        r_parts, v_parts, seg_parts, boot_parts = [], [], [], []
+        for si, (lo, hi) in enumerate(seq_slices):
+            n = hi - lo
+            if n == 0:
+                continue
+            r_parts.append(rewards[lo:hi])
+            v_parts.append(values[lo:hi])
+            seg_parts.append(np.full(n, si + 1, np.int32))
+            b = np.zeros(n, np.float32)
+            b[-1] = no_eos[si] * values[layout[si][0] + layout[si][1] - 1]
+            boot_parts.append(b)
+        if r_parts:
+            _, ret1 = gae_packed(
+                jnp.asarray(np.concatenate(r_parts)),
+                jnp.asarray(np.concatenate(v_parts)),
+                jnp.asarray(np.concatenate(seg_parts)),
+                jnp.asarray(np.concatenate(boot_parts)),
+                self.discount,
+                self.gae_lambda,
+            )
+            ret1 = np.asarray(ret1)
+            off = 0
+            for (lo, hi) in seq_slices:
+                returns_full[lo:hi] = ret1[off : off + (hi - lo)]
+                off += hi - lo
+
+        train_sample = sample.select_keys({"packed_input_ids", "prompt_mask"})
+        _add_aligned_keys(
+            train_sample,
+            {
+                "old_values": values,
+                "returns": returns_full,
+                "loss_mask": loss_mask,
+            },
+        )
+        loss_fn = self._get_loss_fn()
+        all_stats = []
+        for mb in train_sample.split_balanced(
+            min(self.n_minibatches, train_sample.bs)
+        ):
+            stats = model.engine.train_batch(
+                mb,
+                mb_spec,
+                loss_fn=loss_fn,
+                loss_weight_fn=_mask_count,
+                token_key="packed_input_ids",
+                extra_keys=("old_values", "returns", "loss_mask"),
+                version_steps=model.version,
+            )
+            all_stats.append(stats)
+        model.inc_version()
+        return {
+            k: float(np.mean([s[k] for s in all_stats])) for k in all_stats[0]
+        }
+
+    _loss_fn_cache = None
+
+    def _get_loss_fn(self):
+        if self._loss_fn_cache is None:
+            object.__setattr__(
+                self,
+                "_loss_fn_cache",
+                _ppo_critic_loss_factory(self.value_eps_clip),
+            )
+        return self._loss_fn_cache
+
+
+register_interface("ppo_actor", PPOActorInterface)
+register_interface("ppo_critic", PPOCriticInterface)

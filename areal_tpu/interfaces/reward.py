@@ -1,0 +1,135 @@
+"""Multi-task reward interface: verification-based rewards for math & code.
+
+Capability parity: realhf/impl/model/interface/math_rw_interface.py
+(`MultiTaskRewardInterface`, registered "rw-math-code") + the local
+verification paths of realhf/functioncall/.  Dispatches each sequence by its
+task metadata, decodes the response, verifies, and emits ±`reward_value`
+scores (one scalar per sequence, the reference's reward layout).
+"""
+
+import dataclasses
+import json
+import subprocess
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.base import logging
+from areal_tpu.api.model_api import Model, ModelInterface, register_interface
+from areal_tpu.interfaces import math_verify
+
+logger = logging.getLogger("reward")
+
+
+@dataclasses.dataclass
+class MultiTaskRewardInterface(ModelInterface):
+    """id2info maps query_id -> row dict with task/solutions/input_output
+    (loaded from the dataset jsonl, reference math_code_dataset.load_metadata)."""
+
+    id2info: Dict[str, Dict[str, Any]] = dataclasses.field(default_factory=dict)
+    dataset_path: Optional[str] = None
+    reward_value: float = 5.0
+    code_timeout_s: float = 8.0
+
+    def __post_init__(self):
+        if self.dataset_path and not self.id2info:
+            with open(self.dataset_path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    row = json.loads(line)
+                    row.setdefault("task", "math")
+                    self.id2info[str(row.get("query_id", row.get("id")))] = row
+
+    def inference(
+        self, model: Optional[Model], sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        """Scores every sequence; returns key 'rewards' (1 scalar/seq).
+
+        `model` supplies the tokenizer; no forward pass happens (the
+        reference's rw interface also runs verification, not a model)."""
+        tokenizer = model.tokenizer if model is not None else None
+        assert tokenizer is not None, "reward interface needs a tokenizer"
+        tokens = np.asarray(sample.data["packed_input_ids"])
+        pmask = np.asarray(sample.data["prompt_mask"])
+        bounds = sample.cu_seqlens("packed_input_ids")
+        rewards: List[float] = []
+        seqlens_r: List[List[int]] = []
+        si = 0
+        n_correct = 0
+        for ei, group in enumerate(sample.seqlens["packed_input_ids"]):
+            qid = str(sample.ids[ei])
+            info = self.id2info.get(qid, {})
+            task = info.get("task", "math")
+            seqlens_r.append([1] * len(group))
+            for _ in group:
+                lo, hi = bounds[si], bounds[si + 1]
+                resp_tokens = tokens[lo:hi][~pmask[lo:hi].astype(bool)]
+                text = tokenizer.decode(resp_tokens.tolist())
+                ok = self._verify(task, text, info)
+                n_correct += int(ok)
+                rewards.append(self.reward_value if ok else -self.reward_value)
+                si += 1
+        logger.info(
+            f"reward verification: {n_correct}/{len(rewards)} correct"
+        )
+        return SequenceSample(
+            keys={"rewards"},
+            ids=list(sample.ids),
+            seqlens={"rewards": seqlens_r},
+            data={"rewards": np.asarray(rewards, np.float32)},
+            metadata={},
+        )
+
+    def _verify(self, task: str, text: str, info: Dict[str, Any]) -> bool:
+        if task == "math":
+            return math_verify.verify_math(text, info.get("solutions", []))
+        elif task == "code":
+            return self._verify_code(text, info)
+        logger.warning(f"unknown task {task!r}; reward 0")
+        return False
+
+    # -- code verification: run extracted program against input/output pairs
+    # in a subprocess with a timeout (reference: functioncall/code/local_verify)
+    def _verify_code(self, text: str, info: Dict[str, Any]) -> bool:
+        m = _extract_code_block(text)
+        if m is None:
+            return False
+        try:
+            io_spec = info.get("input_output")
+            io_spec = json.loads(io_spec) if isinstance(io_spec, str) else io_spec
+            inputs, outputs = io_spec["inputs"], io_spec["outputs"]
+        except (KeyError, TypeError, json.JSONDecodeError):
+            return False
+        with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+            f.write(m)
+            path = f.name
+        for inp, expected in zip(inputs, outputs):
+            try:
+                proc = subprocess.run(
+                    [sys.executable, path],
+                    input=inp,
+                    capture_output=True,
+                    text=True,
+                    timeout=self.code_timeout_s,
+                )
+            except subprocess.TimeoutExpired:
+                return False
+            if proc.returncode != 0:
+                return False
+            if proc.stdout.strip() != expected.strip():
+                return False
+        return True
+
+
+def _extract_code_block(text: str) -> Optional[str]:
+    import re
+
+    blocks = re.findall(r"```(?:python)?\n(.*?)```", text, flags=re.DOTALL)
+    return blocks[-1] if blocks else None
+
+
+register_interface("rw-math-code", MultiTaskRewardInterface)
