@@ -182,12 +182,9 @@ class GeneratorEngine(Engine):
                 jnp.arange(sp)[None, :] < prompt_len[:, None]
             ).astype(jnp.int32)
             cache = tfm.init_kv_cache(cfg, bsz, s_total, dtype=self.compute_dtype)
-            pre_logits, cache = tfm.prefill(params, cfg, prompt_tok, seg, cache)
-            # Logits at the LAST prompt token predict the first response token.
-            last = jnp.maximum(prompt_len - 1, 0)
-            logits0 = jnp.take_along_axis(
-                pre_logits, last[:, None, None], axis=1
-            )[:, 0]
+            # prefill returns logits at each row's last prompt token — the
+            # distribution over the first response token.
+            logits0, cache = tfm.prefill(params, cfg, prompt_tok, seg, cache)
 
             out_toks = jnp.zeros((bsz, max_new), jnp.int32)
             out_logps = jnp.zeros((bsz, max_new), jnp.float32)

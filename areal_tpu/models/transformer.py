@@ -294,7 +294,10 @@ def prefill(
     cache: KVCache,
 ) -> Tuple[jax.Array, KVCache]:
     """Run the prompt through the model, filling cache[:, :, :S] and
-    returning fp32 logits [B, S, V] (caller gathers the last valid one)."""
+    returning fp32 logits [B, V] at each row's LAST VALID position (the
+    distribution over the first generated token).  Computing the head only
+    there keeps prefill memory at [B, V] instead of [B, S, V] — at a 152k
+    vocab that is the difference between 40 MB and 10 GB."""
     positions = positions_from_segments(segment_ids)
     x = jnp.take(params["embed"], tokens, axis=0)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
@@ -310,7 +313,6 @@ def prefill(
         return y, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
-    s = tokens.shape[1]
     new_cache = KVCache(
         k=jax.lax.dynamic_update_slice(
             cache.k, ks.astype(cache.k.dtype), (0, 0, 0, 0, 0)
@@ -320,7 +322,10 @@ def prefill(
         ),
     )
     x = rms_norm(x, params["final_ln"], cfg.rms_norm_eps)
-    return _head(params, cfg, x), new_cache
+    # Gather each row's last valid hidden state before the (huge) head matmul.
+    last = jnp.maximum(jnp.sum(segment_ids > 0, axis=-1) - 1, 0)  # [B]
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)  # [B,1,D]
+    return _head(params, cfg, x_last)[:, 0], new_cache
 
 
 def decode_step(

@@ -1,0 +1,153 @@
+"""End-to-end RL-step throughput benchmark on the local TPU chip.
+
+Runs a miniature PPO iteration — group generation (n=4) with the 0.5B-class
+qwen2 architecture, reward assignment, GRPO actor update — entirely on one
+chip, and reports samples/sec/chip (a sample = one generated response, the
+reference's unit).
+
+Baseline constant: AReaL's published 1.5B "boba" convergence (250 steps of
+512 prompts × 16 responses in ~240 h on 8×H800, README.md:38-43) works out
+to 250*512*16 / (240*3600*8) ≈ 0.30 samples/sec/chip end-to-end.  Different
+model size / sequence lengths, so vs_baseline is an orientation number, not
+a controlled comparison; it becomes apples-to-apples when multi-chip 7B runs
+land in a later round.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_SAMPLES_PER_SEC_CHIP = 0.30
+
+
+def qwen2_0p5b():
+    from areal_tpu.models.config import ModelConfig
+
+    return ModelConfig(
+        n_layers=24, hidden_dim=896, n_q_heads=14, n_kv_heads=2, head_dim=64,
+        intermediate_dim=4864, vocab_size=151936, rope_theta=1000000.0,
+        qkv_bias=True, tied_embeddings=True, param_dtype="bfloat16",
+    )
+
+
+def main():
+    import jax
+
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model_api import (
+        FinetuneSpec,
+        GenerationHyperparameters,
+        Model,
+        OptimizerConfig,
+    )
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.engines.generator import GeneratorEngine
+    from areal_tpu.engines.train import TrainEngine
+    from areal_tpu.interfaces.ppo import PPOActorInterface
+    from areal_tpu.models import transformer as tfm
+
+    mesh = make_mesh(ParallelConfig(), jax.devices()[:1])
+    cfg = qwen2_0p5b()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+
+    class _Tok:
+        eos_token_id = 151643
+        pad_token_id = 151643
+
+        def decode(self, ids, **kw):
+            return ""
+
+    tok = _Tok()
+    gen_engine = GeneratorEngine(
+        cfg, params, mesh, eos_token_id=tok.eos_token_id, max_decode_batch=32
+    )
+    train_engine = TrainEngine(
+        cfg,
+        params,
+        mesh,
+        optimizer_config=OptimizerConfig(lr=2e-5, warmup_steps_proportion=0.0),
+        ftspec=FinetuneSpec(1, 64, 64),
+    )
+    actor = Model("actor", engine=train_engine, tokenizer=tok, config=cfg)
+    gen = Model("actor_gen", engine=gen_engine, tokenizer=tok, config=cfg)
+
+    n_prompts, group, prompt_len, max_new = 8, 4, 128, 256
+    rng = np.random.default_rng(0)
+    prompts = SequenceSample(
+        keys={"packed_prompts"},
+        ids=[f"p{i}" for i in range(n_prompts)],
+        seqlens={"packed_prompts": [[prompt_len]] * n_prompts},
+        data={
+            "packed_prompts": rng.integers(
+                0, cfg.vocab_size, size=n_prompts * prompt_len
+            ).astype(np.int32)
+        },
+    )
+    g = GenerationHyperparameters(
+        n=group, max_new_tokens=max_new, temperature=1.0, top_p=1.0
+    )
+    actor_if = PPOActorInterface(
+        gconfig=g, n_minibatches=2, disable_value=True, kl_ctl=0.0,
+        adv_norm=True,
+    )
+    mb = MicroBatchSpec(max_tokens_per_mb=4096)
+
+    def one_step(seed):
+        rollout = actor_if.generate(gen, prompts, mb)
+        scores = rng.choice([-5.0, 5.0], size=n_prompts * group).astype(
+            np.float32
+        )
+        rollout.update_(
+            SequenceSample(
+                keys={"rewards"},
+                ids=list(rollout.ids),
+                seqlens={"rewards": [[1] * group] * n_prompts},
+                data={"rewards": scores},
+            )
+        )
+        stats = actor_if.train_step(actor, rollout, mb)
+        # Weight sync train -> generator (colocated hot-swap).
+        gen_engine.set_params(train_engine.get_params())
+        return rollout, stats
+
+    # Warmup (compiles).
+    t0 = time.time()
+    one_step(0)
+    warmup_s = time.time() - t0
+
+    n_iters = 3
+    t0 = time.time()
+    total_samples = 0
+    total_gen_tokens = 0
+    for i in range(n_iters):
+        rollout, stats = one_step(i + 1)
+        total_samples += n_prompts * group
+        total_gen_tokens += int(
+            sum(sample_len for row in rollout.seqlens["packed_input_ids"] for sample_len in row)
+        ) - n_prompts * group * prompt_len
+    dt = time.time() - t0
+
+    samples_per_sec = total_samples / dt
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_samples_per_sec_chip_0.5b",
+                "value": round(samples_per_sec, 4),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(
+                    samples_per_sec / BASELINE_SAMPLES_PER_SEC_CHIP, 3
+                ),
+                "gen_tokens_per_sec": round(total_gen_tokens / dt, 1),
+                "step_seconds": round(dt / n_iters, 2),
+                "warmup_seconds": round(warmup_s, 1),
+                "config": "qwen2-0.5B bf16, 8 prompts x4 group, 128 prompt + <=256 new tokens, GRPO",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

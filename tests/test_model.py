@@ -120,8 +120,10 @@ class TestDecode:
             tiny_params, tiny, tokens[:, :prompt_len],
             jnp.ones((b, prompt_len), jnp.int32), cache
         )
+        # Prefill returns last-position logits only.
         np.testing.assert_allclose(
-            np.asarray(pre_logits), np.asarray(full_logits[:, :prompt_len]),
+            np.asarray(pre_logits),
+            np.asarray(full_logits[:, prompt_len - 1]),
             rtol=2e-4, atol=2e-4,
         )
         for t in range(prompt_len, total):
