@@ -1,0 +1,48 @@
+"""Core abstractions: names, factory specs, interface types.
+
+Capability parity: realhf/api/core/config.py — `ModelName(role, replica_id)`,
+`ModelInterfaceType`, string-keyed factory abstractions, `ModelShardID`.
+"""
+
+import dataclasses
+import enum
+from typing import Any, Dict
+
+
+class ModelInterfaceType(enum.Enum):
+    GENERATE = "generate"
+    INFERENCE = "inference"
+    TRAIN_STEP = "train_step"
+    EVALUATE = "evaluate"
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class ModelName:
+    role: str
+    replica_id: int = 0
+
+    def __str__(self):
+        return f"{self.role}@{self.replica_id}"
+
+
+@dataclasses.dataclass
+class ModelInterfaceAbstraction:
+    type_: str
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModelBackendAbstraction:
+    """Which engine to build for a model: 'train', 'inference', 'generator',
+    or 'mock' (reference backends: megatron/sglang/vllm/inference/mock)."""
+
+    type_: str
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModelAbstraction:
+    """How to build the model params: 'hf' (checkpoint dir) or 'random'."""
+
+    type_: str
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)

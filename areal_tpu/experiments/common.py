@@ -1,0 +1,348 @@
+"""Experiment builders: user config -> (DFG, workers, placement).
+
+Capability parity: realhf/experiments/common/ — `CommonExperimentConfig`
+(allocation parsing, worker-config mapping), `sft_exp.py`, `ppo_math_exp.py`
+(the north-star PPO dataflow with generation, reward, ref, critic and the
+param-sync hooks wired automatically, reference utils.py resolve_rpc_hooks).
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.api.config import (
+    ModelAbstraction,
+    ModelBackendAbstraction,
+    ModelInterfaceAbstraction,
+    ModelInterfaceType,
+    ModelName,
+)
+from areal_tpu.api.data_api import DatasetAbstraction, MicroBatchSpec
+from areal_tpu.api.dfg import DFG, MFCDef, ParamReallocHook, build_graph
+from areal_tpu.api.model_api import FinetuneSpec, GenerationHyperparameters, OptimizerConfig
+from areal_tpu.base.topology import ParallelConfig
+from areal_tpu.system.master import ExperimentSaveEvalControl
+from areal_tpu.system.worker import ModelShardSpec, WorkerConfig
+
+# Ensure built-in interfaces are registered.
+import areal_tpu.interfaces.sft  # noqa: F401
+import areal_tpu.interfaces.ppo  # noqa: F401
+import areal_tpu.interfaces.reward  # noqa: F401
+
+
+@dataclasses.dataclass
+class ExperimentPlan:
+    """Everything the runtime needs to execute a trial."""
+
+    dfg: DFG
+    worker_configs: List[WorkerConfig]
+    model_placement: Dict[str, int]
+    data_worker_ids: List[int]
+    ctrl: ExperimentSaveEvalControl
+    experiment_name: str = "exp"
+    trial_name: str = "trial"
+    fileroot: str = "/tmp/areal_tpu/trial"
+
+
+@dataclasses.dataclass
+class SFTConfig:
+    model: ModelAbstraction
+    dataset: DatasetAbstraction
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    batch_size: int = 8
+    total_train_epochs: int = 1
+    mb_spec: MicroBatchSpec = dataclasses.field(default_factory=MicroBatchSpec)
+    ctrl: ExperimentSaveEvalControl = dataclasses.field(
+        default_factory=ExperimentSaveEvalControl
+    )
+    seed: int = 1
+    experiment_name: str = "sft"
+    trial_name: str = "trial"
+    fileroot: str = "/tmp/areal_tpu/trial"
+
+
+def build_sft(cfg: SFTConfig, tokenizer=None) -> ExperimentPlan:
+    model_name = ModelName("default", 0)
+    node = MFCDef(
+        name="trainDefault",
+        model_name=model_name,
+        interface_type=ModelInterfaceType.TRAIN_STEP,
+        interface_impl=ModelInterfaceAbstraction("sft"),
+        input_keys=("packed_input_ids", "prompt_mask"),
+        n_seqs=cfg.batch_size,
+        mb_spec=cfg.mb_spec,
+    )
+    dfg = build_graph([node])
+    shard = ModelShardSpec(
+        name=model_name,
+        model=cfg.model,
+        backend=ModelBackendAbstraction("train"),
+        interface=ModelInterfaceAbstraction("sft"),
+        parallel=cfg.parallel,
+        optimizer=cfg.optimizer,
+    )
+    worker = WorkerConfig(
+        worker_index=0,
+        shards=[shard],
+        datasets=[cfg.dataset],
+        batch_size=cfg.batch_size,
+        seed=cfg.seed,
+        ftspec=FinetuneSpec(
+            total_train_epochs=cfg.total_train_epochs,
+            train_batch_size=cfg.batch_size,
+        ),
+    )
+    cfg.ctrl.total_train_epochs = cfg.total_train_epochs
+    return ExperimentPlan(
+        dfg=dfg,
+        worker_configs=[worker],
+        model_placement={str(model_name): 0},
+        data_worker_ids=[0],
+        ctrl=cfg.ctrl,
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        fileroot=cfg.fileroot,
+    )
+
+
+@dataclasses.dataclass
+class PPOMathConfig:
+    actor: ModelAbstraction
+    dataset: DatasetAbstraction
+    # None -> GRPO (disable_value), matching the reference's disable_value.
+    critic: Optional[ModelAbstraction] = None
+    ref: Optional[ModelAbstraction] = None
+    reward_interface_args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    actor_parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    gen_parallel: Optional[ParallelConfig] = None  # None = same as actor
+    critic_parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    optimizer: OptimizerConfig = dataclasses.field(
+        default_factory=lambda: OptimizerConfig(lr=2e-5)
+    )
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters
+    )
+    ppo_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    batch_size: int = 8  # prompts per step
+    total_train_epochs: int = 1
+    mb_spec: MicroBatchSpec = dataclasses.field(default_factory=MicroBatchSpec)
+    ctrl: ExperimentSaveEvalControl = dataclasses.field(
+        default_factory=ExperimentSaveEvalControl
+    )
+    seed: int = 1
+    experiment_name: str = "ppo-math"
+    trial_name: str = "trial"
+    fileroot: str = "/tmp/areal_tpu/trial"
+
+
+def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
+    """The reference's ppo-math DFG (ppo_math_exp.py:335): generate ->
+    {reward, ref, critic-inf} -> actor/critic train, with a weight-sync
+    pre-hook on generation (train -> generator hot-swap)."""
+    disable_value = cfg.critic is None
+    actor = ModelName("actor", 0)
+    actor_gen = ModelName("actor_gen", 0)
+    reward = ModelName("reward", 0)
+    ref = ModelName("ref", 0) if cfg.ref is not None else None
+    critic = ModelName("critic", 0) if not disable_value else None
+
+    ppo_kwargs = dict(cfg.ppo_kwargs)
+    ppo_kwargs.setdefault("disable_value", disable_value)
+    actor_if = ModelInterfaceAbstraction(
+        "ppo_actor", {"gconfig": cfg.gconfig, **ppo_kwargs}
+    )
+    nodes = [
+        MFCDef(
+            name="actor_gen",
+            model_name=actor_gen,
+            interface_type=ModelInterfaceType.GENERATE,
+            interface_impl=actor_if,
+            input_keys=("packed_prompts",),
+            output_keys=(
+                "packed_input_ids", "packed_logprobs", "prompt_mask",
+                "seq_no_eos_mask",
+            ),
+            n_seqs=cfg.batch_size,
+            mb_spec=cfg.mb_spec,
+            pre_hooks=[],
+        ),
+        MFCDef(
+            name="rew_inf",
+            model_name=reward,
+            interface_type=ModelInterfaceType.INFERENCE,
+            interface_impl=ModelInterfaceAbstraction(
+                "rw-math-code", cfg.reward_interface_args
+            ),
+            input_keys=("packed_input_ids", "prompt_mask"),
+            output_keys=("rewards",),
+            n_seqs=cfg.batch_size,
+            mb_spec=cfg.mb_spec,
+        ),
+    ]
+    train_inputs = [
+        "packed_input_ids", "prompt_mask", "packed_logprobs",
+        "seq_no_eos_mask", "rewards",
+    ]
+    if ref is not None:
+        nodes.append(
+            MFCDef(
+                name="ref_inf",
+                model_name=ref,
+                interface_type=ModelInterfaceType.INFERENCE,
+                interface_impl=ModelInterfaceAbstraction("ppo_actor"),
+                input_keys=("packed_input_ids",),
+                output_keys=("packed_ref_logprobs",),
+                output_key_remap={"logprobs": "packed_ref_logprobs"},
+                n_seqs=cfg.batch_size,
+                mb_spec=cfg.mb_spec,
+            )
+        )
+        train_inputs.append("packed_ref_logprobs")
+    if critic is not None:
+        nodes.append(
+            MFCDef(
+                name="critic_inf",
+                model_name=critic,
+                interface_type=ModelInterfaceType.INFERENCE,
+                interface_impl=ModelInterfaceAbstraction("ppo_critic"),
+                input_keys=("packed_input_ids", "prompt_mask"),
+                output_keys=("values",),
+                n_seqs=cfg.batch_size,
+                mb_spec=cfg.mb_spec,
+            )
+        )
+        train_inputs.append("values")
+    nodes.append(
+        MFCDef(
+            name="actor_train",
+            model_name=actor,
+            interface_type=ModelInterfaceType.TRAIN_STEP,
+            interface_impl=actor_if,
+            input_keys=tuple(train_inputs),
+            n_seqs=cfg.batch_size,
+            mb_spec=cfg.mb_spec,
+            # After training, push fresh weights into the generator
+            # (reference: param_realloc post-hook / update_weights_from_disk).
+            post_hooks=[ParamReallocHook(target=actor_gen)],
+        )
+    )
+    if critic is not None:
+        nodes.append(
+            MFCDef(
+                name="critic_train",
+                model_name=critic,
+                interface_type=ModelInterfaceType.TRAIN_STEP,
+                interface_impl=ModelInterfaceAbstraction(
+                    "ppo_critic",
+                    {
+                        k: v
+                        for k, v in ppo_kwargs.items()
+                        if k in ("n_minibatches", "kl_ctl")
+                    },
+                ),
+                input_keys=(
+                    "packed_input_ids", "prompt_mask", "packed_logprobs",
+                    "seq_no_eos_mask", "rewards", "values",
+                ),
+                n_seqs=cfg.batch_size,
+                mb_spec=cfg.mb_spec,
+            )
+        )
+    dfg = build_graph(nodes)
+
+    ftspec = FinetuneSpec(
+        total_train_epochs=cfg.total_train_epochs,
+        train_batch_size=cfg.batch_size,
+    )
+    shards = [
+        ModelShardSpec(
+            name=actor,
+            model=cfg.actor,
+            backend=ModelBackendAbstraction("train"),
+            interface=actor_if,
+            parallel=cfg.actor_parallel,
+            optimizer=cfg.optimizer,
+        ),
+        ModelShardSpec(
+            name=actor_gen,
+            model=cfg.actor,
+            backend=ModelBackendAbstraction("generator"),
+            interface=actor_if,
+            parallel=cfg.gen_parallel or cfg.actor_parallel,
+        ),
+        ModelShardSpec(
+            name=reward,
+            model=ModelAbstraction("null"),
+            backend=ModelBackendAbstraction("null"),
+            interface=ModelInterfaceAbstraction(
+                "rw-math-code", cfg.reward_interface_args
+            ),
+        ),
+    ]
+    if ref is not None:
+        shards.append(
+            ModelShardSpec(
+                name=ref,
+                model=cfg.ref,
+                backend=ModelBackendAbstraction("inference"),
+                interface=ModelInterfaceAbstraction("ppo_actor"),
+                parallel=cfg.actor_parallel,
+            )
+        )
+    if critic is not None:
+        shards.append(
+            ModelShardSpec(
+                name=critic,
+                model=cfg.critic,
+                backend=ModelBackendAbstraction("train"),
+                interface=ModelInterfaceAbstraction("ppo_critic"),
+                parallel=cfg.critic_parallel,
+                optimizer=cfg.optimizer,
+            )
+        )
+    worker = WorkerConfig(
+        worker_index=0,
+        shards=shards,
+        datasets=[cfg.dataset],
+        batch_size=cfg.batch_size,
+        seed=cfg.seed,
+        ftspec=ftspec,
+    )
+    cfg.ctrl.total_train_epochs = cfg.total_train_epochs
+    placement = {str(s.name): 0 for s in shards}
+    return ExperimentPlan(
+        dfg=dfg,
+        worker_configs=[worker],
+        model_placement=placement,
+        data_worker_ids=[0],
+        ctrl=cfg.ctrl,
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        fileroot=cfg.fileroot,
+    )
+
+
+def run_experiment(plan: ExperimentPlan, tokenizer=None):
+    """In-process runner: build workers, drive the master loop to completion.
+    (The multi-process ZMQ runtime lives in areal_tpu/system/zmq_runtime.py.)
+    """
+    import asyncio
+
+    from areal_tpu.system.master import InProcessPool, MasterWorker
+    from areal_tpu.system.worker import ModelWorker
+
+    workers = [ModelWorker(wc, tokenizer=tokenizer) for wc in plan.worker_configs]
+    pool = InProcessPool(workers)
+    master = MasterWorker(
+        dfg=plan.dfg,
+        pool=pool,
+        model_placement=plan.model_placement,
+        data_worker_ids=plan.data_worker_ids,
+        ctrl=plan.ctrl,
+        fileroot=plan.fileroot,
+        experiment_name=plan.experiment_name,
+        trial_name=plan.trial_name,
+    )
+    master.load_recover_info()
+    stats = asyncio.run(master.run())
+    return master, stats

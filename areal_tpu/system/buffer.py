@@ -1,0 +1,105 @@
+"""Asyncio sequence buffer: the master's data-readiness ledger.
+
+Capability parity: realhf/system/buffer.py (`AsyncIOSequenceBuffer`) — holds
+metadata-only samples; an MFC's coroutine blocks until enough entries carry
+all of its input keys and haven't been consumed by it yet; entries are
+evicted once every registered consumer has used them.
+"""
+
+import asyncio
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set
+
+from areal_tpu.api.data_api import SequenceSample
+from areal_tpu.api.dfg import MFCDef
+from areal_tpu.base import logging
+
+logger = logging.getLogger("buffer")
+
+
+@dataclasses.dataclass
+class _Entry:
+    sample: SequenceSample  # metadata-only, bs == 1
+    consumed_by: Set[str] = dataclasses.field(default_factory=set)
+    birth_step: int = 0
+
+
+class SequenceBuffer:
+    def __init__(self, consumers: Dict[str, Sequence[str]]):
+        """consumers: rpc_name -> its input keys (to know who must consume
+        an entry before eviction)."""
+        self._entries: Dict[str, _Entry] = {}
+        self._consumers = {k: tuple(v) for k, v in consumers.items()}
+        self._cond = asyncio.Condition()
+
+    def __len__(self):
+        return len(self._entries)
+
+    async def put_batch(self, sample: SequenceSample, step: int = 0) -> None:
+        async with self._cond:
+            for one in sample.unpack():
+                (sid,) = one.ids
+                if sid in self._entries:
+                    self._entries[sid].sample.update_(one)
+                else:
+                    self._entries[sid] = _Entry(sample=one, birth_step=step)
+            self._cond.notify_all()
+
+    async def amend_batch(self, sample: SequenceSample) -> None:
+        """Merge new keys produced by an MFC into existing entries."""
+        async with self._cond:
+            for one in sample.unpack():
+                (sid,) = one.ids
+                if sid not in self._entries:
+                    self._entries[sid] = _Entry(sample=one)
+                else:
+                    self._entries[sid].sample.update_(one)
+            self._cond.notify_all()
+
+    def _ready_ids(self, rpc: MFCDef) -> List[str]:
+        need = set(rpc.input_keys)
+        out = []
+        for sid, e in self._entries.items():
+            if rpc.name in e.consumed_by:
+                continue
+            if need <= e.sample.keys:
+                out.append(sid)
+        return out
+
+    async def get_batch_for_rpc(
+        self, rpc: MFCDef, timeout: Optional[float] = None
+    ) -> SequenceSample:
+        """Wait until rpc.n_seqs entries are ready; mark consumed; evict
+        entries every consumer has used.  Returns a gathered metadata
+        sample restricted to rpc.input_keys."""
+
+        async def _wait():
+            async with self._cond:
+                while True:
+                    ready = self._ready_ids(rpc)
+                    if len(ready) >= rpc.n_seqs:
+                        chosen = ready[: rpc.n_seqs]
+                        parts = []
+                        for sid in chosen:
+                            e = self._entries[sid]
+                            e.consumed_by.add(rpc.name)
+                            parts.append(
+                                e.sample.select_keys(
+                                    set(rpc.input_keys) & e.sample.keys
+                                )
+                            )
+                        self._evict()
+                        return SequenceSample.gather(parts)
+                    await self._cond.wait()
+
+        return await asyncio.wait_for(_wait(), timeout)
+
+    def _evict(self):
+        all_rpcs = set(self._consumers.keys())
+        dead = [
+            sid
+            for sid, e in self._entries.items()
+            if all_rpcs and all_rpcs <= e.consumed_by
+        ]
+        for sid in dead:
+            del self._entries[sid]

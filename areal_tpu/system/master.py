@@ -1,0 +1,283 @@
+"""Master worker: drives the DFG, epoch/step accounting, save/eval cadence,
+recover checkpoints.
+
+Capability parity: realhf/system/master_worker.py + function_executor.py —
+per train step, an asyncio gather runs one coroutine per MFC plus a data
+loader; each MFC coroutine blocks on buffer readiness, dispatches the call
+to the worker hosting its model, and amends the buffer with the outputs.
+"""
+
+import asyncio
+import dataclasses
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.api.config import ModelInterfaceType
+from areal_tpu.api.dfg import DFG, MFCDef, ParamReallocHook
+from areal_tpu.base import logging, recover, timeutil
+from areal_tpu.base.stats import merge_stats
+from areal_tpu.system.buffer import SequenceBuffer
+
+logger = logging.getLogger("master")
+
+
+class WorkerPool:
+    """Transport abstraction: request(worker_id, payload) -> response."""
+
+    async def request(self, worker_id: int, payload: Dict[str, Any]) -> Dict:
+        raise NotImplementedError
+
+    @property
+    def n_workers(self) -> int:
+        raise NotImplementedError
+
+
+class InProcessPool(WorkerPool):
+    """All workers live in this process (single-host trials and the
+    reference-style in-process system tests, tests/experiments/utils.py)."""
+
+    def __init__(self, workers):
+        self.workers = list(workers)
+
+    async def request(self, worker_id: int, payload: Dict[str, Any]) -> Dict:
+        return await asyncio.to_thread(
+            self.workers[worker_id].handle_request, payload
+        )
+
+    @property
+    def n_workers(self) -> int:
+        return len(self.workers)
+
+
+@dataclasses.dataclass
+class ExperimentSaveEvalControl:
+    """Reference: cli_args.py:605."""
+
+    total_train_epochs: int = 1
+    save_freq_steps: Optional[int] = None
+    ckpt_freq_steps: Optional[int] = None
+    ckpt_freq_secs: Optional[float] = None
+    eval_freq_steps: Optional[int] = None
+    benchmark_steps: Optional[int] = None  # stop early after N steps
+
+
+class MasterWorker:
+    def __init__(
+        self,
+        dfg: DFG,
+        pool: WorkerPool,
+        model_placement: Dict[str, int],  # model key -> worker id
+        data_worker_ids: List[int],
+        ctrl: ExperimentSaveEvalControl,
+        fileroot: str = "/tmp/areal_tpu/trial",
+        experiment_name: str = "exp",
+        trial_name: str = "trial",
+    ):
+        self.dfg = dfg
+        self.pool = pool
+        self.placement = model_placement
+        self.data_worker_ids = data_worker_ids
+        self.ctrl = ctrl
+        self.fileroot = fileroot
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+
+        self.buffer = SequenceBuffer(
+            consumers={n.name: n.input_keys for n in dfg.nodes}
+        )
+        self.step_info = recover.StepInfo()
+        self.save_ctl = timeutil.FrequencyControl(
+            frequency_steps=ctrl.save_freq_steps
+        )
+        self.ckpt_ctl = timeutil.FrequencyControl(
+            frequency_steps=ctrl.ckpt_freq_steps,
+            frequency_seconds=ctrl.ckpt_freq_secs,
+        )
+        self.eval_ctl = timeutil.FrequencyControl(
+            frequency_steps=ctrl.eval_freq_steps
+        )
+        self.stats_history: List[Dict[str, float]] = []
+        self._steps_per_epoch: Optional[int] = None
+        self._train_rpcs = [
+            n
+            for n in dfg.nodes
+            if n.interface_type == ModelInterfaceType.TRAIN_STEP
+        ]
+
+    # ---------------- lifecycle ----------------
+
+    async def discover_spec(self) -> Dict[str, int]:
+        sizes = await asyncio.gather(
+            *[
+                self.pool.request(w, {"type": "spec"})
+                for w in self.data_worker_ids
+            ]
+        )
+        steps = max(s["steps_per_epoch"] for s in sizes)
+        self._steps_per_epoch = max(steps, 1)
+        return {
+            "dataset_size": sum(s["dataset_size"] for s in sizes),
+            "steps_per_epoch": self._steps_per_epoch,
+        }
+
+    async def run(self) -> List[Dict[str, float]]:
+        """Train until total_train_epochs (or benchmark_steps) complete."""
+        await self.discover_spec()
+        total_steps = self.ctrl.total_train_epochs * self._steps_per_epoch
+        if self.ctrl.benchmark_steps is not None:
+            total_steps = min(total_steps, self.ctrl.benchmark_steps)
+        logger.info(
+            f"master: {total_steps} steps "
+            f"({self.ctrl.total_train_epochs} epochs x {self._steps_per_epoch})"
+        )
+        while self.step_info.global_step < total_steps:
+            t0 = time.monotonic()
+            stats = await self.execute_step()
+            dt = time.monotonic() - t0
+            self.stats_history.append(stats)
+            logger.info(
+                f"step {self.step_info.global_step + 1}/{total_steps} "
+                f"({dt:.2f}s): { {k: round(v, 4) for k, v in stats.items()} }"
+            )
+            self.step_info = self.step_info.next(self._steps_per_epoch)
+            await self._post_step()
+        return self.stats_history
+
+    async def _post_step(self):
+        if self.save_ctl.check():
+            await self.save(kind="persistent")
+        if self.ckpt_ctl.check():
+            await self.save(kind="recover")
+        # (eval hook: evaluation jobs are launched by the AutomaticEvaluator
+        # watching the checkpoint dir; see areal_tpu/scheduler/evaluator.py)
+
+    # ---------------- one step ----------------
+
+    async def execute_step(self) -> Dict[str, float]:
+        coros = [self._load_data()]
+        results: Dict[str, Dict[str, float]] = {}
+        for node in self.dfg.nodes:
+            coros.append(self._run_mfc(node, results))
+        await asyncio.gather(*coros)
+        await self._clear_worker_caches()
+        merged: Dict[str, float] = {}
+        for name, stats in results.items():
+            for k, v in stats.items():
+                merged[f"{name}/{k}" if len(results) > 1 else k] = v
+        return merged
+
+    async def _load_data(self):
+        resps = await asyncio.gather(
+            *[
+                self.pool.request(w, {"type": "fetch"})
+                for w in self.data_worker_ids
+            ]
+        )
+        for r in resps:
+            await self.buffer.put_batch(
+                r["meta"], step=self.step_info.global_step
+            )
+
+    async def _run_mfc(self, node: MFCDef, results: Dict):
+        batch = await self.buffer.get_batch_for_rpc(node, timeout=600)
+        worker = self.placement[str(node.model_name)]
+        # Pre hooks (param sync from another model, e.g. gen <- train).
+        for hook in node.pre_hooks:
+            await self._run_hook(hook, node, worker)
+        resp = await self.pool.request(
+            worker,
+            {
+                "type": "mfc",
+                "model_name": str(node.model_name),
+                "interface_type": node.interface_type.value,
+                "ids": list(batch.ids),
+                "input_keys": list(node.input_keys),
+                "input_key_remap": dict(node.input_key_remap),
+                "output_key_remap": dict(node.output_key_remap),
+                "mb_spec": node.mb_spec,
+            },
+        )
+        if resp.get("meta") is not None:
+            await self.buffer.amend_batch(resp["meta"])
+        results[node.name] = resp.get("stats") or {}
+        for hook in node.post_hooks:
+            await self._run_hook(hook, node, worker)
+
+    async def _run_hook(self, hook, node: MFCDef, worker: int):
+        if isinstance(hook, ParamReallocHook):
+            await self.pool.request(
+                worker,
+                {
+                    "type": "param_sync",
+                    "src": str(node.model_name),
+                    "dst": str(hook.target),
+                    "eta": hook.eta,
+                },
+            )
+
+    async def _clear_worker_caches(self):
+        keep = list(self.buffer._entries.keys())
+        await asyncio.gather(
+            *[
+                self.pool.request(
+                    w, {"type": "clear_cache", "keep_ids": keep}
+                )
+                for w in range(self.pool.n_workers)
+            ]
+        )
+
+    # ---------------- save / recover ----------------
+
+    async def save(self, kind: str = "persistent"):
+        step = self.step_info.global_step
+        sub = (
+            f"step_{step}" if kind == "persistent" else "recover_checkpoint"
+        )
+        for node in self._train_rpcs:
+            d = os.path.join(
+                self.fileroot, "checkpoints", self.experiment_name,
+                self.trial_name, str(node.model_name), sub,
+            )
+            await self.pool.request(
+                self.placement[str(node.model_name)],
+                {
+                    "type": "save",
+                    "model_name": str(node.model_name),
+                    "save_dir": d,
+                },
+            )
+        if kind == "recover":
+            info = recover.RecoverInfo(
+                last_step_info=self.step_info,
+                save_ctl_states={
+                    "save": self.save_ctl.state_dict(),
+                    "ckpt": self.ckpt_ctl.state_dict(),
+                    "eval": self.eval_ctl.state_dict(),
+                },
+            )
+            recover.dump(
+                info,
+                recover.recover_root(
+                    self.fileroot, self.experiment_name, self.trial_name
+                ),
+            )
+        logger.info(f"saved ({kind}) at step {step}")
+
+    def load_recover_info(self) -> bool:
+        info = recover.load(
+            recover.recover_root(
+                self.fileroot, self.experiment_name, self.trial_name
+            )
+        )
+        if info is None:
+            return False
+        self.step_info = info.last_step_info
+        if "save" in info.save_ctl_states:
+            self.save_ctl.load_state_dict(info.save_ctl_states["save"])
+        if "ckpt" in info.save_ctl_states:
+            self.ckpt_ctl.load_state_dict(info.save_ctl_states["ckpt"])
+        if "eval" in info.save_ctl_states:
+            self.eval_ctl.load_state_dict(info.save_ctl_states["eval"])
+        logger.info(f"recovered at step {self.step_info.global_step}")
+        return True

@@ -1,0 +1,309 @@
+"""Model worker: owns model bundles (engine+interface+tokenizer), a local
+data cache, and dataset shards; executes MFC requests from the master.
+
+Capability parity: realhf/system/model_worker.py (request handling, dataset
+fetch, MFC execution, save/load, data cache) — condensed for the TPU
+process model: one worker per host-local mesh rather than one per GPU, since
+XLA SPMD executes one program per mesh.  Transport-agnostic: the same
+`ModelWorker.handle_request` serves the in-process pool (tests, single-host
+trials) and the ZMQ stream runtime.
+"""
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api import dfg as dfg_api
+from areal_tpu.api.config import (
+    ModelAbstraction,
+    ModelBackendAbstraction,
+    ModelInterfaceAbstraction,
+    ModelInterfaceType,
+    ModelName,
+)
+from areal_tpu.api.data_api import (
+    DatasetAbstraction,
+    MicroBatchSpec,
+    SequenceSample,
+    make_dataset,
+)
+from areal_tpu.api.model_api import (
+    FinetuneSpec,
+    Model,
+    OptimizerConfig,
+    make_interface,
+)
+from areal_tpu.base import logging
+from areal_tpu.base.topology import ParallelConfig, make_mesh
+from areal_tpu.models.config import ModelConfig
+
+# Populate the dataset/interface registries.
+import areal_tpu.data.datasets  # noqa: F401
+import areal_tpu.interfaces.sft  # noqa: F401
+import areal_tpu.interfaces.ppo  # noqa: F401
+import areal_tpu.interfaces.reward  # noqa: F401
+
+logger = logging.getLogger("model_worker")
+
+
+@dataclasses.dataclass
+class ModelShardSpec:
+    """Everything needed to build one named model on this worker."""
+
+    name: ModelName
+    model: ModelAbstraction  # random | hf
+    backend: ModelBackendAbstraction  # train | inference | generator | mock
+    interface: ModelInterfaceAbstraction
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    optimizer: Optional[OptimizerConfig] = None
+
+
+@dataclasses.dataclass
+class WorkerConfig:
+    worker_index: int
+    shards: List[ModelShardSpec]
+    tokenizer_path: Optional[str] = None
+    datasets: List[DatasetAbstraction] = dataclasses.field(default_factory=list)
+    dataset_dp_rank: int = 0
+    dataset_dp_size: int = 1
+    batch_size: int = 8
+    seed: int = 1
+    ftspec: FinetuneSpec = dataclasses.field(default_factory=FinetuneSpec)
+    device_offset: int = 0  # first local device index for this worker's mesh
+
+
+def _build_params_and_config(spec: ModelAbstraction, seed: int):
+    import jax
+
+    from areal_tpu.models import transformer as tfm
+
+    if spec.type_ == "null":
+        return None, None  # engine-less models (e.g. verification rewards)
+    if spec.type_ == "random":
+        cfg: ModelConfig = spec.args["config"]
+        params = tfm.init_params(cfg, jax.random.PRNGKey(seed))
+        return cfg, params
+    elif spec.type_ == "hf":
+        from areal_tpu.models.hf import registry as hf
+
+        return hf.load_hf_checkpoint(
+            spec.args["path"], is_critic=spec.args.get("is_critic", False)
+        )
+    raise ValueError(f"unknown model abstraction {spec.type_!r}")
+
+
+class ModelWorker:
+    def __init__(self, config: WorkerConfig, tokenizer=None):
+        self.config = config
+        self.tokenizer = tokenizer
+        self.models: Dict[str, Model] = {}
+        self.interfaces: Dict[str, Any] = {}
+        self.data_cache: Dict[str, SequenceSample] = {}
+        self.datasets = []
+        self.dataloaders = []
+        self._setup()
+
+    # ---------------- setup ----------------
+
+    def _setup(self):
+        import jax
+
+        from areal_tpu.engines.generator import GeneratorEngine
+        from areal_tpu.engines.inference import InferenceEngine
+        from areal_tpu.engines.train import TrainEngine
+
+        if self.tokenizer is None and self.config.tokenizer_path:
+            from areal_tpu.data.tokenizer import load_hf_tokenizer
+
+            self.tokenizer = load_hf_tokenizer(self.config.tokenizer_path)
+
+        for shard in self.config.shards:
+            cfg, params = _build_params_and_config(
+                shard.model, seed=self.config.seed
+            )
+            devices = jax.devices()[
+                self.config.device_offset : self.config.device_offset
+                + shard.parallel.world_size
+            ]
+            mesh = make_mesh(shard.parallel, devices)
+            btype = shard.backend.type_
+            if btype in ("train", "mock"):
+                engine = TrainEngine(
+                    cfg, params, mesh,
+                    optimizer_config=shard.optimizer or OptimizerConfig(),
+                    ftspec=self.config.ftspec,
+                    **shard.backend.args,
+                )
+            elif btype == "inference":
+                engine = InferenceEngine(cfg, params, mesh, **shard.backend.args)
+            elif btype == "generator":
+                engine = GeneratorEngine(
+                    cfg, params, mesh,
+                    eos_token_id=self.tokenizer.eos_token_id,
+                    pad_token_id=getattr(self.tokenizer, "pad_token_id", None),
+                    **shard.backend.args,
+                )
+            elif btype == "null":
+                engine = None
+            else:
+                raise ValueError(f"unknown backend {btype!r}")
+            key = str(shard.name)
+            self.models[key] = Model(
+                name=key, engine=engine, tokenizer=self.tokenizer, config=cfg
+            )
+            self.interfaces[key] = make_interface(
+                shard.interface.type_, **shard.interface.args
+            )
+            logger.info(
+                f"worker {self.config.worker_index}: built model {key} "
+                f"({shard.backend.type_}, mesh {shard.parallel.to_str()})"
+            )
+
+        for ds_spec in self.config.datasets:
+            ds = make_dataset(
+                ds_spec,
+                seed=self.config.seed,
+                dp_rank=self.config.dataset_dp_rank,
+                world_size=self.config.dataset_dp_size,
+                tokenizer=self.tokenizer,
+            )
+            from areal_tpu.data.datasets import PackedDataLoader
+
+            self.datasets.append(ds)
+            self.dataloaders.append(
+                iter(
+                    _Cycler(
+                        PackedDataLoader(
+                            ds, batch_size=self.config.batch_size,
+                            seed=self.config.seed,
+                        )
+                    )
+                )
+            )
+
+    # ---------------- request handling ----------------
+
+    def handle_request(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        handler = getattr(self, f"_handle_{req['type']}", None)
+        if handler is None:
+            raise ValueError(f"unknown request type {req['type']!r}")
+        return handler(req)
+
+    def _handle_spec(self, req):
+        sizes = [len(ds) for ds in self.datasets]
+        steps = (
+            (sum(sizes) + self.config.batch_size - 1) // self.config.batch_size
+            if sizes
+            else 0
+        )
+        return {"dataset_size": sum(sizes), "steps_per_epoch": steps}
+
+    def _handle_fetch(self, req):
+        """Load the next dataset batch into the cache; return its metadata."""
+        dl_idx = req.get("dataset_index", 0)
+        batch: SequenceSample = next(self.dataloaders[dl_idx])
+        for one in batch.unpack():
+            self.data_cache[one.ids[0]] = one
+        return {"meta": batch.meta()}
+
+    def _handle_mfc(self, req):
+        """Execute one model function call on cached data."""
+        model_key: str = req["model_name"]
+        itype = ModelInterfaceType(req["interface_type"])
+        ids: List[str] = req["ids"]
+        input_keys = set(req["input_keys"])
+        remap_in: Dict[str, str] = req.get("input_key_remap", {})
+        remap_out: Dict[str, str] = req.get("output_key_remap", {})
+        mb_spec: MicroBatchSpec = req.get("mb_spec") or MicroBatchSpec()
+
+        parts = []
+        for sid in ids:
+            entry = self.data_cache[sid]
+            parts.append(entry.select_keys(input_keys & entry.keys))
+        sample = SequenceSample.gather(parts)
+        sample.remap_keys_(remap_in)
+
+        model = self.models[model_key]
+        interface = self.interfaces[model_key]
+        fn = getattr(interface, itype.value)
+        result = fn(model, sample, mb_spec)
+        if itype == ModelInterfaceType.GENERATE:
+            model.inc_version()  # advances the sampling seed per step
+
+        if isinstance(result, SequenceSample):
+            result.remap_keys_(remap_out)
+            for one in result.unpack():
+                sid = one.ids[0]
+                if sid in self.data_cache:
+                    self.data_cache[sid].update_(one)
+                else:
+                    self.data_cache[sid] = one
+            return {"meta": result.meta(), "stats": {}}
+        return {"meta": None, "stats": dict(result or {})}
+
+    def _handle_param_sync(self, req):
+        """Copy/EMA params src -> dst (generator hot-swap, EMA ref).
+        Reference: param_realloc hooks (model_worker.py:1009)."""
+        import jax
+
+        src = self.models[req["src"]].engine
+        dst = self.models[req["dst"]].engine
+        eta = float(req.get("eta", 1.0))
+        if eta >= 1.0:
+            dst.set_params(src.get_params())
+        else:
+            sp = src.get_params()
+            dp = dst.get_params()
+            mixed = jax.tree.map(lambda a, b: eta * a + (1 - eta) * b, sp, dp)
+            dst.set_params(mixed)
+        return {}
+
+    def _handle_save(self, req):
+        key = req["model_name"]
+        self.interfaces[key].save(self.models[key], req["save_dir"])
+        return {"path": req["save_dir"]}
+
+    def _handle_save_optimizer(self, req):
+        eng = self.models[req["model_name"]].engine
+        os.makedirs(os.path.dirname(req["path"]), exist_ok=True)
+        eng.save_optimizer_state(req["path"])
+        return {}
+
+    def _handle_clear_cache(self, req):
+        keep = set(req.get("keep_ids", ()))
+        for sid in list(self.data_cache):
+            if sid not in keep:
+                del self.data_cache[sid]
+        return {}
+
+    def _handle_filter_dataset(self, req):
+        for ds in self.datasets:
+            ds.filter(req["ids"])
+        return {}
+
+    def _handle_ping(self, req):
+        return {"pong": self.config.worker_index}
+
+
+class _Cycler:
+    """Endless epoch iterator over a PackedDataLoader."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.epoch = 0
+        self._it = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            if self._it is None:
+                self._it = iter(self.loader)
+            try:
+                return next(self._it)
+            except StopIteration:
+                self._it = None
+                self.epoch += 1
