@@ -65,6 +65,7 @@ class GeneratorEngine(Engine):
         self.compute_dtype = compute_dtype
         self.max_decode_batch = max_decode_batch
         self.batch_shard = batch_sharding_degree(mesh)
+        self._use_flash = None if mesh.devices.size == 1 else False
         self._gen_fns: Dict[Tuple, Any] = {}
         self.set_params(params)
 
@@ -184,7 +185,9 @@ class GeneratorEngine(Engine):
             cache = tfm.init_kv_cache(cfg, bsz, s_total, dtype=self.compute_dtype)
             # prefill returns logits at each row's last prompt token — the
             # distribution over the first response token.
-            logits0, cache = tfm.prefill(params, cfg, prompt_tok, seg, cache)
+            logits0, cache = tfm.prefill(
+                params, cfg, prompt_tok, seg, cache, use_flash=self._use_flash
+            )
 
             out_toks = jnp.zeros((bsz, max_new), jnp.int32)
             out_logps = jnp.zeros((bsz, max_new), jnp.float32)

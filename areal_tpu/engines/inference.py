@@ -36,6 +36,7 @@ class InferenceEngine(Engine):
             compute_dtype = jnp.float32
         self.compute_dtype = compute_dtype
         self.batch_shard = batch_sharding_degree(mesh)
+        self._use_flash = None if mesh.devices.size == 1 else False
         self._fwd_fns: Dict[Any, Callable] = {}
         self.set_params(params)
 
@@ -101,6 +102,7 @@ class InferenceEngine(Engine):
         if post_fn in self._fwd_fns:
             return self._fwd_fns[post_fn]
         cfg = self.cfg
+        use_flash = self._use_flash
 
         @jax.jit
         def fwd(params, batch):
@@ -110,6 +112,7 @@ class InferenceEngine(Engine):
                 batch["tokens"],
                 batch["segment_ids"],
                 positions=batch["positions"],
+                use_flash=use_flash,
             )
             return post_fn(out, batch)
 

@@ -119,6 +119,9 @@ class TrainEngine(Engine):
         self._apply_fn = None
         self.batch_shard = batch_sharding_degree(mesh)
         self._batch_sharding = sharding.named(mesh, sharding.batch_pspec())
+        # Pallas flash attention is not GSPMD-partitionable; enable it only
+        # on single-device meshes (ring attention covers the sharded case).
+        self._use_flash = None if mesh.devices.size == 1 else False
 
     # ---------------- core jitted fns ----------------
 
@@ -126,6 +129,7 @@ class TrainEngine(Engine):
         if loss_fn in self._grad_fns:
             return self._grad_fns[loss_fn]
         cfg, compute_dtype = self.cfg, self.compute_dtype
+        use_flash = self._use_flash
 
         @jax.jit
         def grad_fn(params, batch, loss_scale):
@@ -137,6 +141,7 @@ class TrainEngine(Engine):
                     batch["segment_ids"],
                     positions=batch["positions"],
                     remat=True,
+                    use_flash=use_flash,
                 )
                 loss, stats = loss_fn(logits, batch)
                 total = loss + cfg.moe_aux_loss_coef * aux
@@ -278,6 +283,7 @@ class TrainEngine(Engine):
         if post_fn in self._fwd_fns:
             return self._fwd_fns[post_fn]
         cfg, compute_dtype = self.cfg, self.compute_dtype
+        use_flash = self._use_flash
 
         @jax.jit
         def fwd(params, batch):
@@ -287,6 +293,7 @@ class TrainEngine(Engine):
                 batch["tokens"],
                 batch["segment_ids"],
                 positions=batch["positions"],
+                use_flash=use_flash,
             )
             return post_fn(logits, batch)
 

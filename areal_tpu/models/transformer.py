@@ -157,6 +157,7 @@ def _block_forward(
     segment_ids: jax.Array,
     cos: jax.Array,
     sin: jax.Array,
+    use_flash: "bool | None" = None,
 ) -> Tuple[jax.Array, jax.Array]:
     b, s, d = x.shape
     h = rms_norm(x, blk["ln1"], cfg.rms_norm_eps)
@@ -169,7 +170,7 @@ def _block_forward(
     k = k.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     q, k = apply_rotary(q, k, cos, sin)
-    attn = packed_attention(q, k, v, segment_ids, causal=True)
+    attn = packed_attention(q, k, v, segment_ids, causal=True, use_flash=use_flash)
     x = x + attn.reshape(b, s, cfg.q_dim) @ blk["wo"]
     h2 = rms_norm(x, blk["ln2"], cfg.rms_norm_eps)
     if cfg.is_moe:
@@ -186,12 +187,13 @@ def _backbone(
     segment_ids: jax.Array,
     positions: jax.Array,
     remat: bool,
+    use_flash: "bool | None" = None,
 ) -> Tuple[jax.Array, jax.Array]:
     x = jnp.take(params["embed"], tokens, axis=0)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
     def body(carry, blk):
-        y, aux = _block_forward(carry, blk, cfg, segment_ids, cos, sin)
+        y, aux = _block_forward(carry, blk, cfg, segment_ids, cos, sin, use_flash)
         return y, aux
 
     if remat:
@@ -221,10 +223,13 @@ def forward(
     segment_ids: jax.Array,  # [B, S] int32, 0 = pad
     positions: Optional[jax.Array] = None,
     remat: bool = False,
+    use_flash: "bool | None" = None,
 ) -> jax.Array:
     """Full forward over packed rows -> fp32 logits [B,S,V] (or values [B,S]
     for critics).  Also returns MoE aux loss via `forward_with_aux`."""
-    out, _ = forward_with_aux(params, cfg, tokens, segment_ids, positions, remat)
+    out, _ = forward_with_aux(
+        params, cfg, tokens, segment_ids, positions, remat, use_flash
+    )
     return out
 
 
@@ -235,10 +240,13 @@ def forward_with_aux(
     segment_ids: jax.Array,
     positions: Optional[jax.Array] = None,
     remat: bool = False,
+    use_flash: "bool | None" = None,
 ) -> Tuple[jax.Array, jax.Array]:
     if positions is None:
         positions = positions_from_segments(segment_ids)
-    x, aux = _backbone(params, cfg, tokens, segment_ids, positions, remat)
+    x, aux = _backbone(
+        params, cfg, tokens, segment_ids, positions, remat, use_flash
+    )
     return _head(params, cfg, x), aux
 
 
@@ -292,6 +300,7 @@ def prefill(
     tokens: jax.Array,  # [B, S] one sequence per row (left-aligned)
     segment_ids: jax.Array,  # [B, S] 1 where valid, 0 pad (single segment/row)
     cache: KVCache,
+    use_flash: "bool | None" = None,
 ) -> Tuple[jax.Array, KVCache]:
     """Run the prompt through the model, filling cache[:, :, :S] and
     returning fp32 logits [B, V] at each row's LAST VALID position (the
@@ -306,7 +315,9 @@ def prefill(
         blk = layer_in
         h = rms_norm(carry, blk["ln1"], cfg.rms_norm_eps)
         q, k, v = _block_kv(h, blk, cfg, cos, sin)
-        attn = packed_attention(q, k, v, segment_ids, causal=True)
+        attn = packed_attention(
+            q, k, v, segment_ids, causal=True, use_flash=use_flash
+        )
         y = carry + attn.reshape(*carry.shape[:2], cfg.q_dim) @ blk["wo"]
         h2 = rms_norm(y, blk["ln2"], cfg.rms_norm_eps)
         y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk))
