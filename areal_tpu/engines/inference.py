@@ -36,7 +36,7 @@ class InferenceEngine(Engine):
             compute_dtype = jnp.float32
         self.compute_dtype = compute_dtype
         self.batch_shard = batch_sharding_degree(mesh)
-        self._use_flash = None if mesh.devices.size == 1 else False
+        self._use_flash, self._cp_mesh = sharding.attn_dispatch(mesh)
         self._fwd_fns: Dict[Any, Callable] = {}
         self.set_params(params)
 
@@ -103,6 +103,7 @@ class InferenceEngine(Engine):
             return self._fwd_fns[post_fn]
         cfg = self.cfg
         use_flash = self._use_flash
+        cp_mesh = self._cp_mesh
 
         @jax.jit
         def fwd(params, batch):
@@ -113,6 +114,7 @@ class InferenceEngine(Engine):
                 batch["segment_ids"],
                 positions=batch["positions"],
                 use_flash=use_flash,
+                cp_mesh=cp_mesh,
             )
             return post_fn(out, batch)
 

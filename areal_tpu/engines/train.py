@@ -119,9 +119,7 @@ class TrainEngine(Engine):
         self._apply_fn = None
         self.batch_shard = batch_sharding_degree(mesh)
         self._batch_sharding = sharding.named(mesh, sharding.batch_pspec())
-        # Pallas flash attention is not GSPMD-partitionable; enable it only
-        # on single-device meshes (ring attention covers the sharded case).
-        self._use_flash = None if mesh.devices.size == 1 else False
+        self._use_flash, self._cp_mesh = sharding.attn_dispatch(mesh)
 
     # ---------------- core jitted fns ----------------
 
@@ -130,6 +128,7 @@ class TrainEngine(Engine):
             return self._grad_fns[loss_fn]
         cfg, compute_dtype = self.cfg, self.compute_dtype
         use_flash = self._use_flash
+        cp_mesh = self._cp_mesh
 
         @jax.jit
         def grad_fn(params, batch, loss_scale):
@@ -142,6 +141,7 @@ class TrainEngine(Engine):
                     positions=batch["positions"],
                     remat=True,
                     use_flash=use_flash,
+                    cp_mesh=cp_mesh,
                 )
                 loss, stats = loss_fn(logits, batch)
                 total = loss + cfg.moe_aux_loss_coef * aux
@@ -160,7 +160,10 @@ class TrainEngine(Engine):
             return self._apply_fn
         optimizer = self.optimizer
 
-        @jax.jit
+        # Donation: params/opt_state/grads buffers are dead after the step —
+        # without it the optimizer step transiently holds 2x params + 2x Adam
+        # state, the peak-memory term for large models on one chip.
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def apply_fn(params, opt_state, grads):
             gnorm = optax.global_norm(grads)
             updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -171,7 +174,7 @@ class TrainEngine(Engine):
         return apply_fn
 
     @staticmethod
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def _accum(acc, grads):
         return jax.tree.map(jnp.add, acc, grads)
 
@@ -284,6 +287,7 @@ class TrainEngine(Engine):
             return self._fwd_fns[post_fn]
         cfg, compute_dtype = self.cfg, self.compute_dtype
         use_flash = self._use_flash
+        cp_mesh = self._cp_mesh
 
         @jax.jit
         def fwd(params, batch):
@@ -294,6 +298,7 @@ class TrainEngine(Engine):
                 batch["segment_ids"],
                 positions=batch["positions"],
                 use_flash=use_flash,
+                cp_mesh=cp_mesh,
             )
             return post_fn(logits, batch)
 

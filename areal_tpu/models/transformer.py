@@ -158,6 +158,7 @@ def _block_forward(
     cos: jax.Array,
     sin: jax.Array,
     use_flash: "bool | None" = None,
+    cp_mesh=None,
 ) -> Tuple[jax.Array, jax.Array]:
     b, s, d = x.shape
     h = rms_norm(x, blk["ln1"], cfg.rms_norm_eps)
@@ -170,7 +171,14 @@ def _block_forward(
     k = k.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     q, k = apply_rotary(q, k, cos, sin)
-    attn = packed_attention(q, k, v, segment_ids, causal=True, use_flash=use_flash)
+    if cp_mesh is not None:
+        from areal_tpu.ops.ring_attention import ring_packed_attention
+
+        attn = ring_packed_attention(q, k, v, segment_ids, cp_mesh, causal=True)
+    else:
+        attn = packed_attention(
+            q, k, v, segment_ids, causal=True, use_flash=use_flash
+        )
     x = x + attn.reshape(b, s, cfg.q_dim) @ blk["wo"]
     h2 = rms_norm(x, blk["ln2"], cfg.rms_norm_eps)
     if cfg.is_moe:
@@ -188,12 +196,15 @@ def _backbone(
     positions: jax.Array,
     remat: bool,
     use_flash: "bool | None" = None,
+    cp_mesh=None,
 ) -> Tuple[jax.Array, jax.Array]:
     x = jnp.take(params["embed"], tokens, axis=0)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
     def body(carry, blk):
-        y, aux = _block_forward(carry, blk, cfg, segment_ids, cos, sin, use_flash)
+        y, aux = _block_forward(
+            carry, blk, cfg, segment_ids, cos, sin, use_flash, cp_mesh
+        )
         return y, aux
 
     if remat:
@@ -224,11 +235,16 @@ def forward(
     positions: Optional[jax.Array] = None,
     remat: bool = False,
     use_flash: "bool | None" = None,
+    cp_mesh=None,
 ) -> jax.Array:
     """Full forward over packed rows -> fp32 logits [B,S,V] (or values [B,S]
-    for critics).  Also returns MoE aux loss via `forward_with_aux`."""
+    for critics).  Also returns MoE aux loss via `forward_with_aux`.
+
+    `cp_mesh`: pass the engine's Mesh to route attention through ring
+    context parallelism over its `seq` axis (areal_tpu/ops/ring_attention).
+    """
     out, _ = forward_with_aux(
-        params, cfg, tokens, segment_ids, positions, remat, use_flash
+        params, cfg, tokens, segment_ids, positions, remat, use_flash, cp_mesh
     )
     return out
 
@@ -241,11 +257,12 @@ def forward_with_aux(
     positions: Optional[jax.Array] = None,
     remat: bool = False,
     use_flash: "bool | None" = None,
+    cp_mesh=None,
 ) -> Tuple[jax.Array, jax.Array]:
     if positions is None:
         positions = positions_from_segments(segment_ids)
     x, aux = _backbone(
-        params, cfg, tokens, segment_ids, positions, remat, use_flash
+        params, cfg, tokens, segment_ids, positions, remat, use_flash, cp_mesh
     )
     return _head(params, cfg, x), aux
 

@@ -12,8 +12,10 @@ Design (standard flash attention v2 tiling, adapted to Mosaic/TPU):
 - backward: two kernels — dq with grid (B*H, nq, nk) and dkv with grid
   (B*H, nk, nq) — both recompute the probability tiles from the saved
   logsumexp instead of materializing [S, S] (O(S) memory).
-- block-level early-out: tiles entirely above the causal diagonal or with no
-  segment overlap contribute nothing and are skipped via @pl.when.
+- block-level early-out: tiles entirely above the causal diagonal are
+  skipped via @pl.when (segment masking is applied densely inside the
+  compute; a per-tile segment-overlap early-out is a possible further
+  optimization, not implemented).
 
 Interpret mode (CPU) is used automatically off-TPU, which is how the unit
 tests exercise the same kernel code path hermetically.
@@ -76,9 +78,12 @@ def _fwd_kernel(
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk]
 
-        seg_q = seg_q_ref[0]  # [bq]
-        seg_k = seg_k_ref[0]  # [bk]
-        mask = (seg_q[:, None] == seg_k[None, :]) & (seg_q[:, None] > 0)
+        # Segment ids arrive sublane/lane-broadcast (Mosaic needs >=2D tiles
+        # with aligned minor dims): q ids [bq, 8] -> [bq, 1], k ids
+        # [8, bk] -> [1, bk].
+        seg_q = seg_q_ref[0][:, 0:1]
+        seg_k = seg_k_ref[0][0:1, :]
+        mask = (seg_q == seg_k) & (seg_q > 0)
         if causal:
             mask &= q_pos >= k_pos
         s = jnp.where(mask, s, NEG_INF)
@@ -102,16 +107,29 @@ def _fwd_kernel(
         l = l_scr[:]
         safe_l = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
-        lse = jnp.where(
-            l > 0, m_scr[:] + jnp.log(safe_l), NEG_INF
-        )
-        lse_ref[0] = lse[:, 0]
+        lse_ref[0] = jnp.where(l > 0, m_scr[:] + jnp.log(safe_l), NEG_INF)
+
+
+def _seg_layouts(seg: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[B, S] int32 -> (q ids [B, S, 8], k ids [B, 8, S]).
+
+    Mosaic requires >=2D tiles whose minor dims are 8/128-aligned or span the
+    array; broadcasting ids over 8 sublanes/lanes (the official TPU flash
+    kernel's trick) satisfies that at 8x int32 cost.  Ids are per-BATCH (not
+    per-head): the BlockSpec index maps divide the b*h grid index by the
+    head count, so no H-fold copy is materialized.
+    """
+    b, s = seg.shape
+    seg_q = jnp.broadcast_to(seg[:, :, None], (b, s, 8))
+    seg_k = jnp.broadcast_to(seg[:, None, :], (b, 8, s))
+    return seg_q, seg_k
 
 
 def _fwd(
-    q, k, v, seg, scale, block_q, block_k, causal
+    q, k, v, seg, hq, scale, block_q, block_k, causal
 ) -> Tuple[jax.Array, jax.Array]:
-    """q/k/v: [BH, S, D]; seg: [BH, S] int32.  Returns (o [BH,S,D], lse [BH,S])."""
+    """q/k/v: [BH, S, D]; seg: [B, S] int32; hq = heads per batch row.
+    Returns (o [BH,S,D], lse [BH,S,1])."""
     bh, s, d = q.shape
     nq = pl.cdiv(s, block_q)
     nk = pl.cdiv(s, block_k)
@@ -119,23 +137,24 @@ def _fwd(
         _fwd_kernel,
         scale=scale, block_q=block_q, block_k=block_k, nk=nk, causal=causal,
     )
+    seg_q, seg_k = _seg_layouts(seg)
     return pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q), lambda b, qi, ki: (b, qi)),
-            pl.BlockSpec((1, block_k), lambda b, qi, ki: (b, ki)),
+            pl.BlockSpec((1, block_q, 8), lambda b, qi, ki: (b // hq, qi, 0)),
+            pl.BlockSpec((1, 8, block_k), lambda b, qi, ki: (b // hq, 0, ki)),
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda b, qi, ki: (b, qi)),
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ],
         scratch_shapes=[
             _vmem((block_q, 1), jnp.float32),
@@ -143,7 +162,7 @@ def _fwd(
             _vmem((block_q, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(seg, seg, q, k, v)
+    )(seg_q, seg_k, q, k, v)
 
 
 def _vmem(shape, dtype):
@@ -178,14 +197,14 @@ def _dq_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]  # [bq, 1]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]  # [bq, 1]
+        delta = delta_ref[0]  # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        seg_q = seg_q_ref[0]
-        seg_k = seg_k_ref[0]
-        mask = (seg_q[:, None] == seg_k[None, :]) & (seg_q[:, None] > 0)
+        seg_q = seg_q_ref[0][:, 0:1]
+        seg_k = seg_k_ref[0][0:1, :]
+        mask = (seg_q == seg_k) & (seg_q > 0)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -230,14 +249,14 @@ def _dkv_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]  # [bq, 1]
+        delta = delta_ref[0]  # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        seg_q = seg_q_ref[0]
-        seg_k = seg_k_ref[0]
-        mask = (seg_q[:, None] == seg_k[None, :]) & (seg_q[:, None] > 0)
+        seg_q = seg_q_ref[0][:, 0:1]
+        seg_k = seg_k_ref[0][0:1, :]
+        mask = (seg_q == seg_k) & (seg_q > 0)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -269,13 +288,15 @@ def _bwd(
 ) -> Tuple[jax.Array, jax.Array, jax.Array, None]:
     q, k, v, o, lse, seg = res
     bh, s, d = q.shape
+    hq = bh // seg.shape[0]
     nq = pl.cdiv(s, block_q)
     nk = pl.cdiv(s, block_k)
     delta = jnp.sum(
-        o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
-    )  # [BH, S]
+        o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [BH, S, 1]
 
-    common_in = [seg, seg, q, k, v, do, lse, delta]
+    seg_q, seg_k = _seg_layouts(seg)
+    common_in = [seg_q, seg_k, q, k, v, do, lse, delta]
 
     dq = pl.pallas_call(
         functools.partial(
@@ -285,14 +306,14 @@ def _bwd(
         ),
         grid=(bh, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q), lambda b, qi, ki: (b, qi)),
-            pl.BlockSpec((1, block_k), lambda b, qi, ki: (b, ki)),
+            pl.BlockSpec((1, block_q, 8), lambda b, qi, ki: (b // hq, qi, 0)),
+            pl.BlockSpec((1, 8, block_k), lambda b, qi, ki: (b // hq, 0, ki)),
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda b, qi, ki: (b, qi)),
-            pl.BlockSpec((1, block_q), lambda b, qi, ki: (b, qi)),
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
@@ -308,14 +329,14 @@ def _bwd(
         ),
         grid=(bh, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, block_q), lambda b, ki, qi: (b, qi)),
-            pl.BlockSpec((1, block_k), lambda b, ki, qi: (b, ki)),
+            pl.BlockSpec((1, block_q, 8), lambda b, ki, qi: (b // hq, qi, 0)),
+            pl.BlockSpec((1, 8, block_k), lambda b, ki, qi: (b // hq, 0, ki)),
             pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda b, ki, qi: (b, qi)),
-            pl.BlockSpec((1, block_q), lambda b, ki, qi: (b, qi)),
+            pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
@@ -341,12 +362,14 @@ def _bwd(
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash_bhsd(q, k, v, seg, scale, block_q, block_k, causal):
-    o, _ = _fwd(q, k, v, seg, scale, block_q, block_k, causal)
+    hq = q.shape[0] // seg.shape[0]
+    o, _ = _fwd(q, k, v, seg, hq, scale, block_q, block_k, causal)
     return o
 
 
 def _flash_fwd_rule(q, k, v, seg, scale, block_q, block_k, causal):
-    o, lse = _fwd(q, k, v, seg, scale, block_q, block_k, causal)
+    hq = q.shape[0] // seg.shape[0]
+    o, lse = _fwd(q, k, v, seg, hq, scale, block_q, block_k, causal)
     return o, (q, k, v, o, lse, seg)
 
 
@@ -388,9 +411,8 @@ def flash_attention(
     def to_bhsd(x):
         return x.transpose(0, 2, 1, 3).reshape(b * hq, s, d)
 
-    seg_rep = jnp.repeat(segment_ids.astype(jnp.int32), hq, axis=0)
     o = _flash_bhsd(
-        to_bhsd(q), to_bhsd(k), to_bhsd(v), seg_rep,
+        to_bhsd(q), to_bhsd(k), to_bhsd(v), segment_ids.astype(jnp.int32),
         d**-0.5, block_q, block_k, causal,
     )
     return o.reshape(b, hq, s, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,141 @@
+"""Ring attention: context parallelism over the `seq` mesh axis.
+
+Fills the reference's long-context gap (SURVEY §2.6: AReaL has no
+CP/ring/Ulysses — long CoT is handled only by packing + micro-batching,
+realhf/base/datapack.py:153).  Here sequence chunks live on different
+devices and K/V blocks rotate around the ring with `lax.ppermute`, so a
+row of length S costs O(S/n) activation memory per device and the
+K/V transfer overlaps with the per-block attention compute (XLA schedules
+the ppermute concurrently with the einsums of the previous block).
+
+Semantics match areal_tpu/ops/attention.packed_attention_reference exactly:
+packed rows, causal within segment, never across segments, padding (seg 0)
+fully masked.  Online-softmax accumulation in fp32 (flash-style), so the
+result is independent of the number of ring steps.
+
+Layout contract (established by `ring_packed_attention`'s shard_map):
+- q/k/v: [B, S, H, d] sharded P((data, fsdp), seq, model, None)
+- segment_ids: [B, S] sharded P((data, fsdp), seq)
+- sequence chunks are CONTIGUOUS: device c on the seq axis holds global
+  positions [c*Sc, (c+1)*Sc).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from areal_tpu.base.topology import SEQ_AXIS
+from areal_tpu.ops.attention import NEG_INF, repeat_kv
+from areal_tpu.parallel.sharding import BATCH
+
+
+def _block_update(o, m, l, q, k, v, q_seg, k_seg, q_pos, k_pos, causal):
+    """One online-softmax accumulation of a K/V block into (o, m, l).
+
+    q: [B, Sq, H, d]; k/v: [B, Sk, Hkv, d]; o: [B, H, Sq, d];
+    m/l: [B, H, Sq].  All accumulation in fp32.
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = q.shape[-1] ** -0.5
+    logits = (
+        jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+        * scale
+    )
+    mask = (q_seg[:, :, None] == k_seg[:, None, :]) & (q_seg > 0)[:, :, None]
+    if causal:
+        mask &= q_pos[:, None] >= k_pos[None, :]
+    logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+    # Keep fully-masked rows stable: exp(NEG_INF - NEG_INF) would be 1.
+    alive = m_new > NEG_INF / 2
+    corr = jnp.where(alive, jnp.exp(m - m_new), 0.0)
+    p = jnp.where(
+        alive[..., None], jnp.exp(logits - m_new[..., None]), 0.0
+    )
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32)
+    )
+    return o_new, m_new, l_new
+
+
+def _ring_shard(q, k, v, segment_ids, axis_name: str, axis_size: int, causal: bool):
+    """shard_map body: each seq-axis member holds one contiguous chunk."""
+    b, sq, h, d = q.shape
+    my = jax.lax.axis_index(axis_name)
+    q_pos = my * sq + jnp.arange(sq, dtype=jnp.int32)
+
+    o = jnp.zeros((b, h, sq, d), jnp.float32)
+    m = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    # Step 0 uses the local chunk; each further step rotates K/V first, so no
+    # final unused rotation is sent around the ring.
+    #
+    # Every device runs all axis_size steps in lockstep (the ppermute is a
+    # per-step barrier), so causally-dead blocks on low ranks cannot shorten
+    # wall-clock under this contiguous-chunk layout; a zigzag/striped chunk
+    # assignment that balances causal work is the known follow-up.
+    o, m, l = _block_update(
+        o, m, l, q, k, v, segment_ids, segment_ids, q_pos, q_pos, causal
+    )
+
+    def step(carry, t):
+        o, m, l, k, v, k_seg = carry
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        k_seg = jax.lax.ppermute(k_seg, axis_name, perm)
+        # After t forward rotations, we hold the chunk born on rank (my - t).
+        k_idx = (my - t) % axis_size
+        k_pos = k_idx * sq + jnp.arange(sq, dtype=jnp.int32)
+        o, m, l = _block_update(
+            o, m, l, q, k, v, segment_ids, k_seg, q_pos, k_pos, causal
+        )
+        return (o, m, l, k, v, k_seg), None
+
+    if axis_size > 1:
+        step = jax.checkpoint(
+            step, policy=jax.checkpoint_policies.nothing_saveable
+        )
+        (o, m, l, *_), _ = jax.lax.scan(
+            step,
+            (o, m, l, k, v, segment_ids),
+            jnp.arange(1, axis_size, dtype=jnp.int32),
+        )
+    out = jnp.where(l[..., None] > 0, o / jnp.maximum(l[..., None], 1e-30), 0.0)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B, Sq, H, d]
+
+
+def ring_packed_attention(
+    q: jax.Array,  # [B, S, n_q, d]
+    k: jax.Array,  # [B, S, n_kv, d]
+    v: jax.Array,  # [B, S, n_kv, d]
+    segment_ids: jax.Array,  # [B, S]
+    mesh: Mesh,
+    causal: bool = True,
+    seq_axis: str = SEQ_AXIS,
+) -> jax.Array:
+    """Packed varlen attention with the sequence dim sharded over `seq_axis`.
+
+    Drop-in for packed_attention when running under a mesh whose seq axis is
+    >1; identical numerics (fp32 online softmax) either way.
+    """
+    n = mesh.shape[seq_axis]
+    qkv_spec = P(BATCH, seq_axis, "model", None)
+    seg_spec = P(BATCH, seq_axis)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_shard, axis_name=seq_axis, axis_size=n, causal=causal
+        ),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, segment_ids)

@@ -92,6 +92,18 @@ def kv_cache_pspec() -> P:
     return P(None, BATCH, None, MODEL_AXIS, None)
 
 
+def attn_dispatch(mesh: Mesh):
+    """Shared engine policy -> (use_flash, cp_mesh).
+
+    Pallas flash attention is not GSPMD-partitionable, so it is enabled
+    (auto, i.e. on-TPU) only on single-device meshes; ring context
+    parallelism takes over whenever the mesh has a nontrivial `seq` axis.
+    """
+    use_flash = None if mesh.devices.size == 1 else False
+    cp_mesh = mesh if mesh.shape[SEQ_AXIS] > 1 else None
+    return use_flash, cp_mesh
+
+
 def named(mesh: Mesh, spec: P) -> NamedSharding:
     return NamedSharding(mesh, spec)
 

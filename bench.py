@@ -93,7 +93,10 @@ def main():
         gconfig=g, n_minibatches=2, disable_value=True, kl_ctl=0.0,
         adv_norm=True,
     )
-    mb = MicroBatchSpec(max_tokens_per_mb=4096)
+    # 1024-token micro-batches: the 152k-vocab fp32 logits + their softmax
+    # grads are the peak-memory term on a 16 GB chip next to fp32 master
+    # params + Adam state.
+    mb = MicroBatchSpec(max_tokens_per_mb=1024)
 
     def one_step(seed):
         rollout = actor_if.generate(gen, prompts, mb)
