@@ -65,7 +65,9 @@ class GeneratorEngine(Engine):
         self.compute_dtype = compute_dtype
         self.max_decode_batch = max_decode_batch
         self.batch_shard = batch_sharding_degree(mesh)
-        self._use_flash = None if mesh.devices.size == 1 else False
+        # Generation has no CP path yet (decode is token-at-a-time); only the
+        # flash half of the shared dispatch policy applies to prefill.
+        self._use_flash, _ = sharding.attn_dispatch(mesh)
         self._gen_fns: Dict[Tuple, Any] = {}
         self.set_params(params)
 

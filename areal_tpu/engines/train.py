@@ -160,10 +160,12 @@ class TrainEngine(Engine):
             return self._apply_fn
         optimizer = self.optimizer
 
-        # Donation: params/opt_state/grads buffers are dead after the step —
-        # without it the optimizer step transiently holds 2x params + 2x Adam
-        # state, the peak-memory term for large models on one chip.
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        # Donation: params/opt_state buffers are dead after the step — without
+        # it the optimizer step transiently holds 2x params + 2x Adam state,
+        # the peak-memory term for large models on one chip.  Grads are NOT
+        # donated: no output matches their shape set (only gnorm remains), so
+        # donating them only triggers unusable-donation warnings.
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def apply_fn(params, opt_state, grads):
             gnorm = optax.global_norm(grads)
             updates, opt_state = optimizer.update(grads, opt_state, params)

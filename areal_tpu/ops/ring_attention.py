@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from areal_tpu.base.topology import SEQ_AXIS
+from areal_tpu.base.topology import MODEL_AXIS, SEQ_AXIS
 from areal_tpu.ops.attention import NEG_INF, repeat_kv
 from areal_tpu.parallel.sharding import BATCH
 
@@ -127,7 +127,7 @@ def ring_packed_attention(
     >1; identical numerics (fp32 online softmax) either way.
     """
     n = mesh.shape[seq_axis]
-    qkv_spec = P(BATCH, seq_axis, "model", None)
+    qkv_spec = P(BATCH, seq_axis, MODEL_AXIS, None)
     seg_spec = P(BATCH, seq_axis)
     fn = jax.shard_map(
         functools.partial(
