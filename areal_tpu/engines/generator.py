@@ -65,9 +65,15 @@ class GeneratorEngine(Engine):
         self.compute_dtype = compute_dtype
         self.max_decode_batch = max_decode_batch
         self.batch_shard = batch_sharding_degree(mesh)
-        # Generation has no CP path yet (decode is token-at-a-time); only the
-        # flash half of the shared dispatch policy applies to prefill.
-        self._use_flash, _ = sharding.attn_dispatch(mesh)
+        # Generation has no CP/PP path (decode is token-at-a-time and
+        # latency-bound); only the flash half of the shared dispatch policy
+        # applies to prefill.
+        self._use_flash, _, pp_mesh, _, _ = sharding.attn_dispatch(mesh)
+        if pp_mesh is not None:
+            raise NotImplementedError(
+                "GeneratorEngine on a pipe>1 mesh; use a pipe=1 layout for "
+                "generation (decoupled gen/train meshes + param realloc)"
+            )
         self._gen_fns: Dict[Tuple, Any] = {}
         self.set_params(params)
 

@@ -15,7 +15,6 @@ from jax.sharding import Mesh
 
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model_api import Engine
-from areal_tpu.base.topology import batch_sharding_degree
 from areal_tpu.engines import packing
 from areal_tpu.models import transformer as tfm
 from areal_tpu.models.config import ModelConfig
@@ -35,8 +34,13 @@ class InferenceEngine(Engine):
         if jax.default_backend() == "cpu":
             compute_dtype = jnp.float32
         self.compute_dtype = compute_dtype
-        self.batch_shard = batch_sharding_degree(mesh)
-        self._use_flash, self._cp_mesh = sharding.attn_dispatch(mesh)
+        (
+            self._use_flash,
+            self._cp_mesh,
+            self._pp_mesh,
+            self._pp_microbatches,
+            self.batch_shard,
+        ) = sharding.attn_dispatch(mesh)
         self._fwd_fns: Dict[Any, Callable] = {}
         self.set_params(params)
 
@@ -104,6 +108,7 @@ class InferenceEngine(Engine):
         cfg = self.cfg
         use_flash = self._use_flash
         cp_mesh = self._cp_mesh
+        pp_mesh, pp_mbs = self._pp_mesh, self._pp_microbatches
 
         @jax.jit
         def fwd(params, batch):
@@ -115,6 +120,8 @@ class InferenceEngine(Engine):
                 positions=batch["positions"],
                 use_flash=use_flash,
                 cp_mesh=cp_mesh,
+                pp_mesh=pp_mesh,
+                pp_microbatches=pp_mbs,
             )
             return post_fn(out, batch)
 

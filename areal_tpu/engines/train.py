@@ -28,7 +28,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model_api import Engine, FinetuneSpec, OptimizerConfig
 from areal_tpu.base import logging
-from areal_tpu.base.topology import batch_sharding_degree
 from areal_tpu.engines import packing
 from areal_tpu.models import transformer as tfm
 from areal_tpu.models.config import ModelConfig
@@ -117,9 +116,14 @@ class TrainEngine(Engine):
         self._grad_fns: Dict[Any, Callable] = {}
         self._fwd_fns: Dict[Any, Callable] = {}
         self._apply_fn = None
-        self.batch_shard = batch_sharding_degree(mesh)
         self._batch_sharding = sharding.named(mesh, sharding.batch_pspec())
-        self._use_flash, self._cp_mesh = sharding.attn_dispatch(mesh)
+        (
+            self._use_flash,
+            self._cp_mesh,
+            self._pp_mesh,
+            self._pp_microbatches,
+            self.batch_shard,
+        ) = sharding.attn_dispatch(mesh)
 
     # ---------------- core jitted fns ----------------
 
@@ -129,6 +133,7 @@ class TrainEngine(Engine):
         cfg, compute_dtype = self.cfg, self.compute_dtype
         use_flash = self._use_flash
         cp_mesh = self._cp_mesh
+        pp_mesh, pp_mbs = self._pp_mesh, self._pp_microbatches
 
         @jax.jit
         def grad_fn(params, batch, loss_scale):
@@ -142,6 +147,8 @@ class TrainEngine(Engine):
                     remat=True,
                     use_flash=use_flash,
                     cp_mesh=cp_mesh,
+                    pp_mesh=pp_mesh,
+                    pp_microbatches=pp_mbs,
                 )
                 loss, stats = loss_fn(logits, batch)
                 total = loss + cfg.moe_aux_loss_coef * aux
@@ -290,6 +297,7 @@ class TrainEngine(Engine):
         cfg, compute_dtype = self.cfg, self.compute_dtype
         use_flash = self._use_flash
         cp_mesh = self._cp_mesh
+        pp_mesh, pp_mbs = self._pp_mesh, self._pp_microbatches
 
         @jax.jit
         def fwd(params, batch):
@@ -301,6 +309,8 @@ class TrainEngine(Engine):
                 positions=batch["positions"],
                 use_flash=use_flash,
                 cp_mesh=cp_mesh,
+                pp_mesh=pp_mesh,
+                pp_microbatches=pp_mbs,
             )
             return post_fn(logits, batch)
 

@@ -197,9 +197,26 @@ def _backbone(
     remat: bool,
     use_flash: "bool | None" = None,
     cp_mesh=None,
+    pp_mesh=None,
+    pp_microbatches: int = 4,
 ) -> Tuple[jax.Array, jax.Array]:
     x = jnp.take(params["embed"], tokens, axis=0)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    if pp_mesh is not None:
+        if cp_mesh is not None:
+            raise NotImplementedError(
+                "combined pipeline + ring context parallelism"
+            )
+        from areal_tpu.parallel.pipeline import pipelined_blocks
+
+        # The pipeline checkpoints each stage tick internally.
+        x, aux = pipelined_blocks(
+            params["blocks"], cfg, x, segment_ids, cos, sin,
+            pp_mesh, pp_microbatches, use_flash,
+        )
+        x = rms_norm(x, params["final_ln"], cfg.rms_norm_eps)
+        return x, aux
 
     def body(carry, blk):
         y, aux = _block_forward(
@@ -236,15 +253,20 @@ def forward(
     remat: bool = False,
     use_flash: "bool | None" = None,
     cp_mesh=None,
+    pp_mesh=None,
+    pp_microbatches: int = 4,
 ) -> jax.Array:
     """Full forward over packed rows -> fp32 logits [B,S,V] (or values [B,S]
     for critics).  Also returns MoE aux loss via `forward_with_aux`.
 
     `cp_mesh`: pass the engine's Mesh to route attention through ring
     context parallelism over its `seq` axis (areal_tpu/ops/ring_attention).
+    `pp_mesh`: pass the Mesh to microbatch-pipeline the block stack over its
+    `pipe` axis (areal_tpu/parallel/pipeline).
     """
     out, _ = forward_with_aux(
-        params, cfg, tokens, segment_ids, positions, remat, use_flash, cp_mesh
+        params, cfg, tokens, segment_ids, positions, remat, use_flash,
+        cp_mesh, pp_mesh, pp_microbatches,
     )
     return out
 
@@ -258,11 +280,14 @@ def forward_with_aux(
     remat: bool = False,
     use_flash: "bool | None" = None,
     cp_mesh=None,
+    pp_mesh=None,
+    pp_microbatches: int = 4,
 ) -> Tuple[jax.Array, jax.Array]:
     if positions is None:
         positions = positions_from_segments(segment_ids)
     x, aux = _backbone(
-        params, cfg, tokens, segment_ids, positions, remat, use_flash, cp_mesh
+        params, cfg, tokens, segment_ids, positions, remat, use_flash,
+        cp_mesh, pp_mesh, pp_microbatches,
     )
     return _head(params, cfg, x), aux
 

@@ -21,31 +21,38 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from areal_tpu.base.topology import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS
+from areal_tpu.base.topology import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+)
 
 BATCH = (DATA_AXIS, FSDP_AXIS)
 
-# Param rules: leaf name -> PartitionSpec (leading layer-stack axis included
-# for block params).
+# Param rules: leaf name -> PartitionSpec.  The leading layer-stack axis of
+# block params shards over `pipe`: stage s holds layers [s*L/P, (s+1)*L/P)
+# (see areal_tpu/parallel/pipeline.py); on pipe=1 meshes it is a no-op.
 _BLOCK_RULES: Dict[str, P] = {
-    "ln1": P(None, None),
-    "ln2": P(None, None),
-    "wq": P(None, FSDP_AXIS, MODEL_AXIS),
-    "wk": P(None, FSDP_AXIS, MODEL_AXIS),
-    "wv": P(None, FSDP_AXIS, MODEL_AXIS),
-    "bq": P(None, MODEL_AXIS),
-    "bk": P(None, MODEL_AXIS),
-    "bv": P(None, MODEL_AXIS),
-    "wo": P(None, MODEL_AXIS, FSDP_AXIS),
+    "ln1": P(PIPE_AXIS, None),
+    "ln2": P(PIPE_AXIS, None),
+    "wq": P(PIPE_AXIS, FSDP_AXIS, MODEL_AXIS),
+    "wk": P(PIPE_AXIS, FSDP_AXIS, MODEL_AXIS),
+    "wv": P(PIPE_AXIS, FSDP_AXIS, MODEL_AXIS),
+    "bq": P(PIPE_AXIS, MODEL_AXIS),
+    "bk": P(PIPE_AXIS, MODEL_AXIS),
+    "bv": P(PIPE_AXIS, MODEL_AXIS),
+    "wo": P(PIPE_AXIS, MODEL_AXIS, FSDP_AXIS),
     # Dense MLP
-    "wg": P(None, FSDP_AXIS, MODEL_AXIS),
-    "wu": P(None, FSDP_AXIS, MODEL_AXIS),
-    "wd": P(None, MODEL_AXIS, FSDP_AXIS),
+    "wg": P(PIPE_AXIS, FSDP_AXIS, MODEL_AXIS),
+    "wu": P(PIPE_AXIS, FSDP_AXIS, MODEL_AXIS),
+    "wd": P(PIPE_AXIS, MODEL_AXIS, FSDP_AXIS),
     # MoE: expert axis = expert parallelism over fsdp; hidden over model.
-    "router": P(None, FSDP_AXIS, None),
-    "moe_wg": P(None, FSDP_AXIS, None, MODEL_AXIS),
-    "moe_wu": P(None, FSDP_AXIS, None, MODEL_AXIS),
-    "moe_wd": P(None, FSDP_AXIS, MODEL_AXIS, None),
+    "router": P(PIPE_AXIS, FSDP_AXIS, None),
+    "moe_wg": P(PIPE_AXIS, FSDP_AXIS, None, MODEL_AXIS),
+    "moe_wu": P(PIPE_AXIS, FSDP_AXIS, None, MODEL_AXIS),
+    "moe_wd": P(PIPE_AXIS, FSDP_AXIS, MODEL_AXIS, None),
 }
 
 _TOP_RULES: Dict[str, P] = {
@@ -93,15 +100,31 @@ def kv_cache_pspec() -> P:
 
 
 def attn_dispatch(mesh: Mesh):
-    """Shared engine policy -> (use_flash, cp_mesh).
+    """Shared engine policy -> (use_flash, cp_mesh, pp_mesh, pp_microbatches,
+    rows_multiple).
 
     Pallas flash attention is not GSPMD-partitionable, so it is enabled
     (auto, i.e. on-TPU) only on single-device meshes; ring context
-    parallelism takes over whenever the mesh has a nontrivial `seq` axis.
+    parallelism takes over whenever the mesh has a nontrivial `seq` axis;
+    the block stack is microbatch-pipelined whenever `pipe` > 1 with
+    4 microbatches per stage (GPipe bubble (P-1)/(M+P-1) < ~20%).
+
+    `rows_multiple` is what packed-batch row counts must divide by: the
+    batch-sharding degree, times the microbatch count under PP (each
+    microbatch must itself split over the batch axes — product, not lcm).
     """
+    import numpy as np
+
+    from areal_tpu.base.topology import BATCH_AXES
+
     use_flash = None if mesh.devices.size == 1 else False
     cp_mesh = mesh if mesh.shape[SEQ_AXIS] > 1 else None
-    return use_flash, cp_mesh
+    pp_mesh = mesh if mesh.shape[PIPE_AXIS] > 1 else None
+    pp_microbatches = 4 * mesh.shape[PIPE_AXIS]
+    rows_multiple = int(np.prod([mesh.shape[a] for a in BATCH_AXES]))
+    if pp_mesh is not None:
+        rows_multiple *= pp_microbatches
+    return use_flash, cp_mesh, pp_mesh, pp_microbatches, rows_multiple
 
 
 def named(mesh: Mesh, spec: P) -> NamedSharding:

@@ -75,8 +75,8 @@ def test_moe_param_rules():
     cfg = tiny_config(n_experts=4)
     params = tfm.init_params(cfg, jax.random.PRNGKey(3))
     specs = sharding.param_pspecs(params)
-    assert specs["blocks"]["wg"] == P(None, "fsdp", None, "model")
-    assert specs["blocks"]["router"] == P(None, "fsdp", None)
+    assert specs["blocks"]["wg"] == P("pipe", "fsdp", None, "model")
+    assert specs["blocks"]["router"] == P("pipe", "fsdp", None)
 
 
 def test_critic_sharded(rng):
