@@ -1,0 +1,164 @@
+"""Quickstart CLI: `python -m areal_tpu.apps.quickstart <exp> [options]`.
+
+Capability parity: realhf/apps/quickstart.py (hydra CLI over registered
+experiment configs) — argparse-based (the config tree is small dataclasses;
+a YAML file via --config covers the reference's prologue path).
+
+Experiments:
+    sft       — supervised fine-tuning (experiments/common.py build_sft)
+    ppo-math  — PPO/GRPO with math-verified rewards (build_ppo_math)
+
+Examples:
+    python -m areal_tpu.apps.quickstart sft \
+        --model.path /ckpts/qwen2-1.5b --dataset.path data.jsonl \
+        --allocation d1f4m2 --batch-size 32 --epochs 1
+    python -m areal_tpu.apps.quickstart ppo-math \
+        --model.path /ckpts/qwen2-1.5b --dataset.path prompts.jsonl \
+        --group-size 8 --workers 1
+"""
+
+import argparse
+import json
+import os
+from typing import Optional
+
+from areal_tpu.api.config import ModelAbstraction
+from areal_tpu.api.data_api import DatasetAbstraction, MicroBatchSpec
+from areal_tpu.api.model_api import GenerationHyperparameters, OptimizerConfig
+from areal_tpu.base import logging
+from areal_tpu.base.topology import ParallelConfig
+from areal_tpu.experiments import common as exps
+from areal_tpu.system.master import ExperimentSaveEvalControl
+
+logger = logging.getLogger("quickstart")
+
+
+def _add_common(p: argparse.ArgumentParser):
+    p.add_argument("--model.path", dest="model_path", required=True,
+                   help="HF checkpoint dir")
+    p.add_argument("--dataset.path", dest="dataset_path", required=True,
+                   help="jsonl dataset path")
+    p.add_argument("--allocation", default="d1",
+                   help="parallel layout, e.g. d2f2m2 / p2f2m2 / d1s4")
+    p.add_argument("--tokenizer-path", default=None,
+                   help="tokenizer dir (default: model path); 'char:<n>' "
+                        "loads the hermetic char tokenizer")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--max-tokens-per-mb", type=int, default=16384)
+    p.add_argument("--lr", type=float, default=2e-5)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--experiment-name", default=None)
+    p.add_argument("--trial-name", default="trial0")
+    p.add_argument("--fileroot", default="/tmp/areal_tpu")
+    p.add_argument("--save-freq-steps", type=int, default=None)
+    p.add_argument("--ckpt-freq-steps", type=int, default=None)
+    p.add_argument("--benchmark-steps", type=int, default=None)
+    p.add_argument("--multiprocess", action="store_true",
+                   help="spawn workers as subprocesses over ZMQ (default: "
+                        "in-process)")
+    p.add_argument("--recover-retries", type=int, default=0)
+
+
+def _ctrl(args) -> ExperimentSaveEvalControl:
+    return ExperimentSaveEvalControl(
+        total_train_epochs=args.epochs,
+        save_freq_steps=args.save_freq_steps,
+        ckpt_freq_steps=args.ckpt_freq_steps,
+        benchmark_steps=args.benchmark_steps,
+    )
+
+
+def _run(plan, args):
+    from areal_tpu.apps import main as runner
+
+    if args.multiprocess:
+        return runner.run_experiment(
+            plan, recover_retries=args.recover_retries
+        )
+    return runner.run_experiment_inproc(plan)
+
+
+def cmd_sft(args):
+    cfg = exps.SFTConfig(
+        model=ModelAbstraction("hf", {"path": args.model_path}),
+        dataset=DatasetAbstraction(
+            "prompt_answer", {"dataset_path": args.dataset_path,
+                              "max_length": args.max_seqlen}
+        ),
+        parallel=ParallelConfig.from_str(args.allocation),
+        optimizer=OptimizerConfig(lr=args.lr),
+        batch_size=args.batch_size,
+        total_train_epochs=args.epochs,
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=args.max_tokens_per_mb),
+        ctrl=_ctrl(args),
+        seed=args.seed,
+        experiment_name=args.experiment_name or "sft",
+        trial_name=args.trial_name,
+        fileroot=args.fileroot,
+    )
+    plan = exps.build_sft(cfg)
+    for wc in plan.worker_configs:
+        wc.tokenizer_path = args.tokenizer_path or args.model_path
+    stats = _run(plan, args)
+    print(json.dumps(stats[-1] if stats else {}))
+
+
+def cmd_ppo_math(args):
+    cfg = exps.PPOMathConfig(
+        actor=ModelAbstraction("hf", {"path": args.model_path}),
+        dataset=DatasetAbstraction(
+            "math_code_prompt", {"dataset_path": args.dataset_path}
+        ),
+        actor_parallel=ParallelConfig.from_str(args.allocation),
+        gen_parallel=(
+            ParallelConfig.from_str(args.gen_allocation)
+            if args.gen_allocation
+            else None
+        ),
+        optimizer=OptimizerConfig(lr=args.lr),
+        gconfig=GenerationHyperparameters(
+            n=args.group_size,
+            max_new_tokens=args.max_new_tokens,
+            temperature=args.temperature,
+        ),
+        batch_size=args.batch_size,
+        total_train_epochs=args.epochs,
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=args.max_tokens_per_mb),
+        ctrl=_ctrl(args),
+        seed=args.seed,
+        experiment_name=args.experiment_name or "ppo-math",
+        trial_name=args.trial_name,
+        fileroot=args.fileroot,
+    )
+    plan = exps.build_ppo_math(cfg)
+    for wc in plan.worker_configs:
+        wc.tokenizer_path = args.tokenizer_path or args.model_path
+    stats = _run(plan, args)
+    print(json.dumps(stats[-1] if stats else {}))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="areal_tpu.apps.quickstart")
+    sub = p.add_subparsers(dest="exp", required=True)
+
+    ps = sub.add_parser("sft", help="supervised fine-tuning")
+    _add_common(ps)
+    ps.add_argument("--max-seqlen", type=int, default=4096)
+    ps.set_defaults(fn=cmd_sft)
+
+    pp = sub.add_parser("ppo-math", help="PPO/GRPO with verified rewards")
+    _add_common(pp)
+    pp.add_argument("--group-size", type=int, default=4)
+    pp.add_argument("--max-new-tokens", type=int, default=1024)
+    pp.add_argument("--temperature", type=float, default=1.0)
+    pp.add_argument("--gen-allocation", default=None,
+                    help="separate layout for generation (decoupled meshes)")
+    pp.set_defaults(fn=cmd_ppo_math)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

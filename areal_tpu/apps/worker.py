@@ -1,0 +1,52 @@
+"""Worker-process bootstrap: load a pickled WorkerConfig, serve the stream.
+
+Capability parity: realhf/apps/remote.py (re-register experiment from cached
+config, run the worker poll loop).  Launched by the scheduler as
+
+    python -m areal_tpu.apps.worker --config <plan_dir> --index <i> \
+        --experiment <name> --trial <name>
+
+Discovery/config env: AREAL_NAME_RESOLVE(=file) + AREAL_NAME_RESOLVE_ROOT
+must point at the trial's shared store (set by apps/main.py).
+"""
+
+import argparse
+import os
+import pickle
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", required=True, help="plan directory")
+    p.add_argument("--index", type=int, required=True)
+    p.add_argument("--experiment", required=True)
+    p.add_argument("--trial", required=True)
+    args = p.parse_args()
+
+    # Workers colocated on one host run on CPU devices unless told otherwise
+    # (one process owns the TPU runtime; see scheduler/local.py).
+    if os.environ.get("AREAL_WORKER_PLATFORM"):
+        import jax
+
+        jax.config.update(
+            "jax_platforms", os.environ["AREAL_WORKER_PLATFORM"]
+        )
+
+    from areal_tpu.base import logging, seeding
+    from areal_tpu.system.stream import run_worker_stream
+    from areal_tpu.system.worker import ModelWorker
+
+    logger = logging.getLogger(f"worker{args.index}")
+    with open(
+        os.path.join(args.config, f"worker_{args.index}.pkl"), "rb"
+    ) as f:
+        config = pickle.load(f)
+    seeding.set_random_seed(config.seed, config.worker_index)
+    worker = ModelWorker(config)
+    logger.info(f"worker {args.index} ready, serving stream")
+    run_worker_stream(worker, args.experiment, args.trial)
+    logger.info(f"worker {args.index} exiting")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,11 @@ from typing import List, Optional
 
 
 def load_hf_tokenizer(path: str, fast: bool = True):
+    # "char:<vocab_size>" loads the hermetic char tokenizer — lets worker
+    # subprocesses in tests/benchmarks bootstrap a tokenizer by path without
+    # an HF checkpoint on disk.
+    if path.startswith("char:"):
+        return CharTokenizer(vocab_size=int(path.split(":", 1)[1]))
     from transformers import AutoTokenizer
 
     tok = AutoTokenizer.from_pretrained(path, use_fast=fast)

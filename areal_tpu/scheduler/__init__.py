@@ -1,0 +1,7 @@
+from areal_tpu.scheduler.client import (  # noqa: F401
+    JobException,
+    JobInfo,
+    JobState,
+    SchedulerClient,
+    make_scheduler,
+)

@@ -1,0 +1,181 @@
+"""ZMQ master⇄worker request-reply stream for multi-process trials.
+
+Capability parity: realhf/system/request_reply_stream.py (ZMQ PUSH/PULL
+pairs + a syn-ack protocol for ordered delivery) — simplified: one ROUTER
+socket on the master and a DEALER per worker gives per-peer FIFO ordering
+from ZMQ/TCP itself, so no syn-ack layer is needed.  Request/response
+matching uses explicit request ids (the master pipelines many concurrent
+requests per worker from the asyncio DFG executor).
+
+Discovery mirrors the reference: the master publishes its tcp address via
+name_resolve (names.request_reply_stream) and every worker announces itself
+with a hello frame carrying its index.
+
+Payloads are pickled python dicts (SequenceSample metadata/arrays are
+numpy-based); this is the CONTROL plane — bulk tensors live on device and
+move via jax collectives / device_put (areal_tpu/parallel/realloc.py).
+"""
+
+import asyncio
+import pickle
+from typing import Any, Dict
+
+import zmq
+import zmq.asyncio
+
+from areal_tpu.base import logging, name_resolve, names, network
+from areal_tpu.system.master import WorkerPool
+
+logger = logging.getLogger("stream")
+
+STREAM_NAME = "master"
+
+
+class ZMQWorkerPool(WorkerPool):
+    """Master side: ROUTER socket, one outstanding-request table."""
+
+    def __init__(self, experiment_name: str, trial_name: str, n_workers: int):
+        self._n_workers = n_workers
+        self._ctx = zmq.asyncio.Context()
+        self._sock = self._ctx.socket(zmq.ROUTER)
+        # bind_to_random_port probes and binds atomically (no TOCTOU).
+        port = self._sock.bind_to_random_port("tcp://*")
+        host = network.gethostip()
+        self._addr = f"tcp://{host}:{port}"
+        name_resolve.add(
+            names.request_reply_stream(experiment_name, trial_name, STREAM_NAME),
+            self._addr,
+            replace=True,
+        )
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._hello: Dict[int, bytes] = {}  # worker index -> zmq identity
+        self._hello_event = asyncio.Event()
+        self._next_req_id = 0
+        self._recv_task = None
+        logger.info(f"master stream bound at {self._addr}")
+
+    @property
+    def n_workers(self) -> int:
+        return self._n_workers
+
+    def _ensure_recv_loop(self):
+        if self._recv_task is None:
+            self._recv_task = asyncio.get_running_loop().create_task(
+                self._recv_loop()
+            )
+
+    async def _recv_loop(self):
+        try:
+            while True:
+                ident, payload = await self._sock.recv_multipart()
+                try:
+                    msg = pickle.loads(payload)
+                except Exception as e:  # corrupt frame: drop, keep serving
+                    logger.error(f"undecodable frame from {ident!r}: {e!r}")
+                    continue
+                if msg.get("type") == "hello":
+                    self._hello[int(msg["worker_index"])] = ident
+                    if len(self._hello) >= self._n_workers:
+                        self._hello_event.set()
+                    continue
+                fut = self._pending.pop(msg.get("req_id"), None)
+                if fut is None:
+                    logger.warning(f"orphan reply req_id={msg.get('req_id')}")
+                    continue
+                if fut.done():  # request cancelled during teardown
+                    continue
+                if msg.get("error"):
+                    fut.set_exception(RuntimeError(msg["error"]))
+                else:
+                    fut.set_result(msg["result"])
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # A dead recv loop must not strand awaiting requests: fail them.
+            logger.error(f"stream recv loop died: {e!r}")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(
+                        RuntimeError(f"stream recv loop died: {e!r}")
+                    )
+            self._pending.clear()
+            raise
+
+    async def wait_workers(self, timeout: float = 300.0):
+        """Block until every worker has said hello."""
+        self._ensure_recv_loop()
+        await asyncio.wait_for(self._hello_event.wait(), timeout)
+        logger.info(f"all {self._n_workers} workers connected")
+
+    async def request(self, worker_id: int, payload: Dict[str, Any]) -> Dict:
+        self._ensure_recv_loop()
+        if not self._hello_event.is_set():
+            await self.wait_workers()
+        req_id = self._next_req_id
+        self._next_req_id += 1
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        msg = pickle.dumps({"req_id": req_id, "request": payload})
+        await self._sock.send_multipart([self._hello[worker_id], msg])
+        return await fut
+
+    async def broadcast(self, payload: Dict[str, Any]):
+        return await asyncio.gather(
+            *[self.request(w, payload) for w in range(self._n_workers)]
+        )
+
+    def close(self):
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+        self._sock.close(linger=0)
+        self._ctx.term()
+
+
+def run_worker_stream(
+    worker,  # ModelWorker
+    experiment_name: str,
+    trial_name: str,
+    timeout: float = 300.0,
+) -> None:
+    """Worker side: connect, announce, serve requests until 'exit'.
+
+    Synchronous by design — MFC execution is device-bound and serial per
+    worker (the reference's model worker also executes one blocking request
+    at a time, model_worker.py:667)."""
+    addr = name_resolve.wait(
+        names.request_reply_stream(experiment_name, trial_name, STREAM_NAME),
+        timeout=timeout,
+    )
+    ctx = zmq.Context()
+    sock = ctx.socket(zmq.DEALER)
+    sock.connect(addr)
+    sock.send(
+        pickle.dumps(
+            {"type": "hello", "worker_index": worker.config.worker_index}
+        )
+    )
+    logger.info(
+        f"worker {worker.config.worker_index} connected to master at {addr}"
+    )
+    try:
+        while True:
+            msg = pickle.loads(sock.recv())
+            req = msg["request"]
+            if req.get("type") == "exit":
+                sock.send(
+                    pickle.dumps({"req_id": msg["req_id"], "result": {}})
+                )
+                break
+            try:
+                result = worker.handle_request(req)
+                reply = {"req_id": msg["req_id"], "result": result}
+            except Exception as e:  # noqa: BLE001 — forwarded to master
+                logger.error(
+                    f"worker {worker.config.worker_index} request "
+                    f"{req.get('type')} failed: {e!r}"
+                )
+                reply = {"req_id": msg["req_id"], "error": repr(e)}
+            sock.send(pickle.dumps(reply))
+    finally:
+        sock.close(linger=0)
+        ctx.term()

@@ -1,0 +1,93 @@
+"""Multi-process runtime: ZMQ stream + local scheduler + worker bootstrap.
+
+Mirrors the reference's end-to-end experiment tests (tests/experiments/
+utils.py: master in the main process, model workers in spawned processes),
+with the file name-resolve backend for discovery.
+"""
+
+import os
+import pickle
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import ModelAbstraction, ModelInterfaceAbstraction
+from areal_tpu.api.data_api import DatasetAbstraction, MicroBatchSpec
+from areal_tpu.api.model_api import OptimizerConfig
+from areal_tpu.base import name_resolve
+from areal_tpu.base.topology import ParallelConfig
+from areal_tpu.models.config import tiny_config
+from areal_tpu.scheduler import JobException, JobState, make_scheduler
+from areal_tpu.system.master import ExperimentSaveEvalControl
+
+from tests import fixtures
+
+
+def test_local_scheduler_lifecycle(tmp_path):
+    sched = make_scheduler("local", "t", "s", log_root=str(tmp_path))
+    sched.submit("ok", [sys.executable, "-c", "print('done')"])
+    sched.wait(timeout=30)
+    info = sched.find("ok")
+    assert info.state == JobState.COMPLETED
+
+    sched2 = make_scheduler("local", "t", "s2", log_root=str(tmp_path))
+    sched2.submit("bad", [sys.executable, "-c", "import sys; sys.exit(3)"])
+    with pytest.raises(JobException):
+        sched2.wait(timeout=30)
+
+    sched3 = make_scheduler("local", "t", "s3", log_root=str(tmp_path))
+    sched3.submit(
+        "hang", [sys.executable, "-c", "import time; time.sleep(600)"]
+    )
+    sched3.stop_all()
+    assert sched3.find("hang").state == JobState.CANCELLED
+
+
+def test_sft_multiprocess_e2e(tmp_path):
+    """Full trial over ZMQ: 1 worker subprocess, master here, 2 steps."""
+    from areal_tpu.experiments.common import SFTConfig, build_sft
+    from areal_tpu.apps import main as runner
+
+    # A tiny jsonl dataset on disk; the worker subprocess bootstraps the
+    # hermetic char tokenizer via the "char:<vocab>" path scheme.
+    rows = fixtures.build_sft_rows(16, seed=5)
+    data_path = tmp_path / "data.jsonl"
+    import json
+
+    with open(data_path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+    cfg = SFTConfig(
+        model=ModelAbstraction("random", {"config": tiny_config()}),
+        dataset=DatasetAbstraction(
+            "prompt_answer",
+            {"dataset_path": str(data_path), "max_length": 128},
+        ),
+        parallel=ParallelConfig(),
+        optimizer=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0),
+        batch_size=8,
+        total_train_epochs=1,
+        mb_spec=MicroBatchSpec(n_mbs=2),
+        ctrl=ExperimentSaveEvalControl(
+            total_train_epochs=1, benchmark_steps=2
+        ),
+        experiment_name="zmqtest",
+        trial_name="t0",
+        fileroot=str(tmp_path / "trial"),
+    )
+    plan = build_sft(cfg)
+    for wc in plan.worker_configs:
+        wc.tokenizer_path = "char:512"
+
+    stats = runner.run_experiment(
+        plan,
+        worker_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        },
+    )
+    assert len(stats) == 2
+    assert np.isfinite(stats[-1]["nll"])
