@@ -1,0 +1,4 @@
+from areal_tpu.search_engine.search import (  # noqa: F401
+    RPCAllocation,
+    search_rpc_allocations,
+)

@@ -1,0 +1,150 @@
+"""Roofline cost/memory estimation per (MFC, mesh, layout) option.
+
+Capability parity: realhf/search_engine/estimate.py (op/comm time + memory
+estimation feeding mdm_search) — re-parameterized for the TPU roofline:
+MXU-bound matmul time, HBM-bound decode, ICI-bound collectives, instead of
+profiled CUDA layer tables.
+
+All estimates are per training step of one MFC, in seconds / bytes
+per device.  Coarse by design: the search only needs correct *ordering*
+between candidate layouts, and the reference likewise searches on a
+simulator, not measurements.
+"""
+
+import dataclasses
+
+from areal_tpu.base.topology import ParallelConfig
+from areal_tpu.models.config import ModelConfig
+from areal_tpu.search_engine.spec import TPUChipSpec
+
+
+@dataclasses.dataclass
+class MFCStats:
+    """Workload of one MFC per step."""
+
+    n_seqs: int              # sequences per step
+    avg_seqlen: int          # average total length (prompt + generated)
+    gen_tokens: int = 0      # decoded tokens per sequence (generate MFCs)
+
+
+def n_params(cfg: ModelConfig) -> float:
+    d, f, L, v = cfg.hidden_dim, cfg.intermediate_dim, cfg.n_layers, cfg.vocab_size
+    attn = d * (cfg.q_dim + 2 * cfg.kv_dim) + cfg.q_dim * d
+    if cfg.is_moe:
+        mlp = 3 * d * cfg.moe_intermediate_dim * cfg.n_experts + d * cfg.n_experts
+    else:
+        mlp = 3 * d * f
+    embed = v * d * (1 if cfg.tied_embeddings else 2)
+    return float(L * (attn + mlp) + embed)
+
+
+def fwd_flops(cfg: ModelConfig, tokens: float, avg_seqlen: float) -> float:
+    """2*N per token matmul flops + quadratic attention term."""
+    quad = 4.0 * cfg.n_layers * cfg.q_dim * avg_seqlen * tokens
+    return 2.0 * n_params(cfg) * tokens + quad
+
+
+def _shard(parallel: ParallelConfig) -> float:
+    """Degree over which params shard (fsdp x model x pipe)."""
+    return float(parallel.fsdp * parallel.model * parallel.pipe)
+
+
+def train_time(
+    cfg: ModelConfig, st: MFCStats, parallel: ParallelConfig, chip: TPUChipSpec
+) -> float:
+    tokens = st.n_seqs * st.avg_seqlen
+    flops = 3.0 * fwd_flops(cfg, tokens, st.avg_seqlen)  # fwd + bwd
+    compute = flops / (parallel.world_size * chip.bf16_flops * chip.mfu)
+    pbytes = 2.0 * n_params(cfg)  # bf16
+    comm = 0.0
+    if parallel.model > 1:
+        # 4 all-reduces of activations per layer (fwd+bwd), ring cost.
+        act = tokens * cfg.hidden_dim * 2.0 / (parallel.data * parallel.fsdp * parallel.seq)
+        comm += (
+            4.0 * cfg.n_layers * act
+            * (parallel.model - 1) / parallel.model
+            / (chip.ici_bw * chip.comm_eff)
+        )
+    if parallel.fsdp > 1:
+        # all-gather params (fwd+bwd) + reduce-scatter grads.
+        comm += 3.0 * (pbytes / parallel.model / parallel.pipe) * (
+            (parallel.fsdp - 1) / parallel.fsdp
+        ) / (chip.ici_bw * chip.comm_eff)
+    if parallel.pipe > 1:
+        # GPipe bubble: (P-1)/(M+P-1) with M=4P microbatches.
+        P = parallel.pipe
+        compute *= 1.0 + (P - 1) / (4.0 * P + P - 1)
+    return compute + comm
+
+
+def inference_time(
+    cfg: ModelConfig, st: MFCStats, parallel: ParallelConfig, chip: TPUChipSpec
+) -> float:
+    tokens = st.n_seqs * st.avg_seqlen
+    compute = fwd_flops(cfg, tokens, st.avg_seqlen) / (
+        parallel.world_size * chip.bf16_flops * chip.mfu
+    )
+    return compute
+
+
+def generate_time(
+    cfg: ModelConfig, st: MFCStats, parallel: ParallelConfig, chip: TPUChipSpec
+) -> float:
+    """Prefill (MXU-bound) + decode (HBM-bound weight streaming)."""
+    prompt_len = max(st.avg_seqlen - st.gen_tokens, 1)
+    prefill = fwd_flops(cfg, st.n_seqs * prompt_len, prompt_len) / (
+        parallel.world_size * chip.bf16_flops * chip.mfu
+    )
+    pbytes_dev = 2.0 * n_params(cfg) / _shard(parallel)
+    batch_per_dev = max(st.n_seqs / (parallel.data * parallel.fsdp), 1.0)
+    per_step_compute = 2.0 * n_params(cfg) * batch_per_dev / (
+        _shard(parallel) * chip.bf16_flops * chip.mfu
+    )
+    per_step = max(pbytes_dev / chip.hbm_bw, per_step_compute)
+    return prefill + st.gen_tokens * per_step
+
+
+def train_persist_mem(cfg: ModelConfig, parallel: ParallelConfig) -> float:
+    """fp32 master + Adam(mu,nu) + bf16 compute copy + fp32 grads."""
+    return n_params(cfg) * (4.0 + 8.0 + 2.0 + 4.0) / _shard(parallel)
+
+
+def gen_persist_mem(
+    cfg: ModelConfig, st: MFCStats, parallel: ParallelConfig
+) -> float:
+    pbytes = 2.0 * n_params(cfg) / _shard(parallel)
+    kv = (
+        2.0 * st.n_seqs * st.avg_seqlen * cfg.n_layers * cfg.kv_dim * 2.0
+        / (parallel.data * parallel.fsdp * parallel.model)
+    )
+    return pbytes + kv
+
+
+def act_mem(
+    cfg: ModelConfig, st: MFCStats, parallel: ParallelConfig, max_tokens_per_mb: int
+) -> float:
+    """Transient activation memory with remat: one layer's activations plus
+    the per-layer residual stream, and the fp32 logits of one micro-batch."""
+    tok_dev = max_tokens_per_mb / (parallel.data * parallel.fsdp * parallel.seq)
+    resid = tok_dev * cfg.hidden_dim * 4.0 * cfg.n_layers / parallel.pipe * 0.1
+    layer = tok_dev * (cfg.hidden_dim * 8.0 + cfg.intermediate_dim * 2.0) / parallel.model
+    logits = tok_dev * cfg.vocab_size * 4.0 * 3.0 / parallel.model
+    return resid + layer + logits
+
+
+def realloc_cost(
+    cfg: ModelConfig,
+    src: ParallelConfig,
+    dst: ParallelConfig,
+    same_mesh: bool,
+    chip: TPUChipSpec,
+) -> float:
+    """Reshard cost between two layouts of the same model's params."""
+    if same_mesh and src == dst:
+        return 0.0
+    pbytes = 2.0 * n_params(cfg)
+    bw = (chip.ici_bw if same_mesh else chip.dcn_bw) * chip.comm_eff
+    # Each device receives its destination shard; approximate total moved
+    # bytes as one full param set over the aggregate bandwidth of the
+    # destination's sharding degree.
+    return pbytes / _shard(dst) / bw * max(_shard(dst) / _shard(src), 1.0)

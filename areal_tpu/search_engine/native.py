@@ -1,0 +1,218 @@
+"""ctypes bindings for the C++ MCMC allocation search (csrc/search/).
+
+Builds the shared library on demand with `make` (g++), mirroring the
+reference's compiled mdm_search extension (csrc/search/search.cpp:706,
+driven from realhf/search_engine/search.py).  A pure-python fallback
+implements the same simulate() semantics for environments without a
+toolchain (and doubles as the parity oracle in tests).
+"""
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("mdm_search")
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_LIB_PATH = os.path.abspath(os.path.join(_CSRC, "build", "libmdm_search.so"))
+_lib = None
+
+INFEASIBLE = 1e30
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    try:
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make"], cwd=os.path.abspath(_CSRC), check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+    except (OSError, subprocess.CalledProcessError) as e:
+        logger.warning(f"cannot load mdm_search ({e!r}); python fallback")
+        return None
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    common = [
+        ctypes.c_int, i32p, i32p, f64p, f64p, f64p, i32p,
+        ctypes.c_int, i32p, i32p,
+        ctypes.c_int, i32p, i32p,
+        ctypes.c_int, i32p, i32p, f64p, i32p,
+        ctypes.c_double,
+    ]
+    lib.mdm_simulate.restype = ctypes.c_double
+    lib.mdm_simulate.argtypes = common + [i32p]
+    lib.mdm_search.restype = ctypes.c_double
+    lib.mdm_search.argtypes = common + [
+        ctypes.c_int64, ctypes.c_uint64, ctypes.c_double, ctypes.c_double,
+        i32p,
+    ]
+    _lib = lib
+    return _lib
+
+
+class Instance:
+    """Flattened search problem (mirrors csrc/search/mdm_search.cpp)."""
+
+    def __init__(
+        self,
+        times: List[List[float]],          # [mfc][option] seconds
+        exec_mems: List[List[float]],      # [mfc][option] bytes
+        persist_mems: List[List[float]],   # [mfc][option] bytes
+        mesh_ids: List[List[int]],         # [mfc][option]
+        mesh_ranges: Sequence[Tuple[int, int]],  # [n_meshes] chip [lo, hi)
+        deps: Sequence[Tuple[int, int]],   # (src, dst) MFC indices
+        syncs: Sequence[Tuple[int, int, np.ndarray]],  # (a, b, cost[na, nb])
+        mem_cap: float,
+    ):
+        self.n_mfcs = len(times)
+        self.n_options = np.array([len(t) for t in times], np.int32)
+        self.opt_offset = np.zeros(self.n_mfcs, np.int32)
+        np.cumsum(self.n_options[:-1], out=self.opt_offset[1:])
+        self.time = np.concatenate([np.asarray(t, np.float64) for t in times])
+        self.exec_mem = np.concatenate(
+            [np.asarray(t, np.float64) for t in exec_mems]
+        )
+        self.persist_mem = np.concatenate(
+            [np.asarray(t, np.float64) for t in persist_mems]
+        )
+        self.mesh_of = np.concatenate(
+            [np.asarray(t, np.int32) for t in mesh_ids]
+        )
+        self.mesh_lo = np.array([r[0] for r in mesh_ranges], np.int32)
+        self.mesh_hi = np.array([r[1] for r in mesh_ranges], np.int32)
+        self.n_meshes = len(mesh_ranges)
+        self.dep_src = np.array([d[0] for d in deps], np.int32)
+        self.dep_dst = np.array([d[1] for d in deps], np.int32)
+        self.sync_a = np.array([s[0] for s in syncs], np.int32)
+        self.sync_b = np.array([s[1] for s in syncs], np.int32)
+        tables = [np.asarray(s[2], np.float64).ravel() for s in syncs]
+        self.sync_cost = (
+            np.concatenate(tables) if tables else np.zeros(0, np.float64)
+        )
+        self.sync_offset = np.zeros(len(syncs), np.int32)
+        off = 0
+        for i, t in enumerate(tables):
+            self.sync_offset[i] = off
+            off += t.size
+        self.mem_cap = float(mem_cap)
+
+    def _args(self):
+        return (
+            self.n_mfcs, self.n_options, self.opt_offset, self.time,
+            self.exec_mem, self.persist_mem, self.mesh_of,
+            self.n_meshes, self.mesh_lo, self.mesh_hi,
+            len(self.dep_src), self.dep_src, self.dep_dst,
+            len(self.sync_a), self.sync_a, self.sync_b,
+            self.sync_cost, self.sync_offset,
+            self.mem_cap,
+        )
+
+    # ---------------- native ----------------
+
+    def simulate(self, assign: Sequence[int]) -> float:
+        a = np.asarray(assign, np.int32)
+        lib = _load()
+        if lib is not None:
+            return float(lib.mdm_simulate(*self._args(), a))
+        return self.simulate_py(a)
+
+    def search(
+        self,
+        iters: int = 20000,
+        seed: int = 0,
+        beta0: float = 0.1,
+        beta1: float = 50.0,
+    ) -> Tuple[np.ndarray, float]:
+        lib = _load()
+        best = np.zeros(self.n_mfcs, np.int32)
+        if lib is not None:
+            cost = float(
+                lib.mdm_search(
+                    *self._args(), iters, seed, beta0, beta1, best
+                )
+            )
+            return best, cost
+        return self.search_py(iters, seed, beta0, beta1)
+
+    # ---------------- pure-python mirror ----------------
+
+    def simulate_py(self, assign: Sequence[int]) -> float:
+        finish = np.zeros(self.n_mfcs)
+        mesh_free = np.zeros(self.n_meshes)
+        mesh_persist = np.zeros(self.n_meshes)
+        mesh_max_exec = np.zeros(self.n_meshes)
+        for i in range(self.n_mfcs):
+            o = self.opt_offset[i] + assign[i]
+            m = self.mesh_of[o]
+            mesh_persist[m] += self.persist_mem[o]
+            mesh_max_exec[m] = max(mesh_max_exec[m], self.exec_mem[o])
+        for m in range(self.n_meshes):
+            peak = mesh_persist[m] + mesh_max_exec[m]
+            for m2 in range(self.n_meshes):
+                if m2 != m and self.mesh_overlap[m, m2]:
+                    peak += mesh_persist[m2]
+            if peak > self.mem_cap:
+                return INFEASIBLE
+        sync_delay = np.zeros(self.n_mfcs)
+        for s in range(len(self.sync_a)):
+            a, b = self.sync_a[s], self.sync_b[s]
+            nb = self.n_options[b]
+            sync_delay[b] += self.sync_cost[
+                self.sync_offset[s] + assign[a] * nb + assign[b]
+            ]
+        for i in range(self.n_mfcs):
+            o = self.opt_offset[i] + assign[i]
+            m = self.mesh_of[o]
+            start = 0.0
+            for s, d in zip(self.dep_src, self.dep_dst):
+                if d == i:
+                    start = max(start, finish[s])
+            for m2 in range(self.n_meshes):
+                if self.mesh_overlap[m, m2]:
+                    start = max(start, mesh_free[m2])
+            start += sync_delay[i]
+            finish[i] = start + self.time[o]
+            mesh_free[m] = finish[i]
+        return float(finish.max(initial=0.0))
+
+    def search_py(self, iters, seed, beta0, beta1):
+        rng = np.random.default_rng(seed)
+        cur = np.array(
+            [int(np.argmin(t)) for t in np.split(self.time, self.opt_offset[1:])],
+            np.int32,
+        )
+        cost = self.simulate_py(cur)
+        if cost >= INFEASIBLE:
+            cur = np.zeros(self.n_mfcs, np.int32)
+            cost = self.simulate_py(cur)
+        best, best_cost = cur.copy(), cost
+        for it in range(iters):
+            beta = beta0 + (beta1 - beta0) * it / max(iters - 1, 1)
+            i = int(rng.integers(self.n_mfcs))
+            if self.n_options[i] <= 1:
+                continue
+            old = cur[i]
+            prop = int(rng.integers(self.n_options[i]))
+            if prop == old:
+                prop = (prop + 1) % self.n_options[i]
+            cur[i] = prop
+            c = self.simulate_py(cur)
+            if c <= cost or (
+                c < INFEASIBLE
+                and rng.random() < np.exp(-beta * (c - cost))
+            ):
+                cost = c
+                if c < best_cost:
+                    best_cost, best = c, cur.copy()
+            else:
+                cur[i] = old
+        return best, float(best_cost)

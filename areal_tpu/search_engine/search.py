@@ -1,0 +1,200 @@
+"""Allocation auto-search: pick (device mesh, layout) per MFC.
+
+Capability parity: realhf/search_engine/search.py `search_rpc_allocations`
+(profile -> estimate -> multi_mcmc_search -> RPCAllocation list) — the
+estimator is the TPU roofline (estimate.py) and the combinatorial search is
+the C++ library (csrc/search/mdm_search.cpp, ctypes via native.py).
+
+Device-mesh candidates over an n-chip slice: the full slice and its two
+contiguous halves (the reference's disjoint gen/train split,
+`sglang.d64p1m1+d32p2m1`).  Layout candidates per mesh: every
+(data, fsdp, model[, pipe]) factorization that divides the model's head
+counts/layers.  The first option of every MFC is the most
+memory-conservative (max sharding) so the search always has a feasible
+fallback.
+"""
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from areal_tpu.api.config import ModelInterfaceType
+from areal_tpu.base import logging
+from areal_tpu.base.topology import ParallelConfig
+from areal_tpu.models.config import ModelConfig
+from areal_tpu.search_engine import estimate, native
+from areal_tpu.search_engine.spec import CHIPS, TPUChipSpec
+
+logger = logging.getLogger("search")
+
+
+@dataclasses.dataclass
+class RPCAllocation:
+    """Result per MFC (reference: api/quickstart/device_mesh.py:317)."""
+
+    rpc_name: str
+    device_range: Tuple[int, int]  # [start, end) chip indices in the slice
+    parallel: ParallelConfig
+    est_time: float
+
+
+@dataclasses.dataclass
+class MFCSpec:
+    name: str
+    model_key: str               # MFCs sharing a model get sync edges
+    interface_type: ModelInterfaceType
+    config: ModelConfig
+    stats: estimate.MFCStats
+    trainable: bool = False      # holds optimizer state
+
+
+def _factorizations(n: int, cfg: ModelConfig, allow_pipe: bool):
+    """(data, fsdp, model, pipe) tuples with product n, honoring the model's
+    divisibility limits."""
+    out = []
+    for m in (x for x in range(1, n + 1) if n % x == 0):
+        if cfg.n_kv_heads % m or cfg.n_q_heads % m:
+            continue
+        for p in (x for x in range(1, n // m + 1) if (n // m) % x == 0):
+            if p > 1 and (not allow_pipe or cfg.n_layers % p):
+                continue
+            rem = n // m // p
+            for f in (x for x in range(1, rem + 1) if rem % x == 0):
+                if cfg.hidden_dim % f:
+                    continue
+                d = rem // f
+                out.append(ParallelConfig(data=d, fsdp=f, model=m, pipe=p))
+    return out
+
+
+def _mesh_candidates(n_devices: int) -> List[Tuple[int, int]]:
+    meshes = [(0, n_devices)]
+    if n_devices >= 2 and n_devices % 2 == 0:
+        meshes += [(0, n_devices // 2), (n_devices // 2, n_devices)]
+    return meshes
+
+
+def _option_time(spec: MFCSpec, pc: ParallelConfig, chip: TPUChipSpec) -> float:
+    if spec.interface_type == ModelInterfaceType.TRAIN_STEP:
+        return estimate.train_time(spec.config, spec.stats, pc, chip)
+    if spec.interface_type == ModelInterfaceType.GENERATE:
+        return estimate.generate_time(spec.config, spec.stats, pc, chip)
+    return estimate.inference_time(spec.config, spec.stats, pc, chip)
+
+
+def _option_mems(
+    spec: MFCSpec, pc: ParallelConfig, max_tokens_per_mb: int
+) -> Tuple[float, float]:
+    if spec.trainable:
+        persist = estimate.train_persist_mem(spec.config, pc)
+    elif spec.interface_type == ModelInterfaceType.GENERATE:
+        persist = estimate.gen_persist_mem(spec.config, spec.stats, pc)
+    else:
+        persist = 2.0 * estimate.n_params(spec.config) / (
+            pc.fsdp * pc.model * pc.pipe
+        )
+    exec_mem = estimate.act_mem(spec.config, spec.stats, pc, max_tokens_per_mb)
+    return exec_mem, persist
+
+
+def search_rpc_allocations(
+    mfcs: Sequence[MFCSpec],
+    deps: Sequence[Tuple[int, int]],
+    n_devices: int,
+    chip: "TPUChipSpec | str" = "v5e",
+    max_tokens_per_mb: int = 16384,
+    iters: int = 20000,
+    seed: int = 1,
+    mem_headroom: float = 0.9,
+) -> List[RPCAllocation]:
+    """Search (mesh, layout) per MFC minimizing simulated step makespan."""
+    if isinstance(chip, str):
+        chip = CHIPS[chip]
+
+    meshes = _mesh_candidates(n_devices)
+    overlap = np.zeros((len(meshes), len(meshes)), bool)
+    for i, (a0, a1) in enumerate(meshes):
+        for j, (b0, b1) in enumerate(meshes):
+            overlap[i, j] = not (a1 <= b0 or b1 <= a0)
+
+    times, exec_mems, persist_mems, mesh_ids = [], [], [], []
+    options: List[List[Tuple[int, ParallelConfig]]] = []
+    for spec in mfcs:
+        opts, t, em, pm, mi = [], [], [], [], []
+        allow_pipe = spec.interface_type != ModelInterfaceType.GENERATE
+        for mesh_id, (lo, hi) in enumerate(meshes):
+            for pc in _factorizations(hi - lo, spec.config, allow_pipe):
+                opts.append((mesh_id, pc))
+                t.append(_option_time(spec, pc, chip))
+                e, p = _option_mems(spec, pc, max_tokens_per_mb)
+                em.append(e)
+                pm.append(p)
+                mi.append(mesh_id)
+        if not opts:
+            raise ValueError(
+                f"no feasible layout for MFC {spec.name} on {n_devices} chips"
+            )
+        # Most-memory-conservative option first: the C++ search restarts
+        # from all-zeros if the greedy init is infeasible.
+        order = np.argsort(
+            [pm[i] + em[i] for i in range(len(opts))], kind="stable"
+        )
+        opts = [opts[i] for i in order]
+        options.append(opts)
+        times.append([t[i] for i in order])
+        exec_mems.append([em[i] for i in order])
+        persist_mems.append([pm[i] for i in order])
+        mesh_ids.append([mi[i] for i in order])
+
+    # Param-sync tables between MFCs sharing a model.
+    syncs = []
+    for i, a in enumerate(mfcs):
+        for j, b in enumerate(mfcs):
+            if i >= j or a.model_key != b.model_key:
+                continue
+            table = np.zeros((len(options[i]), len(options[j])))
+            for oi, (ma, pa) in enumerate(options[i]):
+                for oj, (mb, pb) in enumerate(options[j]):
+                    table[oi, oj] = estimate.realloc_cost(
+                        a.config, pa, pb, same_mesh=bool(overlap[ma, mb]),
+                        chip=chip,
+                    )
+            syncs.append((i, j, table))
+
+    inst = native.Instance(
+        times=times,
+        exec_mems=exec_mems,
+        persist_mems=persist_mems,
+        mesh_ids=mesh_ids,
+        mesh_overlap=overlap,
+        deps=deps,
+        syncs=syncs,
+        mem_cap=chip.hbm_bytes * mem_headroom,
+    )
+    assign, cost = inst.search(iters=iters, seed=seed)
+    if cost >= native.INFEASIBLE:
+        raise RuntimeError(
+            f"no feasible allocation under {chip.hbm_bytes * mem_headroom:.1e}"
+            f" bytes/chip for {n_devices} chips"
+        )
+
+    out = []
+    for i, spec in enumerate(mfcs):
+        mesh_id, pc = options[i][assign[i]]
+        lo, hi = meshes[mesh_id]
+        out.append(
+            RPCAllocation(
+                rpc_name=spec.name,
+                device_range=(lo, hi),
+                parallel=pc,
+                est_time=times[i][assign[i]],
+            )
+        )
+        logger.info(
+            f"alloc {spec.name}: chips [{lo},{hi}) layout {pc.to_str()} "
+            f"(~{times[i][assign[i]]:.3f}s/step)"
+        )
+    logger.info(f"simulated step makespan: {cost:.3f}s")
+    return out

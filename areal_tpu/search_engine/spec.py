@@ -1,0 +1,40 @@
+"""TPU chip/interconnect specs for the allocation-search cost model.
+
+Capability parity: the reference's cluster spec + profiled GPU cost tables
+(realhf/search_engine/estimate.py reads profiled layer stats); on TPU the
+roofline numbers are stable enough to parameterize directly.  Numbers are
+public datasheet values derated by an empirical MFU/utilization factor.
+"""
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class TPUChipSpec:
+    name: str
+    bf16_flops: float        # peak bf16 FLOP/s per chip
+    hbm_bytes: float         # HBM capacity per chip
+    hbm_bw: float            # HBM bandwidth bytes/s
+    ici_bw: float            # per-link ICI bandwidth bytes/s (one direction)
+    dcn_bw: float = 25e9 / 8  # host NIC, bytes/s
+    mfu: float = 0.4         # achievable fraction of peak on matmul-heavy work
+    comm_eff: float = 0.7    # achieved fraction of ICI peak on collectives
+
+
+V5E = TPUChipSpec(
+    name="v5e",
+    bf16_flops=197e12,
+    hbm_bytes=16e9,
+    hbm_bw=819e9,
+    ici_bw=1600e9 / 8 / 2,  # 1.6 Tbps total over links -> per-direction bytes
+)
+
+V5P = TPUChipSpec(
+    name="v5p",
+    bf16_flops=459e12,
+    hbm_bytes=95e9,
+    hbm_bw=2765e9,
+    ici_bw=4800e9 / 8 / 2,
+)
+
+CHIPS = {"v5e": V5E, "v5p": V5P}

@@ -1,0 +1,234 @@
+// MCMC allocation search over (device mesh x parallel layout) per MFC.
+//
+// Capability parity: the reference's csrc/search/ (search.cpp multi_mcmc_search,
+// simulate.cpp, rpc.cpp) — re-built for TPU: the cost tables are computed in
+// Python from a TPU chip spec (MXU flops, HBM, ICI/DCN bandwidth,
+// areal_tpu/search_engine/estimate.py) and this library does the
+// combinatorial part: simulated-annealing over per-MFC option assignments,
+// minimizing the simulated end-to-end step makespan under per-device memory
+// caps.
+//
+// Model:
+//  - Each MFC i has n_options[i] candidate (mesh, layout) options with
+//    execution time time[i][o], per-device memory mem[i][o], and a mesh id
+//    mesh_of[i][o].  A mesh is a contiguous chip range [mesh_lo, mesh_hi);
+//    MFCs whose ranges overlap serialize; disjoint ranges run concurrently.
+//    Memory is accounted per chip: residents of every mesh covering a chip
+//    stack on it.
+//  - DFG dependencies: edge (a -> b) means b starts after a finishes; MFCs
+//    are scheduled in topological order.
+//  - Param-sync pairs (a, b, table): when MFCs a and b hold the same model,
+//    choosing options (oa, ob) adds table[oa][ob] seconds to b's start
+//    (the reallocation cost between the two layouts).
+//  - Persistent memory (params/optimizer) of all MFCs colocated on one mesh
+//    accumulates; exceeding mem_cap makes a state infeasible (infinite cost).
+//
+// Exposed C ABI (driven via ctypes): mdm_search(...), mdm_simulate(...).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <vector>
+
+namespace {
+
+struct Problem {
+  int n_mfcs;
+  const int32_t* n_options;        // [n_mfcs]
+  const int32_t* opt_offset;       // [n_mfcs] prefix offsets into flat arrays
+  const double* time;              // [total_options]
+  const double* exec_mem;          // [total_options] transient per-device
+  const double* persist_mem;       // [total_options] resident per-device
+  const int32_t* mesh_of;          // [total_options]
+  int n_meshes;
+  const int32_t* mesh_lo;          // [n_meshes] chip range start
+  const int32_t* mesh_hi;          // [n_meshes] chip range end (exclusive)
+  int n_deps;
+  const int32_t* dep_src;          // [n_deps]
+  const int32_t* dep_dst;          // [n_deps]
+  int n_syncs;
+  const int32_t* sync_a;           // [n_syncs]
+  const int32_t* sync_b;           // [n_syncs]
+  const double* sync_cost;         // flat [sum over pairs of nA*nB]
+  const int32_t* sync_offset;      // [n_syncs]
+  double mem_cap;
+};
+
+constexpr double kInfeasible = 1e30;
+
+inline bool ranges_overlap(const Problem& p, int a, int b) {
+  return !(p.mesh_hi[a] <= p.mesh_lo[b] || p.mesh_hi[b] <= p.mesh_lo[a]);
+}
+
+// Simulated end-to-end makespan for one assignment (list scheduling in
+// topological order, respecting deps + mesh serialization), plus per-chip
+// memory feasibility.
+double simulate(const Problem& p, const int32_t* assign) {
+  const int n = p.n_mfcs;
+
+  // Per-chip memory: residents of every mesh covering a chip stack; the
+  // transient peak is the largest exec allocation among MFCs on the chip.
+  int n_chips = 0;
+  for (int m = 0; m < p.n_meshes; ++m)
+    if (p.mesh_hi[m] > n_chips) n_chips = p.mesh_hi[m];
+  std::vector<double> chip_persist(n_chips, 0.0), chip_exec(n_chips, 0.0);
+  for (int i = 0; i < n; ++i) {
+    int o = p.opt_offset[i] + assign[i];
+    int m = p.mesh_of[o];
+    for (int c = p.mesh_lo[m]; c < p.mesh_hi[m]; ++c) {
+      chip_persist[c] += p.persist_mem[o];
+      if (p.exec_mem[o] > chip_exec[c]) chip_exec[c] = p.exec_mem[o];
+    }
+  }
+  for (int c = 0; c < n_chips; ++c)
+    if (chip_persist[c] + chip_exec[c] > p.mem_cap) return kInfeasible;
+
+  std::vector<double> sync_delay(n, 0.0);
+  for (int s = 0; s < p.n_syncs; ++s) {
+    int a = p.sync_a[s], b = p.sync_b[s];
+    int nb = p.n_options[b];
+    sync_delay[b] += p.sync_cost[p.sync_offset[s] + assign[a] * nb + assign[b]];
+  }
+
+  // Kahn topological order over dep edges (n is small; recomputing per
+  // simulate keeps the ABI stateless).
+  std::vector<int> indeg(n, 0), order;
+  order.reserve(n);
+  for (int d = 0; d < p.n_deps; ++d) ++indeg[p.dep_dst[d]];
+  for (int i = 0; i < n; ++i)
+    if (indeg[i] == 0) order.push_back(i);
+  for (size_t h = 0; h < order.size(); ++h) {
+    int i = order[h];
+    for (int d = 0; d < p.n_deps; ++d) {
+      if (p.dep_src[d] == i && --indeg[p.dep_dst[d]] == 0)
+        order.push_back(p.dep_dst[d]);
+    }
+  }
+  if (int(order.size()) != n) return kInfeasible;  // dependency cycle
+
+  std::vector<double> finish(n, 0.0);
+  std::vector<double> mesh_free(p.n_meshes, 0.0);
+  for (int i : order) {
+    int o = p.opt_offset[i] + assign[i];
+    int m = p.mesh_of[o];
+    double start = 0.0;
+    for (int d = 0; d < p.n_deps; ++d) {
+      if (p.dep_dst[d] == i && finish[p.dep_src[d]] > start)
+        start = finish[p.dep_src[d]];
+    }
+    // Serialize against every mesh overlapping ours.
+    for (int m2 = 0; m2 < p.n_meshes; ++m2) {
+      if (ranges_overlap(p, m, m2) && mesh_free[m2] > start)
+        start = mesh_free[m2];
+    }
+    start += sync_delay[i];
+    finish[i] = start + p.time[o];
+    mesh_free[m] = finish[i];
+  }
+
+  double makespan = 0.0;
+  for (int i = 0; i < n; ++i)
+    if (finish[i] > makespan) makespan = finish[i];
+  return makespan;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the simulated makespan for one assignment (kInfeasible if over
+// the memory cap).
+double mdm_simulate(
+    int n_mfcs, const int32_t* n_options, const int32_t* opt_offset,
+    const double* time, const double* exec_mem, const double* persist_mem,
+    const int32_t* mesh_of, int n_meshes, const int32_t* mesh_lo,
+    const int32_t* mesh_hi,
+    int n_deps, const int32_t* dep_src, const int32_t* dep_dst,
+    int n_syncs, const int32_t* sync_a, const int32_t* sync_b,
+    const double* sync_cost, const int32_t* sync_offset,
+    double mem_cap, const int32_t* assign) {
+  Problem p{n_mfcs, n_options, opt_offset, time, exec_mem, persist_mem,
+            mesh_of, n_meshes, mesh_lo, mesh_hi, n_deps, dep_src, dep_dst,
+            n_syncs, sync_a, sync_b, sync_cost, sync_offset, mem_cap};
+  return simulate(p, assign);
+}
+
+// Simulated-annealing search; writes the best assignment into best_assign
+// and returns its makespan.  beta ramps linearly beta0 -> beta1 (Metropolis
+// acceptance exp(-beta * (new - old))).
+double mdm_search(
+    int n_mfcs, const int32_t* n_options, const int32_t* opt_offset,
+    const double* time, const double* exec_mem, const double* persist_mem,
+    const int32_t* mesh_of, int n_meshes, const int32_t* mesh_lo,
+    const int32_t* mesh_hi,
+    int n_deps, const int32_t* dep_src, const int32_t* dep_dst,
+    int n_syncs, const int32_t* sync_a, const int32_t* sync_b,
+    const double* sync_cost, const int32_t* sync_offset,
+    double mem_cap, int64_t iters, uint64_t seed, double beta0, double beta1,
+    int32_t* best_assign) {
+  Problem p{n_mfcs, n_options, opt_offset, time, exec_mem, persist_mem,
+            mesh_of, n_meshes, mesh_lo, mesh_hi, n_deps, dep_src, dep_dst,
+            n_syncs, sync_a, sync_b, sync_cost, sync_offset, mem_cap};
+
+  std::mt19937_64 rng(seed);
+  std::vector<int32_t> cur(n_mfcs, 0), best(n_mfcs, 0);
+  // Greedy init: per-MFC cheapest option (ignoring interactions).
+  for (int i = 0; i < n_mfcs; ++i) {
+    int argmin = 0;
+    double tmin = time[opt_offset[i]];
+    for (int o = 1; o < n_options[i]; ++o) {
+      if (time[opt_offset[i] + o] < tmin) {
+        tmin = time[opt_offset[i] + o];
+        argmin = o;
+      }
+    }
+    cur[i] = argmin;
+  }
+  double cur_cost = simulate(p, cur.data());
+  // If greedy is infeasible, restart from all-zeros (callers put the most
+  // memory-conservative option first).
+  if (cur_cost >= kInfeasible) {
+    std::fill(cur.begin(), cur.end(), 0);
+    cur_cost = simulate(p, cur.data());
+  }
+  best = cur;
+  double best_cost = cur_cost;
+
+  std::uniform_int_distribution<int> pick_mfc(0, n_mfcs - 1);
+  std::uniform_real_distribution<double> unif(0.0, 1.0);
+
+  for (int64_t it = 0; it < iters; ++it) {
+    double beta =
+        beta0 + (beta1 - beta0) * (double(it) / double(iters > 1 ? iters - 1 : 1));
+    int i = pick_mfc(rng);
+    if (n_options[i] <= 1) continue;
+    int old = cur[i];
+    int prop = int(rng() % uint64_t(n_options[i]));
+    if (prop == old) prop = (prop + 1) % n_options[i];
+    cur[i] = prop;
+    double c = simulate(p, cur.data());
+    bool accept;
+    if (c <= cur_cost) {
+      accept = true;
+    } else if (c >= kInfeasible) {
+      accept = false;
+    } else {
+      accept = unif(rng) < std::exp(-beta * (c - cur_cost));
+    }
+    if (accept) {
+      cur_cost = c;
+      if (c < best_cost) {
+        best_cost = c;
+        best = cur;
+      }
+    } else {
+      cur[i] = old;
+    }
+  }
+
+  std::memcpy(best_assign, best.data(), sizeof(int32_t) * n_mfcs);
+  return best_cost;
+}
+
+}  // extern "C"

@@ -1,0 +1,119 @@
+"""Allocation search: C++ MCMC engine + TPU roofline estimator.
+
+Mirrors the reference's search-engine usage (search_rpc_allocations over the
+ppo-math DFG); the pure-python simulate is the parity oracle for the C++
+library (reference: csrc/search tests strategy).
+"""
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import ModelInterfaceType
+from areal_tpu.base.topology import ParallelConfig
+from areal_tpu.models.config import ModelConfig
+from areal_tpu.search_engine import estimate, native, search
+from areal_tpu.search_engine.spec import V5E, V5P
+
+
+def qwen_7b():
+    return ModelConfig(
+        n_layers=28, hidden_dim=3584, n_q_heads=28, n_kv_heads=4,
+        head_dim=128, intermediate_dim=18944, vocab_size=152064,
+    )
+
+
+def _instance():
+    # 3 MFCs, 2 meshes (full 8 + two halves), synthetic tables.
+    times = [[1.0, 0.6, 0.3], [2.0, 1.0], [0.5, 0.25]]
+    mems = [[1.0, 2.0, 4.0], [1.0, 3.0], [0.5, 1.0]]
+    persist = [[2.0, 3.0, 4.0], [2.0, 4.0], [1.0, 2.0]]
+    mesh_ids = [[1, 1, 0], [1, 0], [2, 0]]
+    overlap = np.array(
+        [[1, 1, 1], [1, 1, 0], [1, 0, 1]], dtype=bool
+    )  # 0=full, 1=left half, 2=right half
+    deps = [(0, 1), (1, 2)]
+    syncs = [(0, 1, np.full((3, 2), 0.1))]
+    return native.Instance(
+        times, mems, persist, mesh_ids, overlap, deps, syncs, mem_cap=16.0
+    )
+
+
+def test_simulate_native_matches_python():
+    inst = _instance()
+    if native._load() is None:
+        pytest.skip("no native lib")
+    for assign in [(0, 0, 0), (1, 1, 1), (2, 0, 1), (2, 1, 0)]:
+        got = inst.simulate(assign)
+        want = inst.simulate_py(assign)
+        assert got == pytest.approx(want, rel=1e-12), assign
+
+
+def test_simulate_memory_cap():
+    inst = _instance()
+    inst.mem_cap = 3.0  # option sets with persist > 3 on one mesh die
+    assert inst.simulate((2, 1, 1)) >= native.INFEASIBLE
+
+
+def test_search_beats_naive():
+    inst = _instance()
+    best, cost = inst.search(iters=5000, seed=3)
+    naive = inst.simulate([0] * inst.n_mfcs)
+    assert cost <= naive
+    assert cost == pytest.approx(inst.simulate(best), rel=1e-12)
+
+
+def test_search_deterministic_per_seed():
+    inst = _instance()
+    a1, c1 = inst.search(iters=3000, seed=7)
+    a2, c2 = inst.search(iters=3000, seed=7)
+    assert c1 == c2
+    np.testing.assert_array_equal(a1, a2)
+
+
+def test_estimator_orderings():
+    """Roofline estimates must order sanely: more chips -> faster; v5p faster
+    than v5e; decode is HBM-bound."""
+    cfg = qwen_7b()
+    st = estimate.MFCStats(n_seqs=256, avg_seqlen=2048, gen_tokens=1024)
+    t8 = estimate.train_time(cfg, st, ParallelConfig(data=1, fsdp=8), V5P)
+    t32 = estimate.train_time(cfg, st, ParallelConfig(data=4, fsdp=8), V5P)
+    assert t32 < t8
+    assert estimate.train_time(
+        cfg, st, ParallelConfig(data=1, fsdp=8), V5E
+    ) > t8
+    g = estimate.generate_time(cfg, st, ParallelConfig(fsdp=4), V5P)
+    assert g > 0
+    # 7B on one v5e chip cannot hold train state.
+    assert estimate.train_persist_mem(cfg, ParallelConfig()) > V5E.hbm_bytes
+
+
+def test_search_rpc_allocations_ppo_shape():
+    """PPO-math shaped problem on a 16-chip v5p slice: gen + ref + train."""
+    cfg = qwen_7b()
+    st_gen = estimate.MFCStats(n_seqs=128, avg_seqlen=3072, gen_tokens=2048)
+    st_inf = estimate.MFCStats(n_seqs=128, avg_seqlen=3072)
+    st_train = estimate.MFCStats(n_seqs=128, avg_seqlen=3072)
+    mfcs = [
+        search.MFCSpec(
+            "actor_gen", "actor", ModelInterfaceType.GENERATE, cfg, st_gen
+        ),
+        search.MFCSpec(
+            "ref_inf", "ref", ModelInterfaceType.INFERENCE, cfg, st_inf
+        ),
+        search.MFCSpec(
+            "actor_train", "actor", ModelInterfaceType.TRAIN_STEP, cfg,
+            st_train, trainable=True,
+        ),
+    ]
+    deps = [(0, 1), (0, 2), (1, 2)]
+    allocs = search.search_rpc_allocations(
+        mfcs, deps, n_devices=16, chip="v5p", iters=4000, seed=1
+    )
+    assert len(allocs) == 3
+    for a in allocs:
+        lo, hi = a.device_range
+        assert a.parallel.world_size == hi - lo
+        assert a.est_time > 0
+    # Trainable 7B on v5p needs sharding: fsdp*model*pipe > 1.
+    tr = next(a for a in allocs if a.rpc_name == "actor_train")
+    assert tr.parallel.fsdp * tr.parallel.model * tr.parallel.pipe >= 2
