@@ -39,7 +39,10 @@ def _add_common(p: argparse.ArgumentParser):
     p.add_argument("--dataset.path", dest="dataset_path", required=True,
                    help="jsonl dataset path")
     p.add_argument("--allocation", default="d1",
-                   help="parallel layout, e.g. d2f2m2 / p2f2m2 / d1s4")
+                   help="parallel layout, e.g. d2f2m2 / p2f2m2 / d1s4; "
+                        "'search' runs the MCMC allocation search (ppo-math)")
+    p.add_argument("--chip", default="v5e",
+                   help="TPU chip spec for the allocation search (v5e/v5p)")
     p.add_argument("--tokenizer-path", default=None,
                    help="tokenizer dir (default: model path); 'char:<n>' "
                         "loads the hermetic char tokenizer")
@@ -104,18 +107,65 @@ def cmd_sft(args):
     print(json.dumps(stats[-1] if stats else {}))
 
 
+def _searched_ppo_allocation(args):
+    """`--allocation search`: pick (mesh, layout) per MFC with the C++ MCMC
+    search over the TPU roofline estimator (reference: apps/main.py:104-107
+    driving search_rpc_allocations)."""
+    import jax
+
+    from areal_tpu.models.hf import registry as hf
+    from areal_tpu.search_engine.search import search_ppo_math_allocations
+
+    hf_cfg = hf.load_hf_config(args.model_path)
+    model_cfg = hf.HF_FAMILIES[hf_cfg["model_type"]].config_from_hf(hf_cfg)
+    allocs = search_ppo_math_allocations(
+        model_cfg,
+        n_prompts=args.batch_size,
+        group_size=args.group_size,
+        max_new_tokens=args.max_new_tokens,
+        n_devices=jax.device_count(),
+        chip=args.chip,
+        max_tokens_per_mb=args.max_tokens_per_mb,
+        seed=args.seed,
+    )
+    train = allocs["actor_train"]
+    gen = allocs["actor_gen"]
+    logger.info(
+        f"searched allocation: train {train.parallel.to_str()} on chips "
+        f"{train.device_range}, gen {gen.parallel.to_str()} on chips "
+        f"{gen.device_range}"
+    )
+    return train, gen
+
+
 def cmd_ppo_math(args):
+    searched = None
+    if args.allocation == "search":
+        if args.gen_allocation:
+            raise SystemExit(
+                "--gen-allocation conflicts with --allocation search "
+                "(the search chooses the generation layout)"
+            )
+        searched = _searched_ppo_allocation(args)
     cfg = exps.PPOMathConfig(
         actor=ModelAbstraction("hf", {"path": args.model_path}),
         dataset=DatasetAbstraction(
             "math_code_prompt", {"dataset_path": args.dataset_path}
         ),
-        actor_parallel=ParallelConfig.from_str(args.allocation),
+        actor_parallel=(
+            searched[0].parallel
+            if searched
+            else ParallelConfig.from_str(args.allocation)
+        ),
         gen_parallel=(
-            ParallelConfig.from_str(args.gen_allocation)
+            searched[1].parallel
+            if searched
+            else ParallelConfig.from_str(args.gen_allocation)
             if args.gen_allocation
             else None
         ),
+        actor_device_offset=searched[0].device_range[0] if searched else None,
+        gen_device_offset=searched[1].device_range[0] if searched else None,
         optimizer=OptimizerConfig(lr=args.lr),
         gconfig=GenerationHyperparameters(
             n=args.group_size,

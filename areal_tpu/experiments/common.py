@@ -115,6 +115,10 @@ class PPOMathConfig:
     reward_interface_args: Dict[str, Any] = dataclasses.field(default_factory=dict)
     actor_parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     gen_parallel: Optional[ParallelConfig] = None  # None = same as actor
+    # Device placement within the worker's local devices (None = worker
+    # offset).  Set by `--allocation search` for disjoint gen/train meshes.
+    actor_device_offset: Optional[int] = None
+    gen_device_offset: Optional[int] = None
     critic_parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     optimizer: OptimizerConfig = dataclasses.field(
         default_factory=lambda: OptimizerConfig(lr=2e-5)
@@ -262,6 +266,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
             interface=actor_if,
             parallel=cfg.actor_parallel,
             optimizer=cfg.optimizer,
+            device_offset=cfg.actor_device_offset,
         ),
         ModelShardSpec(
             name=actor_gen,
@@ -269,6 +274,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
             backend=ModelBackendAbstraction("generator"),
             interface=actor_if,
             parallel=cfg.gen_parallel or cfg.actor_parallel,
+            device_offset=cfg.gen_device_offset,
         ),
         ModelShardSpec(
             name=reward,
@@ -287,6 +293,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                 backend=ModelBackendAbstraction("inference"),
                 interface=ModelInterfaceAbstraction("ppo_actor"),
                 parallel=cfg.actor_parallel,
+                device_offset=cfg.actor_device_offset,
             )
         )
     if critic is not None:

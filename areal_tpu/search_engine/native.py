@@ -25,14 +25,40 @@ _lib = None
 INFEASIBLE = 1e30
 
 
+def ranges_overlap_matrix(mesh_ranges) -> np.ndarray:
+    """[n, n] bool: do chip ranges [lo, hi) intersect (mirrors the C++
+    ranges_overlap)."""
+    lo = np.array([r[0] for r in mesh_ranges])
+    hi = np.array([r[1] for r in mesh_ranges])
+    return ~((hi[:, None] <= lo[None, :]) | (hi[None, :] <= lo[:, None]))
+
+
+def _stale() -> bool:
+    """True when any csrc/search source is newer than the built library."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    src_dir = os.path.join(os.path.abspath(_CSRC), "search")
+    sources = [os.path.join(os.path.abspath(_CSRC), "Makefile")]
+    if os.path.isdir(src_dir):
+        sources += [
+            os.path.join(src_dir, f)
+            for f in os.listdir(src_dir)
+            if f.endswith((".cpp", ".h"))
+        ]
+    return any(os.path.getmtime(s) > lib_mtime for s in sources)
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib
     if _lib is not None:
         return _lib
     try:
-        if not os.path.exists(_LIB_PATH):
+        if _stale():
+            # `make` only replaces the target on success, so a failed
+            # rebuild leaves any previous (stale but loadable) binary.
             subprocess.run(
-                ["make"], cwd=os.path.abspath(_CSRC), check=True,
+                ["make", "-B"], cwd=os.path.abspath(_CSRC), check=True,
                 capture_output=True,
             )
         lib = ctypes.CDLL(_LIB_PATH)
@@ -90,6 +116,7 @@ class Instance:
         self.mesh_lo = np.array([r[0] for r in mesh_ranges], np.int32)
         self.mesh_hi = np.array([r[1] for r in mesh_ranges], np.int32)
         self.n_meshes = len(mesh_ranges)
+        self.mesh_overlap = ranges_overlap_matrix(mesh_ranges)
         self.dep_src = np.array([d[0] for d in deps], np.int32)
         self.dep_dst = np.array([d[1] for d in deps], np.int32)
         self.sync_a = np.array([s[0] for s in syncs], np.int32)
@@ -146,22 +173,21 @@ class Instance:
     # ---------------- pure-python mirror ----------------
 
     def simulate_py(self, assign: Sequence[int]) -> float:
-        finish = np.zeros(self.n_mfcs)
-        mesh_free = np.zeros(self.n_meshes)
-        mesh_persist = np.zeros(self.n_meshes)
-        mesh_max_exec = np.zeros(self.n_meshes)
+        # Per-chip memory: residents of every mesh covering a chip stack;
+        # transient peak is the largest exec allocation among MFCs on it
+        # (mirrors csrc/search/mdm_search.cpp simulate()).
+        n_chips = int(self.mesh_hi.max(initial=0))
+        chip_persist = np.zeros(n_chips)
+        chip_exec = np.zeros(n_chips)
         for i in range(self.n_mfcs):
             o = self.opt_offset[i] + assign[i]
             m = self.mesh_of[o]
-            mesh_persist[m] += self.persist_mem[o]
-            mesh_max_exec[m] = max(mesh_max_exec[m], self.exec_mem[o])
-        for m in range(self.n_meshes):
-            peak = mesh_persist[m] + mesh_max_exec[m]
-            for m2 in range(self.n_meshes):
-                if m2 != m and self.mesh_overlap[m, m2]:
-                    peak += mesh_persist[m2]
-            if peak > self.mem_cap:
-                return INFEASIBLE
+            lo, hi = self.mesh_lo[m], self.mesh_hi[m]
+            chip_persist[lo:hi] += self.persist_mem[o]
+            chip_exec[lo:hi] = np.maximum(chip_exec[lo:hi], self.exec_mem[o])
+        if np.any(chip_persist + chip_exec > self.mem_cap):
+            return INFEASIBLE
+
         sync_delay = np.zeros(self.n_mfcs)
         for s in range(len(self.sync_a)):
             a, b = self.sync_a[s], self.sync_b[s]
@@ -169,7 +195,27 @@ class Instance:
             sync_delay[b] += self.sync_cost[
                 self.sync_offset[s] + assign[a] * nb + assign[b]
             ]
-        for i in range(self.n_mfcs):
+
+        # Kahn topological order over dep edges, like the C++.
+        indeg = np.zeros(self.n_mfcs, np.int32)
+        for d in self.dep_dst:
+            indeg[d] += 1
+        order = [i for i in range(self.n_mfcs) if indeg[i] == 0]
+        h = 0
+        while h < len(order):
+            i = order[h]
+            h += 1
+            for s, d in zip(self.dep_src, self.dep_dst):
+                if s == i:
+                    indeg[d] -= 1
+                    if indeg[d] == 0:
+                        order.append(int(d))
+        if len(order) != self.n_mfcs:
+            return INFEASIBLE  # dependency cycle
+
+        finish = np.zeros(self.n_mfcs)
+        mesh_free = np.zeros(self.n_meshes)
+        for i in order:
             o = self.opt_offset[i] + assign[i]
             m = self.mesh_of[o]
             start = 0.0

@@ -114,10 +114,7 @@ def search_rpc_allocations(
         chip = CHIPS[chip]
 
     meshes = _mesh_candidates(n_devices)
-    overlap = np.zeros((len(meshes), len(meshes)), bool)
-    for i, (a0, a1) in enumerate(meshes):
-        for j, (b0, b1) in enumerate(meshes):
-            overlap[i, j] = not (a1 <= b0 or b1 <= a0)
+    overlap = native.ranges_overlap_matrix(meshes)
 
     times, exec_mems, persist_mems, mesh_ids = [], [], [], []
     options: List[List[Tuple[int, ParallelConfig]]] = []
@@ -168,7 +165,7 @@ def search_rpc_allocations(
         exec_mems=exec_mems,
         persist_mems=persist_mems,
         mesh_ids=mesh_ids,
-        mesh_overlap=overlap,
+        mesh_ranges=meshes,
         deps=deps,
         syncs=syncs,
         mem_cap=chip.hbm_bytes * mem_headroom,
@@ -198,3 +195,56 @@ def search_rpc_allocations(
         )
     logger.info(f"simulated step makespan: {cost:.3f}s")
     return out
+
+
+def search_ppo_math_allocations(
+    model_cfg: ModelConfig,
+    n_prompts: int,
+    group_size: int,
+    max_new_tokens: int,
+    n_devices: int,
+    chip: "TPUChipSpec | str" = "v5e",
+    prompt_len: int = 512,
+    has_ref: bool = False,
+    max_tokens_per_mb: int = 16384,
+    iters: int = 20000,
+    seed: int = 1,
+) -> Dict[str, RPCAllocation]:
+    """Search allocations for the quickstart ppo-math DFG (actor_gen ->
+    [ref_inf] -> actor_train).  Returns {rpc_name: RPCAllocation}; the
+    quickstart `--allocation search` path translates these into
+    (parallel, device_offset) per shard (reference: apps/main.py:104-107
+    caching search_rpc_allocations results into the experiment setup)."""
+    n_seqs = n_prompts * group_size
+    avg_len = prompt_len + max_new_tokens // 2
+    mfcs = [
+        MFCSpec(
+            "actor_gen", "actor", ModelInterfaceType.GENERATE, model_cfg,
+            estimate.MFCStats(
+                n_seqs=n_seqs, avg_seqlen=avg_len, gen_tokens=max_new_tokens
+            ),
+        ),
+    ]
+    deps = []
+    if has_ref:
+        mfcs.append(
+            MFCSpec(
+                "ref_inf", "ref", ModelInterfaceType.INFERENCE, model_cfg,
+                estimate.MFCStats(n_seqs=n_seqs, avg_seqlen=avg_len),
+            )
+        )
+        deps.append((0, 1))
+    train_idx = len(mfcs)
+    mfcs.append(
+        MFCSpec(
+            "actor_train", "actor", ModelInterfaceType.TRAIN_STEP, model_cfg,
+            estimate.MFCStats(n_seqs=n_seqs, avg_seqlen=avg_len),
+            trainable=True,
+        )
+    )
+    deps += [(i, train_idx) for i in range(train_idx)]
+    allocs = search_rpc_allocations(
+        mfcs, deps, n_devices, chip=chip,
+        max_tokens_per_mb=max_tokens_per_mb, iters=iters, seed=seed,
+    )
+    return {a.rpc_name: a for a in allocs}

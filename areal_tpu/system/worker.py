@@ -58,6 +58,10 @@ class ModelShardSpec:
     interface: ModelInterfaceAbstraction
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     optimizer: Optional[OptimizerConfig] = None
+    # First local device for this shard's mesh; None = the worker's offset.
+    # Lets one worker host disjoint meshes (e.g. search-chosen gen/train
+    # split, reference allocation `sglang.dXp1m1+dYp2m1`).
+    device_offset: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -123,10 +127,12 @@ class ModelWorker:
             cfg, params = _build_params_and_config(
                 shard.model, seed=self.config.seed
             )
-            devices = jax.devices()[
-                self.config.device_offset : self.config.device_offset
-                + shard.parallel.world_size
-            ]
+            off = (
+                shard.device_offset
+                if shard.device_offset is not None
+                else self.config.device_offset
+            )
+            devices = jax.devices()[off : off + shard.parallel.world_size]
             mesh = make_mesh(shard.parallel, devices)
             btype = shard.backend.type_
             if btype in ("train", "mock"):

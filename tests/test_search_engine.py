@@ -28,13 +28,11 @@ def _instance():
     mems = [[1.0, 2.0, 4.0], [1.0, 3.0], [0.5, 1.0]]
     persist = [[2.0, 3.0, 4.0], [2.0, 4.0], [1.0, 2.0]]
     mesh_ids = [[1, 1, 0], [1, 0], [2, 0]]
-    overlap = np.array(
-        [[1, 1, 1], [1, 1, 0], [1, 0, 1]], dtype=bool
-    )  # 0=full, 1=left half, 2=right half
+    meshes = [(0, 8), (0, 4), (4, 8)]  # 0=full, 1=left half, 2=right half
     deps = [(0, 1), (1, 2)]
     syncs = [(0, 1, np.full((3, 2), 0.1))]
     return native.Instance(
-        times, mems, persist, mesh_ids, overlap, deps, syncs, mem_cap=16.0
+        times, mems, persist, mesh_ids, meshes, deps, syncs, mem_cap=16.0
     )
 
 
@@ -117,3 +115,55 @@ def test_search_rpc_allocations_ppo_shape():
     # Trainable 7B on v5p needs sharding: fsdp*model*pipe > 1.
     tr = next(a for a in allocs if a.rpc_name == "actor_train")
     assert tr.parallel.fsdp * tr.parallel.model * tr.parallel.pipe >= 2
+
+
+def test_search_ppo_math_allocations_8chip():
+    """The quickstart `--allocation search` entry on the fake 8-chip cluster:
+    gen + train allocations must fit the slice and be internally consistent."""
+    from areal_tpu.models.config import qwen2_config
+
+    allocs = search.search_ppo_math_allocations(
+        qwen2_config("1.5b"),
+        n_prompts=8,
+        group_size=4,
+        max_new_tokens=1024,
+        n_devices=8,
+        chip="v5p",
+        iters=3000,
+        seed=1,
+    )
+    assert set(allocs) == {"actor_gen", "actor_train"}
+    for a in allocs.values():
+        lo, hi = a.device_range
+        assert 0 <= lo < hi <= 8
+        assert a.parallel.world_size == hi - lo
+
+
+def test_quickstart_search_wiring(tmp_path):
+    """`--allocation search` end to end through the quickstart helper: load
+    an HF config dir, search, and return (train, gen) allocations."""
+    import argparse
+
+    from areal_tpu.apps import quickstart
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.hf import registry as hf
+    import jax
+
+    cfg = tiny_config()
+    params = None
+    from areal_tpu.models import transformer as tfm
+
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    ckpt = tmp_path / "ckpt"
+    hf.save_hf_checkpoint(str(ckpt), cfg, params, model_type="qwen2")
+
+    args = argparse.Namespace(
+        model_path=str(ckpt), batch_size=4, group_size=2,
+        max_new_tokens=64, chip="v5e", max_tokens_per_mb=4096, seed=1,
+    )
+    train, gen = quickstart._searched_ppo_allocation(args)
+    n = jax.device_count()
+    for a in (train, gen):
+        lo, hi = a.device_range
+        assert 0 <= lo < hi <= n
+        assert a.parallel.world_size == hi - lo
