@@ -43,6 +43,10 @@ def _add_common(p: argparse.ArgumentParser):
                         "'search' runs the MCMC allocation search (ppo-math)")
     p.add_argument("--chip", default="v5e",
                    help="TPU chip spec for the allocation search (v5e/v5p)")
+    p.add_argument("--search-devices", type=int, default=None,
+                   help="chip count for --allocation search (required with "
+                        "--multiprocess so the launcher never touches the "
+                        "TPU runtime)")
     p.add_argument("--tokenizer-path", default=None,
                    help="tokenizer dir (default: model path); 'char:<n>' "
                         "loads the hermetic char tokenizer")
@@ -116,6 +120,15 @@ def _searched_ppo_allocation(args):
     from areal_tpu.models.hf import registry as hf
     from areal_tpu.search_engine.search import search_ppo_math_allocations
 
+    if args.multiprocess and not args.search_devices:
+        # jax.device_count() would initialize the TPU runtime in THIS
+        # launcher process, stealing the chips from the spawned workers.
+        raise SystemExit(
+            "--allocation search with --multiprocess needs an explicit "
+            "--search-devices N (the launcher must not initialize the TPU "
+            "runtime itself)"
+        )
+    n_devices = args.search_devices or jax.device_count()
     hf_cfg = hf.load_hf_config(args.model_path)
     model_cfg = hf.HF_FAMILIES[hf_cfg["model_type"]].config_from_hf(hf_cfg)
     allocs = search_ppo_math_allocations(
@@ -123,7 +136,7 @@ def _searched_ppo_allocation(args):
         n_prompts=args.batch_size,
         group_size=args.group_size,
         max_new_tokens=args.max_new_tokens,
-        n_devices=jax.device_count(),
+        n_devices=n_devices,
         chip=args.chip,
         max_tokens_per_mb=args.max_tokens_per_mb,
         seed=args.seed,

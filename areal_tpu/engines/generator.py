@@ -109,8 +109,20 @@ class GeneratorEngine(Engine):
         gconfig: GenerationHyperparameters,
         prompt_key: str = "packed_prompts",
         seed: int = 0,
+        inflight: Optional[bool] = None,
     ) -> SequenceSample:
         """Group-sample `gconfig.n` responses per prompt.
+
+        Two execution modes over the same jitted model step:
+        - static: length-sorted fixed-shape chunks (one jitted
+          prefill+while-loop program per shape) — best when lengths are
+          uniform;
+        - inflight (continuous batching): a fixed slot pool where finished
+          sequences retire and pending requests join between jitted T-token
+          decode chunks — one straggler no longer stalls the whole chunk
+          (reference: InflightBatchingGenerator,
+          realhf/impl/model/nn/real_llm_generate.py:670).
+        Default: inflight when there are more requests than decode slots.
 
         Returns a SequenceSample (one element per prompt, `n` sequences per
         element — the reference's group layout, data_api docstring) with:
@@ -136,12 +148,196 @@ class GeneratorEngine(Engine):
         results: Dict[Tuple[int, int], Tuple[np.ndarray, np.ndarray, bool]] = {}
         key = jax.random.PRNGKey(seed)
         b_cap = max(self.batch_shard, self.max_decode_batch)
-        for start in range(0, len(order), b_cap):
-            chunk = [reqs[j] for j in order[start : start + b_cap]]
-            key, sub = jax.random.split(key)
-            self._generate_chunk(chunk, gconfig, sub, results)
+        if inflight is None:
+            inflight = len(reqs) > b_cap
+        if inflight:
+            self._generate_inflight(
+                [reqs[j] for j in order], gconfig, key, results
+            )
+        else:
+            for start in range(0, len(order), b_cap):
+                chunk = [reqs[j] for j in order[start : start + b_cap]]
+                key, sub = jax.random.split(key)
+                self._generate_chunk(chunk, gconfig, sub, results)
 
         return self._assemble(sample, prompt_key, prompt_lens, results, n)
+
+    # -- continuous batching (inflight refill) --
+
+    def _generate_inflight(self, reqs, gconfig, key, results) -> None:
+        """Fixed slot pool; retire finished rows and admit pending requests
+        between jitted T-token decode chunks."""
+        n_slots = min(max(self.batch_shard, self.max_decode_batch), len(reqs))
+        while n_slots % self.batch_shard:
+            n_slots += 1
+        max_prompt = max(len(t) for (_, _, t) in reqs)
+        s_max = bucket_len(max_prompt + gconfig.max_new_tokens)
+        chunk_t = min(32, gconfig.max_new_tokens)
+
+        cache = tfm.init_kv_cache(
+            self.cfg, n_slots, s_max, dtype=self.compute_dtype
+        )
+        logits_buf = jnp.zeros((n_slots, self.cfg.vocab_size), jnp.float32)
+        cache_len = np.zeros((n_slots,), np.int32)
+        gen_count = np.zeros((n_slots,), np.int32)
+        done_host = np.ones((n_slots,), bool)  # empty slots count as done
+        active: List[Optional[Tuple[int, int]]] = [None] * n_slots
+        toks_acc: Dict[int, List[int]] = {}
+        logps_acc: Dict[int, List[float]] = {}
+        pending = list(reversed(reqs))  # pop() takes the longest first
+
+        decode_fn = self._get_inflight_decode_fn(n_slots, s_max, chunk_t, gconfig)
+
+        while pending or any(a is not None for a in active):
+            # Refill free slots (prefill one request per free slot).
+            for s in range(n_slots):
+                if active[s] is None and pending:
+                    i, rep, toks = pending.pop()
+                    sp = bucket_len(len(toks))
+                    row = np.full((1, sp), self.pad_token_id, np.int32)
+                    row[0, : len(toks)] = toks
+                    row_logits, cache = self._get_prefill_slot_fn(sp)(
+                        self.params, jnp.asarray(row),
+                        jnp.int32(len(toks)), cache, jnp.int32(s),
+                    )
+                    logits_buf = logits_buf.at[s].set(row_logits)
+                    cache_len[s] = len(toks)
+                    gen_count[s] = 0
+                    done_host[s] = False
+                    active[s] = (i, rep)
+                    toks_acc[s] = []
+                    logps_acc[s] = []
+
+            # One jitted chunk: up to chunk_t tokens for every live slot.
+            key, sub = jax.random.split(key)
+            (
+                out_toks, out_logps, logits_buf, cache,
+                new_cache_len, new_gen_count, new_done,
+            ) = decode_fn(
+                self.params, cache, logits_buf,
+                jnp.asarray(cache_len), jnp.asarray(gen_count),
+                jnp.asarray(done_host), sub,
+            )
+            out_toks = np.asarray(out_toks)
+            out_logps = np.asarray(out_logps)
+            cache_len = np.asarray(new_cache_len).copy()
+            gen_count = np.asarray(new_gen_count).copy()
+            new_done = np.asarray(new_done)
+
+            # Host bookkeeping: append tokens, retire finished slots.
+            for s in range(n_slots):
+                if active[s] is None:
+                    continue
+                for t in range(chunk_t):
+                    if len(toks_acc[s]) >= gconfig.max_new_tokens:
+                        break
+                    tok = int(out_toks[s, t])
+                    if tok < 0:  # was already done within the chunk
+                        break
+                    toks_acc[s].append(tok)
+                    logps_acc[s].append(float(out_logps[s, t]))
+                    if tok == self.eos_token_id:
+                        break
+                finished = (
+                    len(toks_acc[s]) >= gconfig.max_new_tokens
+                    or (toks_acc[s] and toks_acc[s][-1] == self.eos_token_id)
+                )
+                if finished:
+                    i, rep = active[s]
+                    gtoks = np.asarray(toks_acc[s], np.int32)
+                    glogps = np.asarray(logps_acc[s], np.float32)
+                    no_eos = not (
+                        len(gtoks) and gtoks[-1] == self.eos_token_id
+                    )
+                    results[(i, rep)] = (gtoks, glogps, no_eos)
+                    active[s] = None
+                    done_host[s] = True
+                else:
+                    done_host[s] = bool(new_done[s])
+
+    def _get_prefill_slot_fn(self, sp: int):
+        sig = ("prefill_slot", sp)
+        if sig in self._gen_fns:
+            return self._gen_fns[sig]
+        cfg, use_flash = self.cfg, self._use_flash
+
+        @jax.jit
+        def fn(params, row, plen, cache, slot_row):
+            return tfm.prefill_into_slot(
+                params, cfg, row, plen, cache, slot_row, use_flash=use_flash
+            )
+
+        self._gen_fns[sig] = fn
+        return fn
+
+    def _get_inflight_decode_fn(
+        self, n_slots: int, s_max: int, chunk_t: int,
+        g: GenerationHyperparameters,
+    ):
+        sig = (
+            "inflight", n_slots, s_max, chunk_t, g.min_new_tokens, g.greedy,
+            g.top_p, g.top_k, g.temperature,
+        )
+        if sig in self._gen_fns:
+            return self._gen_fns[sig]
+        cfg = self.cfg
+        eos = self.eos_token_id
+
+        @jax.jit
+        def fn(params, cache, logits, cache_len, gen_count, done, key):
+            out_toks = jnp.full((n_slots, chunk_t), -1, jnp.int32)
+            out_logps = jnp.zeros((n_slots, chunk_t), jnp.float32)
+
+            def body(t, st):
+                (logits, cache, cache_len, gen_count, done, out_toks,
+                 out_logps) = st
+                sub = jax.random.fold_in(key, t)
+                lg = logits
+                if g.min_new_tokens > 0:
+                    lg = jnp.where(
+                        (gen_count < g.min_new_tokens)[:, None]
+                        & (jnp.arange(cfg.vocab_size) == eos)[None, :],
+                        -1e10,
+                        lg,
+                    )
+                tok, logp = sample_token(
+                    lg, sub,
+                    temperature=g.temperature, top_k=g.top_k, top_p=g.top_p,
+                    greedy=g.greedy,
+                )
+                out_toks = jax.lax.dynamic_update_slice(
+                    out_toks, jnp.where(done, -1, tok)[:, None], (0, t)
+                )
+                out_logps = jax.lax.dynamic_update_slice(
+                    out_logps, jnp.where(done, 0.0, logp)[:, None], (0, t)
+                )
+                # Rows already done keep replaying their last slot (the
+                # write is harmless garbage past their valid window).
+                positions = cache_len
+                next_logits, cache2 = tfm.decode_step_inflight(
+                    params, cfg, jnp.where(done, eos, tok), positions, cache,
+                    slots=jnp.minimum(cache_len, s_max - 1),
+                    valid_to=jnp.minimum(cache_len + 1, s_max),
+                )
+                new_done = done | (tok == eos)
+                cache_len = cache_len + (~done).astype(jnp.int32)
+                gen_count = gen_count + (~done).astype(jnp.int32)
+                return (
+                    next_logits, cache2, cache_len, gen_count, new_done,
+                    out_toks, out_logps,
+                )
+
+            st = (logits, cache, cache_len, gen_count, done, out_toks, out_logps)
+            st = jax.lax.fori_loop(0, chunk_t, body, st)
+            logits, cache, cache_len, gen_count, done, out_toks, out_logps = st
+            return out_toks, out_logps, logits, cache, cache_len, gen_count, done
+
+        self._gen_fns[sig] = fn
+        logger.info(
+            f"compiled inflight decoder n_slots={n_slots} s_max={s_max} "
+            f"chunk={chunk_t}"
+        )
+        return fn
 
     # -- one fixed-shape chunk --
 
@@ -153,10 +349,13 @@ class GeneratorEngine(Engine):
         sp = bucket_len(max(len(t) for (_, _, t) in chunk))
         s_total = bucket_len(sp + gconfig.max_new_tokens)
 
+        # Right-aligned prompts: every row's next token lands at the SAME
+        # cache slot (sp + step), so the decode KV write is one
+        # dynamic_update_slice instead of a per-row scatter.
         prompt_tok = np.full((b, sp), self.pad_token_id, np.int32)
         prompt_len = np.zeros((b,), np.int32)
         for r, (_, _, toks) in enumerate(chunk):
-            prompt_tok[r, : len(toks)] = toks
+            prompt_tok[r, sp - len(toks):] = toks
             prompt_len[r] = len(toks)
 
         fn = self._get_gen_fn(b, sp, s_total, gconfig)
@@ -188,8 +387,9 @@ class GeneratorEngine(Engine):
         def gen(params, prompt_tok, prompt_len, key):
             bsz = prompt_tok.shape[0]
             seg = (
-                jnp.arange(sp)[None, :] < prompt_len[:, None]
+                jnp.arange(sp)[None, :] >= (sp - prompt_len)[:, None]
             ).astype(jnp.int32)
+            valid_from = sp - prompt_len  # [B] first live cache slot
             cache = tfm.init_kv_cache(cfg, bsz, s_total, dtype=self.compute_dtype)
             # prefill returns logits at each row's last prompt token — the
             # distribution over the first response token.
@@ -226,9 +426,9 @@ class GeneratorEngine(Engine):
                 out_logps = out_logps.at[:, step].set(jnp.where(done, 0.0, logp))
                 gen_len = gen_len + (~done).astype(jnp.int32)
                 new_done = done | (tok == eos)
-                pos = prompt_len + step
+                pos = prompt_len + step  # RoPE position per row
                 next_logits, cache = tfm.decode_step(
-                    params, cfg, tok, pos, cache, pos + 1
+                    params, cfg, tok, pos, cache, sp + step, valid_from
                 )
                 return (
                     step + 1, next_logits, key, new_done, gen_len,

@@ -112,7 +112,7 @@ class InferenceEngine(Engine):
 
         @jax.jit
         def fwd(params, batch):
-            out = tfm.forward(
+            x, _ = tfm.hidden_states(
                 params,
                 cfg,
                 batch["tokens"],
@@ -123,7 +123,12 @@ class InferenceEngine(Engine):
                 pp_mesh=pp_mesh,
                 pp_microbatches=pp_mbs,
             )
-            return post_fn(out, batch)
+            return post_fn(
+                tfm.per_token_output(
+                    params, cfg, x, batch["tokens"], batch["segment_ids"]
+                ),
+                batch,
+            )
 
         self._fwd_fns[post_fn] = fwd
         return fwd

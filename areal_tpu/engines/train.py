@@ -79,6 +79,14 @@ def _cast_tree(tree, dtype):
     )
 
 
+def _model_out(params, cfg: ModelConfig, x, batch):
+    """Per-token model output [B, S] from final hidden states (see
+    transformer.per_token_output)."""
+    return tfm.per_token_output(
+        params, cfg, x, batch["tokens"], batch["segment_ids"]
+    )
+
+
 class TrainEngine(Engine):
     """Engine holding fp32 master params + optimizer state on a mesh."""
 
@@ -138,8 +146,9 @@ class TrainEngine(Engine):
         @jax.jit
         def grad_fn(params, batch, loss_scale):
             def losswrap(p):
-                logits, aux = tfm.forward_with_aux(
-                    _cast_tree(p, compute_dtype),
+                pc = _cast_tree(p, compute_dtype)
+                x, aux = tfm.hidden_states(
+                    pc,
                     cfg,
                     batch["tokens"],
                     batch["segment_ids"],
@@ -150,7 +159,11 @@ class TrainEngine(Engine):
                     pp_mesh=pp_mesh,
                     pp_microbatches=pp_mbs,
                 )
-                loss, stats = loss_fn(logits, batch)
+                # Loss fns receive per-token model outputs, never [B,S,V]
+                # logits: critic -> values; LM -> fused chunked next-token
+                # logprobs (the 152k-vocab memory/bandwidth fix).
+                out = _model_out(pc, cfg, x, batch)
+                loss, stats = loss_fn(out, batch)
                 total = loss + cfg.moe_aux_loss_coef * aux
                 return total * loss_scale, stats
 
@@ -301,8 +314,9 @@ class TrainEngine(Engine):
 
         @jax.jit
         def fwd(params, batch):
-            logits = tfm.forward(
-                _cast_tree(params, compute_dtype),
+            pc = _cast_tree(params, compute_dtype)
+            x, _ = tfm.hidden_states(
+                pc,
                 cfg,
                 batch["tokens"],
                 batch["segment_ids"],
@@ -312,7 +326,7 @@ class TrainEngine(Engine):
                 pp_mesh=pp_mesh,
                 pp_microbatches=pp_mbs,
             )
-            return post_fn(logits, batch)
+            return post_fn(_model_out(pc, cfg, x, batch), batch)
 
         self._fwd_fns[post_fn] = fwd
         return fwd

@@ -39,10 +39,8 @@ logger = logging.getLogger("ppo")
 
 
 def _ppo_actor_loss_factory(eps_clip: float):
-    def loss_fn(logits, batch):
-        new_logp = F.next_token_logprobs(
-            logits, batch["tokens"], batch["segment_ids"]
-        )
+    def loss_fn(new_logp, batch):
+        # `new_logp`: the engine's fused per-token next-token logprobs [B,S].
         mask = batch["loss_mask"] > 0
         old_logp = batch["old_logp"]
         adv = batch["advantages"]
@@ -86,8 +84,8 @@ def _ppo_critic_loss_factory(value_eps_clip: float):
     return loss_fn
 
 
-def _logprob_post(logits, batch):
-    return F.next_token_logprobs(logits, batch["tokens"], batch["segment_ids"])
+def _logprob_post(logp, batch):
+    return logp  # engines already emit masked next-token logprobs [B, S]
 
 
 def _value_post(values, batch):

@@ -66,11 +66,10 @@ class SFTInterface(ModelInterface):
         logger.info(f"saved SFT checkpoint to {save_dir}")
 
 
-def _eval_nll_post(logits, batch):
+def _eval_nll_post(logp, batch):
     import jax.numpy as jnp
 
     seg = batch["segment_ids"]
-    logp = F.next_token_logprobs(logits, batch["tokens"], seg)
     label_is_prompt = jnp.pad(
         batch["prompt_mask"][:, 1:], ((0, 0), (0, 1)), constant_values=True
     )

@@ -18,8 +18,8 @@ Functions:
     init_params(cfg, key)                                  -> params
     forward(params, cfg, tokens, segment_ids[, positions]) -> logits/values
     init_kv_cache(cfg, b, s_max)                           -> cache
-    prefill(params, cfg, tokens, segment_ids, positions, cache, cache_offset)
-    decode_step(params, cfg, tokens, positions, cache, cache_len)
+    prefill(params, cfg, tokens, segment_ids, cache)
+    decode_step(params, cfg, tokens, positions, cache, slot, valid_from)
 """
 
 import dataclasses
@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from areal_tpu.models.config import ModelConfig
 from areal_tpu.ops.attention import (
-    decode_attention_reference,
+    decode_attention,
     packed_attention,
     repeat_kv,
 )
@@ -271,6 +271,55 @@ def forward(
     return out
 
 
+def hidden_states(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,
+    segment_ids: jax.Array,
+    positions: Optional[jax.Array] = None,
+    remat: bool = False,
+    use_flash: "bool | None" = None,
+    cp_mesh=None,
+    pp_mesh=None,
+    pp_microbatches: int = 4,
+) -> Tuple[jax.Array, jax.Array]:
+    """Backbone only: final-layernormed hidden states [B, S, D] (+ MoE aux
+    loss), WITHOUT the LM head.  Lets engines fuse the head into a chunked
+    loss (ops/functional.fused_next_token_logprobs) instead of materializing
+    [B, S, V] logits."""
+    if positions is None:
+        positions = positions_from_segments(segment_ids)
+    return _backbone(
+        params, cfg, tokens, segment_ids, positions, remat, use_flash,
+        cp_mesh, pp_mesh, pp_microbatches,
+    )
+
+
+def head_weights(params: Params, cfg: ModelConfig) -> jax.Array:
+    """[D, V] LM-head matrix (transposed embedding when tied)."""
+    return params["embed"].T if cfg.tied_embeddings else params["lm_head"]
+
+
+def per_token_output(
+    params: Params,
+    cfg: ModelConfig,
+    x: jax.Array,  # [B, S, D] from hidden_states()
+    tokens: jax.Array,
+    segment_ids: jax.Array,
+    chunk_size: int = 512,
+) -> jax.Array:
+    """The engine-facing per-token model output [B, S] fp32: critic values
+    (via the value head) or fused chunked next-token logprobs for LMs —
+    never [B, S, V] logits."""
+    if cfg.is_critic:
+        return _head(params, cfg, x)
+    from areal_tpu.ops.functional import fused_next_token_logprobs
+
+    return fused_next_token_logprobs(
+        x, head_weights(params, cfg), tokens, segment_ids, chunk_size
+    )
+
+
 def forward_with_aux(
     params: Params,
     cfg: ModelConfig,
@@ -376,7 +425,10 @@ def prefill(
     )
     x = rms_norm(x, params["final_ln"], cfg.rms_norm_eps)
     # Gather each row's last valid hidden state before the (huge) head matmul.
-    last = jnp.maximum(jnp.sum(segment_ids > 0, axis=-1) - 1, 0)  # [B]
+    # (index of the last nonzero segment: works for left- and right-aligned
+    # prompt layouts alike)
+    idx = jnp.arange(segment_ids.shape[-1])
+    last = jnp.max(jnp.where(segment_ids > 0, idx, 0), axis=-1)  # [B]
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)  # [B,1,D]
     return _head(params, cfg, x_last)[:, 0], new_cache
 
@@ -385,40 +437,137 @@ def decode_step(
     params: Params,
     cfg: ModelConfig,
     tokens: jax.Array,  # [B] int32 — current token per row
-    positions: jax.Array,  # [B] int32 — its position per row
+    positions: jax.Array,  # [B] int32 — its RoPE position per row
     cache: KVCache,
-    cache_len: jax.Array,  # [B] int32 — valid cache length AFTER inserting
+    slot: jax.Array,  # scalar int32 — cache slot written for ALL rows
+    valid_from: jax.Array,  # [B] int32 — first valid cache slot per row
 ) -> Tuple[jax.Array, KVCache]:
-    """One decode step: insert token at cache slot positions, attend over
-    prefix, return fp32 logits [B, V] and the updated cache.
+    """One decode step: write the new token's k/v at cache slot `slot`
+    (shared by every row — the right-aligned prompt layout makes the write a
+    single `dynamic_update_slice`, not a per-row scatter), attend over the
+    live window `[valid_from, slot]`, return fp32 logits [B, V] and the
+    updated cache.
 
-    `cache_len` counts valid entries including the token being inserted; the
-    token's slot is cache_len - 1.
+    The cache rides the layer scan as CARRY (updated in place by XLA), so
+    per-token HBM traffic is one (B, n_kv, d) write + one window read per
+    layer instead of a full-cache rewrite (the fix for the one-hot scatter
+    this replaces).  Reference semantics: the fused decode step replayed via
+    CUDA graphs, realhf/impl/model/nn/real_llm_generate.py:336-368.
     """
     b = tokens.shape[0]
     x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]  # [B,1,D]
     cos, sin = rope_cos_sin(positions[:, None], cfg.head_dim, cfg.rope_theta)
-    slot = cache_len - 1  # [B]
+    slot = jnp.asarray(slot, jnp.int32)
 
-    def body(carry, layer_in):
-        blk, k_cache, v_cache = layer_in
-        h = rms_norm(carry, blk["ln1"], cfg.rms_norm_eps)
+    def body(carry, blk):
+        y, kc, vc, li = carry
+        h = rms_norm(y, blk["ln1"], cfg.rms_norm_eps)
         q, k, v = _block_kv(h, blk, cfg, cos, sin)  # q/k/v [B,1,h,d]
-        # Scatter the new k/v into each row's slot.
-        one_hot = jax.nn.one_hot(slot, k_cache.shape[1], dtype=k_cache.dtype)
-        k_cache = k_cache * (1 - one_hot[:, :, None, None]) + (
-            one_hot[:, :, None, None] * k[:, 0][:, None].astype(k_cache.dtype)
+        # k/v [B,1,h,d] -> [1,B,1,h,d] written at (layer, :, slot).
+        kc = jax.lax.dynamic_update_slice(
+            kc, k.astype(kc.dtype)[None], (li, 0, slot, 0, 0)
         )
-        v_cache = v_cache * (1 - one_hot[:, :, None, None]) + (
-            one_hot[:, :, None, None] * v[:, 0][:, None].astype(v_cache.dtype)
+        vc = jax.lax.dynamic_update_slice(
+            vc, v.astype(vc.dtype)[None], (li, 0, slot, 0, 0)
         )
-        attn = decode_attention_reference(q, k_cache, v_cache, cache_len)
-        y = carry + attn.reshape(b, 1, cfg.q_dim) @ blk["wo"]
+        k_layer = jax.lax.dynamic_index_in_dim(kc, li, axis=0, keepdims=False)
+        v_layer = jax.lax.dynamic_index_in_dim(vc, li, axis=0, keepdims=False)
+        attn = decode_attention(q, k_layer, v_layer, valid_from, slot + 1)
+        y = y + attn.reshape(b, 1, cfg.q_dim) @ blk["wo"]
         h2 = rms_norm(y, blk["ln2"], cfg.rms_norm_eps)
         y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk))
-        return y, (k_cache, v_cache)
+        return (y, kc, vc, li + 1), None
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    (x, kc, vc, _), _ = jax.lax.scan(
+        body, (x, cache.k, cache.v, jnp.int32(0)), params["blocks"]
+    )
     x = rms_norm(x, params["final_ln"], cfg.rms_norm_eps)
     logits = _head(params, cfg, x)[:, 0]  # [B, V]
-    return logits, KVCache(k=ks, v=vs)
+    return logits, KVCache(k=kc, v=vc)
+
+
+def decode_step_inflight(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [B] int32
+    positions: jax.Array,  # [B] int32 RoPE positions
+    cache: KVCache,
+    slots: jax.Array,  # [B] int32 — per-row cache write slot
+    valid_to: jax.Array,  # [B] int32 — one past the last valid slot (incl. new)
+) -> Tuple[jax.Array, KVCache]:
+    """Decode step with PER-ROW write slots (left-aligned rows), for the
+    continuous-batching generator where rows start/stop independently and
+    therefore sit at different cache depths.  The per-row write is a vmapped
+    `dynamic_update_slice` (a small scatter — [B, n_kv, d] per layer), not a
+    full-cache rewrite.  Reference: InflightBatchingGenerator's per-slot
+    cache bookkeeping (realhf/impl/model/nn/real_llm_generate.py:670)."""
+    b = tokens.shape[0]
+    x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]
+    cos, sin = rope_cos_sin(positions[:, None], cfg.head_dim, cfg.rope_theta)
+    zero_from = jnp.zeros((b,), jnp.int32)
+
+    def write_rows(layer, new, slots):
+        # layer [B,S,h,d]; new [B,h,d]; slots [B]
+        return jax.vmap(
+            lambda c, n, s: jax.lax.dynamic_update_slice(
+                c, n[None].astype(c.dtype), (s, 0, 0)
+            )
+        )(layer, new, slots)
+
+    def body(carry, blk):
+        y, kc, vc, li = carry
+        h = rms_norm(y, blk["ln1"], cfg.rms_norm_eps)
+        q, k, v = _block_kv(h, blk, cfg, cos, sin)
+        k_layer = jax.lax.dynamic_index_in_dim(kc, li, axis=0, keepdims=False)
+        v_layer = jax.lax.dynamic_index_in_dim(vc, li, axis=0, keepdims=False)
+        k_layer = write_rows(k_layer, k[:, 0], slots)
+        v_layer = write_rows(v_layer, v[:, 0], slots)
+        kc = jax.lax.dynamic_update_index_in_dim(kc, k_layer, li, axis=0)
+        vc = jax.lax.dynamic_update_index_in_dim(vc, v_layer, li, axis=0)
+        attn = decode_attention(q, k_layer, v_layer, zero_from, valid_to)
+        y = y + attn.reshape(b, 1, cfg.q_dim) @ blk["wo"]
+        h2 = rms_norm(y, blk["ln2"], cfg.rms_norm_eps)
+        y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk))
+        return (y, kc, vc, li + 1), None
+
+    (x, kc, vc, _), _ = jax.lax.scan(
+        body, (x, cache.k, cache.v, jnp.int32(0)), params["blocks"]
+    )
+    x = rms_norm(x, params["final_ln"], cfg.rms_norm_eps)
+    logits = _head(params, cfg, x)[:, 0]
+    return logits, KVCache(k=kc, v=vc)
+
+
+def prefill_into_slot(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [1, SP] left-aligned prompt (padding right)
+    prompt_len: jax.Array,  # scalar int32
+    cache: KVCache,  # [L, n_slots, s_max, h, d]
+    slot_row: jax.Array,  # scalar int32 — which cache row to fill
+    use_flash: "bool | None" = None,
+) -> Tuple[jax.Array, KVCache]:
+    """Prefill ONE request into cache row `slot_row` columns [0, SP); returns
+    fp32 logits [V] at the last prompt token.  Used by the inflight generator
+    to admit a new request into a freed slot."""
+    seg = (jnp.arange(tokens.shape[1])[None, :] < prompt_len).astype(jnp.int32)
+    row_cache = KVCache(
+        k=jnp.zeros(
+            (cfg.n_layers, 1, tokens.shape[1], cfg.n_kv_heads, cfg.head_dim),
+            cache.k.dtype,
+        ),
+        v=jnp.zeros(
+            (cfg.n_layers, 1, tokens.shape[1], cfg.n_kv_heads, cfg.head_dim),
+            cache.v.dtype,
+        ),
+    )
+    logits, row_cache = prefill(
+        params, cfg, tokens, seg, row_cache, use_flash=use_flash
+    )
+    new_k = jax.lax.dynamic_update_slice(
+        cache.k, row_cache.k, (0, slot_row, 0, 0, 0)
+    )
+    new_v = jax.lax.dynamic_update_slice(
+        cache.v, row_cache.v, (0, slot_row, 0, 0, 0)
+    )
+    return logits[0], KVCache(k=new_k, v=new_v)

@@ -76,7 +76,7 @@ def decode_attention_reference(
     v_cache: jax.Array,  # [B, S_max, n_kv, d]
     cache_len: jax.Array,  # [B] int — valid prefix length per row
 ) -> jax.Array:
-    """Single-token decode attention over a dense KV cache."""
+    """Single-token decode attention over a dense KV cache (fp32 oracle)."""
     n_q, n_kv = q.shape[2], k_cache.shape[2]
     k = repeat_kv(k_cache, n_q // n_kv)
     v = repeat_kv(v_cache, n_q // n_kv)
@@ -90,6 +90,45 @@ def decode_attention_reference(
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+def decode_attention(
+    q: jax.Array,  # [B, 1, n_q, d] — one new token per row
+    k_cache: jax.Array,  # [B, S_max, n_kv, d]
+    v_cache: jax.Array,  # [B, S_max, n_kv, d]
+    valid_from: jax.Array,  # [B] int — first valid cache slot per row
+    valid_to: jax.Array,  # scalar/[B] int — one past the last valid slot
+) -> jax.Array:
+    """Single-token GQA decode attention, HBM-lean: no repeat_kv expansion
+    (query heads grouped per KV head) and no fp32 materialization of the
+    cache — bf16 operands with fp32 MXU accumulation.  `[valid_from,
+    valid_to)` is the live window (right-aligned prompt layout).
+
+    Replaces the reference's flash_attn_with_kvcache decode path
+    (realhf/impl/model/modules/attn.py:251)."""
+    b, _, n_q, d = q.shape
+    n_kv = k_cache.shape[2]
+    n_rep = n_q // n_kv
+    qh = q[:, 0].reshape(b, n_kv, n_rep, d)
+    scale = d**-0.5
+    logits = (
+        jnp.einsum(
+            "bgrd,bsgd->bgrs", qh, k_cache.astype(q.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # [B, n_kv, n_rep, S] fp32
+    idx = jnp.arange(k_cache.shape[1])
+    valid = (idx[None, :] >= valid_from[:, None]) & (
+        idx[None, :] < jnp.broadcast_to(valid_to, (b,))[:, None]
+    )  # [B, S]
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bgrs,bsgd->bgrd", probs.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, 1, n_q, d).astype(q.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("causal",))

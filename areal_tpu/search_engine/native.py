@@ -34,7 +34,8 @@ def ranges_overlap_matrix(mesh_ranges) -> np.ndarray:
 
 
 def _stale() -> bool:
-    """True when any csrc/search source is newer than the built library."""
+    """True when any csrc/search source is newer than the built library.
+    Missing sources (prebuilt-only deployment) never mark the lib stale."""
     if not os.path.exists(_LIB_PATH):
         return True
     lib_mtime = os.path.getmtime(_LIB_PATH)
@@ -46,7 +47,9 @@ def _stale() -> bool:
             for f in os.listdir(src_dir)
             if f.endswith((".cpp", ".h"))
         ]
-    return any(os.path.getmtime(s) > lib_mtime for s in sources)
+    return any(
+        os.path.exists(s) and os.path.getmtime(s) > lib_mtime for s in sources
+    )
 
 
 def _load() -> Optional[ctypes.CDLL]:

@@ -236,6 +236,39 @@ def test_train_batch_mb_invariance():
         )
 
 
+def test_fused_next_token_logprobs_matches_dense(rng):
+    """Chunked head+logsumexp == dense log_softmax path, values and grads."""
+    cfg = tiny_config()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(5))
+    b, s = 2, 20
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(b, s)).astype(np.int32)
+    )
+    seg = jnp.asarray(
+        np.where(np.arange(s)[None, :] < [[15], [20]], 1, 0).astype(np.int32)
+    )
+
+    def dense(p):
+        logits = tfm.forward(p, cfg, tokens, seg)
+        lp = F.next_token_logprobs(logits, tokens, seg)
+        return lp.sum(), lp
+
+    def fused(p):
+        x, _ = tfm.hidden_states(p, cfg, tokens, seg)
+        lp = F.fused_next_token_logprobs(
+            x, tfm.head_weights(p, cfg), tokens, seg, chunk_size=8
+        )
+        return lp.sum(), lp
+
+    (s1, lp1), g1 = jax.value_and_grad(dense, has_aux=True)(params)
+    (s2, lp2), g2 = jax.value_and_grad(fused, has_aux=True)(params)
+    np.testing.assert_allclose(np.asarray(lp1), np.asarray(lp2), rtol=1e-5, atol=1e-5)
+    for a, b_ in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-5
+        )
+
+
 def test_forward_returns_aligned_logprobs(rng):
     pc = ParallelConfig.from_str("d1")
     mesh = make_mesh(pc, jax.devices()[:1])
@@ -244,8 +277,8 @@ def test_forward_returns_aligned_logprobs(rng):
     engine = TrainEngine(cfg, params, mesh, ftspec=FinetuneSpec(1, 4, 4))
     sample = fixtures.random_sample(rng, ids=["a", "b", "c"], max_len=30)
 
-    def post(logits, batch):
-        return F.next_token_logprobs(logits, batch["tokens"], batch["segment_ids"])
+    def post(logp, batch):
+        return logp  # engines emit fused next-token logprobs directly
 
     out = engine.forward(
         sample, MicroBatchSpec(), post_fn=post, output_key="logprobs"

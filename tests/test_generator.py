@@ -147,6 +147,60 @@ class TestGenerate:
         else:
             assert ne == 0.0
 
+    def test_inflight_matches_static_greedy(self, cfg, params, rng):
+        """Continuous batching: mixed-length requests, more requests than
+        slots (short ones retire, new ones join) — greedy outputs must equal
+        the static path's per-request results."""
+        mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+        eng = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, max_decode_batch=2
+        )
+        lens = (4, 11, 6, 9, 5)  # 5 requests, 2 slots
+        sample = _prompt_sample(rng, cfg, lens=lens)
+        g = GenerationHyperparameters(n=1, max_new_tokens=8, greedy=True)
+        out_static = eng.generate(
+            sample, MicroBatchSpec(), g, inflight=False
+        )
+        out_inflight = eng.generate(
+            sample, MicroBatchSpec(), g, inflight=True
+        )
+        assert out_inflight.ids == out_static.ids
+        np.testing.assert_array_equal(
+            np.asarray(out_inflight.data["packed_input_ids"]),
+            np.asarray(out_static.data["packed_input_ids"]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_inflight.data["packed_logprobs"]),
+            np.asarray(out_static.data["packed_logprobs"]),
+            rtol=2e-4, atol=2e-4,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_inflight.data["seq_no_eos_mask"]),
+            np.asarray(out_static.data["seq_no_eos_mask"]),
+        )
+
+    def test_inflight_default_on_oversubscription(self, cfg, params, rng):
+        """generate() picks inflight automatically when requests > slots."""
+        mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+        eng = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, max_decode_batch=2
+        )
+        sample = _prompt_sample(rng, cfg, lens=(5, 7, 6))
+        g = GenerationHyperparameters(n=2, max_new_tokens=4)
+        out = eng.generate(sample, MicroBatchSpec(), g, seed=5)
+        assert all(len(x) == 2 for x in out.seqlens["packed_input_ids"])
+        bounds = out.cu_seqlens("packed_input_ids")
+        flat = np.asarray(out.data["packed_input_ids"])
+        pb = sample.cu_seqlens("packed_prompts")
+        pdata = np.asarray(sample.data["packed_prompts"])
+        si = 0
+        for i in range(sample.bs):
+            prompt = pdata[pb[i] : pb[i + 1]]
+            for _ in range(2):
+                seq = flat[bounds[si] : bounds[si + 1]]
+                np.testing.assert_array_equal(seq[: len(prompt)], prompt)
+                si += 1
+
     def test_weight_hotswap_changes_output(self, cfg, params, engine, rng):
         sample = _prompt_sample(rng, cfg, lens=(6,))
         g = GenerationHyperparameters(n=1, max_new_tokens=4, greedy=True)

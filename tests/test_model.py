@@ -132,12 +132,81 @@ class TestDecode:
                 tokens[:, t],
                 jnp.full((b,), t, jnp.int32),
                 cache,
-                jnp.full((b,), t + 1, jnp.int32),
+                jnp.int32(t),  # shared write slot
+                jnp.zeros((b,), jnp.int32),  # valid_from
             )
             np.testing.assert_allclose(
                 np.asarray(step_logits), np.asarray(full_logits[:, t]),
                 rtol=2e-4, atol=2e-4, err_msg=f"step {t}",
             )
+
+    def test_right_aligned_decode_matches_forward(self, tiny, tiny_params, rng):
+        """Rows with different prompt lengths, right-aligned: stepwise decode
+        must equal the full forward on each row's own sequence."""
+        b, sp, total = 2, 8, 12
+        lens = [5, 8]
+        rows = [
+            rng.integers(0, tiny.vocab_size, size=(total - (sp - l),)).astype(np.int32)
+            for l in lens
+        ]
+        # Full-forward oracle per row (left-aligned single segment).
+        oracles = []
+        for toks in rows:
+            t = jnp.asarray(toks)[None, :]
+            seg = jnp.ones_like(t)
+            oracles.append(np.asarray(tfm.forward(tiny_params, tiny, t, seg))[0])
+
+        tokens = np.zeros((b, sp), np.int32)
+        seg = np.zeros((b, sp), np.int32)
+        for r, (l, toks) in enumerate(zip(lens, rows)):
+            tokens[r, sp - l:] = toks[:l]
+            seg[r, sp - l:] = 1
+        cache = tfm.init_kv_cache(tiny, b, total, dtype=jnp.float32)
+        pre_logits, cache = tfm.prefill(
+            tiny_params, tiny, jnp.asarray(tokens), jnp.asarray(seg), cache
+        )
+        for r, l in enumerate(lens):
+            np.testing.assert_allclose(
+                np.asarray(pre_logits)[r], oracles[r][l - 1],
+                rtol=2e-4, atol=2e-4,
+            )
+        valid_from = jnp.asarray([sp - l for l in lens], jnp.int32)
+        for step in range(total - sp):
+            tok = jnp.asarray(
+                [rows[r][lens[r] + step] for r in range(b)], jnp.int32
+            )
+            positions = jnp.asarray(
+                [lens[r] + step for r in range(b)], jnp.int32
+            )
+            step_logits, cache = tfm.decode_step(
+                tiny_params, tiny, tok, positions, cache,
+                jnp.int32(sp + step), valid_from,
+            )
+            for r, l in enumerate(lens):
+                np.testing.assert_allclose(
+                    np.asarray(step_logits)[r], oracles[r][l + step],
+                    rtol=2e-4, atol=2e-4, err_msg=f"step {step} row {r}",
+                )
+
+    def test_decode_attention_matches_reference(self, rng):
+        """GQA windowed decode attention == repeat_kv fp32 oracle."""
+        from areal_tpu.ops.attention import (
+            decode_attention,
+            decode_attention_reference,
+        )
+
+        b, s, n_q, n_kv, d = 3, 16, 8, 2, 32
+        q = jnp.asarray(rng.normal(size=(b, 1, n_q, d)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(b, s, n_kv, d)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(b, s, n_kv, d)).astype(np.float32))
+        cache_len = jnp.asarray([5, 16, 9], jnp.int32)
+        want = decode_attention_reference(q, k, v, cache_len)
+        got = decode_attention(
+            q, k, v, jnp.zeros((b,), jnp.int32), cache_len
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
 
 
 def _torch_state_dict_to_numpy(model):

@@ -160,6 +160,7 @@ def test_quickstart_search_wiring(tmp_path):
     args = argparse.Namespace(
         model_path=str(ckpt), batch_size=4, group_size=2,
         max_new_tokens=64, chip="v5e", max_tokens_per_mb=4096, seed=1,
+        multiprocess=False, search_devices=None,
     )
     train, gen = quickstart._searched_ppo_allocation(args)
     n = jax.device_count()
