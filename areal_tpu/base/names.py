@@ -35,6 +35,10 @@ def distributed_master(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/distributed_master"
 
 
+def push_pull_stream(experiment_name: str, trial_name: str, worker_index: int) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/pushpull/{worker_index}"
+
+
 def model_version(experiment_name: str, trial_name: str, model_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/model_version/{model_name}"
 

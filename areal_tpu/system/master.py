@@ -104,6 +104,11 @@ class MasterWorker:
             for n in dfg.nodes
             if n.interface_type == ModelInterfaceType.TRAIN_STEP
         ]
+        # Cross-worker data plane bookkeeping: which workers hold which
+        # (data id, key) — the master's equivalent of the reference's
+        # GlobalStorageTracker (realhf/system/redistributor.py:12).
+        self._owners: Dict[str, Dict[str, set]] = {}
+        self._xfer_id = 0
 
     # ---------------- lifecycle ----------------
 
@@ -174,10 +179,67 @@ class MasterWorker:
                 for w in self.data_worker_ids
             ]
         )
-        for r in resps:
-            await self.buffer.put_batch(
-                r["meta"], step=self.step_info.global_step
-            )
+        for w, r in zip(self.data_worker_ids, resps):
+            meta = r["meta"]
+            self._record_owner(meta, w)
+            await self.buffer.put_batch(meta, step=self.step_info.global_step)
+
+    def _record_owner(self, meta, worker: int, replace: bool = False):
+        for sid in meta.ids:
+            km = self._owners.setdefault(sid, {})
+            for key in meta.keys:
+                if replace:
+                    km[key] = {worker}
+                else:
+                    km.setdefault(key, set()).add(worker)
+
+    async def _ensure_data(self, node: MFCDef, ids, dst: int):
+        """Move any input (id, key) not yet resident on `dst` from an owning
+        worker, as one tagged transfer per source (the data-plane pre-hook;
+        reference: model_function_call data_transfer pre-hooks +
+        redistributor.derive_plan)."""
+        plans: Dict[int, Dict[str, list]] = {}  # src -> key -> [ids]
+        for sid in ids:
+            km = self._owners.get(sid, {})
+            for key in node.input_keys:
+                holders = km.get(key)
+                if holders is None:
+                    raise KeyError(
+                        f"MFC {node.name}: no worker holds {key!r} for "
+                        f"data id {sid!r}"
+                    )
+                if dst in holders:
+                    continue
+                src = min(holders)
+                plans.setdefault(src, {}).setdefault(key, []).append(sid)
+                km[key].add(dst)
+        for src, key_ids in plans.items():
+            # One transfer per (src, key-set): group ids needing the same keys.
+            by_ids: Dict[tuple, set] = {}
+            for key, sids in key_ids.items():
+                for sid in sids:
+                    by_ids.setdefault(sid, set()).add(key)
+            groups: Dict[frozenset, list] = {}
+            for sid, keys in by_ids.items():
+                groups.setdefault(frozenset(keys), []).append(sid)
+            for keys, sids in groups.items():
+                xfer_id = self._xfer_id
+                self._xfer_id += 1
+                await asyncio.gather(
+                    self.pool.request(
+                        src,
+                        {
+                            "type": "data_send",
+                            "ids": sids,
+                            "keys": sorted(keys),
+                            "dst": dst,
+                            "xfer_id": xfer_id,
+                        },
+                    ),
+                    self.pool.request(
+                        dst, {"type": "data_recv", "xfer_id": xfer_id}
+                    ),
+                )
 
     async def _run_mfc(self, node: MFCDef, results: Dict):
         batch = await self.buffer.get_batch_for_rpc(node, timeout=600)

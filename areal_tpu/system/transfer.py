@@ -1,0 +1,132 @@
+"""Bulk worker-to-worker transfer plane (data + params).
+
+Capability parity: realhf/system/data_manager.py (NCCL bcast/gather/scatter
+of packed tensors between GPU sets) + system/push_pull_stream.py — built for
+the TPU process model: bulk payloads are HOST-side numpy pytrees moving
+directly worker-to-worker over ZMQ PUSH/PULL (the control plane stays on the
+master's request stream).  On-device placement happens at the receiver via
+`device_put` onto its own mesh, so arbitrary src/dst layouts compose without
+a cross-layout collective plan.
+
+Transfers are tagged with a master-assigned `xfer_id`; receivers stash
+out-of-order arrivals so concurrent transfers from different sources cannot
+mismatch (the reference serializes with syn-ack ordering instead,
+request_reply_stream.py:160-226).
+
+Two implementations:
+- InProcTransfer: queues shared between workers in one process (tests,
+  single-host trials).
+- ZMQTransfer: each worker binds a PULL socket, publishes it via
+  name_resolve, and PUSHes to peers lazily.
+"""
+
+import pickle
+import queue
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from areal_tpu.base import logging, name_resolve, names, network
+
+logger = logging.getLogger("transfer")
+
+
+pushpull_name = names.push_pull_stream
+
+
+class TransferPlane:
+    """send() is addressed; recv() drains this worker's inbox."""
+
+    def send(self, dst: int, xfer_id: int, payload: Any) -> None:
+        raise NotImplementedError
+
+    def recv(self, timeout: float = 300.0) -> Tuple[int, Any]:
+        """Returns (xfer_id, payload)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InProcTransfer(TransferPlane):
+    """Shared-queue plane for in-process worker pools."""
+
+    def __init__(self, inboxes: Dict[int, "queue.Queue"], my_index: int):
+        self.inboxes = inboxes
+        self.my_index = my_index
+
+    @classmethod
+    def make_group(cls, n_workers: int):
+        inboxes: Dict[int, queue.Queue] = {
+            i: queue.Queue() for i in range(n_workers)
+        }
+        return [cls(inboxes, i) for i in range(n_workers)]
+
+    def send(self, dst: int, xfer_id: int, payload: Any) -> None:
+        self.inboxes[dst].put((xfer_id, payload))
+
+    def recv(self, timeout: float = 300.0) -> Tuple[int, Any]:
+        return self.inboxes[self.my_index].get(timeout=timeout)
+
+
+class ZMQTransfer(TransferPlane):
+    """PUSH/PULL plane for multi-process trials.
+
+    The PULL socket binds eagerly at construction and its address is
+    published via name_resolve; PUSH sockets to peers are created lazily and
+    cached.  One lock guards sends (worker request handling is serial, but
+    closes can race)."""
+
+    def __init__(self, experiment: str, trial: str, worker_index: int):
+        import zmq
+
+        self.experiment = experiment
+        self.trial = trial
+        self.worker_index = worker_index
+        self._ctx = zmq.Context()
+        self._pull = self._ctx.socket(zmq.PULL)
+        port = self._pull.bind_to_random_port("tcp://*")
+        self._addr = f"tcp://{network.gethostip()}:{port}"
+        name_resolve.add(
+            pushpull_name(experiment, trial, worker_index),
+            self._addr,
+            replace=True,
+        )
+        self._push: Dict[int, Any] = {}
+        self._lock = threading.Lock()
+        logger.info(
+            f"worker {worker_index} transfer plane bound at {self._addr}"
+        )
+
+    def _push_sock(self, dst: int):
+        import zmq
+
+        with self._lock:
+            if dst not in self._push:
+                addr = name_resolve.wait(
+                    pushpull_name(self.experiment, self.trial, dst),
+                    timeout=300,
+                )
+                s = self._ctx.socket(zmq.PUSH)
+                s.connect(addr)
+                self._push[dst] = s
+            return self._push[dst]
+
+    def send(self, dst: int, xfer_id: int, payload: Any) -> None:
+        self._push_sock(dst).send(pickle.dumps((xfer_id, payload)))
+
+    def recv(self, timeout: float = 300.0) -> Tuple[int, Any]:
+        import zmq
+
+        if not self._pull.poll(timeout * 1000):
+            raise TimeoutError(
+                f"worker {self.worker_index}: no transfer within {timeout}s"
+            )
+        return pickle.loads(self._pull.recv())
+
+    def close(self) -> None:
+        with self._lock:
+            for s in self._push.values():
+                s.close(linger=0)
+            self._push.clear()
+        self._pull.close(linger=0)
+        self._ctx.term()

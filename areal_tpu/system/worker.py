@@ -99,9 +99,11 @@ def _build_params_and_config(spec: ModelAbstraction, seed: int):
 
 
 class ModelWorker:
-    def __init__(self, config: WorkerConfig, tokenizer=None):
+    def __init__(self, config: WorkerConfig, tokenizer=None, transfer=None):
         self.config = config
         self.tokenizer = tokenizer
+        self.transfer = transfer  # TransferPlane (system/transfer.py) or None
+        self._xfer_stash: Dict[int, Any] = {}
         self.models: Dict[str, Model] = {}
         self.interfaces: Dict[str, Any] = {}
         self.data_cache: Dict[str, SequenceSample] = {}
@@ -248,6 +250,78 @@ class ModelWorker:
                     self.data_cache[sid] = one
             return {"meta": result.meta(), "stats": {}}
         return {"meta": None, "stats": dict(result or {})}
+
+    # ---------------- cross-worker transfer plane ----------------
+    # The master orchestrates transfers as a concurrent (send, recv) request
+    # pair; payloads are tagged with a master-assigned xfer_id so concurrent
+    # transfers from different sources can't mismatch (reference: the
+    # data_manager's planned NCCL redistribution, data_manager.py:144-416).
+
+    def _recv_xfer(self, xfer_id: int):
+        if xfer_id in self._xfer_stash:
+            return self._xfer_stash.pop(xfer_id)
+        while True:
+            got_id, payload = self.transfer.recv()
+            if got_id == xfer_id:
+                return payload
+            self._xfer_stash[got_id] = payload
+
+    def _handle_data_send(self, req):
+        """Ship cached entries (selected keys) to another worker."""
+        keys = set(req["keys"])
+        parts = []
+        for sid in req["ids"]:
+            entry = self.data_cache[sid]
+            have = keys & entry.keys
+            if not have:
+                raise KeyError(
+                    f"worker {self.config.worker_index}: no keys {keys} "
+                    f"cached for id {sid}"
+                )
+            parts.append(entry.select_keys(have))
+        self.transfer.send(req["dst"], req["xfer_id"], ("data", parts))
+        return {}
+
+    def _handle_data_recv(self, req):
+        kind, parts = self._recv_xfer(req["xfer_id"])
+        assert kind == "data", kind
+        for one in parts:
+            sid = one.ids[0]
+            if sid in self.data_cache:
+                self.data_cache[sid].update_(one)
+            else:
+                self.data_cache[sid] = one
+        return {"n": len(parts)}
+
+    def _handle_param_send(self, req):
+        """Ship a model's host-side param pytree to another worker (the
+        cross-worker half of param realloc; reference model_worker.py:1009)."""
+        import jax
+
+        params = self.models[req["model_name"]].engine.get_params()
+        host = jax.tree.map(np.asarray, params)
+        self.transfer.send(req["dst"], req["xfer_id"], ("params", host))
+        return {}
+
+    def _handle_param_recv(self, req):
+        import jax
+
+        kind, host = self._recv_xfer(req["xfer_id"])
+        assert kind == "params", kind
+        eng = self.models[req["model_name"]].engine
+        eta = float(req.get("eta", 1.0))
+        if eta >= 1.0:
+            eng.set_params(host)
+        else:
+            cur = jax.tree.map(np.asarray, eng.get_params())
+            mixed = jax.tree.map(
+                lambda a, b: eta * np.asarray(a, np.float32)
+                + (1 - eta) * np.asarray(b, np.float32),
+                host,
+                cur,
+            )
+            eng.set_params(mixed)
+        return {}
 
     def _handle_param_sync(self, req):
         """Copy/EMA params src -> dst (generator hot-swap, EMA ref).
