@@ -34,6 +34,7 @@ def main():
 
     from areal_tpu.base import logging, seeding
     from areal_tpu.system.stream import run_worker_stream
+    from areal_tpu.system.transfer import ZMQTransfer
     from areal_tpu.system.worker import ModelWorker
 
     logger = logging.getLogger(f"worker{args.index}")
@@ -42,9 +43,15 @@ def main():
     ) as f:
         config = pickle.load(f)
     seeding.set_random_seed(config.seed, config.worker_index)
-    worker = ModelWorker(config)
+    # Bulk worker-to-worker plane (data/param transfers planned by the
+    # master); bound before model build so peers can connect early.
+    transfer = ZMQTransfer(args.experiment, args.trial, args.index)
+    worker = ModelWorker(config, transfer=transfer)
     logger.info(f"worker {args.index} ready, serving stream")
-    run_worker_stream(worker, args.experiment, args.trial)
+    try:
+        run_worker_stream(worker, args.experiment, args.trial)
+    finally:
+        transfer.close()
     logger.info(f"worker {args.index} exiting")
 
 

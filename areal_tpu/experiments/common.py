@@ -127,6 +127,16 @@ class PPOMathConfig:
         default_factory=GenerationHyperparameters
     )
     ppo_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Model role -> worker index (e.g. {"actor_gen": 1} puts generation on a
+    # second worker; the data/param planes move bytes between them).  Roles
+    # not listed run on worker 0.  Reference: device-mesh allocations like
+    # `sglang.d64p1m1+d32p2m1` (api/cli_args.py allocation_mode).
+    placement: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # Per-worker first local device (in-process multi-worker trials carve
+    # one host's device list into disjoint meshes).
+    worker_device_offsets: Dict[int, int] = dataclasses.field(
+        default_factory=dict
+    )
     batch_size: int = 8  # prompts per step
     total_train_epochs: int = 1
     mb_spec: MicroBatchSpec = dataclasses.field(default_factory=MicroBatchSpec)
@@ -307,19 +317,27 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                 optimizer=cfg.optimizer,
             )
         )
-    worker = WorkerConfig(
-        worker_index=0,
-        shards=shards,
-        datasets=[cfg.dataset],
-        batch_size=cfg.batch_size,
-        seed=cfg.seed,
-        ftspec=ftspec,
-    )
+    placement = {str(s.name): cfg.placement.get(s.name.role, 0) for s in shards}
+    n_workers = max(placement.values(), default=0) + 1
+    worker_configs = []
+    for w in range(n_workers):
+        worker_configs.append(
+            WorkerConfig(
+                worker_index=w,
+                shards=[s for s in shards if placement[str(s.name)] == w],
+                # Datasets live on worker 0 (the data worker); outputs move
+                # to consumers via the master-planned transfer plane.
+                datasets=[cfg.dataset] if w == 0 else [],
+                batch_size=cfg.batch_size,
+                seed=cfg.seed,
+                ftspec=ftspec,
+                device_offset=cfg.worker_device_offsets.get(w, 0),
+            )
+        )
     cfg.ctrl.total_train_epochs = cfg.total_train_epochs
-    placement = {str(s.name): 0 for s in shards}
     return ExperimentPlan(
         dfg=dfg,
-        worker_configs=[worker],
+        worker_configs=worker_configs,
         model_placement=placement,
         data_worker_ids=[0],
         ctrl=cfg.ctrl,
@@ -336,9 +354,14 @@ def run_experiment(plan: ExperimentPlan, tokenizer=None):
     import asyncio
 
     from areal_tpu.system.master import InProcessPool, MasterWorker
+    from areal_tpu.system.transfer import InProcTransfer
     from areal_tpu.system.worker import ModelWorker
 
-    workers = [ModelWorker(wc, tokenizer=tokenizer) for wc in plan.worker_configs]
+    planes = InProcTransfer.make_group(len(plan.worker_configs))
+    workers = [
+        ModelWorker(wc, tokenizer=tokenizer, transfer=planes[i])
+        for i, wc in enumerate(plan.worker_configs)
+    ]
     pool = InProcessPool(workers)
     master = MasterWorker(
         dfg=plan.dfg,

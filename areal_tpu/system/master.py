@@ -109,6 +109,10 @@ class MasterWorker:
         # GlobalStorageTracker (realhf/system/redistributor.py:12).
         self._owners: Dict[str, Dict[str, set]] = {}
         self._xfer_id = 0
+        # (sid, key, dst) -> Future resolved when the transfer lands; lets a
+        # concurrent MFC needing the same copy await it instead of
+        # dispatching against data still in flight.
+        self._inflight: Dict[tuple, asyncio.Future] = {}
 
     # ---------------- lifecycle ----------------
 
@@ -199,6 +203,10 @@ class MasterWorker:
         reference: model_function_call data_transfer pre-hooks +
         redistributor.derive_plan)."""
         plans: Dict[int, Dict[str, list]] = {}  # src -> key -> [ids]
+        waits = set()
+        started: list = []
+        # Planning is synchronous (no awaits), so ownership marks and
+        # in-flight registrations below are atomic wrt other coroutines.
         for sid in ids:
             km = self._owners.get(sid, {})
             for key in node.input_keys:
@@ -209,37 +217,68 @@ class MasterWorker:
                         f"data id {sid!r}"
                     )
                 if dst in holders:
+                    fut = self._inflight.get((sid, key, dst))
+                    if fut is not None:
+                        waits.add(fut)
                     continue
-                src = min(holders)
+                # Valid sources are settled holders (copy not in flight).
+                settled = [
+                    w
+                    for w in holders
+                    if (sid, key, w) not in self._inflight
+                ]
+                src = min(settled)
                 plans.setdefault(src, {}).setdefault(key, []).append(sid)
                 km[key].add(dst)
-        for src, key_ids in plans.items():
-            # One transfer per (src, key-set): group ids needing the same keys.
-            by_ids: Dict[tuple, set] = {}
-            for key, sids in key_ids.items():
-                for sid in sids:
-                    by_ids.setdefault(sid, set()).add(key)
-            groups: Dict[frozenset, list] = {}
-            for sid, keys in by_ids.items():
-                groups.setdefault(frozenset(keys), []).append(sid)
-            for keys, sids in groups.items():
-                xfer_id = self._xfer_id
-                self._xfer_id += 1
-                await asyncio.gather(
-                    self.pool.request(
-                        src,
-                        {
-                            "type": "data_send",
-                            "ids": sids,
-                            "keys": sorted(keys),
-                            "dst": dst,
-                            "xfer_id": xfer_id,
-                        },
-                    ),
-                    self.pool.request(
-                        dst, {"type": "data_recv", "xfer_id": xfer_id}
-                    ),
-                )
+                fut = asyncio.get_running_loop().create_future()
+                self._inflight[(sid, key, dst)] = fut
+                started.append((sid, key, dst))
+        err: Optional[BaseException] = None
+        try:
+            for src, key_ids in plans.items():
+                # One transfer per (src, key-set): group ids needing the
+                # same keys.
+                by_ids: Dict[tuple, set] = {}
+                for key, sids in key_ids.items():
+                    for sid in sids:
+                        by_ids.setdefault(sid, set()).add(key)
+                groups: Dict[frozenset, list] = {}
+                for sid, keys in by_ids.items():
+                    groups.setdefault(frozenset(keys), []).append(sid)
+                for keys, sids in groups.items():
+                    xfer_id = self._xfer_id
+                    self._xfer_id += 1
+                    await asyncio.gather(
+                        self.pool.request(
+                            src,
+                            {
+                                "type": "data_send",
+                                "ids": sids,
+                                "keys": sorted(keys),
+                                "dst": dst,
+                                "xfer_id": xfer_id,
+                            },
+                        ),
+                        self.pool.request(
+                            dst, {"type": "data_recv", "xfer_id": xfer_id}
+                        ),
+                    )
+        except BaseException as e:  # propagate to waiters, then re-raise
+            err = e
+            raise
+        finally:
+            for tag in started:
+                fut = self._inflight.pop(tag, None)
+                if fut is None or fut.done():
+                    continue
+                if err is None:
+                    fut.set_result(None)
+                else:
+                    fut.set_exception(
+                        RuntimeError(f"transfer for {tag} failed: {err!r}")
+                    )
+        if waits:
+            await asyncio.gather(*waits)
 
     async def _run_mfc(self, node: MFCDef, results: Dict):
         batch = await self.buffer.get_batch_for_rpc(node, timeout=600)
@@ -247,6 +286,8 @@ class MasterWorker:
         # Pre hooks (param sync from another model, e.g. gen <- train).
         for hook in node.pre_hooks:
             await self._run_hook(hook, node, worker)
+        # Data-plane pre-hook: ship any input (id, key) this worker lacks.
+        await self._ensure_data(node, batch.ids, worker)
         resp = await self.pool.request(
             worker,
             {
@@ -261,6 +302,9 @@ class MasterWorker:
             },
         )
         if resp.get("meta") is not None:
+            # The producing worker holds the authoritative copy of every
+            # output key; stale copies elsewhere must not be re-used.
+            self._record_owner(resp["meta"], worker, replace=True)
             await self.buffer.amend_batch(resp["meta"])
         results[node.name] = resp.get("stats") or {}
         for hook in node.post_hooks:
@@ -268,18 +312,52 @@ class MasterWorker:
 
     async def _run_hook(self, hook, node: MFCDef, worker: int):
         if isinstance(hook, ParamReallocHook):
-            await self.pool.request(
-                worker,
-                {
-                    "type": "param_sync",
-                    "src": str(node.model_name),
-                    "dst": str(hook.target),
-                    "eta": hook.eta,
-                },
-            )
+            target_worker = self.placement[str(hook.target)]
+            if target_worker == worker:
+                await self.pool.request(
+                    worker,
+                    {
+                        "type": "param_sync",
+                        "src": str(node.model_name),
+                        "dst": str(hook.target),
+                        "eta": hook.eta,
+                    },
+                )
+            else:
+                # Cross-worker realloc: host-side pytree over the transfer
+                # plane (reference: param_realloc NCCL groups,
+                # model_worker.py:1009) — send and recv dispatched as a
+                # concurrent pair so neither side can observe the other's
+                # request ordering.
+                xfer_id = self._xfer_id
+                self._xfer_id += 1
+                await asyncio.gather(
+                    self.pool.request(
+                        worker,
+                        {
+                            "type": "param_send",
+                            "model_name": str(node.model_name),
+                            "dst": target_worker,
+                            "xfer_id": xfer_id,
+                        },
+                    ),
+                    self.pool.request(
+                        target_worker,
+                        {
+                            "type": "param_recv",
+                            "model_name": str(hook.target),
+                            "xfer_id": xfer_id,
+                            "eta": hook.eta,
+                        },
+                    ),
+                )
 
     async def _clear_worker_caches(self):
         keep = list(self.buffer._entries.keys())
+        keep_set = set(keep)
+        for sid in list(self._owners):
+            if sid not in keep_set:
+                del self._owners[sid]
         await asyncio.gather(
             *[
                 self.pool.request(

@@ -131,17 +131,27 @@ class ZMQWorkerPool(WorkerPool):
         self._ctx.term()
 
 
+# Request types served on background threads: the transfer-plane recv side
+# BLOCKS until the peer's send lands, so a serial loop could deadlock when
+# the master dispatches send/recv pairs between two workers concurrently
+# (each stuck in recv while the matching send sits queued behind it).
+# Compute requests stay serial, matching the reference's one-blocking-
+# request-at-a-time model worker (model_worker.py:667).
+_THREADED_TYPES = frozenset(
+    {"data_send", "data_recv", "param_send", "param_recv"}
+)
+
+
 def run_worker_stream(
     worker,  # ModelWorker
     experiment_name: str,
     trial_name: str,
     timeout: float = 300.0,
 ) -> None:
-    """Worker side: connect, announce, serve requests until 'exit'.
+    """Worker side: connect, announce, serve requests until 'exit'."""
+    import queue
+    import threading
 
-    Synchronous by design — MFC execution is device-bound and serial per
-    worker (the reference's model worker also executes one blocking request
-    at a time, model_worker.py:667)."""
     addr = name_resolve.wait(
         names.request_reply_stream(experiment_name, trial_name, STREAM_NAME),
         timeout=timeout,
@@ -157,25 +167,54 @@ def run_worker_stream(
     logger.info(
         f"worker {worker.config.worker_index} connected to master at {addr}"
     )
+
+    replies: "queue.Queue[bytes]" = queue.Queue()
+    threads: list = []
+
+    def _serve(req, req_id):
+        try:
+            result = worker.handle_request(req)
+            reply = {"req_id": req_id, "result": result}
+        except Exception as e:  # noqa: BLE001 — forwarded to master
+            logger.error(
+                f"worker {worker.config.worker_index} request "
+                f"{req.get('type')} failed: {e!r}"
+            )
+            reply = {"req_id": req_id, "error": repr(e)}
+        replies.put(pickle.dumps(reply))
+
+    def _drain_replies():
+        while True:
+            try:
+                sock.send(replies.get_nowait())
+            except queue.Empty:
+                return
+
     try:
         while True:
+            if not sock.poll(100):
+                _drain_replies()
+                continue
             msg = pickle.loads(sock.recv())
             req = msg["request"]
             if req.get("type") == "exit":
+                for t in threads:
+                    t.join(timeout=timeout)
+                _drain_replies()
                 sock.send(
                     pickle.dumps({"req_id": msg["req_id"], "result": {}})
                 )
                 break
-            try:
-                result = worker.handle_request(req)
-                reply = {"req_id": msg["req_id"], "result": result}
-            except Exception as e:  # noqa: BLE001 — forwarded to master
-                logger.error(
-                    f"worker {worker.config.worker_index} request "
-                    f"{req.get('type')} failed: {e!r}"
+            if req.get("type") in _THREADED_TYPES:
+                t = threading.Thread(
+                    target=_serve, args=(req, msg["req_id"]), daemon=True
                 )
-                reply = {"req_id": msg["req_id"], "error": repr(e)}
-            sock.send(pickle.dumps(reply))
+                t.start()
+                threads.append(t)
+                threads = [t for t in threads if t.is_alive()]
+            else:
+                _serve(req, msg["req_id"])
+            _drain_replies()
     finally:
         sock.close(linger=0)
         ctx.term()

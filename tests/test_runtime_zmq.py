@@ -91,3 +91,59 @@ def test_sft_multiprocess_e2e(tmp_path):
     )
     assert len(stats) == 2
     assert np.isfinite(stats[-1]["nll"])
+
+
+def test_ppo_disjoint_workers_multiprocess(tmp_path):
+    """VERDICT r1 'done' criterion: gen and train in DIFFERENT worker
+    processes with their own meshes; a PPO step completes — prompts, rollouts,
+    rewards and fresh weights all cross process boundaries over the ZMQ
+    transfer plane."""
+    import json
+
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.apps import main as runner
+    from areal_tpu.experiments.common import PPOMathConfig, build_ppo_math
+    from areal_tpu.models.config import tiny_config
+
+    rows = fixtures.build_math_rows(8, seed=4)
+    data_path = tmp_path / "math.jsonl"
+    with open(data_path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+    cfg = PPOMathConfig(
+        actor=ModelAbstraction("random", {"config": tiny_config()}),
+        dataset=DatasetAbstraction(
+            "math_code_prompt",
+            {"dataset_path": str(data_path), "max_length": 64},
+        ),
+        reward_interface_args={
+            "id2info": {r["query_id"]: r for r in rows}
+        },
+        gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+        ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+        optimizer=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
+        actor_parallel=ParallelConfig.from_str("d2"),
+        gen_parallel=ParallelConfig.from_str("d2"),
+        placement={"actor_gen": 1, "reward": 1},
+        batch_size=4,
+        total_train_epochs=1,
+        ctrl=ExperimentSaveEvalControl(benchmark_steps=2),
+        experiment_name="zmqppo",
+        trial_name="t0",
+        fileroot=str(tmp_path / "trial"),
+    )
+    plan = build_ppo_math(cfg)
+    for wc in plan.worker_configs:
+        wc.tokenizer_path = "char:512"
+
+    stats = runner.run_experiment(
+        plan,
+        worker_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        },
+    )
+    assert len(stats) == 2
+    assert np.isfinite(stats[-1]["actor_train/actor_loss"])
+    assert abs(stats[0]["actor_train/importance_weight"] - 1.0) < 5e-2
