@@ -41,6 +41,7 @@ def _make_master(plan: ExperimentPlan, pool) -> MasterWorker:
         fileroot=plan.fileroot,
         experiment_name=plan.experiment_name,
         trial_name=plan.trial_name,
+        model_groups=plan.model_groups,
     )
 
 

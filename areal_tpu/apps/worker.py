@@ -43,6 +43,15 @@ def main():
     ) as f:
         config = pickle.load(f)
     seeding.set_random_seed(config.seed, config.worker_index)
+    if config.dist_num_processes > 1:
+        from areal_tpu.base import distributed
+
+        distributed.initialize(
+            args.experiment,
+            args.trial,
+            process_id=config.dist_process_id,
+            num_processes=config.dist_num_processes,
+        )
     # Bulk worker-to-worker plane (data/param transfers planned by the
     # master); bound before model build so peers can connect early.
     transfer = ZMQTransfer(args.experiment, args.trial, args.index)

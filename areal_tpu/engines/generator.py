@@ -33,6 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model_api import Engine, GenerationHyperparameters
 from areal_tpu.base import logging
+from areal_tpu.base.distributed import to_host
 from areal_tpu.base.topology import batch_sharding_degree
 from areal_tpu.engines.packing import bucket_len
 from areal_tpu.models import transformer as tfm
@@ -218,11 +219,11 @@ class GeneratorEngine(Engine):
                 jnp.asarray(cache_len), jnp.asarray(gen_count),
                 jnp.asarray(done_host), sub,
             )
-            out_toks = np.asarray(out_toks)
-            out_logps = np.asarray(out_logps)
-            cache_len = np.asarray(new_cache_len).copy()
-            gen_count = np.asarray(new_gen_count).copy()
-            new_done = np.asarray(new_done)
+            out_toks = to_host(out_toks)
+            out_logps = to_host(out_logps)
+            cache_len = to_host(new_cache_len).copy()
+            gen_count = to_host(new_gen_count).copy()
+            new_done = to_host(new_done)
 
             # Host bookkeeping: append tokens, retire finished slots.
             for s in range(n_slots):
@@ -361,9 +362,9 @@ class GeneratorEngine(Engine):
         fn = self._get_gen_fn(b, sp, s_total, gconfig)
         toks, logps, gen_len = fn(self.params, prompt_tok, prompt_len, key)
         toks, logps, gen_len = (
-            np.asarray(toks),
-            np.asarray(logps),
-            np.asarray(gen_len),
+            to_host(toks),
+            to_host(logps),
+            to_host(gen_len),
         )
         for r, (i, rep, _) in enumerate(chunk):
             gl = int(gen_len[r])

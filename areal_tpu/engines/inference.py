@@ -15,6 +15,7 @@ from jax.sharding import Mesh
 
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model_api import Engine
+from areal_tpu.base.distributed import to_host
 from areal_tpu.engines import packing
 from areal_tpu.models import transformer as tfm
 from areal_tpu.models.config import ModelConfig
@@ -87,7 +88,7 @@ class InferenceEngine(Engine):
                 )
                 for k, v in pk.arrays.items()
             }
-            dense = np.asarray(fwd(self.params, batch))
+            dense = to_host(fwd(self.params, batch))
             outs.append(
                 SequenceSample(
                     keys={output_key},

@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model_api import Engine, FinetuneSpec, OptimizerConfig
 from areal_tpu.base import logging
+from areal_tpu.base.distributed import is_primary, to_host
 from areal_tpu.engines import packing
 from areal_tpu.models import transformer as tfm
 from areal_tpu.models.config import ModelConfig
@@ -290,7 +291,7 @@ class TrainEngine(Engine):
                 max_tokens_per_row=mb_spec.max_tokens_per_mb,
             )
             batch = self._device_batch(pk.arrays)
-            dense = np.asarray(fwd(self.params, batch))
+            dense = to_host(fwd(self.params, batch))
             packed = pk.unpack(dense)
             out = SequenceSample(
                 keys={output_key},
@@ -354,7 +355,11 @@ class TrainEngine(Engine):
     def save_optimizer_state(self, path: str) -> None:
         import pickle
 
-        host = jax.tree.map(np.asarray, self.opt_state)
+        # Host gather is collective on process-spanning meshes — every
+        # group member calls it; only jax process 0 writes the file.
+        host = jax.tree.map(to_host, self.opt_state)
+        if not is_primary():
+            return
         with open(path, "wb") as f:
             pickle.dump(host, f)
 

@@ -41,12 +41,19 @@ class ExperimentPlan:
     experiment_name: str = "exp"
     trial_name: str = "trial"
     fileroot: str = "/tmp/areal_tpu/trial"
+    # model key -> all worker ids forming its (multi-host) mesh; models
+    # absent run on their single placement worker.  group[0] == placement.
+    model_groups: Optional[Dict[str, List[int]]] = None
 
 
 @dataclasses.dataclass
 class SFTConfig:
     model: ModelAbstraction
     dataset: DatasetAbstraction
+    # >1 = lay the model's mesh across this many worker PROCESSES (hosts):
+    # each joins the jax.distributed world and `parallel` describes the
+    # GLOBAL mesh over all their devices.  Requires the ZMQ runtime.
+    n_hosts: int = 1
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
     batch_size: int = 8
@@ -81,27 +88,38 @@ def build_sft(cfg: SFTConfig, tokenizer=None) -> ExperimentPlan:
         parallel=cfg.parallel,
         optimizer=cfg.optimizer,
     )
-    worker = WorkerConfig(
-        worker_index=0,
-        shards=[shard],
-        datasets=[cfg.dataset],
-        batch_size=cfg.batch_size,
-        seed=cfg.seed,
-        ftspec=FinetuneSpec(
-            total_train_epochs=cfg.total_train_epochs,
-            train_batch_size=cfg.batch_size,
-        ),
+    ftspec = FinetuneSpec(
+        total_train_epochs=cfg.total_train_epochs,
+        train_batch_size=cfg.batch_size,
     )
+    worker_configs = [
+        WorkerConfig(
+            worker_index=w,
+            shards=[shard],
+            datasets=[cfg.dataset] if w == 0 else [],
+            batch_size=cfg.batch_size,
+            seed=cfg.seed,
+            ftspec=ftspec,
+            dist_process_id=w,
+            dist_num_processes=cfg.n_hosts,
+        )
+        for w in range(cfg.n_hosts)
+    ]
     cfg.ctrl.total_train_epochs = cfg.total_train_epochs
     return ExperimentPlan(
         dfg=dfg,
-        worker_configs=[worker],
+        worker_configs=worker_configs,
         model_placement={str(model_name): 0},
         data_worker_ids=[0],
         ctrl=cfg.ctrl,
         experiment_name=cfg.experiment_name,
         trial_name=cfg.trial_name,
         fileroot=cfg.fileroot,
+        model_groups=(
+            {str(model_name): list(range(cfg.n_hosts))}
+            if cfg.n_hosts > 1
+            else None
+        ),
     )
 
 
@@ -372,6 +390,7 @@ def run_experiment(plan: ExperimentPlan, tokenizer=None):
         fileroot=plan.fileroot,
         experiment_name=plan.experiment_name,
         trial_name=plan.trial_name,
+        model_groups=plan.model_groups,
     )
     master.load_recover_info()
     stats = asyncio.run(master.run())

@@ -4,7 +4,6 @@ Capability parity: realhf/impl/model/interface/sft_interface.py — packed
 cross-entropy over answer tokens, save as HF checkpoint, eval loss.
 """
 
-import os
 from typing import Dict
 
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
@@ -53,15 +52,11 @@ class SFTInterface(ModelInterface):
     def save(self, model: Model, save_dir: str) -> None:
         from areal_tpu.models.hf import registry as hf
 
-        os.makedirs(save_dir, exist_ok=True)
-        params = model.engine.get_params()
-        import jax
-        import numpy as np
-
-        host = jax.tree.map(np.asarray, params)
+        # Host conversion happens inside save_hf_checkpoint (collective for
+        # process-spanning params; only jax process 0 writes files).
         hf.save_hf_checkpoint(
-            save_dir, model.config, host, model_type="qwen2",
-            tokenizer=model.tokenizer,
+            save_dir, model.config, model.engine.get_params(),
+            model_type="qwen2", tokenizer=model.tokenizer,
         )
         logger.info(f"saved SFT checkpoint to {save_dir}")
 

@@ -159,15 +159,23 @@ def params_from_hf_state_dict(
 def params_to_hf_state_dict(
     cfg: ModelConfig, params: Dict[str, Any]
 ) -> Dict[str, np.ndarray]:
+    from areal_tpu.base.distributed import to_host
+
     out: Dict[str, np.ndarray] = {}
-    out["model.embed_tokens.weight"] = np.asarray(params["embed"], np.float32)
-    out["model.norm.weight"] = np.asarray(params["final_ln"], np.float32)
+    out["model.embed_tokens.weight"] = to_host(params["embed"]).astype(
+        np.float32, copy=False
+    )
+    out["model.norm.weight"] = to_host(params["final_ln"]).astype(
+        np.float32, copy=False
+    )
     if not cfg.is_critic and not cfg.tied_embeddings:
-        out["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T
+        out["lm_head.weight"] = to_host(params["lm_head"]).astype(
+            np.float32, copy=False
+        ).T
     blocks = params["blocks"]
 
     def unstack(name, arr, transpose=False):
-        arr = np.asarray(arr, np.float32)
+        arr = to_host(arr).astype(np.float32, copy=False)
         for i in range(cfg.n_layers):
             t = arr[i]
             out[name.format(i)] = t.T if transpose else t
@@ -239,8 +247,14 @@ def save_hf_checkpoint(
 ) -> None:
     """Write an HF-format checkpoint dir (safetensors + config.json) so the
     reference's eval tooling / vLLM / SGLang can consume our outputs."""
-    os.makedirs(path, exist_ok=True)
+    from areal_tpu.base.distributed import is_primary
+
+    # Host-gathering a process-spanning param tree is collective: every
+    # group member computes the state dict, only jax process 0 writes.
     sd = params_to_hf_state_dict(cfg, params)
+    if not is_primary():
+        return
+    os.makedirs(path, exist_ok=True)
     from safetensors.numpy import save_file
 
     save_file(sd, os.path.join(path, "model.safetensors"))

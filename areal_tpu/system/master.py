@@ -67,16 +67,21 @@ class MasterWorker:
         self,
         dfg: DFG,
         pool: WorkerPool,
-        model_placement: Dict[str, int],  # model key -> worker id
+        model_placement: Dict[str, int],  # model key -> primary worker id
         data_worker_ids: List[int],
         ctrl: ExperimentSaveEvalControl,
         fileroot: str = "/tmp/areal_tpu/trial",
         experiment_name: str = "exp",
         trial_name: str = "trial",
+        # model key -> ALL worker ids forming its (possibly multi-host)
+        # mesh; group[0] must be the primary.  Models absent here run on
+        # their single placement worker.
+        model_groups: Optional[Dict[str, List[int]]] = None,
     ):
         self.dfg = dfg
         self.pool = pool
         self.placement = model_placement
+        self.groups = {k: list(v) for k, v in (model_groups or {}).items()}
         self.data_worker_ids = data_worker_ids
         self.ctrl = ctrl
         self.fileroot = fileroot
@@ -280,76 +285,103 @@ class MasterWorker:
         if waits:
             await asyncio.gather(*waits)
 
+    def _group(self, model_key: str) -> List[int]:
+        return self.groups.get(model_key, [self.placement[model_key]])
+
     async def _run_mfc(self, node: MFCDef, results: Dict):
         batch = await self.buffer.get_batch_for_rpc(node, timeout=600)
-        worker = self.placement[str(node.model_name)]
+        group = self._group(str(node.model_name))
         # Pre hooks (param sync from another model, e.g. gen <- train).
         for hook in node.pre_hooks:
-            await self._run_hook(hook, node, worker)
-        # Data-plane pre-hook: ship any input (id, key) this worker lacks.
-        await self._ensure_data(node, batch.ids, worker)
-        resp = await self.pool.request(
-            worker,
-            {
-                "type": "mfc",
-                "model_name": str(node.model_name),
-                "interface_type": node.interface_type.value,
-                "ids": list(batch.ids),
-                "input_keys": list(node.input_keys),
-                "input_key_remap": dict(node.input_key_remap),
-                "output_key_remap": dict(node.output_key_remap),
-                "mb_spec": node.mb_spec,
-            },
+            await self._run_hook(hook, node, group)
+        # Data-plane pre-hook: every group member executes the MFC
+        # SPMD-symmetrically, so each needs the full input batch resident.
+        await asyncio.gather(
+            *[self._ensure_data(node, batch.ids, w) for w in group]
         )
+        payload = {
+            "type": "mfc",
+            "model_name": str(node.model_name),
+            "interface_type": node.interface_type.value,
+            "ids": list(batch.ids),
+            "input_keys": list(node.input_keys),
+            "input_key_remap": dict(node.input_key_remap),
+            "output_key_remap": dict(node.output_key_remap),
+            "mb_spec": node.mb_spec,
+        }
+        resps = await asyncio.gather(
+            *[self.pool.request(w, payload) for w in group]
+        )
+        resp = resps[0]  # group[0] is the primary
         if resp.get("meta") is not None:
-            # The producing worker holds the authoritative copy of every
-            # output key; stale copies elsewhere must not be re-used.
-            self._record_owner(resp["meta"], worker, replace=True)
+            # Every member computed (and cached) the full outputs; the
+            # primary's copy is authoritative, the rest are extra sources.
+            for i, w in enumerate(group):
+                self._record_owner(resp["meta"], w, replace=(i == 0))
             await self.buffer.amend_batch(resp["meta"])
         results[node.name] = resp.get("stats") or {}
         for hook in node.post_hooks:
-            await self._run_hook(hook, node, worker)
+            await self._run_hook(hook, node, group)
 
-    async def _run_hook(self, hook, node: MFCDef, worker: int):
+    async def _run_hook(self, hook, node: MFCDef, group: List[int]):
         if isinstance(hook, ParamReallocHook):
-            target_worker = self.placement[str(hook.target)]
-            if target_worker == worker:
-                await self.pool.request(
-                    worker,
-                    {
-                        "type": "param_sync",
-                        "src": str(node.model_name),
-                        "dst": str(hook.target),
-                        "eta": hook.eta,
-                    },
+            target_group = self._group(str(hook.target))
+            if target_group == group:
+                # Colocated (same member set): every process holds both
+                # models; the copy/EMA is a local (or SPMD-collective-free)
+                # reshard on each.
+                await asyncio.gather(
+                    *[
+                        self.pool.request(
+                            w,
+                            {
+                                "type": "param_sync",
+                                "src": str(node.model_name),
+                                "dst": str(hook.target),
+                                "eta": hook.eta,
+                            },
+                        )
+                        for w in group
+                    ]
                 )
             else:
-                # Cross-worker realloc: host-side pytree over the transfer
-                # plane (reference: param_realloc NCCL groups,
-                # model_worker.py:1009) — send and recv dispatched as a
-                # concurrent pair so neither side can observe the other's
+                # Cross-set realloc over the transfer plane (reference:
+                # param_realloc NCCL groups, model_worker.py:1009).  EVERY
+                # src member participates in the host gather — a collective
+                # when the src mesh spans processes — then the primary ships
+                # one copy to each target member; sends and recvs are
+                # dispatched concurrently so no side waits on the other's
                 # request ordering.
-                xfer_id = self._xfer_id
-                self._xfer_id += 1
+                xfer_ids = list(
+                    range(self._xfer_id, self._xfer_id + len(target_group))
+                )
+                self._xfer_id += len(target_group)
                 await asyncio.gather(
-                    self.pool.request(
-                        worker,
-                        {
-                            "type": "param_send",
-                            "model_name": str(node.model_name),
-                            "dst": target_worker,
-                            "xfer_id": xfer_id,
-                        },
-                    ),
-                    self.pool.request(
-                        target_worker,
-                        {
-                            "type": "param_recv",
-                            "model_name": str(hook.target),
-                            "xfer_id": xfer_id,
-                            "eta": hook.eta,
-                        },
-                    ),
+                    *[
+                        self.pool.request(
+                            w,
+                            {
+                                "type": "param_send",
+                                "model_name": str(node.model_name),
+                                "dsts": target_group,
+                                "xfer_ids": xfer_ids,
+                                "sender": i == 0,
+                            },
+                        )
+                        for i, w in enumerate(group)
+                    ],
+                    *[
+                        self.pool.request(
+                            w,
+                            {
+                                "type": "param_recv",
+                                "model_name": str(hook.target),
+                                "xfer_id": xid,
+                                "eta": hook.eta,
+                            },
+                        )
+                        for w, xid in zip(target_group, xfer_ids)
+                    ],
                 )
 
     async def _clear_worker_caches(self):
@@ -379,13 +411,21 @@ class MasterWorker:
                 self.fileroot, "checkpoints", self.experiment_name,
                 self.trial_name, str(node.model_name), sub,
             )
-            await self.pool.request(
-                self.placement[str(node.model_name)],
-                {
-                    "type": "save",
-                    "model_name": str(node.model_name),
-                    "save_dir": d,
-                },
+            # All group members join (the host gather of a process-spanning
+            # param tree is collective); only the jax process-0 member
+            # writes files.
+            await asyncio.gather(
+                *[
+                    self.pool.request(
+                        w,
+                        {
+                            "type": "save",
+                            "model_name": str(node.model_name),
+                            "save_dir": d,
+                        },
+                    )
+                    for w in self._group(str(node.model_name))
+                ]
             )
         if kind == "recover":
             info = recover.RecoverInfo(

@@ -65,7 +65,12 @@ class InProcTransfer(TransferPlane):
         self.inboxes[dst].put((xfer_id, payload))
 
     def recv(self, timeout: float = 300.0) -> Tuple[int, Any]:
-        return self.inboxes[self.my_index].get(timeout=timeout)
+        try:
+            return self.inboxes[self.my_index].get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"worker {self.my_index}: no transfer within {timeout}s"
+            ) from None
 
 
 class ZMQTransfer(TransferPlane):
@@ -73,8 +78,10 @@ class ZMQTransfer(TransferPlane):
 
     The PULL socket binds eagerly at construction and its address is
     published via name_resolve; PUSH sockets to peers are created lazily and
-    cached.  One lock guards sends (worker request handling is serial, but
-    closes can race)."""
+    cached.  ZMQ sockets are not thread-safe and transfer handlers run on
+    worker threads (stream.py _THREADED_TYPES), so one lock serializes all
+    sends/closes, and recv() relies on the caller's single-receiver
+    discipline (ModelWorker._recv_xfer: one draining thread at a time)."""
 
     def __init__(self, experiment: str, trial: str, worker_index: int):
         import zmq
@@ -97,9 +104,10 @@ class ZMQTransfer(TransferPlane):
             f"worker {worker_index} transfer plane bound at {self._addr}"
         )
 
-    def _push_sock(self, dst: int):
+    def send(self, dst: int, xfer_id: int, payload: Any) -> None:
         import zmq
 
+        data = pickle.dumps((xfer_id, payload))
         with self._lock:
             if dst not in self._push:
                 addr = name_resolve.wait(
@@ -109,10 +117,7 @@ class ZMQTransfer(TransferPlane):
                 s = self._ctx.socket(zmq.PUSH)
                 s.connect(addr)
                 self._push[dst] = s
-            return self._push[dst]
-
-    def send(self, dst: int, xfer_id: int, payload: Any) -> None:
-        self._push_sock(dst).send(pickle.dumps((xfer_id, payload)))
+            self._push[dst].send(data)
 
     def recv(self, timeout: float = 300.0) -> Tuple[int, Any]:
         import zmq

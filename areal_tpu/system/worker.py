@@ -75,7 +75,13 @@ class WorkerConfig:
     batch_size: int = 8
     seed: int = 1
     ftspec: FinetuneSpec = dataclasses.field(default_factory=FinetuneSpec)
-    device_offset: int = 0  # first local device index for this worker's mesh
+    device_offset: int = 0  # first device index for this worker's mesh
+    # Multi-controller world membership: when dist_num_processes > 1 the
+    # worker bootstrap calls jax.distributed.initialize (coordinator via
+    # name_resolve) BEFORE building models, after which jax.devices() is the
+    # GLOBAL device list and meshes may span hosts.
+    dist_process_id: int = 0
+    dist_num_processes: int = 1
 
 
 def _build_params_and_config(spec: ModelAbstraction, seed: int):
@@ -104,6 +110,15 @@ class ModelWorker:
         self.tokenizer = tokenizer
         self.transfer = transfer  # TransferPlane (system/transfer.py) or None
         self._xfer_stash: Dict[int, Any] = {}
+        import threading
+
+        # Single-receiver discipline: transfer.recv() is never called from
+        # two threads at once (ZMQ sockets are not thread-safe, and two
+        # drainers could steal each other's payload).  One thread at a time
+        # owns the socket; the rest wait on the condition for their
+        # xfer_id to appear in the stash.
+        self._xfer_cond = threading.Condition()
+        self._xfer_recv_busy = False
         self.models: Dict[str, Model] = {}
         self.interfaces: Dict[str, Any] = {}
         self.data_cache: Dict[str, SequenceSample] = {}
@@ -257,14 +272,37 @@ class ModelWorker:
     # transfers from different sources can't mismatch (reference: the
     # data_manager's planned NCCL redistribution, data_manager.py:144-416).
 
-    def _recv_xfer(self, xfer_id: int):
-        if xfer_id in self._xfer_stash:
-            return self._xfer_stash.pop(xfer_id)
+    def _recv_xfer(self, xfer_id: int, timeout: float = 300.0):
+        import time
+
+        deadline = time.monotonic() + timeout
         while True:
-            got_id, payload = self.transfer.recv()
-            if got_id == xfer_id:
-                return payload
-            self._xfer_stash[got_id] = payload
+            with self._xfer_cond:
+                while True:
+                    if xfer_id in self._xfer_stash:
+                        return self._xfer_stash.pop(xfer_id)
+                    if not self._xfer_recv_busy:
+                        self._xfer_recv_busy = True
+                        break  # this thread becomes the socket receiver
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"worker {self.config.worker_index}: xfer "
+                            f"{xfer_id} not received within {timeout}s"
+                        )
+                    self._xfer_cond.wait(remaining)
+            try:
+                got_id, payload = self.transfer.recv(
+                    timeout=max(deadline - time.monotonic(), 0.001)
+                )
+                with self._xfer_cond:
+                    if got_id == xfer_id:
+                        return payload
+                    self._xfer_stash[got_id] = payload
+            finally:
+                with self._xfer_cond:
+                    self._xfer_recv_busy = False
+                    self._xfer_cond.notify_all()
 
     def _handle_data_send(self, req):
         """Ship cached entries (selected keys) to another worker."""
@@ -294,17 +332,27 @@ class ModelWorker:
         return {"n": len(parts)}
 
     def _handle_param_send(self, req):
-        """Ship a model's host-side param pytree to another worker (the
-        cross-worker half of param realloc; reference model_worker.py:1009)."""
+        """Ship a model's host-side param pytree to other workers (the
+        cross-worker half of param realloc; reference model_worker.py:1009).
+        Every member of a process-spanning src mesh calls this — the host
+        gather is a collective — but only the designated sender pushes."""
         import jax
 
+        from areal_tpu.base.distributed import to_host
+
         params = self.models[req["model_name"]].engine.get_params()
-        host = jax.tree.map(np.asarray, params)
-        self.transfer.send(req["dst"], req["xfer_id"], ("params", host))
+        host = jax.tree.map(to_host, params)
+        if req.get("sender", True):
+            dsts = req.get("dsts") or [req["dst"]]
+            xids = req.get("xfer_ids") or [req["xfer_id"]]
+            for dst, xid in zip(dsts, xids):
+                self.transfer.send(dst, xid, ("params", host))
         return {}
 
     def _handle_param_recv(self, req):
         import jax
+
+        from areal_tpu.base.distributed import to_host
 
         kind, host = self._recv_xfer(req["xfer_id"])
         assert kind == "params", kind
@@ -313,7 +361,7 @@ class ModelWorker:
         if eta >= 1.0:
             eng.set_params(host)
         else:
-            cur = jax.tree.map(np.asarray, eng.get_params())
+            cur = jax.tree.map(to_host, eng.get_params())
             mixed = jax.tree.map(
                 lambda a, b: eta * np.asarray(a, np.float32)
                 + (1 - eta) * np.asarray(b, np.float32),

@@ -1,0 +1,113 @@
+"""Sympy-grade math verification parity suite.
+
+Mirrors the tricky-pair coverage of the reference's qwen grader
+(/root/reference/math_verify_utils_qwen.py): fractions vs decimals vs
+radicals, intervals, sets, tuples, matrices, equations — graded through
+the process-pool path (`answers_match_sympy`) and the full
+`verify_math` pipeline.
+"""
+
+import pytest
+
+from areal_tpu.interfaces.math_sympy import (
+    answers_match_sympy,
+    latex_to_expr,
+    sympy_match_worker,
+)
+
+
+MATCH_PAIRS = [
+    # fractions / decimals / radicals
+    (r"0.5", r"\frac{1}{2}"),
+    (r"\dfrac{3}{4}", r"0.75"),
+    (r"\frac{\sqrt{2}}{2}", r"\frac{1}{\sqrt{2}}"),
+    (r"2\sqrt{3}", r"\sqrt{12}"),
+    (r"\sqrt[3]{8}", r"2"),
+    (r"\frac{1}{3} + \frac{1}{6}", r"\frac{1}{2}"),
+    (r"1\frac{1}{2}", r"\frac{3}{2}"),
+    (r"-\frac{7}{2}", r"-3.5"),
+    (r"\frac{22}{7}", r"22/7"),
+    (r"0.1", r"\frac{1}{10}"),
+    # symbolic
+    (r"x^2 - 1", r"(x-1)(x+1)"),
+    (r"2x + 2", r"2(x+1)"),
+    (r"\frac{x^2-4}{x-2}", r"x+2"),
+    (r"e^{2\ln 3}", r"9"),
+    (r"\cos(0)", r"1"),
+    (r"2\pi", r"\pi \cdot 2"),
+    (r"\frac{\pi}{4}", r"0.25\pi"),
+    # equations
+    (r"x = 5", r"5"),
+    (r"y = \frac{1}{2}", r"0.5"),
+    # percent / formatting noise
+    (r"50\%", r"50"),
+    (r"1{,}000", r"1000"),
+    (r"\left(3\right)", r"3"),
+    (r"45^\circ", r"45"),
+    # tuples / points
+    (r"(1, 2)", r"(1.0, 2.0)"),
+    (r"(\frac{1}{2}, \frac{3}{4})", r"(0.5, 0.75)"),
+    # intervals
+    (r"[0, 1)", r"[0, 1)"),
+    (r"(-\infty, 3]", r"(-\infty, 3]"),
+    (r"(1,2] \cup [3,4)", r"(1,2] \cup [3,4)"),
+    # sets
+    (r"\{1, 2, 3\}", r"\{3, 2, 1\}"),
+    (r"\{\frac{1}{2}, 2\}", r"\{2, 0.5\}"),
+    # matrices
+    (
+        r"\begin{pmatrix} 1 & \frac{1}{2} \\ 0 & 1 \end{pmatrix}",
+        r"\begin{pmatrix} 1 & 0.5 \\ 0 & 1 \end{pmatrix}",
+    ),
+    (r"\begin{bmatrix} 2 \\ 4 \end{bmatrix}", r"\begin{bmatrix} 2 \\ 4 \end{bmatrix}"),
+]
+
+REJECT_PAIRS = [
+    (r"0.5", r"\frac{1}{3}"),
+    (r"\sqrt{2}", r"2"),
+    (r"(1, 2)", r"(2, 1)"),
+    (r"[0, 1)", r"[0, 1]"),  # bracket kind differs
+    (r"\{1, 2\}", r"\{1, 2, 3\}"),
+    (r"x + 1", r"x - 1"),
+    (r"\begin{pmatrix} 1 \\ 0 \end{pmatrix}", r"\begin{pmatrix} 0 \\ 1 \end{pmatrix}"),
+    (r"2\pi", r"\pi"),
+    (r"x = 5", r"4"),
+    (r"\frac{22}{7}", r"\pi"),  # close numerically but not equal
+]
+
+
+@pytest.mark.parametrize("pred,gold", MATCH_PAIRS)
+def test_equivalent_pairs(pred, gold):
+    assert sympy_match_worker(pred, gold), (
+        pred, gold, latex_to_expr(pred), latex_to_expr(gold),
+    )
+
+
+@pytest.mark.parametrize("pred,gold", REJECT_PAIRS)
+def test_non_equivalent_pairs(pred, gold):
+    assert not sympy_match_worker(pred, gold), (
+        pred, gold, latex_to_expr(pred), latex_to_expr(gold),
+    )
+
+
+def test_pool_path_and_timeout_recovery():
+    # Through the process pool...
+    assert answers_match_sympy(r"\frac{1}{2}", "0.5")
+    assert not answers_match_sympy("1", "2")
+    # ...and a pathological input must come back False within the timeout,
+    # after which the pool still serves.
+    assert not answers_match_sympy("(" * 2000, "1", timeout=2.0)
+    assert answers_match_sympy(r"2\sqrt{3}", r"\sqrt{12}")
+
+
+def test_verify_math_uses_sympy_stage():
+    from areal_tpu.interfaces.math_verify import verify_math
+
+    # The fast string/Fraction path cannot grade these; the sympy stage must.
+    assert verify_math(
+        r"... the answer is \boxed{\frac{\sqrt{2}}{2}}",
+        [r"\boxed{\frac{1}{\sqrt{2}}}"],
+    )
+    assert not verify_math(
+        r"... the answer is \boxed{\sqrt{2}}", [r"\boxed{2}"]
+    )

@@ -93,6 +93,75 @@ def test_sft_multiprocess_e2e(tmp_path):
     assert np.isfinite(stats[-1]["nll"])
 
 
+def test_sft_multihost_spmd(tmp_path):
+    """One model, one GLOBAL d4 mesh laid across TWO worker processes (2
+    local devices each) via jax.distributed — the multi-controller
+    equivalent of the reference's multi-node NCCL world
+    (impl/model/comm/global_comm.py).  Both processes execute the train
+    MFC SPMD-symmetrically; gradients cross process boundaries through
+    XLA collectives (gloo on the CPU fake cluster)."""
+    import json
+
+    from areal_tpu.experiments.common import (
+        SFTConfig,
+        build_sft,
+        run_experiment as run_inproc,
+    )
+    from areal_tpu.apps import main as runner
+
+    rows = fixtures.build_sft_rows(16, seed=5)
+    data_path = tmp_path / "data.jsonl"
+    with open(data_path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+    def make_cfg(n_hosts, parallel, root):
+        return SFTConfig(
+            model=ModelAbstraction("random", {"config": tiny_config()}),
+            dataset=DatasetAbstraction(
+                "prompt_answer",
+                {"dataset_path": str(data_path), "max_length": 128},
+            ),
+            n_hosts=n_hosts,
+            parallel=ParallelConfig.from_str(parallel),
+            optimizer=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0),
+            batch_size=8,
+            total_train_epochs=1,
+            mb_spec=MicroBatchSpec(n_mbs=2),
+            ctrl=ExperimentSaveEvalControl(
+                total_train_epochs=1, benchmark_steps=2
+            ),
+            experiment_name="zmqdist",
+            trial_name="t0",
+            fileroot=str(root),
+        )
+
+    plan = build_sft(make_cfg(2, "d4", tmp_path / "dist"))
+    for wc in plan.worker_configs:
+        wc.tokenizer_path = "char:512"
+    assert plan.model_groups == {"default@0": [0, 1]}
+    stats = runner.run_experiment(
+        plan,
+        worker_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        },
+    )
+    assert len(stats) == 2
+    assert np.isfinite(stats[-1]["nll"])
+
+    # The distributed run must compute the same math as a single-process
+    # run of the identical trial (d4 over 4 in-process devices).
+    plan1 = build_sft(make_cfg(1, "d4", tmp_path / "solo"))
+    for wc in plan1.worker_configs:
+        wc.tokenizer_path = "char:512"
+    _, stats1 = run_inproc(plan1, tokenizer=None)
+    for s_dist, s_solo in zip(stats, stats1):
+        assert np.isclose(s_dist["nll"], s_solo["nll"], rtol=1e-3), (
+            s_dist, s_solo,
+        )
+
+
 def test_ppo_disjoint_workers_multiprocess(tmp_path):
     """VERDICT r1 'done' criterion: gen and train in DIFFERENT worker
     processes with their own meshes; a PPO step completes — prompts, rollouts,
