@@ -1,0 +1,216 @@
+"""Observability: FLOPs accounting, timing marks, MFU, per-step stats sinks.
+
+Capability parity: realhf/system/flops_counter.py (per-MFC FLOP tallies),
+realhf/base/monitor.py:281-703 (time marks, metrics export) and the
+master's per-step perf log (realhf/system/master_worker.py:434-473) —
+rebuilt around analytic transformer FLOP formulas (the packed-sequence
+attention term uses the exact sum of per-sequence s^2) and a jsonl +
+optional tensorboard/wandb sink instead of CUDA counters.
+"""
+
+import contextlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("monitor")
+
+
+# ---------------- FLOPs ----------------
+
+
+def matmul_params(cfg) -> int:
+    """Parameters that participate in matmuls for ONE token's forward pass
+    (active experts only for MoE; embedding lookup excluded)."""
+    h = cfg.hidden_dim
+    d = cfg.head_dim
+    attn = h * (cfg.n_q_heads * d + 2 * cfg.n_kv_heads * d) + cfg.n_q_heads * d * h
+    if cfg.is_moe:
+        inter = cfg.moe_intermediate_dim or cfg.intermediate_dim
+        mlp = 3 * h * inter * cfg.n_experts_per_tok
+    else:
+        mlp = 3 * h * cfg.intermediate_dim
+    per_layer = attn + mlp
+    head = 0 if cfg.is_critic else h * cfg.vocab_size
+    return cfg.n_layers * per_layer + head
+
+
+def flops_forward(
+    cfg, n_tokens: int, sum_sq_seqlens: Optional[float] = None
+) -> float:
+    """Forward-pass FLOPs over packed sequences: 2*N per token for matmuls
+    plus the quadratic attention term 4*h_q*sum_i(s_i^2) per layer (QK^T
+    and attn@V, causal factor folded into the constant the same way the
+    reference counts it, flops_counter.py)."""
+    mm = 2.0 * matmul_params(cfg) * n_tokens
+    if sum_sq_seqlens is None:
+        sum_sq_seqlens = float(n_tokens) ** 2
+    attn = 2.0 * 2.0 * cfg.n_q_heads * cfg.head_dim * sum_sq_seqlens * cfg.n_layers
+    return mm + attn
+
+
+def flops_train(cfg, n_tokens: int, sum_sq_seqlens: Optional[float] = None) -> float:
+    """fwd + bwd ~= 3x forward."""
+    return 3.0 * flops_forward(cfg, n_tokens, sum_sq_seqlens)
+
+
+def flops_generate(
+    cfg,
+    prompt_lens: Sequence[int],
+    gen_lens: Sequence[int],
+) -> float:
+    """Prefill (packed forward over prompts) + incremental decode: each new
+    token costs 2*N matmul FLOPs plus attention over its live prefix."""
+    p_tokens = float(sum(prompt_lens))
+    p_sq = float(sum(p * p for p in prompt_lens))
+    total = flops_forward(cfg, int(p_tokens), p_sq)
+    n = 2.0 * matmul_params(cfg)
+    attn_c = 4.0 * cfg.n_q_heads * cfg.head_dim * cfg.n_layers
+    for p, g in zip(prompt_lens, gen_lens):
+        total += n * g
+        # sum over decode steps of (p + t) ~ g*p + g^2/2
+        total += attn_c * (g * p + g * g / 2.0)
+    return total
+
+
+# Peak bf16 TFLOP/s per chip by accelerator kind (public specs); used for
+# MFU.  Override with AREAL_PEAK_TFLOPS.
+_PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5 lite": 197.0,  # v5e
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,  # trillium
+    "v6e": 918.0,
+}
+
+
+def peak_tflops_per_device() -> Optional[float]:
+    env = os.environ.get("AREAL_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return None
+    for key, val in _PEAK_TFLOPS.items():
+        if key in kind:
+            return val
+    return None
+
+
+def mfu(flops: float, seconds: float, n_devices: int) -> Optional[float]:
+    peak = peak_tflops_per_device()
+    if peak is None or seconds <= 0 or n_devices <= 0:
+        return None
+    return flops / seconds / (peak * 1e12 * n_devices)
+
+
+# ---------------- timing marks ----------------
+
+
+class Timers:
+    """Named wall-clock marks (reference: base/monitor.py time_mark /
+    tmark decorators) — accumulate durations, drain as a stats dict."""
+
+    def __init__(self):
+        self._acc: Dict[str, float] = {}
+        self._count: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def record(self, name: str):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            dt = time.monotonic() - t0
+            self._acc[name] = self._acc.get(name, 0.0) + dt
+            self._count[name] = self._count.get(name, 0) + 1
+
+    def drain(self, prefix: str = "time/") -> Dict[str, float]:
+        out = {f"{prefix}{k}": v for k, v in self._acc.items()}
+        self._acc.clear()
+        self._count.clear()
+        return out
+
+
+# ---------------- stats sinks ----------------
+
+
+class StatsLogger:
+    """Per-step scalar sink: always jsonl; tensorboard / wandb when asked.
+
+    Capability parity: the reference's wandb+tensorboard loggers
+    (realhf/base/stats_logger.py via master worker) — jsonl is the source
+    of truth so trials remain greppable with zero services running.
+    """
+
+    def __init__(
+        self,
+        fileroot: str,
+        experiment_name: str,
+        trial_name: str,
+        use_tensorboard: Optional[bool] = None,
+        use_wandb: Optional[bool] = None,
+    ):
+        self.dir = os.path.join(fileroot, "logs", experiment_name, trial_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, "stats.jsonl")
+        if use_tensorboard is None:
+            use_tensorboard = bool(os.environ.get("AREAL_TENSORBOARD"))
+        if use_wandb is None:
+            use_wandb = bool(os.environ.get("AREAL_WANDB"))
+        self._tb = None
+        if use_tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=os.path.join(self.dir, "tb"))
+            except Exception as e:  # torch/tb missing or broken: jsonl only
+                logger.warning(f"tensorboard disabled: {e!r}")
+        self._wandb = None
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb
+                wandb.init(
+                    project=experiment_name,
+                    name=trial_name,
+                    dir=self.dir,
+                    mode=os.environ.get("WANDB_MODE", "offline"),
+                )
+            except Exception as e:
+                logger.warning(f"wandb disabled: {e!r}")
+
+    def log(self, step: int, stats: Dict[str, float]) -> None:
+        row = {"global_step": step, "ts": time.time(), **stats}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        if self._tb is not None:
+            for k, v in stats.items():
+                self._tb.add_scalar(k, v, global_step=step)
+            self._tb.flush()
+        if self._wandb is not None:
+            self._wandb.log(stats, step=step)
+
+    def close(self):
+        if self._tb is not None:
+            self._tb.close()
+        if self._wandb is not None:
+            self._wandb.finish()
+
+
+def read_stats(fileroot: str, experiment_name: str, trial_name: str) -> List[Dict]:
+    path = os.path.join(
+        fileroot, "logs", experiment_name, trial_name, "stats.jsonl"
+    )
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]

@@ -37,6 +37,9 @@ class RecoverInfo:
     used_data_ids: List[str] = dataclasses.field(default_factory=list)
     model_versions: Dict[str, int] = dataclasses.field(default_factory=dict)
     hash_vals_to_ignore: List[int] = dataclasses.field(default_factory=list)
+    # Data-worker id -> per-dataloader (epoch, cursor) positions; replayed
+    # on restart so recovered trials do not resample consumed batches.
+    data_states: Dict[int, List[Any]] = dataclasses.field(default_factory=dict)
 
 
 def recover_root(fileroot: str, experiment_name: str, trial_name: str) -> str:

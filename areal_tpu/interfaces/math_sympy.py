@@ -299,13 +299,14 @@ def _executor():
 def _kill_executor():
     global _EXECUTOR
     if _EXECUTOR is not None:
-        _EXECUTOR.shutdown(wait=False, cancel_futures=True)
-        for p in getattr(_EXECUTOR, "_processes", {}).values():
+        ex, _EXECUTOR = _EXECUTOR, None
+        procs = list((getattr(ex, "_processes", None) or {}).values())
+        ex.shutdown(wait=False, cancel_futures=True)
+        for p in procs:
             try:
                 p.kill()
             except Exception:
                 pass
-        _EXECUTOR = None
 
 
 def answers_match_sympy(pred: str, gold: str, timeout: float = 3.0) -> bool:

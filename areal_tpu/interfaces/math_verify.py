@@ -3,8 +3,10 @@
 Capability parity: realhf/functioncall/math/verify.py + math_parser.py (the
 local verification path; the remote FaaS path is an HTTP wrapper around the
 same grading).  Grading: extract the last \\boxed{...} (or final-answer
-line) from the generated text and compare against any of the gold solutions
-after normalization — exact string, numeric, or fraction equivalence.
+line) from the generated text and compare against any of the gold
+solutions — a fast string/Fraction pre-filter first, then sympy-grade
+symbolic equivalence (math_sympy.py, the qwen-grader parity layer) for
+everything the fast path cannot decide.
 """
 
 import re
@@ -92,16 +94,29 @@ def answers_match(pred: str, gold: str) -> bool:
     return False
 
 
-def verify_math(generated_text: str, solutions: List[str]) -> bool:
+def verify_math(
+    generated_text: str, solutions: List[str], use_sympy: bool = True
+) -> bool:
     """True iff the generated answer matches any gold solution (each gold
-    may itself be a \\boxed{...} wrapper or a raw answer)."""
+    may itself be a \\boxed{...} wrapper or a raw answer).  The cheap
+    string/Fraction path decides most cases; symbolically equivalent forms
+    (0.5 vs \\frac{\\sqrt2}{2}-style mismatches, intervals, matrices) fall
+    through to the sympy grader with a hard per-call timeout."""
     pred = extract_answer(generated_text)
     if pred is None:
         return False
+    golds = []
     for sol in solutions:
         gold = extract_boxed(sol)
         if gold is None:
             gold = sol
         if answers_match(pred, gold):
             return True
+        golds.append(gold)
+    if use_sympy:
+        from areal_tpu.interfaces.math_sympy import answers_match_sympy
+
+        for gold in golds:
+            if answers_match_sympy(pred, gold):
+                return True
     return False

@@ -146,11 +146,15 @@ def params_from_hf_state_dict(
         "final_ln": jnp.asarray(get("model.norm.weight"), dtype=dtype),
     }
     if cfg.is_critic:
-        # Critic-from-actor init: fresh value head (reference:
-        # conversion/hf_registry.py critic init path).
-        import jax
-
-        params["value_head"] = jnp.zeros((cfg.hidden_dim, 1), dtype=dtype)
+        if "value_head.weight" in sd:
+            # Our own critic checkpoints carry the trained head.
+            params["value_head"] = jnp.asarray(
+                get("value_head.weight"), dtype=dtype
+            )
+        else:
+            # Critic-from-actor init: fresh value head (reference:
+            # conversion/hf_registry.py critic init path).
+            params["value_head"] = jnp.zeros((cfg.hidden_dim, 1), dtype=dtype)
     elif not cfg.tied_embeddings:
         params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype=dtype)
     return params
@@ -168,17 +172,29 @@ def params_to_hf_state_dict(
     out["model.norm.weight"] = to_host(params["final_ln"]).astype(
         np.float32, copy=False
     )
-    if not cfg.is_critic and not cfg.tied_embeddings:
-        out["lm_head.weight"] = to_host(params["lm_head"]).astype(
+    if cfg.is_critic:
+        # Not an HF key — preserved so our critic checkpoints roundtrip
+        # (recover would otherwise zero the trained value head).
+        out["value_head.weight"] = to_host(params["value_head"]).astype(
             np.float32, copy=False
-        ).T
+        )
+    elif not cfg.tied_embeddings:
+        # ascontiguousarray: safetensors serializes the raw buffer, so a
+        # transposed VIEW would be written in untransposed memory order.
+        out["lm_head.weight"] = np.ascontiguousarray(
+            to_host(params["lm_head"]).astype(np.float32, copy=False).T
+        )
     blocks = params["blocks"]
 
     def unstack(name, arr, transpose=False):
         arr = to_host(arr).astype(np.float32, copy=False)
         for i in range(cfg.n_layers):
             t = arr[i]
-            out[name.format(i)] = t.T if transpose else t
+            # ascontiguousarray: see lm_head note — safetensors writes the
+            # raw buffer and would silently drop the transpose.
+            out[name.format(i)] = (
+                np.ascontiguousarray(t.T) if transpose else t
+            )
 
     unstack("model.layers.{}.input_layernorm.weight", blocks["ln1"])
     unstack("model.layers.{}.self_attn.q_proj.weight", blocks["wq"], True)

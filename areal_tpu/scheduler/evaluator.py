@@ -1,0 +1,255 @@
+"""Automatic checkpoint evaluator.
+
+Capability parity: realhf/scheduler/evaluator.py:28-306
+(`AutomaticEvaluator`: watch the trial's checkpoint dir, launch one eval
+job per new checkpoint, log pass rates per global step) — condensed for
+this runtime: evaluation runs in-process with the repo's own
+GeneratorEngine (no external vLLM container), grades with the sympy-backed
+`verify_math`, and writes one `eval_step_{N}.json` per checkpoint into the
+trial's eval dir.
+"""
+
+import dataclasses
+import json
+import os
+import re
+import time
+from typing import Dict, List, Optional
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("evaluator")
+
+
+@dataclasses.dataclass
+class EvalConfig:
+    """What to evaluate and how to decode (reference: cli_args
+    AutomaticEvaluator config: data_names, max_gen_tokens, greedy...)."""
+
+    data_path: str  # jsonl rows: {"prompt", "solutions" or "answers"}
+    tokenizer_path: Optional[str] = None  # None -> load from the ckpt dir
+    max_new_tokens: int = 256
+    n_samples: int = 1  # sequences per prompt (pass@k needs k>1)
+    greedy: bool = True
+    temperature: float = 1.0
+    max_prompts: Optional[int] = None
+    parallel: str = "d1"
+    batch_size: int = 64
+
+
+def _load_rows(path: str, limit: Optional[int]) -> List[Dict]:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+            if limit is not None and len(rows) >= limit:
+                break
+    return rows
+
+
+def evaluate_checkpoint(
+    ckpt_dir: str, config: EvalConfig, seed: int = 0
+) -> Dict[str, float]:
+    """Generate over the held-out set with the checkpoint's weights and
+    grade with verify_math.  Returns {'pass@1': ..., 'pass@n': ..., ...}."""
+    import jax
+    import numpy as np
+
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.data.tokenizer import load_hf_tokenizer
+    from areal_tpu.engines.generator import GeneratorEngine
+    from areal_tpu.interfaces.math_verify import verify_math
+    from areal_tpu.models.hf import registry as hf
+
+    cfg, params = hf.load_hf_checkpoint(ckpt_dir)
+    tokenizer = load_hf_tokenizer(config.tokenizer_path or ckpt_dir)
+    pc = ParallelConfig.from_str(config.parallel)
+    mesh = make_mesh(pc, jax.devices()[: pc.world_size])
+    engine = GeneratorEngine(
+        cfg,
+        params,
+        mesh,
+        eos_token_id=tokenizer.eos_token_id,
+        pad_token_id=getattr(tokenizer, "pad_token_id", None),
+    )
+    gconfig = GenerationHyperparameters(
+        n=config.n_samples,
+        max_new_tokens=config.max_new_tokens,
+        greedy=config.greedy,
+        temperature=config.temperature,
+    )
+
+    rows = _load_rows(config.data_path, config.max_prompts)
+    n_correct = 0
+    n_total = 0
+    n_any = 0
+    t0 = time.monotonic()
+    for start in range(0, len(rows), config.batch_size):
+        chunk = rows[start : start + config.batch_size]
+        parts = []
+        for i, r in enumerate(chunk):
+            toks = np.asarray(
+                tokenizer.encode(r["prompt"]), dtype=np.int32
+            )
+            if len(toks) == 0:
+                toks = np.asarray([tokenizer.eos_token_id], np.int32)
+            parts.append(
+                SequenceSample(
+                    keys={"packed_prompts"},
+                    ids=[str(r.get("query_id", start + i))],
+                    seqlens={"packed_prompts": [[len(toks)]]},
+                    data={"packed_prompts": toks},
+                )
+            )
+        batch = SequenceSample.gather(parts)
+        out = engine.generate(
+            batch, MicroBatchSpec(), gconfig, seed=seed + start
+        )
+        for r, one in zip(chunk, out.unpack()):
+            solutions = r.get("solutions") or r.get("answers") or []
+            bounds = one.cu_seqlens("packed_input_ids")
+            toks_all = np.asarray(one.data["packed_input_ids"])
+            pmask = np.asarray(one.data["prompt_mask"])
+            any_ok = False
+            for s in range(len(bounds) - 1):
+                lo, hi = bounds[s], bounds[s + 1]
+                resp = toks_all[lo:hi][~pmask[lo:hi].astype(bool)]
+                text = tokenizer.decode(resp.tolist())
+                ok = bool(verify_math(text, solutions))
+                n_correct += ok
+                n_total += 1
+                any_ok = any_ok or ok
+            n_any += any_ok
+    result = {
+        "pass@1": n_correct / max(n_total, 1),
+        f"pass@{config.n_samples}": n_any / max(len(rows), 1),
+        "n_prompts": float(len(rows)),
+        "n_samples": float(n_total),
+        "eval_seconds": time.monotonic() - t0,
+    }
+    return result
+
+
+_STEP_RE = re.compile(r"^(?:step_|epoch\w*_)(\d+)$")
+
+
+class AutomaticEvaluator:
+    """Watch a checkpoint root; evaluate each new step dir exactly once.
+
+    Layout produced by the master (system/master.py save):
+        <fileroot>/checkpoints/<exp>/<trial>/<model>/step_<N>/
+    Eval outputs land in <fileroot>/eval/<exp>/<trial>/eval_step_<N>.json.
+    """
+
+    def __init__(
+        self,
+        ckpt_root: str,
+        output_dir: str,
+        config: EvalConfig,
+    ):
+        self.ckpt_root = ckpt_root
+        self.output_dir = output_dir
+        self.config = config
+        os.makedirs(output_dir, exist_ok=True)
+
+    def _done_steps(self) -> set:
+        done = set()
+        for f in os.listdir(self.output_dir):
+            m = re.match(r"^eval_step_(\d+)\.json$", f)
+            if m:
+                done.add(int(m.group(1)))
+        return done
+
+    def pending(self) -> List[int]:
+        """Step numbers with a complete checkpoint but no eval output."""
+        if not os.path.isdir(self.ckpt_root):
+            return []
+        steps = []
+        done = self._done_steps()
+        for d in os.listdir(self.ckpt_root):
+            m = _STEP_RE.match(d)
+            if not m:
+                continue
+            step = int(m.group(1))
+            if step in done:
+                continue
+            if os.path.exists(
+                os.path.join(self.ckpt_root, d, "config.json")
+            ):
+                steps.append(step)
+        return sorted(steps)
+
+    def step(self) -> List[int]:
+        """Evaluate every pending checkpoint; returns evaluated steps."""
+        ran = []
+        for step in self.pending():
+            ckpt = None
+            for d in os.listdir(self.ckpt_root):
+                m = _STEP_RE.match(d)
+                if m and int(m.group(1)) == step:
+                    ckpt = os.path.join(self.ckpt_root, d)
+                    break
+            logger.info(f"evaluating checkpoint step {step}: {ckpt}")
+            result = evaluate_checkpoint(ckpt, self.config)
+            result["global_step"] = float(step)
+            out = os.path.join(self.output_dir, f"eval_step_{step}.json")
+            with open(out + ".tmp", "w") as f:
+                json.dump(result, f, indent=2)
+            os.replace(out + ".tmp", out)
+            logger.info(
+                f"step {step}: pass@1={result['pass@1']:.4f} "
+                f"({int(result['n_samples'])} samples)"
+            )
+            ran.append(step)
+        return ran
+
+    def watch(self, interval: float = 10.0, until: Optional[float] = None):
+        """Poll loop (reference evaluator's thread loop, evaluator.py:120)."""
+        while True:
+            self.step()
+            if until is not None and time.time() >= until:
+                return
+            time.sleep(interval)
+
+
+def main():
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Evaluate trial checkpoints (pass@1 on a jsonl set)"
+    )
+    p.add_argument("--ckpt-root", required=True)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--data", required=True)
+    p.add_argument("--tokenizer", default=None)
+    p.add_argument("--max-new-tokens", type=int, default=256)
+    p.add_argument("--n-samples", type=int, default=1)
+    p.add_argument("--max-prompts", type=int, default=None)
+    p.add_argument("--parallel", default="d1")
+    p.add_argument("--watch", action="store_true")
+    p.add_argument("--interval", type=float, default=10.0)
+    args = p.parse_args()
+    ev = AutomaticEvaluator(
+        args.ckpt_root,
+        args.output_dir,
+        EvalConfig(
+            data_path=args.data,
+            tokenizer_path=args.tokenizer,
+            max_new_tokens=args.max_new_tokens,
+            n_samples=args.n_samples,
+            max_prompts=args.max_prompts,
+            parallel=args.parallel,
+        ),
+    )
+    if args.watch:
+        ev.watch(args.interval)
+    else:
+        ev.step()
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional
 from areal_tpu.api.config import ModelInterfaceType
 from areal_tpu.api.dfg import DFG, MFCDef, ParamReallocHook
 from areal_tpu.base import logging, recover, timeutil
+from areal_tpu.base.monitor import StatsLogger
 from areal_tpu.base.stats import merge_stats
 from areal_tpu.system.buffer import SequenceBuffer
 
@@ -103,7 +104,9 @@ class MasterWorker:
             frequency_steps=ctrl.eval_freq_steps
         )
         self.stats_history: List[Dict[str, float]] = []
+        self.stats_logger = StatsLogger(fileroot, experiment_name, trial_name)
         self._steps_per_epoch: Optional[int] = None
+        self._restore_pending: Optional[recover.RecoverInfo] = None
         self._train_rpcs = [
             n
             for n in dfg.nodes
@@ -145,15 +148,19 @@ class MasterWorker:
             f"master: {total_steps} steps "
             f"({self.ctrl.total_train_epochs} epochs x {self._steps_per_epoch})"
         )
+        if self._restore_pending:
+            await self._restore_worker_state()
         while self.step_info.global_step < total_steps:
             t0 = time.monotonic()
             stats = await self.execute_step()
             dt = time.monotonic() - t0
+            stats["time/step_s"] = dt
             self.stats_history.append(stats)
             logger.info(
                 f"step {self.step_info.global_step + 1}/{total_steps} "
                 f"({dt:.2f}s): { {k: round(v, 4) for k, v in stats.items()} }"
             )
+            self.stats_logger.log(self.step_info.global_step + 1, stats)
             self.step_info = self.step_info.next(self._steps_per_epoch)
             await self._post_step()
         return self.stats_history
@@ -407,10 +414,7 @@ class MasterWorker:
             f"step_{step}" if kind == "persistent" else "recover_checkpoint"
         )
         for node in self._train_rpcs:
-            d = os.path.join(
-                self.fileroot, "checkpoints", self.experiment_name,
-                self.trial_name, str(node.model_name), sub,
-            )
+            d = self._ckpt_dir(node, sub)
             # All group members join (the host gather of a process-spanning
             # param tree is collective); only the jax process-0 member
             # writes files.
@@ -428,12 +432,42 @@ class MasterWorker:
                 ]
             )
         if kind == "recover":
+            # Optimizer state next to the weights (Adam moments + schedule
+            # position; reference: megatron.py:687-736).
+            for node in self._train_rpcs:
+                d = self._ckpt_dir(node, sub)
+                await asyncio.gather(
+                    *[
+                        self.pool.request(
+                            w,
+                            {
+                                "type": "save_optimizer",
+                                "model_name": str(node.model_name),
+                                "path": os.path.join(
+                                    d, "optimizer_state.pkl"
+                                ),
+                            },
+                        )
+                        for w in self._group(str(node.model_name))
+                    ]
+                )
+            # Data stream position per data worker.
+            states = await asyncio.gather(
+                *[
+                    self.pool.request(w, {"type": "data_state"})
+                    for w in self.data_worker_ids
+                ]
+            )
             info = recover.RecoverInfo(
                 last_step_info=self.step_info,
                 save_ctl_states={
                     "save": self.save_ctl.state_dict(),
                     "ckpt": self.ckpt_ctl.state_dict(),
                     "eval": self.eval_ctl.state_dict(),
+                },
+                data_states={
+                    w: s["states"]
+                    for w, s in zip(self.data_worker_ids, states)
                 },
             )
             recover.dump(
@@ -443,6 +477,12 @@ class MasterWorker:
                 ),
             )
         logger.info(f"saved ({kind}) at step {step}")
+
+    def _ckpt_dir(self, node: MFCDef, sub: str) -> str:
+        return os.path.join(
+            self.fileroot, "checkpoints", self.experiment_name,
+            self.trial_name, str(node.model_name), sub,
+        )
 
     def load_recover_info(self) -> bool:
         info = recover.load(
@@ -459,5 +499,48 @@ class MasterWorker:
             self.ckpt_ctl.load_state_dict(info.save_ctl_states["ckpt"])
         if "eval" in info.save_ctl_states:
             self.eval_ctl.load_state_dict(info.save_ctl_states["eval"])
+        # Worker-side state (weights, optimizer, data cursors) is restored
+        # at run() start, once the pool is serving.
+        self._restore_pending = info
         logger.info(f"recovered at step {self.step_info.global_step}")
         return True
+
+    async def _restore_worker_state(self):
+        """Reload trained weights + optimizer state from the recover
+        checkpoint and rewind data streams; refresh dependent models (e.g.
+        the generator) by replaying each train node's realloc post-hooks."""
+        info = self._restore_pending
+        self._restore_pending = None
+        for node in self._train_rpcs:
+            d = self._ckpt_dir(node, "recover_checkpoint")
+            if not os.path.isdir(d):
+                continue
+            group = self._group(str(node.model_name))
+            await asyncio.gather(
+                *[
+                    self.pool.request(
+                        w,
+                        {
+                            "type": "load_model",
+                            "model_name": str(node.model_name),
+                            "ckpt_dir": d,
+                            "optimizer_path": os.path.join(
+                                d, "optimizer_state.pkl"
+                            ),
+                        },
+                    )
+                    for w in group
+                ]
+            )
+            for hook in node.post_hooks:
+                await self._run_hook(hook, node, group)
+            logger.info(f"restored {node.model_name} from {d}")
+        data_states = getattr(info, "data_states", None) or {}
+        await asyncio.gather(
+            *[
+                self.pool.request(
+                    w, {"type": "load_data_state", "states": states}
+                )
+                for w, states in data_states.items()
+            ]
+        )

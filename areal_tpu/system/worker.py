@@ -11,6 +11,7 @@ trials) and the ZMQ stream runtime.
 
 import dataclasses
 import os
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -251,20 +252,78 @@ class ModelWorker:
         model = self.models[model_key]
         interface = self.interfaces[model_key]
         fn = getattr(interface, itype.value)
+        t0 = time.monotonic()
         result = fn(model, sample, mb_spec)
+        mfc_seconds = time.monotonic() - t0
         if itype == ModelInterfaceType.GENERATE:
             model.inc_version()  # advances the sampling seed per step
 
         if isinstance(result, SequenceSample):
             result.remap_keys_(remap_out)
+            perf = self._mfc_perf(model, itype, sample, result, mfc_seconds)
             for one in result.unpack():
                 sid = one.ids[0]
                 if sid in self.data_cache:
                     self.data_cache[sid].update_(one)
                 else:
                     self.data_cache[sid] = one
-            return {"meta": result.meta(), "stats": {}}
-        return {"meta": None, "stats": dict(result or {})}
+            return {"meta": result.meta(), "stats": perf}
+        perf = self._mfc_perf(model, itype, sample, None, mfc_seconds)
+        return {"meta": None, "stats": {**dict(result or {}), **perf}}
+
+    def _mfc_perf(
+        self, model, itype, sample, result, seconds: float
+    ) -> Dict[str, float]:
+        """Per-MFC wall time + analytic FLOPs + MFU (reference:
+        system/flops_counter.py + master_worker.py:434-473)."""
+        from areal_tpu.base import monitor
+
+        perf = {"perf/time_s": seconds}
+        cfg = model.config
+        if cfg is None:
+            return perf
+        try:
+            flops = None
+            if itype == ModelInterfaceType.GENERATE and result is not None:
+                prompt_lens = [
+                    sum(s) for s in sample.seqlens[next(iter(sample.keys))]
+                ]
+                out_lens = [
+                    sum(s) for s in result.seqlens["packed_input_ids"]
+                ]
+                n_rep = max(len(out_lens) // max(len(prompt_lens), 1), 1)
+                p_exp, g_lens = [], []
+                for i, total in enumerate(out_lens):
+                    p = prompt_lens[i // n_rep]
+                    p_exp.append(p)
+                    g_lens.append(max(total - p, 0))
+                flops = monitor.flops_generate(cfg, p_exp, g_lens)
+            else:
+                key = (
+                    "packed_input_ids"
+                    if "packed_input_ids" in sample.keys
+                    else next(iter(sample.keys))
+                )
+                lens = [sum(s) for s in sample.seqlens[key]]
+                tokens = int(sum(lens))
+                sum_sq = float(sum(l * l for l in lens))
+                if itype == ModelInterfaceType.TRAIN_STEP:
+                    flops = monitor.flops_train(cfg, tokens, sum_sq)
+                else:
+                    flops = monitor.flops_forward(cfg, tokens, sum_sq)
+            if flops is not None:
+                perf["perf/tflops"] = flops / 1e12
+                n_dev = (
+                    model.engine.mesh.devices.size
+                    if getattr(model.engine, "mesh", None) is not None
+                    else 0
+                )
+                u = monitor.mfu(flops, seconds, n_dev)
+                if u is not None:
+                    perf["perf/mfu"] = u
+        except Exception as e:  # perf accounting must never fail the MFC
+            logger.warning(f"perf accounting failed: {e!r}")
+        return perf
 
     # ---------------- cross-worker transfer plane ----------------
     # The master orchestrates transfers as a concurrent (send, recv) request
@@ -393,6 +452,35 @@ class ModelWorker:
         self.interfaces[key].save(self.models[key], req["save_dir"])
         return {"path": req["save_dir"]}
 
+    def _handle_load_model(self, req):
+        """Restore a model's weights (and optionally optimizer state) from
+        a checkpoint dir — the worker half of trial recovery (reference:
+        model_worker recover path via make_model from recover ckpts)."""
+        from areal_tpu.models.hf import registry as hf
+
+        key = req["model_name"]
+        model = self.models[key]
+        _, params = hf.load_hf_checkpoint(
+            req["ckpt_dir"],
+            is_critic=bool(model.config is not None and model.config.is_critic),
+            dtype=np.float32,  # exact recover: ckpts store f32 masters
+        )
+        model.engine.set_params(params)
+        opt = req.get("optimizer_path")
+        if opt and os.path.exists(opt) and hasattr(
+            model.engine, "load_optimizer_state"
+        ):
+            model.engine.load_optimizer_state(opt)
+        return {}
+
+    def _handle_data_state(self, req):
+        return {"states": [dl.state_dict() for dl in self.dataloaders]}
+
+    def _handle_load_data_state(self, req):
+        for dl, sd in zip(self.dataloaders, req["states"]):
+            dl.load_state_dict(sd)
+        return {}
+
     def _handle_save_optimizer(self, req):
         eng = self.models[req["model_name"]].engine
         os.makedirs(os.path.dirname(req["path"]), exist_ok=True)
@@ -416,11 +504,16 @@ class ModelWorker:
 
 
 class _Cycler:
-    """Endless epoch iterator over a PackedDataLoader."""
+    """Endless epoch iterator over a PackedDataLoader, with a resumable
+    (epoch, cursor) position: shuffling is seeded per epoch, so replaying
+    `cursor` batches restores the exact data stream — the mechanism behind
+    recover's no-resample guarantee (reference tracks consumed-data hashes
+    instead, master_worker.py:113-155)."""
 
     def __init__(self, loader):
         self.loader = loader
         self.epoch = 0
+        self.cursor = 0  # batches already yielded in the current epoch
         self._it = None
 
     def __iter__(self):
@@ -431,7 +524,23 @@ class _Cycler:
             if self._it is None:
                 self._it = iter(self.loader)
             try:
-                return next(self._it)
+                batch = next(self._it)
+                self.cursor += 1
+                return batch
             except StopIteration:
                 self._it = None
                 self.epoch += 1
+                self.cursor = 0
+
+    def state_dict(self):
+        return {"epoch": self.epoch, "cursor": self.cursor}
+
+    def load_state_dict(self, state):
+        self.epoch = int(state["epoch"])
+        self.cursor = 0
+        # PackedDataLoader increments its epoch counter per __iter__; align
+        # it, then replay the already-consumed batches of this epoch.
+        self.loader._epoch = self.epoch
+        self._it = None
+        for _ in range(int(state["cursor"])):
+            next(self)

@@ -1,0 +1,82 @@
+"""AutomaticEvaluator: checkpoint-dir watching + pass@1 grading (the
+reference's scheduler/evaluator.py test surface)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from areal_tpu.models import transformer as tfm
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.hf import registry as hf
+from areal_tpu.scheduler.evaluator import (
+    AutomaticEvaluator,
+    EvalConfig,
+    evaluate_checkpoint,
+)
+
+from tests import fixtures
+
+
+def _write_ckpt(root, step):
+    cfg = tiny_config()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    d = os.path.join(root, f"step_{step}")
+    hf.save_hf_checkpoint(d, cfg, params, model_type="qwen2")
+    return d
+
+
+def _write_data(path, n=4):
+    rows = fixtures.build_math_rows(n, seed=7)
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return rows
+
+
+def test_evaluate_checkpoint_smoke(tmp_path):
+    ckpt = _write_ckpt(tmp_path / "ckpts", 1)
+    data = tmp_path / "aime.jsonl"
+    _write_data(data)
+    res = evaluate_checkpoint(
+        ckpt,
+        EvalConfig(
+            data_path=str(data),
+            tokenizer_path="char:512",
+            max_new_tokens=8,
+            n_samples=2,
+            greedy=False,
+        ),
+    )
+    assert 0.0 <= res["pass@1"] <= 1.0
+    assert res["n_samples"] == 8.0  # 4 prompts x 2 samples
+    assert res["n_prompts"] == 4.0
+
+
+def test_automatic_evaluator_watches_and_dedupes(tmp_path):
+    ckpt_root = tmp_path / "ckpts"
+    out_dir = tmp_path / "eval"
+    data = tmp_path / "aime.jsonl"
+    _write_data(data)
+    cfg = EvalConfig(
+        data_path=str(data), tokenizer_path="char:512", max_new_tokens=8
+    )
+    ev = AutomaticEvaluator(str(ckpt_root), str(out_dir), cfg)
+    assert ev.pending() == []  # no checkpoints yet
+
+    _write_ckpt(ckpt_root, 2)
+    assert ev.pending() == [2]
+    assert ev.step() == [2]
+    with open(out_dir / "eval_step_2.json") as f:
+        res = json.load(f)
+    assert res["global_step"] == 2.0
+    assert "pass@1" in res
+
+    # Already evaluated -> nothing pending; a new ckpt appears -> only it.
+    assert ev.step() == []
+    _write_ckpt(ckpt_root, 4)
+    assert ev.step() == [4]
+    assert sorted(os.listdir(out_dir)) == [
+        "eval_step_2.json", "eval_step_4.json",
+    ]

