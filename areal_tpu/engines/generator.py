@@ -69,7 +69,7 @@ class GeneratorEngine(Engine):
         # Generation has no CP/PP path (decode is token-at-a-time and
         # latency-bound); only the flash half of the shared dispatch policy
         # applies to prefill.
-        self._use_flash, _, pp_mesh, _, _ = sharding.attn_dispatch(mesh)
+        self._use_flash, _, pp_mesh, _, _ = sharding.attn_dispatch(mesh, cfg)
         if pp_mesh is not None:
             raise NotImplementedError(
                 "GeneratorEngine on a pipe>1 mesh; use a pipe=1 layout for "
@@ -260,7 +260,12 @@ class GeneratorEngine(Engine):
         sig = ("prefill_slot", sp)
         if sig in self._gen_fns:
             return self._gen_fns[sig]
-        cfg, use_flash = self.cfg, self._use_flash
+        cfg = self.cfg
+        # Slot prefill is batch-1: a Mesh (shard_map'd flash) cannot shard
+        # one row over data/fsdp — fall back to dense for this path only.
+        use_flash = (
+            False if isinstance(self._use_flash, Mesh) else self._use_flash
+        )
 
         @jax.jit
         def fn(params, row, plen, cache, slot_row):

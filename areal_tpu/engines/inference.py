@@ -41,7 +41,7 @@ class InferenceEngine(Engine):
             self._pp_mesh,
             self._pp_microbatches,
             self.batch_shard,
-        ) = sharding.attn_dispatch(mesh)
+        ) = sharding.attn_dispatch(mesh, cfg)
         self._fwd_fns: Dict[Any, Callable] = {}
         self.set_params(params)
 

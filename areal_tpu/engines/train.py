@@ -99,6 +99,11 @@ class TrainEngine(Engine):
         optimizer_config: Optional[OptimizerConfig] = None,
         ftspec: Optional[FinetuneSpec] = None,
         compute_dtype=jnp.bfloat16,
+        # Master-weight / Adam-moment dtype.  fp32 is the Megatron-style
+        # default; bf16 halves optimizer memory (params+mu+nu: 12 vs 6
+        # bytes/param) for memory-bound single-chip configs — the tradeoff
+        # large-model recipes make when HBM, not accuracy, binds.
+        master_dtype=jnp.float32,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -108,11 +113,12 @@ class TrainEngine(Engine):
         if jax.default_backend() == "cpu":
             compute_dtype = jnp.float32
         self.compute_dtype = compute_dtype
+        self.master_dtype = master_dtype
 
         self.param_specs = sharding.param_pspecs(params)
         self.param_shardings = sharding.tree_named(mesh, self.param_specs)
-        # fp32 master copy, sharded.
-        params = _cast_tree(params, jnp.float32)
+        # Master copy, sharded.
+        params = _cast_tree(params, master_dtype)
         self.params = jax.device_put(params, self.param_shardings)
         self.optimizer = make_optimizer(
             self.optimizer_config, max(self.ftspec.total_train_steps, 1)
@@ -132,7 +138,7 @@ class TrainEngine(Engine):
             self._pp_mesh,
             self._pp_microbatches,
             self.batch_shard,
-        ) = sharding.attn_dispatch(mesh)
+        ) = sharding.attn_dispatch(mesh, cfg)
 
     # ---------------- core jitted fns ----------------
 
@@ -144,8 +150,7 @@ class TrainEngine(Engine):
         cp_mesh = self._cp_mesh
         pp_mesh, pp_mbs = self._pp_mesh, self._pp_microbatches
 
-        @jax.jit
-        def grad_fn(params, batch, loss_scale):
+        def _value_and_grad(params, batch, loss_scale):
             def losswrap(p):
                 pc = _cast_tree(p, compute_dtype)
                 x, aux = tfm.hidden_states(
@@ -168,25 +173,35 @@ class TrainEngine(Engine):
                 total = loss + cfg.moe_aux_loss_coef * aux
                 return total * loss_scale, stats
 
-            (loss, stats), grads = jax.value_and_grad(losswrap, has_aux=True)(
-                params
-            )
+            return jax.value_and_grad(losswrap, has_aux=True)(params)
+
+        @jax.jit
+        def grad_fn(params, batch, loss_scale):
+            (loss, stats), grads = _value_and_grad(params, batch, loss_scale)
             return grads, loss, stats
 
-        self._grad_fns[loss_fn] = grad_fn
-        return grad_fn
+        # Fused accumulate: the running grad sum is DONATED and updated
+        # in-graph, so accumulation never holds two full grad trees — the
+        # term that pushes large single-chip configs out of HBM.
+        @functools.partial(jax.jit, donate_argnums=(3,))
+        def grad_acc_fn(params, batch, loss_scale, acc):
+            (loss, stats), grads = _value_and_grad(params, batch, loss_scale)
+            return jax.tree.map(jnp.add, acc, grads), loss, stats
+
+        self._grad_fns[loss_fn] = (grad_fn, grad_acc_fn)
+        return self._grad_fns[loss_fn]
 
     def _get_apply_fn(self):
         if self._apply_fn is not None:
             return self._apply_fn
         optimizer = self.optimizer
 
-        # Donation: params/opt_state buffers are dead after the step — without
-        # it the optimizer step transiently holds 2x params + 2x Adam state,
-        # the peak-memory term for large models on one chip.  Grads are NOT
-        # donated: no output matches their shape set (only gnorm remains), so
-        # donating them only triggers unusable-donation warnings.
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        # Donation: params/opt_state/grads buffers are all dead after the
+        # step — without it the optimizer step transiently holds 2x params
+        # + 2x Adam state, the peak-memory term for large models on one
+        # chip.  Grads share the params' shape/dtype set (master dtype), so
+        # their buffers are reusable for the updated params.
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def apply_fn(params, opt_state, grads):
             gnorm = optax.global_norm(grads)
             updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -195,11 +210,6 @@ class TrainEngine(Engine):
 
         self._apply_fn = apply_fn
         return apply_fn
-
-    @staticmethod
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def _accum(acc, grads):
-        return jax.tree.map(jnp.add, acc, grads)
 
     # ---------------- Engine API ----------------
 
@@ -233,15 +243,19 @@ class TrainEngine(Engine):
         total_weight = float(sum(loss_weight_fn(p.arrays) for p in packs))
         total_weight = max(total_weight, 1.0)
 
-        grad_fn = self._get_grad_fn(loss_fn)
+        grad_fn, grad_acc_fn = self._get_grad_fn(loss_fn)
         acc = None
         losses = []
         all_stats = []
         for pk in packs:
             batch = self._device_batch(pk.arrays)
             scale = jnp.float32(1.0 / total_weight)
-            grads, loss, stats = grad_fn(self.params, batch, scale)
-            acc = grads if acc is None else self._accum(acc, grads)
+            if acc is None:
+                acc, loss, stats = grad_fn(self.params, batch, scale)
+            else:
+                acc, loss, stats = grad_acc_fn(
+                    self.params, batch, scale, acc
+                )
             losses.append(loss)
             all_stats.append(stats)
 
@@ -349,7 +363,7 @@ class TrainEngine(Engine):
 
     def set_params(self, params) -> None:
         self.params = jax.device_put(
-            _cast_tree(params, jnp.float32), self.param_shardings
+            _cast_tree(params, self.master_dtype), self.param_shardings
         )
 
     def save_optimizer_state(self, path: str) -> None:

@@ -142,9 +142,21 @@ def packed_attention(
     v: jax.Array,
     segment_ids: jax.Array,
     causal: bool = True,
-    use_flash: Optional[bool] = None,
+    use_flash=None,  # None=auto | bool | Mesh (shard_map the kernel)
 ) -> jax.Array:
-    """Dispatch: Pallas flash kernel on TPU, dense reference elsewhere."""
+    """Dispatch: Pallas flash kernel on TPU, dense reference elsewhere.
+    A Mesh value runs the kernel under shard_map with the standard layout
+    (batch over data/fsdp, heads over model) — the multi-chip flash path."""
+    from jax.sharding import Mesh
+
+    if isinstance(use_flash, Mesh):
+        from areal_tpu.ops.pallas.flash_attention import (
+            flash_attention_sharded,
+        )
+
+        return flash_attention_sharded(
+            q, k, v, segment_ids, use_flash, causal=causal
+        )
     if use_flash is None:
         use_flash = jax.default_backend() == "tpu"
     if use_flash:

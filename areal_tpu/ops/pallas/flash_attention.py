@@ -125,12 +125,26 @@ def _seg_layouts(seg: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return seg_q, seg_k
 
 
+def _kv_index(hq: int, hkv: int):
+    """Grid index (batch-major b*hq) -> kv row in the UNEXPANDED [B*hkv]
+    array: in-kernel GQA — q head h reads kv head h // (hq//hkv), so the
+    7x repeat_kv materialization never happens."""
+    n_rep = hq // hkv
+
+    def idx(b, qi, ki):
+        return (b // hq) * hkv + (b % hq) // n_rep, ki, 0
+
+    return idx
+
+
 def _fwd(
     q, k, v, seg, hq, scale, block_q, block_k, causal
 ) -> Tuple[jax.Array, jax.Array]:
-    """q/k/v: [BH, S, D]; seg: [B, S] int32; hq = heads per batch row.
-    Returns (o [BH,S,D], lse [BH,S,1])."""
+    """q: [B*hq, S, D]; k/v: [B*hkv, S, D] (unexpanded GQA); seg: [B, S]
+    int32.  Returns (o [B*hq,S,D], lse [B*hq,S,1])."""
     bh, s, d = q.shape
+    hkv = k.shape[0] // seg.shape[0]
+    kv_idx = _kv_index(hq, hkv)
     nq = pl.cdiv(s, block_q)
     nk = pl.cdiv(s, block_k)
     kernel = functools.partial(
@@ -145,8 +159,8 @@ def _fwd(
             pl.BlockSpec((1, block_q, 8), lambda b, qi, ki: (b // hq, qi, 0)),
             pl.BlockSpec((1, 8, block_k), lambda b, qi, ki: (b // hq, 0, ki)),
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
@@ -288,7 +302,16 @@ def _bwd(
 ) -> Tuple[jax.Array, jax.Array, jax.Array, None]:
     q, k, v, o, lse, seg = res
     bh, s, d = q.shape
-    hq = bh // seg.shape[0]
+    b = seg.shape[0]
+    hq = bh // b
+    hkv = k.shape[0] // b
+    n_rep = hq // hkv
+    kv_idx_q = _kv_index(hq, hkv)  # grid order (b, qi, ki)
+
+    def kv_idx_k(bi, ki, qi):  # grid order (b, ki, qi): s-block is ki
+        row, _, _ = kv_idx_q(bi, qi, ki)
+        return row, ki, 0
+
     nq = pl.cdiv(s, block_q)
     nk = pl.cdiv(s, block_k)
     delta = jnp.sum(
@@ -309,8 +332,8 @@ def _bwd(
             pl.BlockSpec((1, block_q, 8), lambda b, qi, ki: (b // hq, qi, 0)),
             pl.BlockSpec((1, 8, block_k), lambda b, qi, ki: (b // hq, 0, ki)),
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx_q),
+            pl.BlockSpec((1, block_k, d), kv_idx_q),
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
@@ -321,7 +344,9 @@ def _bwd(
         interpret=_interpret(),
     )(*common_in)
 
-    dk, dv = pl.pallas_call(
+    # dk/dv come out per Q-HEAD (the grid walks q heads); the n_rep grads
+    # sharing one kv head are group-summed after the kernel.
+    dk_x, dv_x = pl.pallas_call(
         functools.partial(
             _dkv_kernel,
             scale=scale, block_q=block_q, block_k=block_k, nq=nq,
@@ -332,8 +357,8 @@ def _bwd(
             pl.BlockSpec((1, block_q, 8), lambda b, ki, qi: (b // hq, qi, 0)),
             pl.BlockSpec((1, 8, block_k), lambda b, ki, qi: (b // hq, 0, ki)),
             pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx_k),
+            pl.BlockSpec((1, block_k, d), kv_idx_k),
             pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),
@@ -343,8 +368,8 @@ def _bwd(
             pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
         ],
         scratch_shapes=[
             _vmem((block_k, d), jnp.float32),
@@ -352,6 +377,16 @@ def _bwd(
         ],
         interpret=_interpret(),
     )(*common_in)
+
+    def group_sum(g):
+        return (
+            g.reshape(b, hkv, n_rep, s, d)
+            .sum(axis=2)
+            .reshape(b * hkv, s, d)
+        )
+
+    dk = group_sum(dk_x).astype(k.dtype)
+    dv = group_sum(dv_x).astype(v.dtype)
     return dq, dk, dv, None
 
 
@@ -389,16 +424,11 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
 ) -> jax.Array:
-    """Segment-aware causal flash attention over packed rows.  GQA: kv heads
-    are repeated to match q heads before the kernel (XLA fuses the
-    broadcast; per-group kv indexing inside the kernel is a later
-    optimization)."""
-    from areal_tpu.ops.attention import repeat_kv
-
+    """Segment-aware causal flash attention over packed rows.  GQA is
+    native: kv stays at n_kv heads and the kernel's BlockSpec index maps
+    route q head h to kv head h // n_rep — no repeat_kv materialization."""
     b, s, hq, d = q.shape
-    n_rep = hq // k.shape[2]
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
+    hkv = k.shape[2]
 
     block_q = min(block_q, s)
     block_k = min(block_k, s)
@@ -409,10 +439,65 @@ def flash_attention(
         )
 
     def to_bhsd(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * hq, s, d)
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
     o = _flash_bhsd(
         to_bhsd(q), to_bhsd(k), to_bhsd(v), segment_ids.astype(jnp.int32),
         d**-0.5, block_q, block_k, causal,
     )
     return o.reshape(b, hq, s, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_sharded(
+    q: jax.Array,  # [B, S, n_q, d]
+    k: jax.Array,  # [B, S, n_kv, d]
+    v: jax.Array,
+    segment_ids: jax.Array,  # [B, S]
+    mesh,
+    causal: bool = True,
+) -> jax.Array:
+    """The multi-chip wrapper: Pallas kernels are not GSPMD-partitionable,
+    so `shard_map` pins the layout — batch over (data, fsdp), heads over
+    `model`, sequence unsharded (ring attention owns the seq axis) — and
+    each device runs the kernel on its local shard.  Attention is
+    independent per (batch row, head), so no collectives are needed; GQA
+    locality requires n_kv % model_axis == 0 (contiguous head sharding
+    keeps each q-head group with its kv head)."""
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from areal_tpu.base.topology import (
+        DATA_AXIS,
+        FSDP_AXIS,
+        MODEL_AXIS,
+        SEQ_AXIS,
+    )
+
+    if mesh.shape[SEQ_AXIS] != 1:
+        raise ValueError("flash_attention_sharded: seq axis must be 1 (CP "
+                         "uses ring attention)")
+    m = mesh.shape[MODEL_AXIS]
+    if k.shape[2] % m or q.shape[2] % m:
+        raise ValueError(
+            f"flash_attention_sharded: the model axis ({m}) must divide "
+            f"both head counts ({q.shape[2]}q/{k.shape[2]}kv)"
+        )
+    batch = (DATA_AXIS, FSDP_AXIS)
+    spec_qkv = P(batch, None, MODEL_AXIS, None)
+    spec_seg = P(batch, None)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_seg),
+        out_specs=spec_qkv,
+        check_vma=False,  # pallas_call outputs carry no vma metadata
+    )
+    def inner(ql, kl, vl, segl):
+        return flash_attention(ql, kl, vl, segl, causal=causal)
+
+    return inner(q, k, v, segment_ids)

