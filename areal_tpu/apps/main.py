@@ -42,6 +42,8 @@ def _make_master(plan: ExperimentPlan, pool) -> MasterWorker:
         experiment_name=plan.experiment_name,
         trial_name=plan.trial_name,
         model_groups=plan.model_groups,
+        model_replicas=plan.model_replicas,
+        difficulty_filter=plan.difficulty_filter,
     )
 
 

@@ -34,6 +34,11 @@ logger = logging.getLogger("quickstart")
 
 
 def _add_common(p: argparse.ArgumentParser):
+    p.add_argument("--config", default=None,
+                   help="YAML file of option defaults (keys = flag names, "
+                        "e.g. 'model.path:'); CLI flags override it — the "
+                        "reference's prologue path (realhf/apps/main.py "
+                        "--config)")
     p.add_argument("--model.path", dest="model_path", required=True,
                    help="HF checkpoint dir")
     p.add_argument("--dataset.path", dest="dataset_path", required=True,
@@ -65,6 +70,69 @@ def _add_common(p: argparse.ArgumentParser):
                    help="spawn workers as subprocesses over ZMQ (default: "
                         "in-process)")
     p.add_argument("--recover-retries", type=int, default=0)
+    p.add_argument("--eval-data", default=None,
+                   help="held-out jsonl; after the trial, every saved "
+                        "checkpoint is graded (pass@1) by the automatic "
+                        "evaluator")
+    p.add_argument("--eval-max-new-tokens", type=int, default=256)
+
+
+def _apply_yaml_config(parser: argparse.ArgumentParser, argv):
+    """Pre-read --config <yaml> and install its values as parser defaults
+    (CLI flags still win).  YAML keys use the flag spelling ('model.path',
+    'batch-size') or the python dest ('model_path')."""
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--config", default=None)
+    known, _ = pre.parse_known_args(argv)
+    if not known.config:
+        return
+    import yaml
+
+    with open(known.config) as f:
+        raw = yaml.safe_load(f) or {}
+    dests = {a.dest for a in parser._actions}
+    mapped = {}
+    for key, val in raw.items():
+        dest = key.replace("-", "_")
+        if dest not in dests:
+            dest = key.replace(".", "_").replace("-", "_")
+        if dest not in dests:
+            raise SystemExit(f"--config: unknown option {key!r}")
+        mapped[dest] = val
+    parser.set_defaults(**mapped)
+    # YAML-provided values satisfy required flags.
+    for a in parser._actions:
+        if a.dest in mapped and a.required:
+            a.required = False
+
+
+def _maybe_eval(args, plan):
+    if not args.eval_data:
+        return
+    from areal_tpu.scheduler.evaluator import AutomaticEvaluator, EvalConfig
+
+    exp, trial = plan.experiment_name, plan.trial_name
+    for node in plan.dfg.nodes:
+        from areal_tpu.api.config import ModelInterfaceType
+
+        if node.interface_type != ModelInterfaceType.TRAIN_STEP:
+            continue
+        ckpt_root = os.path.join(
+            args.fileroot, "checkpoints", exp, trial, str(node.model_name)
+        )
+        if not os.path.isdir(ckpt_root):
+            continue
+        ev = AutomaticEvaluator(
+            ckpt_root,
+            os.path.join(args.fileroot, "eval", exp, trial),
+            EvalConfig(
+                data_path=args.eval_data,
+                tokenizer_path=args.tokenizer_path or args.model_path,
+                max_new_tokens=args.eval_max_new_tokens,
+            ),
+        )
+        steps = ev.step()
+        logger.info(f"evaluated checkpoints at steps {steps}")
 
 
 def _ctrl(args) -> ExperimentSaveEvalControl:
@@ -108,6 +176,7 @@ def cmd_sft(args):
     for wc in plan.worker_configs:
         wc.tokenizer_path = args.tokenizer_path or args.model_path
     stats = _run(plan, args)
+    _maybe_eval(args, plan)
     print(json.dumps(stats[-1] if stats else {}))
 
 
@@ -198,6 +267,7 @@ def cmd_ppo_math(args):
     for wc in plan.worker_configs:
         wc.tokenizer_path = args.tokenizer_path or args.model_path
     stats = _run(plan, args)
+    _maybe_eval(args, plan)
     print(json.dumps(stats[-1] if stats else {}))
 
 
@@ -219,6 +289,13 @@ def main(argv=None):
                     help="separate layout for generation (decoupled meshes)")
     pp.set_defaults(fn=cmd_ppo_math)
 
+    # Install YAML defaults on whichever subcommand was chosen.
+    import sys as _sys
+
+    raw_argv = list(argv if argv is not None else _sys.argv[1:])
+    if raw_argv and raw_argv[0] in ("sft", "ppo-math"):
+        sub_parser = {"sft": ps, "ppo-math": pp}[raw_argv[0]]
+        _apply_yaml_config(sub_parser, raw_argv[1:])
     args = p.parse_args(argv)
     args.fn(args)
 

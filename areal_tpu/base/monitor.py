@@ -28,11 +28,12 @@ def matmul_params(cfg) -> int:
     h = cfg.hidden_dim
     d = cfg.head_dim
     attn = h * (cfg.n_q_heads * d + 2 * cfg.n_kv_heads * d) + cfg.n_q_heads * d * h
+    n_mats = 3 if getattr(cfg, "mlp_gated", True) else 2
     if cfg.is_moe:
         inter = cfg.moe_intermediate_dim or cfg.intermediate_dim
-        mlp = 3 * h * inter * cfg.n_experts_per_tok
+        mlp = n_mats * h * inter * cfg.n_experts_per_tok
     else:
-        mlp = 3 * h * cfg.intermediate_dim
+        mlp = n_mats * h * cfg.intermediate_dim
     per_layer = attn + mlp
     head = 0 if cfg.is_critic else h * cfg.vocab_size
     return cfg.n_layers * per_layer + head

@@ -241,10 +241,18 @@ class PackedDataLoader:
         order = rng.permutation(n)
         self._epoch += 1
         for i in range(0, n, self.batch_size):
-            idx = order[i : i + self.batch_size]
+            # Difficulty filtering can shrink the dataset mid-epoch; drop
+            # stale indices from the snapshot permutation.
+            idx = [
+                int(j)
+                for j in order[i : i + self.batch_size]
+                if j < len(self.dataset)
+            ]
+            if not idx:
+                continue
             if self.drop_last and len(idx) < self.batch_size:
                 return
-            yield SequenceSample.gather([self.dataset[int(j)] for j in idx])
+            yield SequenceSample.gather([self.dataset[j] for j in idx])
 
 
 data_api.register_dataset("prompt_answer", PromptAnswerDataset)

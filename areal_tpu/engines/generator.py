@@ -88,12 +88,37 @@ class GeneratorEngine(Engine):
             else x,
             params,
         )
+        # New weights supersede any host-offloaded copy.
+        self._host_offload = None
+        self._offload_shardings = None
         self.params = jax.device_put(
             cast, sharding.tree_named(self.mesh, sharding.param_pspecs(cast))
         )
 
     def get_params(self):
+        self._ensure_loaded()
         return self.params
+
+    def offload(self) -> None:
+        """Host-offload weights while idle (OffloadHook)."""
+        if getattr(self, "_host_offload", None) is not None:
+            return
+        from areal_tpu.base.distributed import to_host
+
+        self._offload_shardings = jax.tree.map(
+            lambda x: x.sharding, self.params
+        )
+        self._host_offload = jax.tree.map(to_host, self.params)
+        self.params = None
+
+    def _ensure_loaded(self) -> None:
+        if getattr(self, "_host_offload", None) is None:
+            return
+        self.params = jax.tree.map(
+            jax.device_put, self._host_offload, self._offload_shardings
+        )
+        self._host_offload = None
+        self._offload_shardings = None
 
     # ---------------- generation ----------------
 
@@ -133,6 +158,7 @@ class GeneratorEngine(Engine):
           prompt_mask       — True on prompt tokens
           seq_no_eos_mask   — 1.0 per sequence iff truncated (no EOS)
         """
+        self._ensure_loaded()
         prompt_lens = sample.seqlens_of(prompt_key)
         bounds = sample.cu_seqlens(prompt_key)
         prompts = np.asarray(sample.data[prompt_key])

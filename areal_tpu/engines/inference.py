@@ -52,12 +52,37 @@ class InferenceEngine(Engine):
             else x,
             params,
         )
+        # New weights supersede any host-offloaded copy.
+        self._host_offload = None
+        self._offload_shardings = None
         self.params = jax.device_put(
             cast, sharding.tree_named(self.mesh, sharding.param_pspecs(cast))
         )
 
     def get_params(self):
+        self._ensure_loaded()
         return self.params
+
+    def offload(self) -> None:
+        """Host-offload frozen params while idle (OffloadHook)."""
+        if getattr(self, "_host_offload", None) is not None:
+            return
+        from areal_tpu.base.distributed import to_host
+
+        self._offload_shardings = jax.tree.map(
+            lambda x: x.sharding, self.params
+        )
+        self._host_offload = jax.tree.map(to_host, self.params)
+        self.params = None
+
+    def _ensure_loaded(self) -> None:
+        if getattr(self, "_host_offload", None) is None:
+            return
+        self.params = jax.tree.map(
+            jax.device_put, self._host_offload, self._offload_shardings
+        )
+        self._host_offload = None
+        self._offload_shardings = None
 
     def train_batch(self, *a, **k):
         raise NotImplementedError("InferenceEngine cannot train")
@@ -71,6 +96,7 @@ class InferenceEngine(Engine):
         token_key: str = "packed_input_ids",
         extra_keys: Sequence[str] = (),
     ) -> SequenceSample:
+        self._ensure_loaded()
         mbs = sample.split(mb_spec)
         fwd = self._get_fwd_fn(post_fn)
         outs = []

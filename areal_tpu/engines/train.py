@@ -229,6 +229,7 @@ class TrainEngine(Engine):
         micro-batches uses `loss_weight_fn(batch) -> float` (e.g. number of
         loss tokens) so the final gradient equals the full-batch mean.
         """
+        self._ensure_loaded()
         mbs = sample.split(mb_spec)
         packs = [
             packing.pack_sample(
@@ -293,6 +294,7 @@ class TrainEngine(Engine):
         """Forward-only pass; `post_fn(logits, batch) -> [B, S, ...]` runs
         inside jit (e.g. gather next-token logprobs).  Output is re-packed
         into a SequenceSample keyed `output_key`, token-aligned."""
+        self._ensure_loaded()
         mbs = sample.split(mb_spec)
         fwd = self._get_fwd_fn(post_fn)
         outs = []
@@ -356,18 +358,55 @@ class TrainEngine(Engine):
             for k, v in arrays.items()
         }
 
+    # ---------------- offload ----------------
+
+    def offload(self) -> None:
+        """Move params + optimizer state to host, freeing HBM while the
+        model is idle (reference: OffloadHook, real_llm_api.py:308-405).
+        The next engine call reloads transparently."""
+        if getattr(self, "_host_offload", None) is not None:
+            return
+        from areal_tpu.base.distributed import to_host
+
+        self._offload_shardings = (
+            jax.tree.map(lambda x: x.sharding, self.params),
+            jax.tree.map(lambda x: x.sharding, self.opt_state),
+        )
+        self._host_offload = (
+            jax.tree.map(to_host, self.params),
+            jax.tree.map(to_host, self.opt_state),
+        )
+        self.params = None
+        self.opt_state = None
+
+    def _ensure_loaded(self) -> None:
+        if getattr(self, "_host_offload", None) is None:
+            return
+        host_p, host_o = self._host_offload
+        shard_p, shard_o = self._offload_shardings
+        self.params = jax.tree.map(jax.device_put, host_p, shard_p)
+        self.opt_state = jax.tree.map(jax.device_put, host_o, shard_o)
+        self._host_offload = None
+        self._offload_shardings = None
+
     # ---------------- params / ckpt ----------------
 
     def get_params(self):
+        self._ensure_loaded()
         return self.params
 
     def set_params(self, params) -> None:
+        # New weights supersede any host-offloaded copy.
+        self._host_offload = None
+        self._offload_shardings = None
         self.params = jax.device_put(
             _cast_tree(params, self.master_dtype), self.param_shardings
         )
 
     def save_optimizer_state(self, path: str) -> None:
         import pickle
+
+        self._ensure_loaded()
 
         # Host gather is collective on process-spanning meshes — every
         # group member calls it; only jax process 0 writes the file.
@@ -379,6 +418,8 @@ class TrainEngine(Engine):
 
     def load_optimizer_state(self, path: str) -> None:
         import pickle
+
+        self._ensure_loaded()
 
         with open(path, "rb") as f:
             host = pickle.load(f)

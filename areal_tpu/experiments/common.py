@@ -17,7 +17,13 @@ from areal_tpu.api.config import (
     ModelName,
 )
 from areal_tpu.api.data_api import DatasetAbstraction, MicroBatchSpec
-from areal_tpu.api.dfg import DFG, MFCDef, ParamReallocHook, build_graph
+from areal_tpu.api.dfg import (
+    DFG,
+    MFCDef,
+    OffloadHook,
+    ParamReallocHook,
+    build_graph,
+)
 from areal_tpu.api.model_api import FinetuneSpec, GenerationHyperparameters, OptimizerConfig
 from areal_tpu.base.topology import ParallelConfig
 from areal_tpu.system.master import ExperimentSaveEvalControl
@@ -44,6 +50,12 @@ class ExperimentPlan:
     # model key -> all worker ids forming its (multi-host) mesh; models
     # absent run on their single placement worker.  group[0] == placement.
     model_groups: Optional[Dict[str, List[int]]] = None
+    # model key -> worker ids each holding an independent replica (DP
+    # dispatch: generate/inference batches are token-balance-split).
+    model_replicas: Optional[Dict[str, List[int]]] = None
+    # {"min_accuracy": .., "max_accuracy": ..} -> dynamic difficulty
+    # filtering of prompts by per-step group accuracy.
+    difficulty_filter: Optional[Dict[str, float]] = None
 
 
 @dataclasses.dataclass
@@ -145,11 +157,21 @@ class PPOMathConfig:
         default_factory=GenerationHyperparameters
     )
     ppo_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Remove prompts whose group accuracy falls outside this band after
+    # each step (dynamic difficulty filtering; reference
+    # model_worker.py:574-639).  e.g. {"min_accuracy": 0.05,
+    # "max_accuracy": 0.95}.
+    dataset_filter: Optional[Dict[str, float]] = None
+    # Host-offload the reference model's params after each ref_inf call
+    # (OffloadHook; frees its HBM between steps).
+    offload_ref: bool = False
     # Model role -> worker index (e.g. {"actor_gen": 1} puts generation on a
-    # second worker; the data/param planes move bytes between them).  Roles
-    # not listed run on worker 0.  Reference: device-mesh allocations like
-    # `sglang.d64p1m1+d32p2m1` (api/cli_args.py allocation_mode).
-    placement: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # second worker; the data/param planes move bytes between them) or a
+    # LIST of worker indices (independent replicas: generate/inference
+    # batches are token-balance-split across them — the reference's DP
+    # dispatch).  Roles not listed run on worker 0.  Reference: device-mesh
+    # allocations like `sglang.d64p1m1+d32p2m1` (api/cli_args.py).
+    placement: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # Per-worker first local device (in-process multi-worker trials carve
     # one host's device list into disjoint meshes).
     worker_device_offsets: Dict[int, int] = dataclasses.field(
@@ -227,6 +249,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                 output_key_remap={"logprobs": "packed_ref_logprobs"},
                 n_seqs=cfg.batch_size,
                 mb_spec=cfg.mb_spec,
+                post_hooks=[OffloadHook()] if cfg.offload_ref else [],
             )
         )
         train_inputs.append("packed_ref_logprobs")
@@ -335,14 +358,24 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                 optimizer=cfg.optimizer,
             )
         )
-    placement = {str(s.name): cfg.placement.get(s.name.role, 0) for s in shards}
-    n_workers = max(placement.values(), default=0) + 1
+    workers_of: Dict[str, List[int]] = {}
+    replicas: Dict[str, List[int]] = {}
+    for s in shards:
+        where = cfg.placement.get(s.name.role, 0)
+        if isinstance(where, int):
+            workers_of[str(s.name)] = [where]
+        else:
+            workers_of[str(s.name)] = list(where)
+            if len(where) > 1:
+                replicas[str(s.name)] = list(where)
+    placement = {k: v[0] for k, v in workers_of.items()}
+    n_workers = max(w for ws in workers_of.values() for w in ws) + 1
     worker_configs = []
     for w in range(n_workers):
         worker_configs.append(
             WorkerConfig(
                 worker_index=w,
-                shards=[s for s in shards if placement[str(s.name)] == w],
+                shards=[s for s in shards if w in workers_of[str(s.name)]],
                 # Datasets live on worker 0 (the data worker); outputs move
                 # to consumers via the master-planned transfer plane.
                 datasets=[cfg.dataset] if w == 0 else [],
@@ -362,6 +395,8 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
         experiment_name=cfg.experiment_name,
         trial_name=cfg.trial_name,
         fileroot=cfg.fileroot,
+        model_replicas=replicas or None,
+        difficulty_filter=cfg.dataset_filter,
     )
 
 
@@ -391,6 +426,8 @@ def run_experiment(plan: ExperimentPlan, tokenizer=None):
         experiment_name=plan.experiment_name,
         trial_name=plan.trial_name,
         model_groups=plan.model_groups,
+        model_replicas=plan.model_replicas,
+        difficulty_filter=plan.difficulty_filter,
     )
     master.load_recover_info()
     stats = asyncio.run(master.run())

@@ -56,7 +56,8 @@ class SFTInterface(ModelInterface):
         # process-spanning params; only jax process 0 writes files).
         hf.save_hf_checkpoint(
             save_dir, model.config, model.engine.get_params(),
-            model_type="qwen2", tokenizer=model.tokenizer,
+            model_type=hf.infer_model_type(model.config),
+            tokenizer=model.tokenizer,
         )
         logger.info(f"saved SFT checkpoint to {save_dir}")
 

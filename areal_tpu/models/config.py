@@ -39,6 +39,21 @@ class ModelConfig:
     moe_intermediate_dim: int = 0
     # Router aux loss coefficient (reference: modules/moe/router.py)
     moe_aux_loss_coef: float = 0.001
+    # "topk": capacity-based dispatch — expert FLOPs scale with top-k, not
+    # E (tokens over capacity are dropped, GShard-style).  "dense": every
+    # expert computes every token then results are weight-masked — E/k
+    # times the FLOPs, kept as the numerics oracle.
+    moe_dispatch: str = "topk"
+    # Expert capacity = ceil(T * k / E * this); 1.0 = perfectly balanced.
+    moe_capacity_factor: float = 1.25
+    # ---- architecture family switches (reference: api/from_hf/*) ----
+    hidden_act: str = "silu"  # silu | gelu | gelu_tanh
+    norm_type: str = "rms"  # rms | layernorm (layernorm adds bias params)
+    rms_norm_offset: bool = False  # gemma: scale by (1 + w)
+    embed_scale: bool = False  # gemma: embeddings scaled by sqrt(hidden)
+    pos_emb: str = "rope"  # rope | learned (gpt2 wpe)
+    mlp_gated: bool = True  # False = plain fc/act/proj (gpt2)
+    proj_bias: bool = False  # biases on attn-out + mlp matmuls (gpt2)
 
     @property
     def dtype(self):

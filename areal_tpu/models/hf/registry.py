@@ -5,15 +5,18 @@ hf_registry.py — config⇄config and state-dict⇄state-dict converters per mo
 family, used for loading pretrained checkpoints and saving HF-format outputs
 (so downstream eval harnesses can consume them directly).
 
-Families here: llama, qwen2 (identical tensor naming; qwen2 adds qkv bias).
-The reference additionally registers gpt2/gemma/mistral/mixtral — same
-registry mechanism, added as needed.
+Families here (full reference parity, api/from_hf/*): llama, qwen2
+(identical tensor naming; qwen2 adds qkv bias), mistral, gemma (gelu_tanh +
+(1+w) rms offset + scaled embeddings), mixtral (MoE expert stacking), gpt2
+(learned positions, LayerNorm+bias, fused c_attn, non-gated gelu MLP).
 """
 
+import dataclasses
 import json
 import os
 from typing import Any, Callable, Dict, Optional
 
+import jax
 import numpy as np
 
 from areal_tpu.base import logging
@@ -28,10 +31,26 @@ class HFFamily:
         name: str,
         config_from_hf: Callable[[dict], ModelConfig],
         config_to_hf: Callable[[ModelConfig], dict],
+        # State-dict converters; default = the llama-like tensor naming
+        # shared by llama/qwen2/mistral/gemma.
+        params_from_sd: Optional[Callable] = None,
+        params_to_sd: Optional[Callable] = None,
     ):
         self.name = name
         self.config_from_hf = config_from_hf
         self.config_to_hf = config_to_hf
+        # None -> resolved to the llama-like default at use (the functions
+        # are defined below the early family registrations).
+        self._params_from_sd = params_from_sd
+        self._params_to_sd = params_to_sd
+
+    def params_from_sd(self, cfg, sd, dtype=None):
+        fn = self._params_from_sd or params_from_hf_state_dict
+        return fn(cfg, sd, dtype=dtype)
+
+    def params_to_sd(self, cfg, params):
+        fn = self._params_to_sd or params_to_hf_state_dict
+        return fn(cfg, params)
 
 
 HF_FAMILIES: Dict[str, HFFamily] = {}
@@ -103,7 +122,8 @@ register_hf_family(
 
 
 def params_from_hf_state_dict(
-    cfg: ModelConfig, sd: Dict[str, np.ndarray], dtype=None
+    cfg: ModelConfig, sd: Dict[str, np.ndarray], dtype=None,
+    skip_mlp: bool = False,
 ) -> Dict[str, Any]:
     """HF tensors -> layer-stacked pytree.  HF linears are [out, in]; ours
     are [in, out], so weights transpose."""
@@ -130,10 +150,11 @@ def params_from_hf_state_dict(
         "wv": stack("model.layers.{}.self_attn.v_proj.weight", transpose=True),
         "wo": stack("model.layers.{}.self_attn.o_proj.weight", transpose=True),
         "ln2": stack("model.layers.{}.post_attention_layernorm.weight"),
-        "wg": stack("model.layers.{}.mlp.gate_proj.weight", transpose=True),
-        "wu": stack("model.layers.{}.mlp.up_proj.weight", transpose=True),
-        "wd": stack("model.layers.{}.mlp.down_proj.weight", transpose=True),
     }
+    if not skip_mlp:  # mixtral routes its MoE tensors separately
+        blocks["wg"] = stack("model.layers.{}.mlp.gate_proj.weight", transpose=True)
+        blocks["wu"] = stack("model.layers.{}.mlp.up_proj.weight", transpose=True)
+        blocks["wd"] = stack("model.layers.{}.mlp.down_proj.weight", transpose=True)
     if cfg.qkv_bias:
         blocks["bq"] = stack("model.layers.{}.self_attn.q_proj.bias")
         blocks["bk"] = stack("model.layers.{}.self_attn.k_proj.bias")
@@ -161,7 +182,7 @@ def params_from_hf_state_dict(
 
 
 def params_to_hf_state_dict(
-    cfg: ModelConfig, params: Dict[str, Any]
+    cfg: ModelConfig, params: Dict[str, Any], skip_mlp: bool = False
 ) -> Dict[str, np.ndarray]:
     from areal_tpu.base.distributed import to_host
 
@@ -202,14 +223,343 @@ def params_to_hf_state_dict(
     unstack("model.layers.{}.self_attn.v_proj.weight", blocks["wv"], True)
     unstack("model.layers.{}.self_attn.o_proj.weight", blocks["wo"], True)
     unstack("model.layers.{}.post_attention_layernorm.weight", blocks["ln2"])
-    unstack("model.layers.{}.mlp.gate_proj.weight", blocks["wg"], True)
-    unstack("model.layers.{}.mlp.up_proj.weight", blocks["wu"], True)
-    unstack("model.layers.{}.mlp.down_proj.weight", blocks["wd"], True)
+    if not skip_mlp:  # mixtral writes its MoE tensors separately
+        unstack("model.layers.{}.mlp.gate_proj.weight", blocks["wg"], True)
+        unstack("model.layers.{}.mlp.up_proj.weight", blocks["wu"], True)
+        unstack("model.layers.{}.mlp.down_proj.weight", blocks["wd"], True)
     if cfg.qkv_bias:
         unstack("model.layers.{}.self_attn.q_proj.bias", blocks["bq"])
         unstack("model.layers.{}.self_attn.k_proj.bias", blocks["bk"])
         unstack("model.layers.{}.self_attn.v_proj.bias", blocks["bv"])
     return out
+
+
+# ---------------- mistral ----------------
+# Llama tensor naming; sliding-window attention is NOT modeled (full causal
+# attention — exact for sequences within the window, reference api/from_hf/
+# mistral.py maps the same fields).
+
+
+def _mistral_config_from_hf(hf: dict) -> ModelConfig:
+    cfg = _llama_like_config_from_hf(hf)
+    return dataclasses.replace(cfg, qkv_bias=False)
+
+
+register_hf_family(
+    HFFamily(
+        "mistral",
+        _mistral_config_from_hf,
+        lambda cfg: {
+            **_llama_like_config_to_hf(cfg, "mistral"),
+            "model_type": "mistral",
+            "architectures": ["MistralForCausalLM"],
+            "sliding_window": None,
+        },
+    )
+)
+
+
+# ---------------- gemma ----------------
+
+
+def _gemma_config_from_hf(hf: dict) -> ModelConfig:
+    return ModelConfig(
+        n_layers=hf["num_hidden_layers"],
+        hidden_dim=hf["hidden_size"],
+        n_q_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=hf["head_dim"],
+        intermediate_dim=hf["intermediate_size"],
+        vocab_size=hf["vocab_size"],
+        max_position_embeddings=hf.get("max_position_embeddings", 8192),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+        tied_embeddings=True,  # gemma always ties
+        hidden_act="gelu_tanh",  # gelu_pytorch_tanh
+        rms_norm_offset=True,  # norm scales by (1 + w)
+        embed_scale=True,  # embeddings scaled by sqrt(hidden)
+    )
+
+
+def _gemma_config_to_hf(cfg: ModelConfig) -> dict:
+    return {
+        "model_type": "gemma",
+        "num_hidden_layers": cfg.n_layers,
+        "hidden_size": cfg.hidden_dim,
+        "num_attention_heads": cfg.n_q_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "head_dim": cfg.head_dim,
+        "intermediate_size": cfg.intermediate_dim,
+        "vocab_size": cfg.vocab_size,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "tie_word_embeddings": True,
+        "hidden_act": "gelu_pytorch_tanh",
+        "hidden_activation": "gelu_pytorch_tanh",
+        "torch_dtype": "bfloat16",
+        "architectures": ["GemmaForCausalLM"],
+    }
+
+
+register_hf_family(
+    HFFamily("gemma", _gemma_config_from_hf, _gemma_config_to_hf)
+)
+
+
+# ---------------- mixtral ----------------
+
+
+def _mixtral_config_from_hf(hf: dict) -> ModelConfig:
+    base = _llama_like_config_from_hf(hf)
+    return dataclasses.replace(
+        base,
+        qkv_bias=False,
+        n_experts=hf["num_local_experts"],
+        n_experts_per_tok=hf["num_experts_per_tok"],
+        moe_intermediate_dim=hf["intermediate_size"],
+    )
+
+
+def _mixtral_config_to_hf(cfg: ModelConfig) -> dict:
+    out = _llama_like_config_to_hf(cfg, "mixtral")
+    out.update(
+        model_type="mixtral",
+        architectures=["MixtralForCausalLM"],
+        num_local_experts=cfg.n_experts,
+        num_experts_per_tok=cfg.n_experts_per_tok,
+        intermediate_size=cfg.moe_intermediate_dim or cfg.intermediate_dim,
+    )
+    return out
+
+
+def _mixtral_params_from_sd(cfg, sd, dtype=None):
+    """Attention/norms via the llama-like path; MoE tensors
+    (block_sparse_moe.gate + experts.{e}.w1/w2/w3) stacked over (L, E)."""
+    import jax.numpy as jnp
+
+    params = params_from_hf_state_dict(cfg, sd, dtype=dtype, skip_mlp=True)
+    dtype = dtype or cfg.dtype
+
+    def stack_experts(fmt, transpose):
+        layers = []
+        for i in range(cfg.n_layers):
+            experts = [
+                np.asarray(sd[fmt.format(i, e)])
+                for e in range(cfg.n_experts)
+            ]
+            layers.append(
+                np.stack([t.T if transpose else t for t in experts], axis=0)
+            )
+        return jnp.asarray(np.stack(layers, axis=0), dtype=dtype)
+
+    blocks = params["blocks"]
+    blocks["router"] = jnp.asarray(
+        np.stack(
+            [
+                np.asarray(
+                    sd[f"model.layers.{i}.block_sparse_moe.gate.weight"]
+                ).T
+                for i in range(cfg.n_layers)
+            ],
+            axis=0,
+        ),
+        dtype=dtype,
+    )
+    moe = "model.layers.{}.block_sparse_moe.experts.{}"
+    blocks["wg"] = stack_experts(moe + ".w1.weight", True)  # [L,E,D,F]
+    blocks["wd"] = stack_experts(moe + ".w2.weight", True)  # [L,E,F,D]
+    blocks["wu"] = stack_experts(moe + ".w3.weight", True)  # [L,E,D,F]
+    return params
+
+
+def _mixtral_params_to_sd(cfg, params):
+    from areal_tpu.base.distributed import to_host
+
+    out = params_to_hf_state_dict(cfg, params, skip_mlp=True)
+    blocks = params["blocks"]
+    router = to_host(blocks["router"]).astype(np.float32, copy=False)
+    wg = to_host(blocks["wg"]).astype(np.float32, copy=False)
+    wu = to_host(blocks["wu"]).astype(np.float32, copy=False)
+    wd = to_host(blocks["wd"]).astype(np.float32, copy=False)
+    moe = "model.layers.{}.block_sparse_moe"
+    for i in range(cfg.n_layers):
+        out[moe.format(i) + ".gate.weight"] = np.ascontiguousarray(
+            router[i].T
+        )
+        for e in range(cfg.n_experts):
+            pre = moe.format(i) + f".experts.{e}"
+            out[pre + ".w1.weight"] = np.ascontiguousarray(wg[i, e].T)
+            out[pre + ".w2.weight"] = np.ascontiguousarray(wd[i, e].T)
+            out[pre + ".w3.weight"] = np.ascontiguousarray(wu[i, e].T)
+    return out
+
+
+register_hf_family(
+    HFFamily(
+        "mixtral",
+        _mixtral_config_from_hf,
+        _mixtral_config_to_hf,
+        params_from_sd=_mixtral_params_from_sd,
+        params_to_sd=_mixtral_params_to_sd,
+    )
+)
+
+
+# ---------------- gpt2 ----------------
+# Different lineage: learned positions, LayerNorm with bias, fused c_attn,
+# plain (non-gated) gelu MLP, biases everywhere, Conv1D weights stored
+# [in, out] — which matches this codebase's convention directly.
+
+
+def _gpt2_config_from_hf(hf: dict) -> ModelConfig:
+    d = hf["n_embd"]
+    heads = hf["n_head"]
+    return ModelConfig(
+        n_layers=hf["n_layer"],
+        hidden_dim=d,
+        n_q_heads=heads,
+        n_kv_heads=heads,
+        head_dim=d // heads,
+        intermediate_dim=hf.get("n_inner") or 4 * d,
+        vocab_size=hf["vocab_size"],
+        max_position_embeddings=hf.get("n_positions", 1024),
+        rms_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        qkv_bias=True,
+        tied_embeddings=True,
+        hidden_act="gelu_tanh",  # gelu_new
+        norm_type="layernorm",
+        pos_emb="learned",
+        mlp_gated=False,
+        proj_bias=True,
+    )
+
+
+def _gpt2_config_to_hf(cfg: ModelConfig) -> dict:
+    return {
+        "model_type": "gpt2",
+        "n_layer": cfg.n_layers,
+        "n_embd": cfg.hidden_dim,
+        "n_head": cfg.n_q_heads,
+        "n_inner": cfg.intermediate_dim,
+        "vocab_size": cfg.vocab_size,
+        "n_positions": cfg.max_position_embeddings,
+        "n_ctx": cfg.max_position_embeddings,
+        "layer_norm_epsilon": cfg.rms_norm_eps,
+        "activation_function": "gelu_new",
+        "tie_word_embeddings": True,
+        "torch_dtype": "float32",
+        "architectures": ["GPT2LMHeadModel"],
+    }
+
+
+def _gpt2_params_from_sd(cfg, sd, dtype=None):
+    import jax.numpy as jnp
+
+    dtype = dtype or cfg.dtype
+    L, D = cfg.n_layers, cfg.hidden_dim
+
+    def get(name):
+        key = name if name in sd else "transformer." + name
+        return np.asarray(sd[key])
+
+    def stack(fmt):
+        return np.stack([get(fmt.format(i)) for i in range(L)], axis=0)
+
+    c_attn_w = stack("h.{}.attn.c_attn.weight")  # [L, D, 3D] (Conv1D: in,out)
+    c_attn_b = stack("h.{}.attn.c_attn.bias")  # [L, 3D]
+    blocks = {
+        "ln1": stack("h.{}.ln_1.weight"),
+        "ln1_b": stack("h.{}.ln_1.bias"),
+        "wq": c_attn_w[:, :, :D],
+        "wk": c_attn_w[:, :, D : 2 * D],
+        "wv": c_attn_w[:, :, 2 * D :],
+        "bq": c_attn_b[:, :D],
+        "bk": c_attn_b[:, D : 2 * D],
+        "bv": c_attn_b[:, 2 * D :],
+        "wo": stack("h.{}.attn.c_proj.weight"),
+        "bo": stack("h.{}.attn.c_proj.bias"),
+        "ln2": stack("h.{}.ln_2.weight"),
+        "ln2_b": stack("h.{}.ln_2.bias"),
+        "wg": stack("h.{}.mlp.c_fc.weight"),
+        "bfc": stack("h.{}.mlp.c_fc.bias"),
+        "wd": stack("h.{}.mlp.c_proj.weight"),
+        "bproj": stack("h.{}.mlp.c_proj.bias"),
+    }
+    params = {
+        "embed": get("wte.weight"),
+        "pos_embed": get("wpe.weight"),
+        "blocks": blocks,
+        "final_ln": get("ln_f.weight"),
+        "final_ln_b": get("ln_f.bias"),
+    }
+    params = jax.tree.map(lambda x: jnp.asarray(x, dtype=dtype), params)
+    if cfg.is_critic:
+        params["value_head"] = jnp.zeros((D, 1), dtype=dtype)
+    return params
+
+
+def _gpt2_params_to_sd(cfg, params):
+    from areal_tpu.base.distributed import to_host
+
+    host = jax.tree.map(
+        lambda x: to_host(x).astype(np.float32, copy=False), params
+    )
+    blocks = host["blocks"]
+    out = {
+        "wte.weight": host["embed"],
+        "wpe.weight": host["pos_embed"],
+        "ln_f.weight": host["final_ln"],
+        "ln_f.bias": host["final_ln_b"],
+    }
+    for i in range(cfg.n_layers):
+        pre = f"h.{i}."
+        out[pre + "ln_1.weight"] = blocks["ln1"][i]
+        out[pre + "ln_1.bias"] = blocks["ln1_b"][i]
+        out[pre + "attn.c_attn.weight"] = np.ascontiguousarray(
+            np.concatenate(
+                [blocks["wq"][i], blocks["wk"][i], blocks["wv"][i]], axis=1
+            )
+        )
+        out[pre + "attn.c_attn.bias"] = np.ascontiguousarray(
+            np.concatenate(
+                [blocks["bq"][i], blocks["bk"][i], blocks["bv"][i]]
+            )
+        )
+        out[pre + "attn.c_proj.weight"] = blocks["wo"][i]
+        out[pre + "attn.c_proj.bias"] = blocks["bo"][i]
+        out[pre + "ln_2.weight"] = blocks["ln2"][i]
+        out[pre + "ln_2.bias"] = blocks["ln2_b"][i]
+        out[pre + "mlp.c_fc.weight"] = blocks["wg"][i]
+        out[pre + "mlp.c_fc.bias"] = blocks["bfc"][i]
+        out[pre + "mlp.c_proj.weight"] = blocks["wd"][i]
+        out[pre + "mlp.c_proj.bias"] = blocks["bproj"][i]
+    return {k: np.ascontiguousarray(v) for k, v in out.items()}
+
+
+register_hf_family(
+    HFFamily(
+        "gpt2",
+        _gpt2_config_from_hf,
+        _gpt2_config_to_hf,
+        params_from_sd=_gpt2_params_from_sd,
+        params_to_sd=_gpt2_params_to_sd,
+    )
+)
+
+
+def infer_model_type(cfg: ModelConfig) -> str:
+    """Best-fit HF family for a ModelConfig — the save path's dispatcher
+    when the caller didn't record where the weights came from."""
+    if cfg.norm_type == "layernorm":
+        return "gpt2"
+    if cfg.is_moe:
+        return "mixtral"
+    if cfg.rms_norm_offset:
+        return "gemma"
+    if cfg.qkv_bias:
+        return "qwen2"
+    return "llama"
 
 
 # ---------------- checkpoint IO ----------------
@@ -249,7 +599,7 @@ def load_hf_checkpoint(
                 os.path.join(path, f), map_location="cpu", weights_only=True
             )
             sd.update({k: v.float().numpy() for k, v in t.items()})
-    params = params_from_hf_state_dict(cfg, sd, dtype=dtype)
+    params = family.params_from_sd(cfg, sd, dtype=dtype)
     logger.info(f"loaded HF checkpoint from {path} ({hf_cfg['model_type']})")
     return cfg, params
 
@@ -260,20 +610,52 @@ def save_hf_checkpoint(
     params: Dict[str, Any],
     model_type: str = "qwen2",
     tokenizer=None,
+    max_shard_bytes: int = 5 * 1024**3,
 ) -> None:
     """Write an HF-format checkpoint dir (safetensors + config.json) so the
-    reference's eval tooling / vLLM / SGLang can consume our outputs."""
+    reference's eval tooling / vLLM / SGLang can consume our outputs.
+    State dicts over `max_shard_bytes` split into the standard
+    model-XXXXX-of-YYYYY.safetensors shards + index json (the layout
+    transformers/vLLM expect for large models)."""
     from areal_tpu.base.distributed import is_primary
 
     # Host-gathering a process-spanning param tree is collective: every
     # group member computes the state dict, only jax process 0 writes.
-    sd = params_to_hf_state_dict(cfg, params)
+    sd = HF_FAMILIES[model_type].params_to_sd(cfg, params)
     if not is_primary():
         return
     os.makedirs(path, exist_ok=True)
     from safetensors.numpy import save_file
 
-    save_file(sd, os.path.join(path, "model.safetensors"))
+    total = sum(v.nbytes for v in sd.values())
+    if total <= max_shard_bytes:
+        save_file(sd, os.path.join(path, "model.safetensors"))
+    else:
+        shards: list = [[]]
+        size = 0
+        for k in sd:
+            if size + sd[k].nbytes > max_shard_bytes and shards[-1]:
+                shards.append([])
+                size = 0
+            shards[-1].append(k)
+            size += sd[k].nbytes
+        n = len(shards)
+        weight_map = {}
+        for i, keys in enumerate(shards):
+            fname = f"model-{i + 1:05d}-of-{n:05d}.safetensors"
+            save_file({k: sd[k] for k in keys}, os.path.join(path, fname))
+            weight_map.update({k: fname for k in keys})
+        with open(
+            os.path.join(path, "model.safetensors.index.json"), "w"
+        ) as f:
+            json.dump(
+                {
+                    "metadata": {"total_size": total},
+                    "weight_map": weight_map,
+                },
+                f,
+                indent=2,
+            )
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump(HF_FAMILIES[model_type].config_to_hf(cfg), f, indent=2)
     if tokenizer is not None and hasattr(tokenizer, "save_pretrained"):

@@ -70,6 +70,14 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         blocks["bq"] = jnp.zeros((L, cfg.q_dim), dtype)
         blocks["bk"] = jnp.zeros((L, cfg.kv_dim), dtype)
         blocks["bv"] = jnp.zeros((L, cfg.kv_dim), dtype)
+    if cfg.norm_type == "layernorm":
+        blocks["ln1_b"] = jnp.zeros((L, D), dtype)
+        blocks["ln2_b"] = jnp.zeros((L, D), dtype)
+    if cfg.proj_bias:
+        blocks["bo"] = jnp.zeros((L, D), dtype)
+        blocks["bproj"] = jnp.zeros((L, D), dtype)
+        if not cfg.mlp_gated:
+            blocks["bfc"] = jnp.zeros((L, F), dtype)
     if cfg.is_moe:
         E, FM = cfg.n_experts, cfg.moe_intermediate_dim
         km = jax.random.split(ks[4], 4)
@@ -80,7 +88,8 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     else:
         km = jax.random.split(ks[4], 3)
         blocks["wg"] = dense(km[0], (L, D, F), D)
-        blocks["wu"] = dense(km[1], (L, D, F), D)
+        if cfg.mlp_gated:
+            blocks["wu"] = dense(km[1], (L, D, F), D)
         blocks["wd"] = dense(km[2], (L, F, D), F)
 
     params: Params = {
@@ -88,6 +97,14 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         "blocks": blocks,
         "final_ln": jnp.ones((D,), dtype),
     }
+    if cfg.norm_type == "layernorm":
+        params["final_ln_b"] = jnp.zeros((D,), dtype)
+    if cfg.pos_emb == "learned":
+        params["pos_embed"] = dense(
+            jax.random.fold_in(k_embed, 1),
+            (cfg.max_position_embeddings, D),
+            D,
+        )
     if cfg.is_critic:
         params["value_head"] = dense(k_head, (D, 1), D)
     elif not cfg.tied_embeddings:
@@ -98,6 +115,45 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
 # --------------------------------------------------------------------------
 # Forward
 # --------------------------------------------------------------------------
+
+
+def _act(x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    if cfg.hidden_act == "silu":
+        return jax.nn.silu(x)
+    if cfg.hidden_act == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if cfg.hidden_act == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    raise ValueError(f"unknown hidden_act {cfg.hidden_act!r}")
+
+
+def _norm(
+    x: jax.Array, w: jax.Array, b: Optional[jax.Array], cfg: ModelConfig
+) -> jax.Array:
+    if cfg.norm_type == "rms":
+        scale = w.astype(jnp.float32) + 1.0 if cfg.rms_norm_offset else w
+        return rms_norm(x, scale, cfg.rms_norm_eps)
+    # LayerNorm (gpt2): mean-centered, with bias, fp32 accumulation.
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + cfg.rms_norm_eps)
+    out = out * w.astype(jnp.float32)
+    if b is not None:
+        out = out + b.astype(jnp.float32)
+    return out.astype(dtype)
+
+
+def _embed(
+    params: Params, cfg: ModelConfig, tokens: jax.Array, positions: jax.Array
+) -> jax.Array:
+    x = jnp.take(params["embed"], tokens, axis=0)
+    if cfg.embed_scale:  # gemma normalizer, computed in fp32
+        x = (x.astype(jnp.float32) * (cfg.hidden_dim**0.5)).astype(x.dtype)
+    if cfg.pos_emb == "learned":
+        x = x + jnp.take(params["pos_embed"], positions, axis=0)
+    return x
 
 
 def positions_from_segments(segment_ids: jax.Array) -> jax.Array:
@@ -115,39 +171,101 @@ def positions_from_segments(segment_ids: jax.Array) -> jax.Array:
     return idx - seg_start
 
 
-def _mlp_dense(h: jax.Array, blk: Params) -> jax.Array:
-    gate = jax.nn.silu(h @ blk["wg"])
-    return (gate * (h @ blk["wu"])) @ blk["wd"]
+def _mlp_dense(h: jax.Array, blk: Params, cfg: ModelConfig) -> jax.Array:
+    if cfg.mlp_gated:
+        gate = _act(h @ blk["wg"], cfg)
+        out = (gate * (h @ blk["wu"])) @ blk["wd"]
+        if cfg.proj_bias:
+            out = out + blk["bproj"]
+        return out
+    # Plain fc -> act -> proj (gpt2).
+    hmid = h @ blk["wg"]
+    if cfg.proj_bias:
+        hmid = hmid + blk["bfc"]
+    out = _act(hmid, cfg) @ blk["wd"]
+    if cfg.proj_bias:
+        out = out + blk["bproj"]
+    return out
 
 
-def _mlp_moe(h: jax.Array, blk: Params, cfg: ModelConfig) -> Tuple[jax.Array, jax.Array]:
-    """Token-choice top-k MoE with full expert compute + weight masking.
-
-    Every token runs through a dense einsum over ALL experts, then results
-    are combined with the (sparse) router weights.  On TPU this trades FLOPs
-    for perfectly static shapes and MXU-friendly batched matmuls; the expert
-    axis shards over the mesh (see sharding rules).  Returns (out, aux_loss).
-    Reference semantics: realhf/impl/model/modules/moe/ (router top-k with
-    aux load-balancing loss).
-    """
-    b, s, d = h.shape
-    x = h.reshape(-1, d)  # [T, D]
+def _moe_route(x: jax.Array, blk: Params, cfg: ModelConfig):
+    """Router: top-k weights/indices + switch-style load-balancing aux."""
     router_logits = (x.astype(jnp.float32)) @ blk["router"].astype(jnp.float32)  # [T, E]
     probs = jax.nn.softmax(router_logits, axis=-1)
     top_w, top_idx = jax.lax.top_k(probs, cfg.n_experts_per_tok)  # [T, k]
     top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
     one_hot = jax.nn.one_hot(top_idx, cfg.n_experts, dtype=probs.dtype)  # [T,k,E]
+    # Load-balancing aux loss (switch-style): E * sum_e f_e * P_e.
+    load = jnp.mean(one_hot.sum(axis=1), axis=0)  # fraction routed per expert
+    importance = jnp.mean(probs, axis=0)
+    aux = cfg.n_experts * jnp.sum(load * importance)
+    return top_w, top_idx, one_hot, aux
+
+
+def _mlp_moe_dense(h: jax.Array, blk: Params, cfg: ModelConfig) -> Tuple[jax.Array, jax.Array]:
+    """Numerics-oracle MoE: full expert compute + weight masking.
+
+    Every token runs through a dense einsum over ALL experts, then results
+    are combined with the (sparse) router weights — E/k times the FLOPs of
+    real dispatch, but perfectly static and exactly equal to un-dropped
+    top-k routing.  Reference semantics: realhf/impl/model/modules/moe/.
+    """
+    b, s, d = h.shape
+    x = h.reshape(-1, d)  # [T, D]
+    top_w, _, one_hot, aux = _moe_route(x, blk, cfg)
     comb = jnp.einsum("tk,tke->te", top_w, one_hot)  # [T, E]
     # All-expert compute: [E, T, F] einsums.
     gate = jax.nn.silu(jnp.einsum("td,edf->etf", x, blk["wg"]))
     up = jnp.einsum("td,edf->etf", x, blk["wu"])
     expert_out = jnp.einsum("etf,efd->etd", gate * up, blk["wd"])  # [E,T,D]
     out = jnp.einsum("te,etd->td", comb.astype(expert_out.dtype), expert_out)
-    # Load-balancing aux loss (switch-style): E * sum_e f_e * P_e.
-    load = jnp.mean(one_hot.sum(axis=1), axis=0)  # fraction routed per expert
-    importance = jnp.mean(probs, axis=0)
-    aux = cfg.n_experts * jnp.sum(load * importance)
     return out.reshape(b, s, d), aux
+
+
+def _mlp_moe_topk(h: jax.Array, blk: Params, cfg: ModelConfig) -> Tuple[jax.Array, jax.Array]:
+    """Capacity-based top-k dispatch (GShard-style): expert matmuls run on
+    [E, C, D] gathered slots, C = ceil(T*k/E * capacity_factor), so FLOPs
+    scale with top-k rather than E.  First-choice assignments claim
+    capacity before second choices; tokens over capacity are dropped
+    (their combine weight is zero), matching the reference's token-choice
+    router with capacity (realhf/impl/model/modules/moe/experts.py).  The
+    expert axis of the dispatch einsums shards over the mesh (see
+    parallel/sharding.py moe rules) — GSPMD inserts the all-to-alls.
+    """
+    import math
+
+    b, s, d = h.shape
+    x = h.reshape(-1, d)  # [T, D]
+    T = x.shape[0]
+    E, k = cfg.n_experts, cfg.n_experts_per_tok
+    top_w, _, one_hot, aux = _moe_route(x, blk, cfg)
+    cap = max(int(math.ceil(T * k / E * cfg.moe_capacity_factor)), 1)
+
+    # Queue position of each (choice slot, token) in its expert, choice-
+    # slot-major so first choices win capacity.
+    sel = one_hot.transpose(1, 0, 2).reshape(k * T, E)  # [k*T, E]
+    pos = jnp.cumsum(sel, axis=0) - sel  # position BEFORE this entry
+    keep = sel * (pos < cap)
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=x.dtype)  # [kT,E,C]
+    disp_k = keep[..., None] * slot  # [k*T, E, C]
+    disp = disp_k.reshape(k, T, E, cap)
+    dispatch = disp.sum(axis=0)  # [T, E, C] 0/1
+    combine = jnp.einsum(
+        "tk,ktec->tec", top_w.astype(x.dtype), disp.astype(x.dtype)
+    )  # [T, E, C]
+
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)  # [E, C, D]
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, blk["wg"]))
+    up = jnp.einsum("ecd,edf->ecf", xe, blk["wu"])
+    ye = jnp.einsum("ecf,efd->ecd", gate * up, blk["wd"])  # [E, C, D]
+    out = jnp.einsum("tec,ecd->td", combine, ye)
+    return out.reshape(b, s, d), aux
+
+
+def _mlp_moe(h: jax.Array, blk: Params, cfg: ModelConfig) -> Tuple[jax.Array, jax.Array]:
+    if cfg.moe_dispatch == "dense":
+        return _mlp_moe_dense(h, blk, cfg)
+    return _mlp_moe_topk(h, blk, cfg)
 
 
 def _block_forward(
@@ -161,7 +279,7 @@ def _block_forward(
     cp_mesh=None,
 ) -> Tuple[jax.Array, jax.Array]:
     b, s, d = x.shape
-    h = rms_norm(x, blk["ln1"], cfg.rms_norm_eps)
+    h = _norm(x, blk["ln1"], blk.get("ln1_b"), cfg)
     q = h @ blk["wq"]
     k = h @ blk["wk"]
     v = h @ blk["wv"]
@@ -170,7 +288,8 @@ def _block_forward(
     q = q.reshape(b, s, cfg.n_q_heads, cfg.head_dim)
     k = k.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-    q, k = apply_rotary(q, k, cos, sin)
+    if cfg.pos_emb == "rope":
+        q, k = apply_rotary(q, k, cos, sin)
     if cp_mesh is not None:
         from areal_tpu.ops.ring_attention import ring_packed_attention
 
@@ -179,12 +298,15 @@ def _block_forward(
         attn = packed_attention(
             q, k, v, segment_ids, causal=True, use_flash=use_flash
         )
-    x = x + attn.reshape(b, s, cfg.q_dim) @ blk["wo"]
-    h2 = rms_norm(x, blk["ln2"], cfg.rms_norm_eps)
+    attn_out = attn.reshape(b, s, cfg.q_dim) @ blk["wo"]
+    if cfg.proj_bias:
+        attn_out = attn_out + blk["bo"]
+    x = x + attn_out
+    h2 = _norm(x, blk["ln2"], blk.get("ln2_b"), cfg)
     if cfg.is_moe:
         mlp_out, aux = _mlp_moe(h2, blk, cfg)
     else:
-        mlp_out, aux = _mlp_dense(h2, blk), jnp.zeros((), jnp.float32)
+        mlp_out, aux = _mlp_dense(h2, blk, cfg), jnp.zeros((), jnp.float32)
     return x + mlp_out, aux
 
 
@@ -200,7 +322,7 @@ def _backbone(
     pp_mesh=None,
     pp_microbatches: int = 4,
 ) -> Tuple[jax.Array, jax.Array]:
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = _embed(params, cfg, tokens, positions)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
     if pp_mesh is not None:
@@ -215,7 +337,7 @@ def _backbone(
             params["blocks"], cfg, x, segment_ids, cos, sin,
             pp_mesh, pp_microbatches, use_flash,
         )
-        x = rms_norm(x, params["final_ln"], cfg.rms_norm_eps)
+        x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
         return x, aux
 
     def body(carry, blk):
@@ -227,7 +349,7 @@ def _backbone(
     if remat:
         body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
     x, auxes = jax.lax.scan(body, x, params["blocks"])
-    x = rms_norm(x, params["final_ln"], cfg.rms_norm_eps)
+    x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
     return x, jnp.sum(auxes)
 
 
@@ -381,7 +503,8 @@ def _block_kv(
     q = q.reshape(b, s, cfg.n_q_heads, cfg.head_dim)
     k = k.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-    q, k = apply_rotary(q, k, cos, sin)
+    if cfg.pos_emb == "rope":
+        q, k = apply_rotary(q, k, cos, sin)
     return q, k, v
 
 
@@ -399,19 +522,22 @@ def prefill(
     there keeps prefill memory at [B, V] instead of [B, S, V] — at a 152k
     vocab that is the difference between 40 MB and 10 GB."""
     positions = positions_from_segments(segment_ids)
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = _embed(params, cfg, tokens, positions)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
     def body(carry, layer_in):
         blk = layer_in
-        h = rms_norm(carry, blk["ln1"], cfg.rms_norm_eps)
+        h = _norm(carry, blk["ln1"], blk.get("ln1_b"), cfg)
         q, k, v = _block_kv(h, blk, cfg, cos, sin)
         attn = packed_attention(
             q, k, v, segment_ids, causal=True, use_flash=use_flash
         )
-        y = carry + attn.reshape(*carry.shape[:2], cfg.q_dim) @ blk["wo"]
-        h2 = rms_norm(y, blk["ln2"], cfg.rms_norm_eps)
-        y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk))
+        y = attn.reshape(*carry.shape[:2], cfg.q_dim) @ blk["wo"]
+        if cfg.proj_bias:
+            y = y + blk["bo"]
+        y = carry + y
+        h2 = _norm(y, blk["ln2"], blk.get("ln2_b"), cfg)
+        y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk, cfg))
         return y, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
@@ -423,7 +549,7 @@ def prefill(
             cache.v, vs.astype(cache.v.dtype), (0, 0, 0, 0, 0)
         ),
     )
-    x = rms_norm(x, params["final_ln"], cfg.rms_norm_eps)
+    x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
     # Gather each row's last valid hidden state before the (huge) head matmul.
     # (index of the last nonzero segment: works for left- and right-aligned
     # prompt layouts alike)
@@ -455,13 +581,13 @@ def decode_step(
     CUDA graphs, realhf/impl/model/nn/real_llm_generate.py:336-368.
     """
     b = tokens.shape[0]
-    x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]  # [B,1,D]
+    x = _embed(params, cfg, tokens, positions)[:, None, :]  # [B,1,D]
     cos, sin = rope_cos_sin(positions[:, None], cfg.head_dim, cfg.rope_theta)
     slot = jnp.asarray(slot, jnp.int32)
 
     def body(carry, blk):
         y, kc, vc, li = carry
-        h = rms_norm(y, blk["ln1"], cfg.rms_norm_eps)
+        h = _norm(y, blk["ln1"], blk.get("ln1_b"), cfg)
         q, k, v = _block_kv(h, blk, cfg, cos, sin)  # q/k/v [B,1,h,d]
         # k/v [B,1,h,d] -> [1,B,1,h,d] written at (layer, :, slot).
         kc = jax.lax.dynamic_update_slice(
@@ -473,15 +599,18 @@ def decode_step(
         k_layer = jax.lax.dynamic_index_in_dim(kc, li, axis=0, keepdims=False)
         v_layer = jax.lax.dynamic_index_in_dim(vc, li, axis=0, keepdims=False)
         attn = decode_attention(q, k_layer, v_layer, valid_from, slot + 1)
-        y = y + attn.reshape(b, 1, cfg.q_dim) @ blk["wo"]
-        h2 = rms_norm(y, blk["ln2"], cfg.rms_norm_eps)
-        y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk))
+        ao = attn.reshape(b, 1, cfg.q_dim) @ blk["wo"]
+        if cfg.proj_bias:
+            ao = ao + blk["bo"]
+        y = y + ao
+        h2 = _norm(y, blk["ln2"], blk.get("ln2_b"), cfg)
+        y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk, cfg))
         return (y, kc, vc, li + 1), None
 
     (x, kc, vc, _), _ = jax.lax.scan(
         body, (x, cache.k, cache.v, jnp.int32(0)), params["blocks"]
     )
-    x = rms_norm(x, params["final_ln"], cfg.rms_norm_eps)
+    x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
     logits = _head(params, cfg, x)[:, 0]  # [B, V]
     return logits, KVCache(k=kc, v=vc)
 
@@ -502,7 +631,7 @@ def decode_step_inflight(
     full-cache rewrite.  Reference: InflightBatchingGenerator's per-slot
     cache bookkeeping (realhf/impl/model/nn/real_llm_generate.py:670)."""
     b = tokens.shape[0]
-    x = jnp.take(params["embed"], tokens, axis=0)[:, None, :]
+    x = _embed(params, cfg, tokens, positions)[:, None, :]
     cos, sin = rope_cos_sin(positions[:, None], cfg.head_dim, cfg.rope_theta)
     zero_from = jnp.zeros((b,), jnp.int32)
 
@@ -516,7 +645,7 @@ def decode_step_inflight(
 
     def body(carry, blk):
         y, kc, vc, li = carry
-        h = rms_norm(y, blk["ln1"], cfg.rms_norm_eps)
+        h = _norm(y, blk["ln1"], blk.get("ln1_b"), cfg)
         q, k, v = _block_kv(h, blk, cfg, cos, sin)
         k_layer = jax.lax.dynamic_index_in_dim(kc, li, axis=0, keepdims=False)
         v_layer = jax.lax.dynamic_index_in_dim(vc, li, axis=0, keepdims=False)
@@ -525,15 +654,18 @@ def decode_step_inflight(
         kc = jax.lax.dynamic_update_index_in_dim(kc, k_layer, li, axis=0)
         vc = jax.lax.dynamic_update_index_in_dim(vc, v_layer, li, axis=0)
         attn = decode_attention(q, k_layer, v_layer, zero_from, valid_to)
-        y = y + attn.reshape(b, 1, cfg.q_dim) @ blk["wo"]
-        h2 = rms_norm(y, blk["ln2"], cfg.rms_norm_eps)
-        y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk))
+        ao = attn.reshape(b, 1, cfg.q_dim) @ blk["wo"]
+        if cfg.proj_bias:
+            ao = ao + blk["bo"]
+        y = y + ao
+        h2 = _norm(y, blk["ln2"], blk.get("ln2_b"), cfg)
+        y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk, cfg))
         return (y, kc, vc, li + 1), None
 
     (x, kc, vc, _), _ = jax.lax.scan(
         body, (x, cache.k, cache.v, jnp.int32(0)), params["blocks"]
     )
-    x = rms_norm(x, params["final_ln"], cfg.rms_norm_eps)
+    x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
     logits = _head(params, cfg, x)[:, 0]
     return logits, KVCache(k=kc, v=vc)
 

@@ -37,6 +37,11 @@ BATCH = (DATA_AXIS, FSDP_AXIS)
 _BLOCK_RULES: Dict[str, P] = {
     "ln1": P(PIPE_AXIS, None),
     "ln2": P(PIPE_AXIS, None),
+    "ln1_b": P(PIPE_AXIS, None),
+    "ln2_b": P(PIPE_AXIS, None),
+    "bo": P(PIPE_AXIS, None),
+    "bproj": P(PIPE_AXIS, None),
+    "bfc": P(PIPE_AXIS, MODEL_AXIS),  # matches wg's model-sharded output
     "wq": P(PIPE_AXIS, FSDP_AXIS, MODEL_AXIS),
     "wk": P(PIPE_AXIS, FSDP_AXIS, MODEL_AXIS),
     "wv": P(PIPE_AXIS, FSDP_AXIS, MODEL_AXIS),
@@ -57,7 +62,9 @@ _BLOCK_RULES: Dict[str, P] = {
 
 _TOP_RULES: Dict[str, P] = {
     "embed": P(MODEL_AXIS, FSDP_AXIS),
+    "pos_embed": P(None, FSDP_AXIS),
     "final_ln": P(None),
+    "final_ln_b": P(None),
     "lm_head": P(FSDP_AXIS, MODEL_AXIS),
     "value_head": P(FSDP_AXIS, None),
 }

@@ -103,4 +103,8 @@ def make_scheduler(
         from areal_tpu.scheduler.local import LocalSchedulerClient
 
         return LocalSchedulerClient(expr_name, trial_name, **kwargs)
+    if mode == "slurm":
+        from areal_tpu.scheduler.slurm import SlurmSchedulerClient
+
+        return SlurmSchedulerClient(expr_name, trial_name, **kwargs)
     raise ValueError(f"unknown scheduler mode {mode!r}")

@@ -1,0 +1,239 @@
+"""Slurm scheduler client.
+
+Capability parity: realhf/scheduler/slurm/client.py:32 (`SlurmSchedulerClient`
+— sbatch submission, squeue/sacct state polling, scancel teardown) — slimmed
+to the sbatch surface a TPU-pod slurm deployment exposes; GPU/gres types and
+the pyxis container plumbing are replaced by plain `--wrap` launches with an
+optional container prefix.
+"""
+
+import os
+import re
+import subprocess
+import time
+from typing import Dict, List, Optional, Sequence
+
+from areal_tpu.base import logging
+from areal_tpu.scheduler.client import (
+    JobException,
+    JobInfo,
+    JobState,
+    SchedulerClient,
+)
+
+logger = logging.getLogger("slurm")
+
+# Slurm state -> JobState (reference: slurm/utils.py STATUS_MAPPING).
+_STATE_MAP = {
+    "PENDING": JobState.PENDING,
+    "CONFIGURING": JobState.PENDING,
+    "RUNNING": JobState.RUNNING,
+    "COMPLETING": JobState.RUNNING,
+    "COMPLETED": JobState.COMPLETED,
+    "FAILED": JobState.FAILED,
+    "OUT_OF_MEMORY": JobState.FAILED,
+    "NODE_FAIL": JobState.FAILED,
+    "TIMEOUT": JobState.FAILED,
+    "PREEMPTED": JobState.CANCELLED,
+    "CANCELLED": JobState.CANCELLED,
+}
+
+
+def _run(cmd: Sequence[str]) -> str:
+    out = subprocess.run(
+        list(cmd), capture_output=True, text=True, check=True
+    )
+    return out.stdout
+
+
+class SlurmSchedulerClient(SchedulerClient):
+    """sbatch/squeue/scancel-backed scheduler.
+
+    Each worker is one sbatch job (`--wrap`).  Worker env vars ride
+    `--export`; a `wrap_cmd_prefix` (e.g. a container runtime) prepends the
+    payload command.
+    """
+
+    def __init__(
+        self,
+        expr_name: str,
+        trial_name: str,
+        log_root: str = "/tmp/areal_tpu/logs",
+        env: Optional[Dict[str, str]] = None,
+        partition: Optional[str] = None,
+        account: Optional[str] = None,
+        time_limit: Optional[str] = None,
+        cpus_per_task: int = 8,
+        mem_gb: int = 32,
+        nodes_per_job: int = 1,
+        wrap_cmd_prefix: str = "",
+        extra_sbatch_args: Sequence[str] = (),
+    ):
+        super().__init__(expr_name, trial_name)
+        self.log_root = os.path.join(log_root, self.run_name)
+        os.makedirs(self.log_root, exist_ok=True)
+        self.env = dict(env or {})
+        self.partition = partition
+        self.account = account
+        self.time_limit = time_limit
+        self.cpus_per_task = cpus_per_task
+        self.mem_gb = mem_gb
+        self.nodes_per_job = nodes_per_job
+        self.wrap_cmd_prefix = wrap_cmd_prefix
+        self.extra_sbatch_args = list(extra_sbatch_args)
+        self._jobs: Dict[str, str] = {}  # worker_type -> slurm job id
+        self._logs: Dict[str, str] = {}
+
+    # -------------- submission --------------
+
+    def sbatch_cmd(self, worker_type: str, cmd: List[str]) -> List[str]:
+        """The sbatch argv for one worker (exposed for tests/dry runs)."""
+        log = os.path.join(
+            self.log_root, worker_type.replace("/", "_") + ".log"
+        )
+        self._logs[worker_type] = log
+        payload = " ".join(cmd)
+        if self.wrap_cmd_prefix:
+            payload = f"{self.wrap_cmd_prefix} {payload}"
+        if self.env:
+            # Env rides the wrapped command line, not --export: slurm's
+            # --export parser splits on commas inside VALUES (e.g.
+            # LIBTPU_INIT_ARGS flag lists), silently truncating them.
+            import shlex
+
+            pairs = " ".join(
+                f"{k}={shlex.quote(str(v))}" for k, v in self.env.items()
+            )
+            payload = f"env {pairs} {payload}"
+        argv = [
+            "sbatch",
+            "--parsable",
+            f"--job-name={self.run_name}:{worker_type}",
+            f"--output={log}",
+            "--error=" + log,
+            f"--nodes={self.nodes_per_job}",
+            "--ntasks-per-node=1",
+            f"--cpus-per-task={self.cpus_per_task}",
+            f"--mem={self.mem_gb}G",
+        ]
+        if self.partition:
+            argv.append(f"--partition={self.partition}")
+        if self.account:
+            argv.append(f"--account={self.account}")
+        if self.time_limit:
+            argv.append(f"--time={self.time_limit}")
+        argv.extend(self.extra_sbatch_args)
+        argv.append(f"--wrap={payload}")
+        return argv
+
+    def submit(self, worker_type: str, cmd: List[str], **kwargs) -> None:
+        out = _run(self.sbatch_cmd(worker_type, cmd)).strip()
+        # --parsable prints "<jobid>[;cluster]".
+        job_id = out.split(";")[0].strip()
+        if not re.fullmatch(r"\d+", job_id):
+            raise RuntimeError(f"unparsable sbatch output: {out!r}")
+        self._jobs[worker_type] = job_id
+        logger.info(f"submitted {worker_type} as slurm job {job_id}")
+
+    # -------------- state --------------
+
+    def _query_states(self) -> Dict[str, JobState]:
+        if not self._jobs:
+            return {}
+        ids = ",".join(self._jobs.values())
+        by_id: Dict[str, JobState] = {}
+        try:
+            out = _run(["squeue", "-h", "-j", ids, "-o", "%i %T"])
+            for line in out.splitlines():
+                parts = line.split()
+                if len(parts) >= 2:
+                    state = parts[1].split("+")[0]
+                    by_id[parts[0]] = _STATE_MAP.get(
+                        state, JobState.RUNNING
+                    )
+        except subprocess.CalledProcessError:
+            pass  # all jobs already left the queue
+        missing = [j for j in self._jobs.values() if j not in by_id]
+        if missing:
+            # Finished jobs drop out of squeue; sacct has the verdict.
+            try:
+                out = _run(
+                    [
+                        "sacct", "-n", "-P", "-j", ",".join(missing),
+                        "-o", "JobID,State",
+                    ]
+                )
+                for line in out.splitlines():
+                    parts = line.split("|")
+                    if len(parts) >= 2 and "." not in parts[0]:
+                        state = parts[1].split()[0].split("+")[0]
+                        by_id[parts[0]] = _STATE_MAP.get(
+                            state, JobState.COMPLETED
+                        )
+            except (subprocess.CalledProcessError, FileNotFoundError):
+                pass
+            # Still unaccounted (accounting disabled returns zero rows, or
+            # record lag right after dequeue): gone = finished, not fatal.
+            for j in missing:
+                by_id.setdefault(j, JobState.COMPLETED)
+        return {
+            wt: by_id.get(jid, JobState.NOT_FOUND)
+            for wt, jid in self._jobs.items()
+        }
+
+    def find(self, worker_type: str) -> JobInfo:
+        state = self._query_states().get(worker_type, JobState.NOT_FOUND)
+        return JobInfo(
+            name=worker_type,
+            state=state,
+            log_path=self._logs.get(worker_type),
+        )
+
+    def find_all(self, pattern: str = "") -> List[JobInfo]:
+        states = self._query_states()
+        return [
+            JobInfo(name=wt, state=st, log_path=self._logs.get(wt))
+            for wt, st in states.items()
+            if pattern in wt
+        ]
+
+    # -------------- teardown / wait --------------
+
+    def stop(self, worker_type: str) -> None:
+        job_id = self._jobs.get(worker_type)
+        if job_id:
+            subprocess.run(["scancel", job_id], capture_output=True)
+
+    def stop_all(self) -> None:
+        if self._jobs:
+            subprocess.run(
+                ["scancel", *self._jobs.values()], capture_output=True
+            )
+
+    def wait(
+        self,
+        timeout: Optional[float] = None,
+        check_status=(JobState.FAILED, JobState.CANCELLED, JobState.NOT_FOUND),
+        remove_status=(JobState.COMPLETED,),
+        update: bool = False,
+        poll_interval: float = 10.0,
+    ) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        left = set(self._jobs)
+        while left:
+            states = self._query_states()
+            for wt in list(left):
+                st = states.get(wt, JobState.NOT_FOUND)
+                if st in check_status:
+                    raise JobException(self.run_name, wt, "slurm", st)
+                if st in remove_status:
+                    left.discard(wt)
+                    if update:
+                        self._jobs.pop(wt, None)
+            if not left:
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"slurm jobs still active after {timeout}s: {sorted(left)}"
+                )
+            time.sleep(poll_interval)

@@ -14,7 +14,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from areal_tpu.api.config import ModelInterfaceType
-from areal_tpu.api.dfg import DFG, MFCDef, ParamReallocHook
+from areal_tpu.api.dfg import DFG, MFCDef, OffloadHook, ParamReallocHook
 from areal_tpu.base import logging, recover, timeutil
 from areal_tpu.base.monitor import StatsLogger
 from areal_tpu.base.stats import merge_stats
@@ -78,11 +78,23 @@ class MasterWorker:
         # mesh; group[0] must be the primary.  Models absent here run on
         # their single placement worker.
         model_groups: Optional[Dict[str, List[int]]] = None,
+        # model key -> worker ids each holding an INDEPENDENT replica;
+        # generate/inference MFCs are token-balance-split across them (the
+        # reference's DP dispatch, model_function_call.py:282-472).
+        model_replicas: Optional[Dict[str, List[int]]] = None,
+        # Dynamic difficulty filtering: after each step, prompts whose group
+        # accuracy falls outside [min_accuracy, max_accuracy] are removed
+        # from the datasets (reference: model_worker.py:574-639).
+        difficulty_filter: Optional[Dict[str, float]] = None,
     ):
         self.dfg = dfg
         self.pool = pool
         self.placement = model_placement
         self.groups = {k: list(v) for k, v in (model_groups or {}).items()}
+        self.replicas = {
+            k: list(v) for k, v in (model_replicas or {}).items()
+        }
+        self.difficulty_filter = difficulty_filter
         self.data_worker_ids = data_worker_ids
         self.ctrl = ctrl
         self.fileroot = fileroot
@@ -181,6 +193,8 @@ class MasterWorker:
         for node in self.dfg.nodes:
             coros.append(self._run_mfc(node, results))
         await asyncio.gather(*coros)
+        if self.difficulty_filter:
+            await self._apply_difficulty_filter()
         await self._clear_worker_caches()
         merged: Dict[str, float] = {}
         for name, stats in results.items():
@@ -295,22 +309,78 @@ class MasterWorker:
     def _group(self, model_key: str) -> List[int]:
         return self.groups.get(model_key, [self.placement[model_key]])
 
+    def _hook_target_set(self, model_key: str) -> List[int]:
+        """Workers that must receive a param hook for this model: every
+        replica, or the SPMD group."""
+        return self.replicas.get(model_key) or self._group(model_key)
+
     async def _run_mfc(self, node: MFCDef, results: Dict):
         batch = await self.buffer.get_batch_for_rpc(node, timeout=600)
         group = self._group(str(node.model_name))
         # Pre hooks (param sync from another model, e.g. gen <- train).
         for hook in node.pre_hooks:
             await self._run_hook(hook, node, group)
+        replicas = self.replicas.get(str(node.model_name))
+        splittable = (
+            replicas
+            and len(replicas) > 1
+            and node.interface_type
+            in (ModelInterfaceType.GENERATE, ModelInterfaceType.INFERENCE)
+            and len(batch.ids) >= len(replicas)
+        )
+        if splittable:
+            stats_list = await self._run_mfc_split(node, batch, replicas)
+            merged: Dict[str, float] = {}
+            for st in stats_list:
+                for k, v in (st or {}).items():
+                    merged.setdefault(k, []).append(v)
+            results[node.name] = {
+                k: float(sum(v) / len(v)) for k, v in merged.items()
+            }
+        else:
+            resp = await self._dispatch_mfc(node, list(batch.ids), group)
+            results[node.name] = resp.get("stats") or {}
+        for hook in node.post_hooks:
+            await self._run_hook(hook, node, group)
+
+    async def _run_mfc_split(self, node: MFCDef, batch, replicas: List[int]):
+        """DP dispatch: token-balance-split the batch over independent
+        replicas, run the sub-calls concurrently, gather their outputs
+        (reference: FFD split + DP-head gather,
+        model_function_call.py:282)."""
+        from areal_tpu.base.datapack import partition_balanced
+
+        key = next(iter(set(node.input_keys) & set(batch.keys)), None)
+        if key is None:
+            key = next(iter(batch.keys))
+        sizes = [int(sum(s)) for s in batch.seqlens[key]]
+        bins = partition_balanced(sizes, len(replicas))
+        parts = [
+            [batch.ids[i] for i in bin_idx]
+            for bin_idx in bins
+        ]
+        resps = await asyncio.gather(
+            *[
+                self._dispatch_mfc(node, ids, [w])
+                for ids, w in zip(parts, replicas)
+                if ids
+            ]
+        )
+        return [r.get("stats") for r in resps]
+
+    async def _dispatch_mfc(
+        self, node: MFCDef, ids: List[str], group: List[int]
+    ) -> Dict:
         # Data-plane pre-hook: every group member executes the MFC
         # SPMD-symmetrically, so each needs the full input batch resident.
         await asyncio.gather(
-            *[self._ensure_data(node, batch.ids, w) for w in group]
+            *[self._ensure_data(node, ids, w) for w in group]
         )
         payload = {
             "type": "mfc",
             "model_name": str(node.model_name),
             "interface_type": node.interface_type.value,
-            "ids": list(batch.ids),
+            "ids": ids,
             "input_keys": list(node.input_keys),
             "input_key_remap": dict(node.input_key_remap),
             "output_key_remap": dict(node.output_key_remap),
@@ -326,13 +396,24 @@ class MasterWorker:
             for i, w in enumerate(group):
                 self._record_owner(resp["meta"], w, replace=(i == 0))
             await self.buffer.amend_batch(resp["meta"])
-        results[node.name] = resp.get("stats") or {}
-        for hook in node.post_hooks:
-            await self._run_hook(hook, node, group)
+        return resp
 
     async def _run_hook(self, hook, node: MFCDef, group: List[int]):
-        if isinstance(hook, ParamReallocHook):
-            target_group = self._group(str(hook.target))
+        if isinstance(hook, OffloadHook):
+            await asyncio.gather(
+                *[
+                    self.pool.request(
+                        w,
+                        {
+                            "type": "offload",
+                            "model_name": str(node.model_name),
+                        },
+                    )
+                    for w in self.replicas.get(str(node.model_name)) or group
+                ]
+            )
+        elif isinstance(hook, ParamReallocHook):
+            target_group = self._hook_target_set(str(hook.target))
             if target_group == group:
                 # Colocated (same member set): every process holds both
                 # models; the copy/EMA is a local (or SPMD-collective-free)
@@ -390,6 +471,44 @@ class MasterWorker:
                         for w, xid in zip(target_group, xfer_ids)
                     ],
                 )
+
+    async def _apply_difficulty_filter(self):
+        """Remove prompts whose group accuracy this step falls outside the
+        configured band — too easy and too hard prompts give GRPO zero
+        advantage (reference: model_worker.py:574-639 dataset filtering)."""
+        by_worker: Dict[int, List[str]] = {}
+        for sid, km in self._owners.items():
+            holders = km.get("rewards")
+            if holders:
+                by_worker.setdefault(min(holders), []).append(sid)
+        if not by_worker:
+            return
+        resps = await asyncio.gather(
+            *[
+                self.pool.request(w, {"type": "data_accuracy", "ids": ids})
+                for w, ids in by_worker.items()
+            ]
+        )
+        accs: Dict[str, float] = {}
+        for r in resps:
+            accs.update(r.get("accuracy") or {})
+        lo = self.difficulty_filter.get("min_accuracy", 0.0)
+        hi = self.difficulty_filter.get("max_accuracy", 1.0)
+        drop = [sid for sid, a in accs.items() if a < lo or a > hi]
+        if not drop:
+            return
+        await asyncio.gather(
+            *[
+                self.pool.request(
+                    w, {"type": "filter_dataset", "ids": drop}
+                )
+                for w in self.data_worker_ids
+            ]
+        )
+        logger.info(
+            f"difficulty filter: removed {len(drop)}/{len(accs)} prompts "
+            f"outside accuracy [{lo}, {hi}]"
+        )
 
     async def _clear_worker_caches(self):
         keep = list(self.buffer._entries.keys())

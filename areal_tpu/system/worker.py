@@ -225,9 +225,29 @@ class ModelWorker:
         return {"dataset_size": sum(sizes), "steps_per_epoch": steps}
 
     def _handle_fetch(self, req):
-        """Load the next dataset batch into the cache; return its metadata."""
+        """Load the next dataset batch into the cache; return its metadata.
+        Batches can come up short after difficulty filtering shrinks the
+        dataset mid-epoch — top up from the stream so the master's buffer
+        (which waits for exactly n_seqs) never stalls."""
         dl_idx = req.get("dataset_index", 0)
-        batch: SequenceSample = next(self.dataloaders[dl_idx])
+        dl = self.dataloaders[dl_idx]
+        singles: List[SequenceSample] = []
+        have = set()
+        attempts = 0
+        while len(singles) < self.config.batch_size:
+            if attempts > 16:
+                raise RuntimeError(
+                    f"dataset {dl_idx} cannot fill a batch of "
+                    f"{self.config.batch_size} (filtered too far?)"
+                )
+            attempts += 1
+            for one in next(dl).unpack():
+                # Top-ups can repeat ids (epoch wrap on a shrunken
+                # dataset); the cache and buffer are id-keyed, so dedup.
+                if one.ids[0] not in have:
+                    have.add(one.ids[0])
+                    singles.append(one)
+        batch = SequenceSample.gather(singles)
         for one in batch.unpack():
             self.data_cache[one.ids[0]] = one
         return {"meta": batch.meta()}
@@ -486,6 +506,27 @@ class ModelWorker:
         os.makedirs(os.path.dirname(req["path"]), exist_ok=True)
         eng.save_optimizer_state(req["path"])
         return {}
+
+    def _handle_offload(self, req):
+        """Host-offload a model's device state (OffloadHook; reference
+        model_worker.py:1009 offload path).  Reload is transparent on the
+        engine's next call."""
+        eng = self.models[req["model_name"]].engine
+        if eng is not None and hasattr(eng, "offload"):
+            eng.offload()
+        return {}
+
+    def _handle_data_accuracy(self, req):
+        """Per-id mean success over a group's rewards (the input to dynamic
+        difficulty filtering; reference model_worker.py:574-639)."""
+        out = {}
+        for sid in req["ids"]:
+            entry = self.data_cache.get(sid)
+            if entry is None or "rewards" not in entry.keys:
+                continue
+            r = np.asarray(entry.data["rewards"], np.float32)
+            out[sid] = float((r > 0).mean()) if r.size else 0.0
+        return {"accuracy": out}
 
     def _handle_clear_cache(self, req):
         keep = set(req.get("keep_ids", ()))

@@ -179,6 +179,97 @@ class TestPPOMathExperiment:
         # Ratio sanity on the on-policy first step.
         assert abs(stats[0]["actor_train/importance_weight"] - 1.0) < 5e-2
 
+    def test_ppo_offload_and_difficulty_filter(self, tmp_path):
+        """OffloadHook frees the ref model after each ref_inf call (it
+        reloads transparently next step), and dynamic difficulty filtering
+        removes prompts whose group accuracy falls outside the band —
+        a random actor scores 0 on every prompt, so min_accuracy=0.5 must
+        shrink the dataset (reference: model_worker.py:574-639)."""
+        from areal_tpu.experiments.common import run_experiment as _run
+
+        tok = fixtures.make_tokenizer()
+        rows = fixtures.build_math_rows(8, seed=4)
+        id2info = {r["query_id"]: r for r in rows}
+        cfg = PPOMathConfig(
+            actor=ModelAbstraction("random", {"config": tiny_config()}),
+            ref=ModelAbstraction("random", {"config": tiny_config()}),
+            dataset=DatasetAbstraction(
+                "math_code_prompt",
+                {
+                    "dataset_builder": lambda: rows,
+                    "max_length": 64,
+                    "max_filter_percentage": 0.5,
+                },
+            ),
+            reward_interface_args={"id2info": id2info},
+            gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+            ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+            optimizer=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
+            dataset_filter={"min_accuracy": 0.5, "max_accuracy": 1.0},
+            offload_ref=True,
+            batch_size=4,
+            total_train_epochs=1,
+            ctrl=ExperimentSaveEvalControl(benchmark_steps=2),
+            fileroot=str(tmp_path),
+        )
+        plan = build_ppo_math(cfg, tok)
+        ref_node = next(n for n in plan.dfg.nodes if n.name == "ref_inf")
+        assert ref_node.post_hooks  # the offload hook is wired
+        master, stats = run_experiment(plan, tokenizer=tok)
+        assert len(stats) == 2
+        assert np.isfinite(stats[-1]["actor_train/actor_loss"])
+        # The in-process pool keeps worker objects reachable: the ref
+        # engine must be offloaded after the trial, and the dataset
+        # filtered down (capped by max_filter_percentage).
+        worker = master.pool.workers[0]
+        assert worker.models["ref@0"].engine._host_offload is not None
+        assert len(worker.datasets[0]) < 8
+
+    def test_ppo_dp_dispatch_replicas(self, tmp_path):
+        """DP dispatch (reference model_function_call.py:282): the ref
+        model runs as two independent replicas on workers 0 and 1; the
+        master token-balance-splits each ref_inf batch across them and
+        gathers the outputs.  Inference is deterministic, so the trial
+        must match the single-replica run exactly."""
+        tok = fixtures.make_tokenizer()
+        rows = fixtures.build_math_rows(8, seed=4)
+        id2info = {r["query_id"]: r for r in rows}
+
+        def make_cfg(split: bool, root):
+            return PPOMathConfig(
+                actor=ModelAbstraction("random", {"config": tiny_config()}),
+                ref=ModelAbstraction("random", {"config": tiny_config()}),
+                dataset=DatasetAbstraction(
+                    "math_code_prompt",
+                    {"dataset_builder": lambda: rows, "max_length": 64},
+                ),
+                reward_interface_args={"id2info": id2info},
+                gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+                ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+                optimizer=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
+                placement={"ref": [0, 1]} if split else {},
+                batch_size=4,
+                total_train_epochs=1,
+                ctrl=ExperimentSaveEvalControl(benchmark_steps=2),
+                fileroot=str(root),
+            )
+
+        plan = build_ppo_math(make_cfg(True, tmp_path / "split"), tok)
+        assert plan.model_replicas == {"ref@0": [0, 1]}
+        assert len(plan.worker_configs) == 2
+        master, stats = run_experiment(plan, tokenizer=tok)
+
+        master1, stats1 = run_experiment(
+            build_ppo_math(make_cfg(False, tmp_path / "solo"), tok),
+            tokenizer=tok,
+        )
+        for k, v in stats1[-1].items():
+            if "perf/" in k or "time/" in k:
+                continue
+            assert np.isclose(stats[-1][k], v, rtol=1e-3, atol=1e-5), (
+                k, stats[-1][k], v,
+            )
+
     def test_ppo_disjoint_workers(self, tmp_path):
         """Generation+reward on worker 1 (devices 4:6), training on worker 0
         (devices 0:2): every step moves prompts 0->1, rollouts/rewards 1->0,

@@ -98,6 +98,50 @@ class TestForward:
         assert logits.shape == (2, 32, cfg.vocab_size)
         assert float(aux) > 0  # load-balancing loss is positive
 
+    def test_moe_topk_matches_dense_oracle(self, rng):
+        """Capacity-based dispatch == all-expert masked compute when no
+        token is dropped (capacity_factor covers worst-case imbalance)."""
+        import dataclasses
+
+        cfg = tiny_config(n_experts=4)
+        # Worst case: every token routed to ONE expert -> C = T*k.
+        cfg_topk = dataclasses.replace(
+            cfg, moe_dispatch="topk",
+            moe_capacity_factor=float(cfg.n_experts),
+        )
+        cfg_dense = dataclasses.replace(cfg, moe_dispatch="dense")
+        params = tfm.init_params(cfg, jax.random.PRNGKey(2))
+        tokens, seg = _packed_batch(rng, cfg)
+        lo_t, aux_t = tfm.forward_with_aux(params, cfg_topk, tokens, seg)
+        lo_d, aux_d = tfm.forward_with_aux(params, cfg_dense, tokens, seg)
+        np.testing.assert_allclose(
+            np.asarray(lo_t), np.asarray(lo_d), rtol=2e-5, atol=2e-5
+        )
+        np.testing.assert_allclose(float(aux_t), float(aux_d), rtol=1e-6)
+
+    def test_moe_topk_drops_over_capacity_and_trains(self, rng):
+        """With a tight capacity some tokens drop (finite outputs, not
+        equal to the oracle) and gradients still flow through routing."""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        cfg = dataclasses.replace(
+            tiny_config(n_experts=4), moe_capacity_factor=0.5
+        )
+        params = tfm.init_params(cfg, jax.random.PRNGKey(2))
+        tokens, seg = _packed_batch(rng, cfg)
+
+        def loss(p):
+            lo, aux = tfm.forward_with_aux(p, cfg, tokens, seg)
+            return jnp.sum(lo * 1e-3) + aux
+
+        g = jax.grad(loss)(params)
+        for leaf in jax.tree.leaves(g):
+            assert np.all(np.isfinite(np.asarray(leaf)))
+        # The router itself must receive gradient (routing is learned).
+        assert float(np.abs(np.asarray(g["blocks"]["router"])).max()) > 0
+
     def test_remat_matches(self, tiny, tiny_params, rng):
         tokens, seg = _packed_batch(rng, tiny)
         l1 = tfm.forward(tiny_params, tiny, tokens, seg, remat=False)
@@ -213,39 +257,79 @@ def _torch_state_dict_to_numpy(model):
     return {k: v.detach().float().numpy() for k, v in model.state_dict().items()}
 
 
+def _tiny_hf_model(family):
+    """Tiny randomly-initialized transformers model per family — the oracle
+    for every registered HF family (reference: api/from_hf coverage)."""
+    import transformers
+
+    llama_kw = dict(
+        vocab_size=199, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-6, rope_theta=10000.0,
+        tie_word_embeddings=False, attention_dropout=0.0,
+    )
+    if family == "llama":
+        return transformers.LlamaForCausalLM(
+            transformers.LlamaConfig(**llama_kw)
+        )
+    if family == "qwen2":
+        return transformers.Qwen2ForCausalLM(
+            transformers.Qwen2Config(**llama_kw)
+        )
+    if family == "mistral":
+        return transformers.MistralForCausalLM(
+            transformers.MistralConfig(**llama_kw, sliding_window=4096)
+        )
+    if family == "gemma":
+        return transformers.GemmaForCausalLM(
+            transformers.GemmaConfig(
+                **{**llama_kw, "tie_word_embeddings": True},
+                head_dim=16,
+                hidden_act="gelu_pytorch_tanh",
+                hidden_activation="gelu_pytorch_tanh",
+            )
+        )
+    if family == "mixtral":
+        return transformers.MixtralForCausalLM(
+            transformers.MixtralConfig(
+                **llama_kw,
+                num_local_experts=4,
+                num_experts_per_tok=2,
+                router_aux_loss_coef=0.0,
+            )
+        )
+    if family == "gpt2":
+        return transformers.GPT2LMHeadModel(
+            transformers.GPT2Config(
+                vocab_size=199, n_embd=64, n_layer=3, n_head=4,
+                n_positions=128, n_inner=128,
+                activation_function="gelu_new",
+                resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+            )
+        )
+    raise ValueError(family)
+
+
 class TestHFParity:
-    @pytest.mark.parametrize("family", ["llama", "qwen2"])
+    @pytest.mark.parametrize(
+        "family", ["llama", "qwen2", "mistral", "gemma", "mixtral", "gpt2"]
+    )
     def test_forward_matches_transformers(self, family, rng):
         torch = pytest.importorskip("torch")
-        import transformers
 
-        if family == "llama":
-            hf_cfg = transformers.LlamaConfig(
-                vocab_size=199, hidden_size=64, intermediate_size=128,
-                num_hidden_layers=3, num_attention_heads=4,
-                num_key_value_heads=2, max_position_embeddings=128,
-                rms_norm_eps=1e-6, rope_theta=10000.0, tie_word_embeddings=False,
-                attention_dropout=0.0,
-            )
-            hf_model = transformers.LlamaForCausalLM(hf_cfg)
-        else:
-            hf_cfg = transformers.Qwen2Config(
-                vocab_size=199, hidden_size=64, intermediate_size=128,
-                num_hidden_layers=3, num_attention_heads=4,
-                num_key_value_heads=2, max_position_embeddings=128,
-                rms_norm_eps=1e-6, rope_theta=10000.0, tie_word_embeddings=False,
-                attention_dropout=0.0,
-            )
-            hf_model = transformers.Qwen2ForCausalLM(hf_cfg)
+        hf_model = _tiny_hf_model(family)
+        hf_cfg = hf_model.config
         hf_model.eval()
 
-        cfg = hf_registry.HF_FAMILIES[family].config_from_hf(
-            json.loads(hf_cfg.to_json_string())
-        )
+        fam = hf_registry.HF_FAMILIES[family]
+        cfg = fam.config_from_hf(json.loads(hf_cfg.to_json_string()))
+        if cfg.is_moe:
+            # The oracle computes every expert exactly; so must we.
+            import dataclasses as _dc
+
+            cfg = _dc.replace(cfg, moe_dispatch="dense")
         sd = _torch_state_dict_to_numpy(hf_model)
-        params = hf_registry.params_from_hf_state_dict(
-            cfg, sd, dtype=jnp.float32
-        )
+        params = fam.params_from_sd(cfg, sd, dtype=jnp.float32)
 
         toks = rng.integers(0, 199, size=(1, 17)).astype(np.int64)
         with torch.no_grad():
@@ -283,6 +367,59 @@ class TestHFParity:
         for (path, a), (_, b) in zip(p1, p2):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-6, err_msg=str(path)
+            )
+
+    def test_sharded_checkpoint_roundtrip(self, tiny, tiny_params, tmp_path):
+        """A tiny max_shard_bytes forces the multi-shard layout (index json
+        + model-XXXXX-of-YYYYY files); the loader reads it back exactly."""
+        hf_registry.save_hf_checkpoint(
+            str(tmp_path), tiny, tiny_params, model_type="qwen2",
+            max_shard_bytes=200_000,
+        )
+        import os
+
+        files = sorted(os.listdir(str(tmp_path)))
+        assert "model.safetensors.index.json" in files
+        shards = [f for f in files if f.endswith(".safetensors")]
+        assert len(shards) > 1
+        with open(tmp_path / "model.safetensors.index.json") as f:
+            index = json.load(f)
+        assert set(index["weight_map"].values()) == set(shards)
+        _, params2 = hf_registry.load_hf_checkpoint(
+            str(tmp_path), dtype=jnp.float32
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(tiny_params),
+            jax.tree_util.tree_leaves(params2),
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    def test_gpt2_checkpoint_roundtrip(self, tmp_path, rng):
+        """GPT2's custom state-dict converters roundtrip every leaf."""
+        import dataclasses as _dc
+
+        cfg = hf_registry.HF_FAMILIES["gpt2"].config_from_hf(
+            {
+                "model_type": "gpt2", "n_embd": 64, "n_layer": 3,
+                "n_head": 4, "n_positions": 128, "n_inner": 128,
+                "vocab_size": 199,
+            }
+        )
+        cfg = _dc.replace(cfg, param_dtype="float32")
+        params = tfm.init_params(cfg, jax.random.PRNGKey(5))
+        hf_registry.save_hf_checkpoint(
+            str(tmp_path), cfg, params, model_type="gpt2"
+        )
+        cfg2, params2 = hf_registry.load_hf_checkpoint(
+            str(tmp_path), dtype=jnp.float32
+        )
+        assert cfg2.norm_type == "layernorm" and cfg2.pos_emb == "learned"
+        p1, _ = jax.tree_util.tree_flatten_with_path(params)
+        p2, _ = jax.tree_util.tree_flatten_with_path(params2)
+        assert [k for k, _ in p1] == [k for k, _ in p2]
+        for (path_, a), (_, b) in zip(p1, p2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, err_msg=str(path_)
             )
 
     def test_critic_checkpoint_keeps_value_head(self, tmp_path, rng):

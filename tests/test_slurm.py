@@ -1,0 +1,102 @@
+"""Slurm scheduler client against fake sbatch/squeue/sacct/scancel binaries
+(hermetic — mirrors the reference's slurm client behavior contract)."""
+
+import os
+import stat
+import subprocess
+
+import pytest
+
+from areal_tpu.scheduler import JobException, JobState, make_scheduler
+
+
+def _write_bin(dirpath, name, script):
+    p = dirpath / name
+    p.write_text("#!/bin/bash\n" + script)
+    p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    return p
+
+
+@pytest.fixture
+def fake_slurm(tmp_path, monkeypatch):
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    state = tmp_path / "state"
+    state.mkdir()
+    _write_bin(
+        bindir, "sbatch",
+        f'echo "$@" >> {state}/sbatch.log\n'
+        f'N=$(cat {state}/njobs 2>/dev/null || echo 100)\n'
+        f'echo $((N+1)) > {state}/njobs\n'
+        'echo $((N+1))\n',
+    )
+    _write_bin(
+        bindir, "squeue",
+        f'cat {state}/squeue.out 2>/dev/null || exit 1\n',
+    )
+    _write_bin(
+        bindir, "sacct",
+        f'cat {state}/sacct.out 2>/dev/null\n',
+    )
+    _write_bin(
+        bindir, "scancel",
+        f'echo "$@" >> {state}/scancel.log\n',
+    )
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    return state
+
+
+def test_submit_states_wait_and_cancel(tmp_path, fake_slurm):
+    sched = make_scheduler(
+        "slurm", "e", "t",
+        log_root=str(tmp_path / "logs"),
+        env={"AREAL_NAME_RESOLVE": "file"},
+        partition="tpu",
+        time_limit="1:00:00",
+    )
+    sched.submit_array(
+        "model_worker",
+        lambda i: ["python", "-m", "areal_tpu.apps.worker", "--index", str(i)],
+        count=2,
+    )
+    assert sorted(sched._jobs.values()) == ["101", "102"]
+
+    sbatch_log = (fake_slurm / "sbatch.log").read_text()
+    assert "--partition=tpu" in sbatch_log
+    assert "--time=1:00:00" in sbatch_log
+    assert (
+        "--wrap=env AREAL_NAME_RESOLVE=file "
+        "python -m areal_tpu.apps.worker --index 1" in sbatch_log
+    )
+    assert "--job-name=e_t:model_worker/0" in sbatch_log
+
+    # Both running per squeue.
+    (fake_slurm / "squeue.out").write_text("101 RUNNING\n102 PENDING\n")
+    infos = {j.name: j.state for j in sched.find_all()}
+    assert infos == {
+        "model_worker/0": JobState.RUNNING,
+        "model_worker/1": JobState.PENDING,
+    }
+
+    # Jobs leave squeue; sacct says one finished, one failed -> wait raises.
+    (fake_slurm / "squeue.out").unlink()
+    (fake_slurm / "sacct.out").write_text(
+        "101|COMPLETED\n101.batch|COMPLETED\n102|FAILED\n"
+    )
+    with pytest.raises(JobException):
+        sched.wait(timeout=5, poll_interval=0.01)
+
+    # Clean completion path.
+    (fake_slurm / "sacct.out").write_text("101|COMPLETED\n102|COMPLETED\n")
+    sched.wait(timeout=5, poll_interval=0.01)
+
+    sched.stop_all()
+    assert "101 102" in (fake_slurm / "scancel.log").read_text()
+
+
+def test_bad_sbatch_output_raises(tmp_path, fake_slurm, monkeypatch):
+    bindir = tmp_path / "bin"
+    _write_bin(bindir, "sbatch", 'echo "sbatch: error"\n')
+    sched = make_scheduler("slurm", "e", "t", log_root=str(tmp_path / "l"))
+    with pytest.raises(RuntimeError):
+        sched.submit("w", ["true"])
