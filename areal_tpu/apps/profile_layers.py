@@ -1,0 +1,132 @@
+"""Per-layer profiling: measured forward/backward/decode costs vs the
+roofline estimator.
+
+Capability parity: realhf/apps/profile_layers.py + profile_exp (per-layer
+op timing used to calibrate the allocation search) — TPU version: times one
+transformer block, the full stack, the LM head, and a decode step on the
+live chip across sequence lengths, and prints a JSON table next to the
+analytic FLOPs/MFU so the search estimator can be sanity-checked against
+hardware.
+
+Usage:
+    python -m areal_tpu.apps.profile_layers --size 1.5b \
+        --seqlens 512,2048 --batch 8
+    python -m areal_tpu.apps.profile_layers --model.path /ckpts/qwen2-7b
+"""
+
+import argparse
+import json
+import time
+
+
+def _timeit(fn, *args, iters=10):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def profile(cfg, batch: int, seqlens, decode_batch: int = 32):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from areal_tpu.base import monitor
+    from areal_tpu.models import transformer as tfm
+
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    rows = []
+    for s in seqlens:
+        toks = jnp.asarray(
+            np.random.default_rng(0).integers(
+                0, cfg.vocab_size, size=(batch, s)
+            ),
+            jnp.int32,
+        )
+        seg = jnp.ones((batch, s), jnp.int32)
+
+        fwd = jax.jit(lambda p, t, sg: tfm.hidden_states(p, cfg, t, sg)[0])
+        t_fwd = _timeit(fwd, params, toks, seg)
+
+        def loss(p, t, sg):
+            x, aux = tfm.hidden_states(p, cfg, t, sg, remat=True)
+            out = tfm.per_token_output(p, cfg, x, t, sg)
+            return jnp.sum(out) * 1e-6 + aux
+
+        bwd = jax.jit(jax.grad(loss))
+        t_bwd = _timeit(bwd, params, toks, seg, iters=5)
+
+        n_tok = batch * s
+        sum_sq = float(batch * s * s)
+        fl_fwd = monitor.flops_forward(cfg, n_tok, sum_sq)
+        fl_train = monitor.flops_train(cfg, n_tok, sum_sq)
+        rows.append(
+            {
+                "seqlen": s,
+                "batch": batch,
+                "fwd_ms": round(t_fwd * 1e3, 3),
+                "fwd_bwd_ms": round(t_bwd * 1e3, 3),
+                "fwd_mfu": monitor.mfu(fl_fwd, t_fwd, 1),
+                "train_mfu": monitor.mfu(fl_train, t_bwd, 1),
+                "fwd_tflops": round(fl_fwd / 1e12, 4),
+            }
+        )
+
+    # Decode step at a mid window.
+    s_max = max(seqlens)
+    cache = tfm.init_kv_cache(cfg, decode_batch, s_max, dtype=cfg.dtype)
+    toks = jnp.ones((decode_batch,), jnp.int32)
+    pos = jnp.full((decode_batch,), s_max // 2, jnp.int32)
+    vf = jnp.zeros((decode_batch,), jnp.int32)
+    step = jax.jit(
+        lambda p, t, po, c: tfm.decode_step(
+            p, cfg, t, po, c, jnp.int32(s_max // 2), vf
+        )
+    )
+    t_dec = _timeit(step, params, toks, pos, cache)
+    decode = {
+        "decode_batch": decode_batch,
+        "window": s_max,
+        "decode_step_ms": round(t_dec * 1e3, 3),
+        "decode_tokens_per_sec": round(decode_batch / t_dec, 1),
+    }
+    return {"layers": cfg.n_layers, "per_seqlen": rows, "decode": decode}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="areal_tpu.apps.profile_layers")
+    p.add_argument("--model.path", dest="model_path", default=None)
+    p.add_argument("--size", default="1.5b", help="qwen2 preset when no path")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--decode-batch", type=int, default=32)
+    p.add_argument("--seqlens", default="512,2048")
+    args = p.parse_args(argv)
+
+    if args.model_path:
+        from areal_tpu.models.hf import registry as hf
+
+        hf_cfg = hf.load_hf_config(args.model_path)
+        cfg = hf.HF_FAMILIES[hf_cfg["model_type"]].config_from_hf(hf_cfg)
+    elif args.size == "tiny":
+        from areal_tpu.models.config import tiny_config
+
+        cfg = tiny_config()
+    else:
+        from areal_tpu.models.config import qwen2_config
+
+        cfg = qwen2_config(args.size, param_dtype="bfloat16")
+    seqlens = [int(s) for s in args.seqlens.split(",")]
+    print(
+        json.dumps(
+            profile(cfg, args.batch, seqlens, args.decode_batch), indent=2
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

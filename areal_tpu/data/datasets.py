@@ -54,9 +54,11 @@ class _DatasetBase:
     def __getitem__(self, idx: int) -> SequenceSample:
         raise NotImplementedError
 
-    def filter(self, to_remove_ids) -> None:
+    def filter(self, to_remove_ids) -> int:
         """Drop samples by id (dynamic difficulty filtering hook; reference
-        math_code_dataset.py:83-198).  Default: no-op for static datasets."""
+        math_code_dataset.py:83-198).  Returns the number removed.
+        Default: no-op for static datasets."""
+        return 0
 
 
 class PromptAnswerDataset(_DatasetBase):
@@ -163,7 +165,10 @@ class MathCodePromptDataset(PromptDataset):
     makes them useless for training (too easy/too hard).
     """
 
-    def __init__(self, *args, filter_threshold: float = 1e4, max_filter_percentage: float = 0.0, **kwargs):
+    # max_filter_percentage caps CUMULATIVE removal per filter call; 1.0 =
+    # uncapped (a 0.0 default silently disabled the feature for anyone who
+    # enabled dataset_filter without also tuning the dataset args).
+    def __init__(self, *args, filter_threshold: float = 1e4, max_filter_percentage: float = 1.0, **kwargs):
         super().__init__(*args, **kwargs)
         self.filter_threshold = filter_threshold
         self.max_filter_percentage = max_filter_percentage
@@ -196,10 +201,10 @@ class MathCodePromptDataset(PromptDataset):
         s.metadata = {"task": [row["task"]]}
         return s
 
-    def filter(self, to_remove_ids) -> None:
+    def filter(self, to_remove_ids) -> int:
         to_remove = set(map(str, to_remove_ids))
         if not to_remove:
-            return
+            return 0
         n_max = int(len(self.ids) * self.max_filter_percentage)
         removed = 0
         keep = []
@@ -212,6 +217,7 @@ class MathCodePromptDataset(PromptDataset):
         self.prompts = [self.prompts[i] for i in keep]
         self.metadata_rows = [self.metadata_rows[i] for i in keep]
         logger.info(f"filtered {removed} prompts; {len(self.ids)} remain")
+        return removed
 
 
 class PackedDataLoader:

@@ -35,7 +35,8 @@ from areal_tpu.api.model_api import Engine, GenerationHyperparameters
 from areal_tpu.base import logging
 from areal_tpu.base.distributed import to_host
 from areal_tpu.base.topology import batch_sharding_degree
-from areal_tpu.engines.packing import bucket_len
+from areal_tpu.engines.offload import HostOffloadMixin
+from areal_tpu.engines.packing import decode_bucket_len as bucket_len
 from areal_tpu.models import transformer as tfm
 from areal_tpu.models.config import ModelConfig
 from areal_tpu.ops.sampling import sample_token
@@ -44,7 +45,7 @@ from areal_tpu.parallel import sharding
 logger = logging.getLogger("generator")
 
 
-class GeneratorEngine(Engine):
+class GeneratorEngine(HostOffloadMixin, Engine):
     def __init__(
         self,
         cfg: ModelConfig,
@@ -98,27 +99,6 @@ class GeneratorEngine(Engine):
     def get_params(self):
         self._ensure_loaded()
         return self.params
-
-    def offload(self) -> None:
-        """Host-offload weights while idle (OffloadHook)."""
-        if getattr(self, "_host_offload", None) is not None:
-            return
-        from areal_tpu.base.distributed import to_host
-
-        self._offload_shardings = jax.tree.map(
-            lambda x: x.sharding, self.params
-        )
-        self._host_offload = jax.tree.map(to_host, self.params)
-        self.params = None
-
-    def _ensure_loaded(self) -> None:
-        if getattr(self, "_host_offload", None) is None:
-            return
-        self.params = jax.tree.map(
-            jax.device_put, self._host_offload, self._offload_shardings
-        )
-        self._host_offload = None
-        self._offload_shardings = None
 
     # ---------------- generation ----------------
 
@@ -176,6 +156,10 @@ class GeneratorEngine(Engine):
         key = jax.random.PRNGKey(seed)
         b_cap = max(self.batch_shard, self.max_decode_batch)
         if inflight is None:
+            # Static chunks win when every request fits one pool (uniform
+            # lengths, no refills, zero per-chunk host round-trips);
+            # inflight wins when stragglers would otherwise stall retired
+            # slots.
             inflight = len(reqs) > b_cap
         if inflight:
             self._generate_inflight(
@@ -198,11 +182,15 @@ class GeneratorEngine(Engine):
         while n_slots % self.batch_shard:
             n_slots += 1
         max_prompt = max(len(t) for (_, _, t) in reqs)
-        s_max = bucket_len(max_prompt + gconfig.max_new_tokens)
         chunk_t = min(32, gconfig.max_new_tokens)
-
+        # The cache starts at the smallest bucket covering the prompts and
+        # GROWS through buckets as sequences lengthen: every decode step
+        # streams the whole window, so depth it doesn't need yet is pure
+        # wasted HBM bandwidth (the chunk fn recompiles per bucket, a
+        # handful of shapes total).
+        cur_w = bucket_len(max_prompt + chunk_t)
         cache = tfm.init_kv_cache(
-            self.cfg, n_slots, s_max, dtype=self.compute_dtype
+            self.cfg, n_slots, cur_w, dtype=self.compute_dtype
         )
         logits_buf = jnp.zeros((n_slots, self.cfg.vocab_size), jnp.float32)
         cache_len = np.zeros((n_slots,), np.int32)
@@ -212,8 +200,6 @@ class GeneratorEngine(Engine):
         toks_acc: Dict[int, List[int]] = {}
         logps_acc: Dict[int, List[float]] = {}
         pending = list(reversed(reqs))  # pop() takes the longest first
-
-        decode_fn = self._get_inflight_decode_fn(n_slots, s_max, chunk_t, gconfig)
 
         while pending or any(a is not None for a in active):
             # Refill free slots (prefill one request per free slot).
@@ -235,7 +221,23 @@ class GeneratorEngine(Engine):
                     toks_acc[s] = []
                     logps_acc[s] = []
 
+            # Grow the cache window when the next chunk could overflow it.
+            # Geometric (doubling) growth bounds recompiles + cache copies
+            # to O(log length); dead slots are excluded (cache_len resets
+            # on retirement).
+            need = int(cache_len.max()) + chunk_t
+            if need > cur_w:
+                new_w = bucket_len(max(need, 2 * cur_w))
+                pad = [(0, 0), (0, 0), (0, new_w - cur_w), (0, 0), (0, 0)]
+                cache = tfm.KVCache(
+                    k=jnp.pad(cache.k, pad), v=jnp.pad(cache.v, pad)
+                )
+                cur_w = new_w
+
             # One jitted chunk: up to chunk_t tokens for every live slot.
+            decode_fn = self._get_inflight_decode_fn(
+                n_slots, cur_w, chunk_t, gconfig
+            )
             key, sub = jax.random.split(key)
             (
                 out_toks, out_logps, logits_buf, cache,
@@ -279,6 +281,7 @@ class GeneratorEngine(Engine):
                     results[(i, rep)] = (gtoks, glogps, no_eos)
                     active[s] = None
                     done_host[s] = True
+                    cache_len[s] = 0  # dead slot must not drive growth
                 else:
                     done_host[s] = bool(new_done[s])
 
@@ -293,7 +296,9 @@ class GeneratorEngine(Engine):
             False if isinstance(self._use_flash, Mesh) else self._use_flash
         )
 
-        @jax.jit
+        # Cache donated: the caller rebinds it from the output, and a
+        # non-donated multi-GB cache would be COPIED on every admission.
+        @functools.partial(jax.jit, donate_argnums=(3,))
         def fn(params, row, plen, cache, slot_row):
             return tfm.prefill_into_slot(
                 params, cfg, row, plen, cache, slot_row, use_flash=use_flash
@@ -315,7 +320,9 @@ class GeneratorEngine(Engine):
         cfg = self.cfg
         eos = self.eos_token_id
 
-        @jax.jit
+        # Cache/logits donated: rebound from outputs each chunk; without
+        # donation every chunk call copies the full KV cache.
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
         def fn(params, cache, logits, cache_len, gen_count, done, key):
             out_toks = jnp.full((n_slots, chunk_t), -1, jnp.int32)
             out_logps = jnp.zeros((n_slots, chunk_t), jnp.float32)

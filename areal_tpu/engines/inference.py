@@ -17,12 +17,13 @@ from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model_api import Engine
 from areal_tpu.base.distributed import to_host
 from areal_tpu.engines import packing
+from areal_tpu.engines.offload import HostOffloadMixin
 from areal_tpu.models import transformer as tfm
 from areal_tpu.models.config import ModelConfig
 from areal_tpu.parallel import sharding
 
 
-class InferenceEngine(Engine):
+class InferenceEngine(HostOffloadMixin, Engine):
     def __init__(
         self,
         cfg: ModelConfig,
@@ -52,7 +53,7 @@ class InferenceEngine(Engine):
             else x,
             params,
         )
-        # New weights supersede any host-offloaded copy.
+        # New weights supersede any host-offloaded copy (params-only).
         self._host_offload = None
         self._offload_shardings = None
         self.params = jax.device_put(
@@ -62,27 +63,6 @@ class InferenceEngine(Engine):
     def get_params(self):
         self._ensure_loaded()
         return self.params
-
-    def offload(self) -> None:
-        """Host-offload frozen params while idle (OffloadHook)."""
-        if getattr(self, "_host_offload", None) is not None:
-            return
-        from areal_tpu.base.distributed import to_host
-
-        self._offload_shardings = jax.tree.map(
-            lambda x: x.sharding, self.params
-        )
-        self._host_offload = jax.tree.map(to_host, self.params)
-        self.params = None
-
-    def _ensure_loaded(self) -> None:
-        if getattr(self, "_host_offload", None) is None:
-            return
-        self.params = jax.tree.map(
-            jax.device_put, self._host_offload, self._offload_shardings
-        )
-        self._host_offload = None
-        self._offload_shardings = None
 
     def train_batch(self, *a, **k):
         raise NotImplementedError("InferenceEngine cannot train")

@@ -1,0 +1,53 @@
+"""Host-offload support shared by the engines (OffloadHook backend).
+
+Reference: realhf/impl/model/nn/real_llm_api.py:308-405 (async offload of
+idle models) — here a synchronous host round-trip: `offload()` gathers the
+device state to host numpy (collective when the mesh spans processes) and
+drops the device buffers; `_ensure_loaded()` restores them on the next use.
+"""
+
+from typing import Any, Optional, Tuple
+
+
+class HostOffloadMixin:
+    """Params-only offload; TrainEngine extends with optimizer state."""
+
+    _host_offload: Optional[Any] = None
+    _offload_shardings: Optional[Any] = None
+
+    def _offload_state(self) -> Tuple[Any, ...]:
+        return (self.params,)
+
+    def _restore_state(self, state: Tuple[Any, ...]) -> None:
+        (self.params,) = state
+
+    def _drop_state(self) -> None:
+        self.params = None
+
+    def offload(self) -> None:
+        """Move device state to host, freeing HBM while the model is idle;
+        the next engine call reloads transparently."""
+        if self._host_offload is not None:
+            return
+        import jax
+
+        from areal_tpu.base.distributed import to_host
+
+        state = self._offload_state()
+        self._offload_shardings = jax.tree.map(
+            lambda x: x.sharding, state
+        )
+        self._host_offload = jax.tree.map(to_host, state)
+        self._drop_state()
+
+    def _ensure_loaded(self) -> None:
+        if self._host_offload is None:
+            return
+        import jax
+
+        state = jax.tree.map(
+            jax.device_put, self._host_offload, self._offload_shardings
+        )
+        self._host_offload = None
+        self._offload_shardings = None
+        self._restore_state(state)

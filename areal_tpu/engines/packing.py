@@ -23,9 +23,11 @@ from areal_tpu.base import datapack
 _BUCKET_QUANTUM = 128
 
 
-def bucket_len(n: int, quantum: int = _BUCKET_QUANTUM) -> int:
+def bucket_len(n: int, quantum: int = _BUCKET_QUANTUM, large_step: int = 0) -> int:
     """Round up to a bucketed static length: next power of two below 1024,
-    then multiples of `quantum`· 8 — bounds distinct compile shapes."""
+    then multiples of `large_step` (default `quantum`·8 = 1024) — bounds
+    distinct compile shapes for the TRAINING pack path, where every new
+    shape costs a full fwd+bwd compile."""
     n = max(n, 1)
     if n <= 128:
         return 128
@@ -34,8 +36,17 @@ def bucket_len(n: int, quantum: int = _BUCKET_QUANTUM) -> int:
         while p < n:
             p *= 2
         return p
-    step = quantum * 8  # 1024
+    step = large_step or quantum * 8  # 1024
     return ((n + step - 1) // step) * step
+
+
+def decode_bucket_len(n: int) -> int:
+    """Finer buckets (256 above 1024) for DECODE cache windows: every
+    decode step streams the whole window, so coarse buckets directly tax
+    every generated token (a 1024 quantum made a 1152-token request pay
+    for a 2048-deep window); decode-step compiles are far cheaper than
+    train-step compiles, so the extra shapes are affordable."""
+    return bucket_len(n, large_step=_BUCKET_QUANTUM * 2)
 
 
 @dataclasses.dataclass

@@ -30,6 +30,7 @@ from areal_tpu.api.model_api import Engine, FinetuneSpec, OptimizerConfig
 from areal_tpu.base import logging
 from areal_tpu.base.distributed import is_primary, to_host
 from areal_tpu.engines import packing
+from areal_tpu.engines.offload import HostOffloadMixin
 from areal_tpu.models import transformer as tfm
 from areal_tpu.models.config import ModelConfig
 from areal_tpu.parallel import sharding
@@ -88,7 +89,7 @@ def _model_out(params, cfg: ModelConfig, x, batch):
     )
 
 
-class TrainEngine(Engine):
+class TrainEngine(HostOffloadMixin, Engine):
     """Engine holding fp32 master params + optimizer state on a mesh."""
 
     def __init__(
@@ -358,36 +359,17 @@ class TrainEngine(Engine):
             for k, v in arrays.items()
         }
 
-    # ---------------- offload ----------------
+    # ---------------- offload (HostOffloadMixin + optimizer state) ------
 
-    def offload(self) -> None:
-        """Move params + optimizer state to host, freeing HBM while the
-        model is idle (reference: OffloadHook, real_llm_api.py:308-405).
-        The next engine call reloads transparently."""
-        if getattr(self, "_host_offload", None) is not None:
-            return
-        from areal_tpu.base.distributed import to_host
+    def _offload_state(self):
+        return (self.params, self.opt_state)
 
-        self._offload_shardings = (
-            jax.tree.map(lambda x: x.sharding, self.params),
-            jax.tree.map(lambda x: x.sharding, self.opt_state),
-        )
-        self._host_offload = (
-            jax.tree.map(to_host, self.params),
-            jax.tree.map(to_host, self.opt_state),
-        )
+    def _restore_state(self, state):
+        self.params, self.opt_state = state
+
+    def _drop_state(self):
         self.params = None
         self.opt_state = None
-
-    def _ensure_loaded(self) -> None:
-        if getattr(self, "_host_offload", None) is None:
-            return
-        host_p, host_o = self._host_offload
-        shard_p, shard_o = self._offload_shardings
-        self.params = jax.tree.map(jax.device_put, host_p, shard_p)
-        self.opt_state = jax.tree.map(jax.device_put, host_o, shard_o)
-        self._host_offload = None
-        self._offload_shardings = None
 
     # ---------------- params / ckpt ----------------
 
@@ -396,9 +378,9 @@ class TrainEngine(Engine):
         return self.params
 
     def set_params(self, params) -> None:
-        # New weights supersede any host-offloaded copy.
-        self._host_offload = None
-        self._offload_shardings = None
+        # Restore any offloaded state first (the optimizer state must
+        # survive; the reloaded params are immediately replaced).
+        self._ensure_loaded()
         self.params = jax.device_put(
             _cast_tree(params, self.master_dtype), self.param_shardings
         )

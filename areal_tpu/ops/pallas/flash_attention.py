@@ -12,10 +12,11 @@ Design (standard flash attention v2 tiling, adapted to Mosaic/TPU):
 - backward: two kernels — dq with grid (B*H, nq, nk) and dkv with grid
   (B*H, nk, nq) — both recompute the probability tiles from the saved
   logsumexp instead of materializing [S, S] (O(S) memory).
-- block-level early-out: tiles entirely above the causal diagonal are
-  skipped via @pl.when (segment masking is applied densely inside the
-  compute; a per-tile segment-overlap early-out is a possible further
-  optimization, not implemented).
+- block-level early-out via @pl.when: tiles entirely above the causal
+  diagonal AND tiles whose q/k segment-id ranges cannot overlap are
+  skipped — packed rows concatenate unrelated sequences with
+  non-decreasing ids, so the work is near block-diagonal in the number
+  of packed sequences rather than O(row_len^2).
 
 Interpret mode (CPU) is used automatically off-TPU, which is how the unit
 tests exercise the same kernel code path hermetically.
@@ -65,8 +66,20 @@ def _fwd_kernel(
         jnp.int32, (block_q, block_k), 1
     )
 
-    # Skip tiles strictly above the causal diagonal.
-    run = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+    # Skip tiles strictly above the causal diagonal, and tiles whose q/k
+    # SEGMENTS cannot overlap (packed rows concatenate unrelated sequences;
+    # ids are non-decreasing along the row, so a disjoint id range means
+    # the whole tile is masked — this turns O(row^2) into near
+    # block-diagonal work).
+    causal_ok = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+    sq = seg_q_ref[0][:, 0]
+    sk = seg_k_ref[0][0, :]
+    overlap = (
+        (jnp.min(sk) <= jnp.max(sq))
+        & (jnp.max(sk) >= jnp.min(sq))
+        & (jnp.max(sq) > 0)
+    )
+    run = causal_ok & overlap
 
     @pl.when(run)
     def _compute():
@@ -203,7 +216,15 @@ def _dq_kernel(
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+    causal_ok = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+    sq = seg_q_ref[0][:, 0]
+    sk = seg_k_ref[0][0, :]
+    overlap = (
+        (jnp.min(sk) <= jnp.max(sq))
+        & (jnp.max(sk) >= jnp.min(sq))
+        & (jnp.max(sq) > 0)
+    )
+    run = causal_ok & overlap
 
     @pl.when(run)
     def _compute():
@@ -255,7 +276,15 @@ def _dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+    causal_ok = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+    sq = seg_q_ref[0][:, 0]
+    sk = seg_k_ref[0][0, :]
+    overlap = (
+        (jnp.min(sk) <= jnp.max(sq))
+        & (jnp.max(sk) >= jnp.min(sq))
+        & (jnp.max(sq) > 0)
+    )
+    run = causal_ok & overlap
 
     @pl.when(run)
     def _compute():

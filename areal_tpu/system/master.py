@@ -95,6 +95,7 @@ class MasterWorker:
             k: list(v) for k, v in (model_replicas or {}).items()
         }
         self.difficulty_filter = difficulty_filter
+        self._filtered_ids: List[str] = []
         self.data_worker_ids = data_worker_ids
         self.ctrl = ctrl
         self.fileroot = fileroot
@@ -497,7 +498,7 @@ class MasterWorker:
         drop = [sid for sid, a in accs.items() if a < lo or a > hi]
         if not drop:
             return
-        await asyncio.gather(
+        resps = await asyncio.gather(
             *[
                 self.pool.request(
                     w, {"type": "filter_dataset", "ids": drop}
@@ -505,9 +506,11 @@ class MasterWorker:
                 for w in self.data_worker_ids
             ]
         )
+        removed = sum(int(r.get("removed") or 0) for r in resps)
+        self._filtered_ids.extend(drop)
         logger.info(
-            f"difficulty filter: removed {len(drop)}/{len(accs)} prompts "
-            f"outside accuracy [{lo}, {hi}]"
+            f"difficulty filter: removed {removed} prompts "
+            f"({len(drop)}/{len(accs)} flagged outside accuracy [{lo}, {hi}])"
         )
 
     async def _clear_worker_caches(self):
@@ -588,6 +591,7 @@ class MasterWorker:
                     w: s["states"]
                     for w, s in zip(self.data_worker_ids, states)
                 },
+                used_data_ids=list(self._filtered_ids),
             )
             recover.dump(
                 info,
@@ -654,6 +658,19 @@ class MasterWorker:
             for hook in node.post_hooks:
                 await self._run_hook(hook, node, group)
             logger.info(f"restored {node.model_name} from {d}")
+        # Re-apply difficulty filtering BEFORE rewinding cursors so the
+        # dataset the replay walks matches the pre-crash one.
+        filtered = getattr(info, "used_data_ids", None) or []
+        if filtered:
+            self._filtered_ids = list(filtered)
+            await asyncio.gather(
+                *[
+                    self.pool.request(
+                        w, {"type": "filter_dataset", "ids": filtered}
+                    )
+                    for w in self.data_worker_ids
+                ]
+            )
         data_states = getattr(info, "data_states", None) or {}
         await asyncio.gather(
             *[

@@ -536,9 +536,10 @@ class ModelWorker:
         return {}
 
     def _handle_filter_dataset(self, req):
+        removed = 0
         for ds in self.datasets:
-            ds.filter(req["ids"])
-        return {}
+            removed += int(ds.filter(req["ids"]) or 0)
+        return {"removed": removed}
 
     def _handle_ping(self, req):
         return {"pong": self.config.worker_index}

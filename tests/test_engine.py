@@ -48,8 +48,15 @@ class TestPacking:
         assert packing.bucket_len(128) == 128
         assert packing.bucket_len(129) == 256
         assert packing.bucket_len(1000) == 1024
+        # Training rows keep coarse (1024) buckets: every new shape costs
+        # a full fwd+bwd compile.
         assert packing.bucket_len(1025) == 2048
         assert packing.bucket_len(30000) == 30720
+        # Decode cache windows bucket finer (256 above 1024): every decode
+        # step streams the whole window.
+        assert packing.decode_bucket_len(1025) == 1280
+        assert packing.decode_bucket_len(1153) == 1280
+        assert packing.decode_bucket_len(512) == 512
 
     def test_misaligned_extra_key_rejected(self, rng):
         sample = fixtures.random_sample(rng, ids=["a", "b"])
