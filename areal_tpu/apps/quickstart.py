@@ -145,6 +145,10 @@ def _ctrl(args) -> ExperimentSaveEvalControl:
 
 
 def _run(plan, args):
+    # Deferred here so `--help`/arg errors never pay the jax import.
+    from areal_tpu.base import compilation_cache
+
+    compilation_cache.enable()
     from areal_tpu.apps import main as runner
 
     if args.multiprocess:

@@ -32,7 +32,9 @@ def main():
             "jax_platforms", os.environ["AREAL_WORKER_PLATFORM"]
         )
 
-    from areal_tpu.base import logging, seeding
+    from areal_tpu.base import compilation_cache, logging, seeding
+
+    compilation_cache.enable()
     from areal_tpu.system.stream import run_worker_stream
     from areal_tpu.system.transfer import ZMQTransfer
     from areal_tpu.system.worker import ModelWorker

@@ -402,7 +402,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
 
 def run_experiment(plan: ExperimentPlan, tokenizer=None):
     """In-process runner: build workers, drive the master loop to completion.
-    (The multi-process ZMQ runtime lives in areal_tpu/system/zmq_runtime.py.)
+    (The multi-process ZMQ runtime is areal_tpu/apps/main.py run_experiment.)
     """
     import asyncio
 

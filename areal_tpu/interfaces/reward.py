@@ -33,6 +33,11 @@ class MultiTaskRewardInterface(ModelInterface):
     dataset_path: Optional[str] = None
     reward_value: float = 5.0
     code_timeout_s: float = 8.0
+    # http://host:port of a reward_service.py deployment; verification is
+    # batched to it (local fallback on failure).  None = grade in-process.
+    remote_url: Optional[str] = None
+    # Generous default: code batches can run minutes of sandboxed tests.
+    remote_timeout_s: float = 600.0
 
     def __post_init__(self):
         if self.dataset_path and not self.id2info:
@@ -56,10 +61,9 @@ class MultiTaskRewardInterface(ModelInterface):
         tokens = np.asarray(sample.data["packed_input_ids"])
         pmask = np.asarray(sample.data["prompt_mask"])
         bounds = sample.cu_seqlens("packed_input_ids")
-        rewards: List[float] = []
         seqlens_r: List[List[int]] = []
+        todo: List[Dict[str, Any]] = []
         si = 0
-        n_correct = 0
         for ei, group in enumerate(sample.seqlens["packed_input_ids"]):
             qid = str(sample.ids[ei])
             info = self.id2info.get(qid, {})
@@ -69,10 +73,30 @@ class MultiTaskRewardInterface(ModelInterface):
                 lo, hi = bounds[si], bounds[si + 1]
                 resp_tokens = tokens[lo:hi][~pmask[lo:hi].astype(bool)]
                 text = tokenizer.decode(resp_tokens.tolist())
-                ok = self._verify(task, text, info)
-                n_correct += int(ok)
-                rewards.append(self.reward_value if ok else -self.reward_value)
+                todo.append(
+                    {
+                        "task": task,
+                        "text": text,
+                        "solutions": info.get("solutions") or [],
+                        "input_output": info.get("input_output"),
+                        "timeout_s": self.code_timeout_s,
+                    }
+                )
                 si += 1
+        if self.remote_url:
+            from areal_tpu.interfaces.reward_service import RemoteVerifier
+
+            oks = RemoteVerifier(
+                self.remote_url, timeout_s=self.remote_timeout_s
+            ).verify_batch(todo)
+        else:
+            oks = [
+                self._verify(it["task"], it["text"], it) for it in todo
+            ]
+        n_correct = sum(map(int, oks))
+        rewards = [
+            self.reward_value if ok else -self.reward_value for ok in oks
+        ]
         logger.info(
             f"reward verification: {n_correct}/{len(rewards)} correct"
         )

@@ -1,0 +1,168 @@
+"""Remote reward verification service (reward FaaS).
+
+Capability parity: realhf/functioncall/ (the HTTP verification service the
+reference calls for math/code grading at scale, functioncall/math/verify.py
++ the FaaS deployment it wraps) — a stdlib HTTP server exposing the SAME
+local graders (`verify_math`, code execution) so verification can run on
+separate CPU hosts instead of stealing cycles from TPU workers, plus a
+client with transparent local fallback.
+
+Server:
+    python -m areal_tpu.interfaces.reward_service --port 8090
+Client (used by MultiTaskRewardInterface when `remote_url` is set):
+    RemoteVerifier("http://host:8090").verify_batch(items)
+"""
+
+import dataclasses
+import json
+import os
+import threading
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("reward_service")
+
+
+def _grade_one(item: Dict[str, Any]) -> bool:
+    from areal_tpu.interfaces import math_verify
+    from areal_tpu.interfaces.reward import MultiTaskRewardInterface
+
+    task = item.get("task", "math")
+    if task == "math":
+        return bool(
+            math_verify.verify_math(
+                item.get("text", ""), item.get("solutions") or []
+            )
+        )
+    if task == "code":
+        iface = MultiTaskRewardInterface(
+            code_timeout_s=float(item.get("timeout_s", 8.0))
+        )
+        return bool(
+            iface._verify_code(
+                item.get("text", ""),
+                {"input_output": item.get("input_output")},
+            )
+        )
+    return False
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # route through our logger
+        logger.debug(fmt % args)
+
+    def _send(self, code: int, payload: Dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/health":
+            self._send(200, {"status": "ok"})
+        else:
+            self._send(404, {"error": "unknown path"})
+
+    def do_POST(self):
+        if self.path != "/verify":
+            self._send(404, {"error": "unknown path"})
+            return
+        token = getattr(self.server, "auth_token", None)
+        if token and self.headers.get("X-Areal-Token") != token:
+            self._send(403, {"error": "bad token"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n))
+            items = req["items"]
+            # Code grading runs sandboxed subprocesses with multi-second
+            # timeouts; grade the batch in parallel.
+            with ThreadPoolExecutor(max_workers=8) as ex:
+                results = list(ex.map(_grade_one, items))
+            self._send(200, {"results": results})
+        except Exception as e:  # noqa: BLE001 — report to the client
+            self._send(500, {"error": repr(e)})
+
+
+def serve(
+    host: str = "127.0.0.1",
+    port: int = 8090,
+    background: bool = False,
+    token: str = "",
+) -> ThreadingHTTPServer:
+    """Run the verification server; `background=True` returns immediately
+    with the server thread running (tests / embedded use).
+
+    Code grading EXECUTES submitted programs: the default bind is loopback,
+    and any non-loopback deployment should set a shared token
+    (--token / AREAL_REWARD_TOKEN; clients send X-Areal-Token)."""
+    srv = ThreadingHTTPServer((host, port), _Handler)
+    srv.auth_token = token or os.environ.get("AREAL_REWARD_TOKEN", "")
+    logger.info(f"reward service listening on {host}:{srv.server_port}")
+    if background:
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return srv
+
+
+@dataclasses.dataclass
+class RemoteVerifier:
+    """Client for the reward service with local fallback.
+
+    The reference tolerates FaaS flakiness by retrying then falling back;
+    here a failed round-trip falls back to in-process grading so a dead
+    service degrades throughput, never correctness."""
+
+    url: str
+    timeout_s: float = 600.0
+    token: str = ""
+
+    def verify_batch(self, items: List[Dict[str, Any]]) -> List[bool]:
+        try:
+            headers = {"Content-Type": "application/json"}
+            tok = self.token or os.environ.get("AREAL_REWARD_TOKEN", "")
+            if tok:
+                headers["X-Areal-Token"] = tok
+            req = urllib.request.Request(
+                self.url.rstrip("/") + "/verify",
+                data=json.dumps({"items": items}).encode(),
+                headers=headers,
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                out = json.loads(r.read())
+            results = [bool(x) for x in out["results"]]
+            if len(results) != len(items):
+                raise ValueError("result length mismatch")
+            return results
+        except Exception as e:  # noqa: BLE001 — degrade to local grading
+            logger.warning(
+                f"remote verification failed ({e!r}); grading locally"
+            )
+            return [_grade_one(it) for it in items]
+
+
+def main():
+    import argparse
+
+    p = argparse.ArgumentParser(prog="areal_tpu.interfaces.reward_service")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address; non-loopback binds should set --token")
+    p.add_argument("--port", type=int, default=8090)
+    p.add_argument("--token", default="",
+                   help="shared secret (or AREAL_REWARD_TOKEN)")
+    args = p.parse_args()
+    serve(args.host, args.port, token=args.token)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,10 @@ def evaluate_checkpoint(
     import jax
     import numpy as np
 
+    from areal_tpu.base import compilation_cache
+
+    compilation_cache.enable()
+
     from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
     from areal_tpu.api.model_api import GenerationHyperparameters
     from areal_tpu.base.topology import ParallelConfig, make_mesh

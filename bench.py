@@ -35,6 +35,10 @@ def main(size: str = "1.5b"):
     import jax
     import jax.numpy as jnp
 
+    from areal_tpu.base import compilation_cache
+
+    compilation_cache.enable()
+
     from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
     from areal_tpu.api.model_api import (
         FinetuneSpec,

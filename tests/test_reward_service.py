@@ -1,0 +1,82 @@
+"""Remote reward verification service (functioncall FaaS parity):
+server round-trip, local fallback, and the reward interface's remote path."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from areal_tpu.interfaces.reward_service import RemoteVerifier, serve
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = serve("127.0.0.1", 0, background=True)
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_health_and_verify_roundtrip(server):
+    with urllib.request.urlopen(server + "/health", timeout=5) as r:
+        assert json.loads(r.read())["status"] == "ok"
+    v = RemoteVerifier(server)
+    items = [
+        {"task": "math", "text": r"the answer is \boxed{\frac{1}{2}}",
+         "solutions": [r"\boxed{0.5}"]},
+        {"task": "math", "text": r"\boxed{3}", "solutions": [r"\boxed{4}"]},
+        {"task": "code",
+         "text": "```python\nprint(input())\n```",
+         "input_output": json.dumps(
+             {"inputs": ["hi"], "outputs": ["hi"]}
+         )},
+    ]
+    assert v.verify_batch(items) == [True, False, True]
+
+
+def test_local_fallback_on_dead_service():
+    v = RemoteVerifier("http://127.0.0.1:1", timeout_s=0.5)
+    items = [
+        {"task": "math", "text": r"\boxed{7}", "solutions": [r"\boxed{7}"]}
+    ]
+    assert v.verify_batch(items) == [True]
+
+
+def test_reward_interface_remote_path(server):
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model_api import Model
+    from areal_tpu.interfaces.reward import MultiTaskRewardInterface
+    from tests import fixtures
+
+    tok = fixtures.make_tokenizer()
+    right = tok.encode(r"\boxed{4}")
+    wrong = tok.encode(r"\boxed{5}")
+    parts = []
+    for qid, resp in [("q0", right), ("q1", wrong)]:
+        toks = np.asarray(list(resp), np.int32)
+        parts.append(
+            SequenceSample(
+                keys={"packed_input_ids", "prompt_mask"},
+                ids=[qid],
+                seqlens={
+                    "packed_input_ids": [[len(toks)]],
+                    "prompt_mask": [[len(toks)]],
+                },
+                data={
+                    "packed_input_ids": toks,
+                    "prompt_mask": np.zeros(len(toks), bool),
+                },
+            )
+        )
+    sample = SequenceSample.gather(parts)
+    iface = MultiTaskRewardInterface(
+        id2info={
+            "q0": {"task": "math", "solutions": [r"\boxed{4}"]},
+            "q1": {"task": "math", "solutions": [r"\boxed{4}"]},
+        },
+        remote_url=server,
+    )
+    model = Model("reward", engine=None, tokenizer=tok, config=None)
+    out = iface.inference(model, sample, MicroBatchSpec())
+    r = np.asarray(out.data["rewards"])
+    assert r[0] > 0 and r[1] < 0
