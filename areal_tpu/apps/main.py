@@ -130,11 +130,24 @@ def run_experiment(
 
     last_err = None
     for attempt in range(recover_retries + 1):
+        # Workers must import areal_tpu regardless of the launcher's cwd
+        # (the package is not pip-installed; reference relies on install).
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        pkg_root = os.path.dirname(pkg_root)  # dir containing areal_tpu/
+        pythonpath = os.environ.get("PYTHONPATH", "")
+        if pkg_root not in pythonpath.split(os.pathsep):
+            pythonpath = (
+                f"{pkg_root}{os.pathsep}{pythonpath}" if pythonpath
+                else pkg_root
+            )
         sched = make_scheduler(
             scheduler_mode,
             plan.experiment_name,
             plan.trial_name,
             env={
+                "PYTHONPATH": pythonpath,
                 "AREAL_NAME_RESOLVE": "file",
                 "AREAL_NAME_RESOLVE_ROOT": root,
                 # Colocated workers default to CPU: one process owns the TPU

@@ -54,15 +54,27 @@ def main():
             process_id=config.dist_process_id,
             num_processes=config.dist_num_processes,
         )
+    # Lifecycle side channel (ping/pause/resume/exit + TTL keepalive) —
+    # reference: worker_base.py WorkerServer, bound before the model build
+    # so the controller can see the worker during its (slow) setup.
+    from areal_tpu.system.worker_control import WorkerServer, WorkerState
+
+    control = WorkerServer(
+        args.experiment, args.trial, f"model_worker/{args.index}"
+    )
     # Bulk worker-to-worker plane (data/param transfers planned by the
     # master); bound before model build so peers can connect early.
     transfer = ZMQTransfer(args.experiment, args.trial, args.index)
     worker = ModelWorker(config, transfer=transfer)
+    control.state = WorkerState.RUNNING
     logger.info(f"worker {args.index} ready, serving stream")
     try:
-        run_worker_stream(worker, args.experiment, args.trial)
+        run_worker_stream(
+            worker, args.experiment, args.trial, control=control
+        )
     finally:
         transfer.close()
+        control.stop()
     logger.info(f"worker {args.index} exiting")
 
 

@@ -49,3 +49,11 @@ def experiment_status(experiment_name: str, trial_name: str) -> str:
 
 def worker_key(experiment_name: str, trial_name: str, key: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/worker_key/{key}"
+
+
+def worker_control(experiment_name: str, trial_name: str, worker_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/control/{worker_name}"
+
+
+def worker_keepalive(experiment_name: str, trial_name: str, worker_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/keepalive/{worker_name}"

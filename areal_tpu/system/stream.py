@@ -147,6 +147,7 @@ def run_worker_stream(
     experiment_name: str,
     trial_name: str,
     timeout: float = 300.0,
+    control=None,  # Optional[worker_control.WorkerServer]
 ) -> None:
     """Worker side: connect, announce, serve requests until 'exit'."""
     import queue
@@ -192,11 +193,21 @@ def run_worker_stream(
 
     try:
         while True:
+            # Controller-initiated exit (side channel; see worker_control).
+            if control is not None and control.state.value == "exiting":
+                for t in threads:  # in-flight transfers finish first
+                    t.join(timeout=timeout)
+                _drain_replies()
+                break
             if not sock.poll(100):
                 _drain_replies()
                 continue
             msg = pickle.loads(sock.recv())
             req = msg["request"]
+            # A paused worker holds requests until the controller resumes it
+            # (reference: worker_base.py PAUSED state gating _poll).
+            if control is not None and req.get("type") != "exit":
+                control.wait_if_paused()
             if req.get("type") == "exit":
                 for t in threads:
                     t.join(timeout=timeout)

@@ -1,0 +1,286 @@
+"""Worker lifecycle control: per-worker command server + controller panel.
+
+Capability parity: realhf/system/worker_base.py:71-460 (`Worker` state
+machine + `WorkerServer`) and realhf/system/worker_control.py (ZMQ
+implementation), condensed for the TPU runtime: the heavy data path stays
+on the master request-reply stream (areal_tpu/system/stream.py); this is a
+SIDE channel the controller uses to configure, pause/resume, ping, and
+stop workers independently of in-flight MFC traffic, plus TTL-keepalive
+liveness detection (reference: name_resolve keepalive keys,
+worker_base.py + name_resolve.py keepalive).
+
+Lifecycle states mirror the reference's WorkerServerStatus:
+READY -> CONFIGURED -> RUNNING <-> PAUSED -> EXITING.
+"""
+
+import enum
+import os
+import pickle
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import zmq
+
+from areal_tpu.base import logging, name_resolve, names, network
+
+logger = logging.getLogger("worker_control")
+
+KEEPALIVE_TTL = 10.0  # seconds; panel treats older entries as dead
+
+
+class WorkerState(str, enum.Enum):
+    READY = "ready"
+    CONFIGURED = "configured"
+    RUNNING = "running"
+    PAUSED = "paused"
+    EXITING = "exiting"
+    ERROR = "error"
+
+
+class WorkerServer:
+    """Worker-side command server.
+
+    Serves controller commands on a dedicated REP socket from a daemon
+    thread.  Built-in commands: ping / status / configure / start / pause /
+    resume / exit.  Extra commands come from `register_handler`.  `pause`
+    blocks the owning worker's serve loop via `wait_if_paused()` until
+    `resume` (reference: worker_base.py PAUSED state).
+    """
+
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        worker_name: str,
+        keepalive_ttl: float = KEEPALIVE_TTL,
+    ):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.worker_name = worker_name
+        self.state = WorkerState.READY
+        self.config: Any = None
+        # Shared-secret auth (same pattern as the reward service's
+        # X-Areal-Token): set AREAL_WORKER_TOKEN on both sides to require
+        # it; unset = open, for single-host trials behind a firewall.
+        self._token = os.environ.get("AREAL_WORKER_TOKEN", "")
+        self._handlers: Dict[str, Callable[[Dict], Any]] = {}
+        self._not_paused = threading.Event()
+        self._not_paused.set()
+        self._stop = threading.Event()
+        self.exited = threading.Event()
+
+        self._ctx = zmq.Context()
+        self._sock = self._ctx.socket(zmq.REP)
+        port = self._sock.bind_to_random_port("tcp://*")
+        self._addr = f"tcp://{network.gethostip()}:{port}"
+        name_resolve.add(
+            names.worker_control(experiment_name, trial_name, worker_name),
+            self._addr,
+            replace=True,
+        )
+        self._keepalive_name = names.worker_keepalive(
+            experiment_name, trial_name, worker_name
+        )
+        self._keepalive_ttl = keepalive_ttl
+        name_resolve.add(
+            self._keepalive_name,
+            str(time.time()),
+            keepalive_ttl=keepalive_ttl,
+            replace=True,
+        )
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        logger.info(f"worker {worker_name} control server at {self._addr}")
+
+    def register_handler(self, command: str, fn: Callable[[Dict], Any]):
+        self._handlers[command] = fn
+
+    @property
+    def paused(self) -> bool:
+        return not self._not_paused.is_set()
+
+    def wait_if_paused(self, timeout: Optional[float] = None) -> bool:
+        """Called by the owning worker's serve loop before each request."""
+        return self._not_paused.wait(timeout)
+
+    def _handle(self, command: str, payload: Dict) -> Any:
+        if command == "ping":
+            return {"state": self.state.value, "name": self.worker_name}
+        if command == "status":
+            return {"state": self.state.value}
+        if command == "configure":
+            self.config = payload.get("config")
+            self.state = WorkerState.CONFIGURED
+            return {"state": self.state.value}
+        if command == "start":
+            self.state = WorkerState.RUNNING
+            return {"state": self.state.value}
+        if command == "pause":
+            self._not_paused.clear()
+            self.state = WorkerState.PAUSED
+            return {"state": self.state.value}
+        if command == "resume":
+            self._not_paused.set()
+            self.state = WorkerState.RUNNING
+            return {"state": self.state.value}
+        if command == "exit":
+            self.state = WorkerState.EXITING
+            self._not_paused.set()  # never leave the serve loop stuck
+            self._stop.set()
+            return {"state": self.state.value}
+        if command in self._handlers:
+            return self._handlers[command](payload)
+        raise ValueError(f"unknown control command {command!r}")
+
+    def _serve(self):
+        poller = zmq.Poller()
+        poller.register(self._sock, zmq.POLLIN)
+        last_touch = time.time()
+        try:
+            while not self._stop.is_set():
+                # Refresh the liveness key well inside its TTL.
+                now = time.time()
+                if now - last_touch > self._keepalive_ttl / 3:
+                    try:
+                        name_resolve.default().touch(self._keepalive_name)
+                    except Exception:
+                        pass
+                    last_touch = now
+                if not poller.poll(200):
+                    continue
+                raw = self._sock.recv()
+                # REP sockets require exactly one send per recv: every
+                # failure mode after a successful recv (bad pickle, bad
+                # token, handler error) must still produce a reply, or the
+                # socket deadlocks and the control thread dies.
+                try:
+                    msg = pickle.loads(raw)
+                    if self._token and msg.get("token") != self._token:
+                        raise PermissionError("bad control token")
+                    result = self._handle(
+                        msg.get("command"), msg.get("payload") or {}
+                    )
+                    reply = {"result": result}
+                except Exception as e:  # noqa: BLE001 — forwarded to panel
+                    reply = {"error": repr(e)}
+                self._sock.send(pickle.dumps(reply))
+        finally:
+            self._sock.close(linger=0)
+            self._ctx.term()
+            self.exited.set()
+
+    def stop(self):
+        self._stop.set()
+        self._not_paused.set()
+        self.exited.wait(timeout=5.0)
+
+
+class WorkerControlPanel:
+    """Controller side: discover worker control servers, issue commands.
+
+    Reference: worker_base.py WorkerControlPanel (group configure/start/
+    ping over ZMQ or Ray queues).
+    """
+
+    def __init__(self, experiment_name: str, trial_name: str):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self._ctx = zmq.Context()
+        self._socks: Dict[str, zmq.Socket] = {}
+        self._addrs: Dict[str, str] = {}
+        self._token = os.environ.get("AREAL_WORKER_TOKEN", "")
+
+    def connect(self, worker_names: List[str], timeout: float = 60.0):
+        deadline = time.time() + timeout
+        for wn in worker_names:
+            addr = name_resolve.wait(
+                names.worker_control(
+                    self.experiment_name, self.trial_name, wn
+                ),
+                timeout=max(0.1, deadline - time.time()),
+            )
+            self._addrs[wn] = addr
+            self._socks[wn] = self._fresh_sock(addr)
+
+    def _fresh_sock(self, addr: str) -> zmq.Socket:
+        sock = self._ctx.socket(zmq.REQ)
+        sock.connect(addr)
+        return sock
+
+    @property
+    def worker_names(self) -> List[str]:
+        return list(self._socks)
+
+    def _send(self, worker_name: str, command: str, payload: Optional[Dict]):
+        self._socks[worker_name].send(
+            pickle.dumps(
+                {"command": command, "payload": payload, "token": self._token}
+            )
+        )
+
+    def _recv(self, worker_name: str, command: str, deadline: float) -> Any:
+        sock = self._socks[worker_name]
+        if not sock.poll(max(0, int((deadline - time.time()) * 1000))):
+            # A REQ socket with an unanswered send can never send again;
+            # replace it so the channel survives a slow/stuck worker.
+            sock.close(linger=0)
+            self._socks[worker_name] = self._fresh_sock(
+                self._addrs[worker_name]
+            )
+            raise TimeoutError(
+                f"worker {worker_name} did not answer {command!r}"
+            )
+        reply = pickle.loads(sock.recv())
+        if "error" in reply:
+            raise RuntimeError(
+                f"worker {worker_name} {command!r} failed: {reply['error']}"
+            )
+        return reply["result"]
+
+    def request(
+        self,
+        worker_name: str,
+        command: str,
+        payload: Optional[Dict] = None,
+        timeout: float = 60.0,
+    ) -> Any:
+        self._send(worker_name, command, payload)
+        return self._recv(worker_name, command, time.time() + timeout)
+
+    def group_request(
+        self,
+        command: str,
+        payloads: Optional[Dict[str, Dict]] = None,
+        timeout: float = 60.0,
+    ) -> Dict[str, Any]:
+        """Send `command` to every connected worker, then gather replies —
+        group latency is max-of-workers, not sum (each worker has its own
+        REQ socket, so the sends all go out before any reply is awaited)."""
+        for wn in self._socks:
+            self._send(wn, command, (payloads or {}).get(wn))
+        deadline = time.time() + timeout
+        return {
+            wn: self._recv(wn, command, deadline) for wn in self._socks
+        }
+
+    def check_liveness(self) -> Dict[str, bool]:
+        """TTL-keepalive liveness per worker (reference: name_resolve
+        keepalive keys; a worker whose server thread stalls past the TTL
+        reads as dead)."""
+        alive = {}
+        for wn in self._socks:
+            key = names.worker_keepalive(
+                self.experiment_name, self.trial_name, wn
+            )
+            try:
+                name_resolve.get(key)
+                alive[wn] = True
+            except name_resolve.NameEntryNotFoundError:
+                alive[wn] = False
+        return alive
+
+    def close(self):
+        for sock in self._socks.values():
+            sock.close(linger=0)
+        self._ctx.term()

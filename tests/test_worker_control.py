@@ -1,0 +1,113 @@
+"""Worker lifecycle control panel (reference: system/worker_base.py:71-460,
+system/worker_control.py): configure/start/ping/pause/resume/exit over the
+per-worker command server, TTL keepalive liveness, and the pause gate
+holding the stream serve loop."""
+
+import threading
+import time
+
+import pytest
+
+from areal_tpu.base import name_resolve
+from areal_tpu.system.worker_control import (
+    WorkerControlPanel,
+    WorkerServer,
+    WorkerState,
+)
+
+
+@pytest.fixture
+def panel_and_servers():
+    servers = [
+        WorkerServer("ctltest", "t0", f"model_worker/{i}", keepalive_ttl=1.0)
+        for i in range(2)
+    ]
+    panel = WorkerControlPanel("ctltest", "t0")
+    panel.connect([s.worker_name for s in servers], timeout=10.0)
+    yield panel, servers
+    for s in servers:
+        s.stop()
+    panel.close()
+
+
+def test_lifecycle_commands(panel_and_servers):
+    panel, servers = panel_and_servers
+
+    out = panel.group_request("ping")
+    assert all(r["state"] == "ready" for r in out.values())
+
+    out = panel.group_request(
+        "configure",
+        payloads={s.worker_name: {"config": {"seed": 7}} for s in servers},
+    )
+    assert all(r["state"] == "configured" for r in out.values())
+    assert servers[0].config == {"seed": 7}
+
+    panel.group_request("start")
+    assert servers[0].state == WorkerState.RUNNING
+
+    panel.request(servers[0].worker_name, "pause")
+    assert servers[0].paused and not servers[1].paused
+    panel.request(servers[0].worker_name, "resume")
+    assert not servers[0].paused
+
+    panel.group_request("exit")
+    for s in servers:
+        assert s.exited.wait(timeout=5.0)
+
+
+def test_custom_handler_and_errors(panel_and_servers):
+    panel, servers = panel_and_servers
+    servers[0].register_handler("stats", lambda p: {"echo": p["x"] * 2})
+    assert panel.request(
+        servers[0].worker_name, "stats", {"x": 21}
+    ) == {"echo": 42}
+    with pytest.raises(RuntimeError, match="unknown control command"):
+        panel.request(servers[0].worker_name, "nope")
+
+
+def test_pause_gates_work(panel_and_servers):
+    """wait_if_paused blocks until resume — the stream loop's gate."""
+    panel, servers = panel_and_servers
+    s = servers[0]
+    panel.request(s.worker_name, "pause")
+
+    done = threading.Event()
+
+    def worker_loop():
+        s.wait_if_paused()
+        done.set()
+
+    t = threading.Thread(target=worker_loop, daemon=True)
+    t.start()
+    assert not done.wait(timeout=0.3)
+    panel.request(s.worker_name, "resume")
+    assert done.wait(timeout=5.0)
+    t.join(timeout=5.0)
+
+
+def test_timeout_recovers_req_socket(panel_and_servers):
+    """A timed-out request must not poison the REQ channel (the panel
+    replaces the socket, so the next attempt raises Timeout again instead
+    of zmq EFSM)."""
+    panel, servers = panel_and_servers
+    servers[0].stop()  # serve thread gone: requests will never be answered
+    for _ in range(2):
+        with pytest.raises(TimeoutError):
+            panel.request(servers[0].worker_name, "ping", timeout=0.3)
+    # The healthy worker is unaffected.
+    assert panel.request(servers[1].worker_name, "ping")["state"] == "ready"
+
+
+def test_keepalive_liveness(panel_and_servers):
+    panel, servers = panel_and_servers
+    assert panel.check_liveness() == {
+        s.worker_name: True for s in servers
+    }
+    # Stop one server thread: its keepalive key stops refreshing and
+    # expires after the TTL.
+    servers[0].stop()
+    time.sleep(1.5)
+    alive = panel.check_liveness()
+    assert alive[servers[0].worker_name] is False
+    assert alive[servers[1].worker_name] is True
