@@ -165,6 +165,11 @@ class PPOMathConfig:
     # Host-offload the reference model's params after each ref_inf call
     # (OffloadHook; frees its HBM between steps).
     offload_ref: bool = False
+    # Run reward verification and ref-model inference as ONE fused MFC on
+    # the ref worker (reference: FusedThreadingForwardInterface,
+    # ppo_math_exp.py:132-136) — CPU reward grading overlaps the device
+    # forward.  Requires a ref model.
+    fuse_rew_ref: bool = False
     # Model role -> worker index (e.g. {"actor_gen": 1} puts generation on a
     # second worker; the data/param planes move bytes between them) or a
     # LIST of worker indices (independent replicas: generate/inference
@@ -220,38 +225,73 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
             mb_spec=cfg.mb_spec,
             pre_hooks=[],
         ),
-        MFCDef(
-            name="rew_inf",
-            model_name=reward,
-            interface_type=ModelInterfaceType.INFERENCE,
-            interface_impl=ModelInterfaceAbstraction(
-                "rw-math-code", cfg.reward_interface_args
-            ),
-            input_keys=("packed_input_ids", "prompt_mask"),
-            output_keys=("rewards",),
-            n_seqs=cfg.batch_size,
-            mb_spec=cfg.mb_spec,
-        ),
     ]
-    train_inputs = [
-        "packed_input_ids", "prompt_mask", "packed_logprobs",
-        "seq_no_eos_mask", "rewards",
-    ]
-    if ref is not None:
+    fuse = cfg.fuse_rew_ref and ref is not None
+    fused_if = ModelInterfaceAbstraction(
+        "fused",
+        {
+            "interfaces": {
+                "rew": {
+                    "type_": "rw-math-code",
+                    "args": cfg.reward_interface_args,
+                },
+                "ref": {"type_": "ppo_actor", "args": {}},
+            }
+        },
+    )
+    if fuse:
+        # One MFC on the ref worker grades rewards (CPU process pool) while
+        # the ref forward runs on device (reference: "fused-threading" MFC,
+        # ppo_math_exp.py:132-136).
         nodes.append(
             MFCDef(
-                name="ref_inf",
+                name="fused_rew_ref",
                 model_name=ref,
                 interface_type=ModelInterfaceType.INFERENCE,
-                interface_impl=ModelInterfaceAbstraction("ppo_actor"),
-                input_keys=("packed_input_ids",),
-                output_keys=("packed_ref_logprobs",),
+                interface_impl=fused_if,
+                input_keys=("packed_input_ids", "prompt_mask"),
+                output_keys=("rewards", "packed_ref_logprobs"),
                 output_key_remap={"logprobs": "packed_ref_logprobs"},
                 n_seqs=cfg.batch_size,
                 mb_spec=cfg.mb_spec,
                 post_hooks=[OffloadHook()] if cfg.offload_ref else [],
             )
         )
+    else:
+        nodes.append(
+            MFCDef(
+                name="rew_inf",
+                model_name=reward,
+                interface_type=ModelInterfaceType.INFERENCE,
+                interface_impl=ModelInterfaceAbstraction(
+                    "rw-math-code", cfg.reward_interface_args
+                ),
+                input_keys=("packed_input_ids", "prompt_mask"),
+                output_keys=("rewards",),
+                n_seqs=cfg.batch_size,
+                mb_spec=cfg.mb_spec,
+            )
+        )
+    train_inputs = [
+        "packed_input_ids", "prompt_mask", "packed_logprobs",
+        "seq_no_eos_mask", "rewards",
+    ]
+    if ref is not None:
+        if not fuse:
+            nodes.append(
+                MFCDef(
+                    name="ref_inf",
+                    model_name=ref,
+                    interface_type=ModelInterfaceType.INFERENCE,
+                    interface_impl=ModelInterfaceAbstraction("ppo_actor"),
+                    input_keys=("packed_input_ids",),
+                    output_keys=("packed_ref_logprobs",),
+                    output_key_remap={"logprobs": "packed_ref_logprobs"},
+                    n_seqs=cfg.batch_size,
+                    mb_spec=cfg.mb_spec,
+                    post_hooks=[OffloadHook()] if cfg.offload_ref else [],
+                )
+            )
         train_inputs.append("packed_ref_logprobs")
     if critic is not None:
         nodes.append(
@@ -327,22 +367,28 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
             parallel=cfg.gen_parallel or cfg.actor_parallel,
             device_offset=cfg.gen_device_offset,
         ),
-        ModelShardSpec(
-            name=reward,
-            model=ModelAbstraction("null"),
-            backend=ModelBackendAbstraction("null"),
-            interface=ModelInterfaceAbstraction(
-                "rw-math-code", cfg.reward_interface_args
-            ),
-        ),
     ]
+    if not fuse:
+        shards.append(
+            ModelShardSpec(
+                name=reward,
+                model=ModelAbstraction("null"),
+                backend=ModelBackendAbstraction("null"),
+                interface=ModelInterfaceAbstraction(
+                    "rw-math-code", cfg.reward_interface_args
+                ),
+            )
+        )
     if ref is not None:
         shards.append(
             ModelShardSpec(
                 name=ref,
                 model=cfg.ref,
                 backend=ModelBackendAbstraction("inference"),
-                interface=ModelInterfaceAbstraction("ppo_actor"),
+                interface=(
+                    fused_if if fuse
+                    else ModelInterfaceAbstraction("ppo_actor")
+                ),
                 parallel=cfg.actor_parallel,
                 device_offset=cfg.actor_device_offset,
             )

@@ -1,0 +1,147 @@
+"""Null experiments: exercise the full runtime with zero device compute.
+
+Capability parity: realhf/experiments/common/null_exp.py (NullSFTConfig /
+NullPPOConfig registered for system tests) — a trial whose MFCs use the
+"null" interface and backend, so master/worker dispatch, the data plane,
+buffer readiness, and epoch accounting all run exactly as in a real trial
+while each MFC is a no-op.  Used to smoke-test launchers and schedulers.
+"""
+
+import dataclasses
+
+from areal_tpu.api.config import (
+    ModelAbstraction,
+    ModelBackendAbstraction,
+    ModelInterfaceAbstraction,
+    ModelInterfaceType,
+    ModelName,
+)
+from areal_tpu.api.data_api import DatasetAbstraction, MicroBatchSpec
+from areal_tpu.api.dfg import MFCDef, build_graph
+from areal_tpu.api.model_api import FinetuneSpec
+from areal_tpu.experiments.common import ExperimentPlan
+from areal_tpu.system.master import ExperimentSaveEvalControl
+from areal_tpu.system.worker import ModelShardSpec, WorkerConfig
+
+
+@dataclasses.dataclass
+class NullSFTConfig:
+    dataset: DatasetAbstraction
+    batch_size: int = 8
+    total_train_epochs: int = 1
+    n_workers: int = 1
+    ctrl: ExperimentSaveEvalControl = dataclasses.field(
+        default_factory=ExperimentSaveEvalControl
+    )
+    seed: int = 1
+    experiment_name: str = "null-sft"
+    trial_name: str = "trial"
+    fileroot: str = "/tmp/areal_tpu/trial"
+
+
+def build_null_sft(cfg: NullSFTConfig) -> ExperimentPlan:
+    """Single no-op train MFC over a real dataset (null_exp.py NullSFT)."""
+    model_name = ModelName("default", 0)
+    node = MFCDef(
+        name="trainDefault",
+        model_name=model_name,
+        interface_type=ModelInterfaceType.TRAIN_STEP,
+        interface_impl=ModelInterfaceAbstraction("null"),
+        input_keys=("packed_input_ids", "prompt_mask"),
+        n_seqs=cfg.batch_size,
+        mb_spec=MicroBatchSpec(),
+    )
+    shard = ModelShardSpec(
+        name=model_name,
+        model=ModelAbstraction("null"),
+        backend=ModelBackendAbstraction("null"),
+        interface=ModelInterfaceAbstraction("null"),
+    )
+    ftspec = FinetuneSpec(
+        total_train_epochs=cfg.total_train_epochs,
+        train_batch_size=cfg.batch_size,
+    )
+    worker_configs = [
+        WorkerConfig(
+            worker_index=w,
+            shards=[shard] if w == 0 else [],
+            datasets=[cfg.dataset] if w == 0 else [],
+            batch_size=cfg.batch_size,
+            seed=cfg.seed,
+            ftspec=ftspec,
+        )
+        for w in range(cfg.n_workers)
+    ]
+    cfg.ctrl.total_train_epochs = cfg.total_train_epochs
+    return ExperimentPlan(
+        dfg=build_graph([node]),
+        worker_configs=worker_configs,
+        model_placement={str(model_name): 0},
+        data_worker_ids=[0],
+        ctrl=cfg.ctrl,
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        fileroot=cfg.fileroot,
+    )
+
+
+def build_null_ppo(cfg: NullSFTConfig) -> ExperimentPlan:
+    """Two-MFC null graph (reward inference -> train) over prompt data —
+    the minimal multi-node DFG for runtime tests (null_exp.py NullPPO)."""
+    rew = ModelName("reward", 0)
+    actor = ModelName("actor", 0)
+    nodes = [
+        MFCDef(
+            name="rew_inf",
+            model_name=rew,
+            interface_type=ModelInterfaceType.INFERENCE,
+            interface_impl=ModelInterfaceAbstraction("null"),
+            input_keys=("packed_prompts",),
+            output_keys=("rewards",),
+            n_seqs=cfg.batch_size,
+            mb_spec=MicroBatchSpec(),
+        ),
+        MFCDef(
+            name="actor_train",
+            model_name=actor,
+            interface_type=ModelInterfaceType.TRAIN_STEP,
+            interface_impl=ModelInterfaceAbstraction("null"),
+            input_keys=("packed_prompts", "rewards"),
+            n_seqs=cfg.batch_size,
+            mb_spec=MicroBatchSpec(),
+        ),
+    ]
+    shards = [
+        ModelShardSpec(
+            name=name,
+            model=ModelAbstraction("null"),
+            backend=ModelBackendAbstraction("null"),
+            interface=ModelInterfaceAbstraction("null"),
+        )
+        for name in (rew, actor)
+    ]
+    ftspec = FinetuneSpec(
+        total_train_epochs=cfg.total_train_epochs,
+        train_batch_size=cfg.batch_size,
+    )
+    worker_configs = [
+        WorkerConfig(
+            worker_index=0,
+            shards=shards,
+            datasets=[cfg.dataset],
+            batch_size=cfg.batch_size,
+            seed=cfg.seed,
+            ftspec=ftspec,
+        )
+    ]
+    cfg.ctrl.total_train_epochs = cfg.total_train_epochs
+    return ExperimentPlan(
+        dfg=build_graph(nodes),
+        worker_configs=worker_configs,
+        model_placement={str(rew): 0, str(actor): 0},
+        data_worker_ids=[0],
+        ctrl=cfg.ctrl,
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        fileroot=cfg.fileroot,
+    )

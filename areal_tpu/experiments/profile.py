@@ -1,0 +1,210 @@
+"""Profiling experiment: time each MFC kind across parallel layouts.
+
+Capability parity: realhf/experiments/benchmark/profile_exp.py
+(ProfileConfig enumerates (MFC × ParallelismConfig) and runs each setup
+sequentially, feeding measured timings to logs/the search engine) — TPU
+version drives the engines directly on one process: for every enumerated
+`ParallelConfig` that fits the device count it builds the mesh, runs
+train_batch / forward / generate on synthetic packed batches, and reports
+wall time + analytic TFLOP/s per (mfc, layout).  The output JSON is the
+measured counterpart of the allocation-search estimator
+(areal_tpu/search_engine/estimate.py) and calibrates it against hardware.
+
+Per-layer (rather than per-MFC) timing lives in apps/profile_layers.py.
+"""
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model_api import (
+    FinetuneSpec,
+    GenerationHyperparameters,
+    OptimizerConfig,
+)
+from areal_tpu.base import logging, monitor
+from areal_tpu.base.topology import ParallelConfig, make_mesh
+from areal_tpu.models.config import ModelConfig
+
+logger = logging.getLogger("profile_exp")
+
+
+def decompose_parallel_configs(n_devices: int) -> List[ParallelConfig]:
+    """All (data, fsdp, model) factorizations of n_devices (reference:
+    base/topology.py decompose_to_three_factors feeding profile_exp)."""
+    out = []
+    for data in range(1, n_devices + 1):
+        if n_devices % data:
+            continue
+        rest = n_devices // data
+        for fsdp in range(1, rest + 1):
+            if rest % fsdp:
+                continue
+            model = rest // fsdp
+            out.append(ParallelConfig(data=data, fsdp=fsdp, model=model))
+    return out
+
+
+@dataclasses.dataclass
+class ProfileConfig:
+    model_config: ModelConfig
+    n_devices: int = 1
+    # None = enumerate every (data, fsdp, model) factorization.
+    parallel_configs: Optional[Sequence[ParallelConfig]] = None
+    mfcs: Sequence[str] = ("train_step", "inference", "generate")
+    batch_size: int = 8
+    seqlen: int = 128
+    gen_new_tokens: int = 32
+    n_iters: int = 3
+    seed: int = 0
+    fileroot: str = "/tmp/areal_tpu/profile"
+
+
+def _synthetic_batch(cfg: ModelConfig, bs: int, seqlen: int, seed: int):
+    rng = np.random.default_rng(seed)
+    seqlens = [seqlen] * bs
+    tokens = rng.integers(0, cfg.vocab_size, size=sum(seqlens)).astype(
+        np.int32
+    )
+    pmask = np.zeros(sum(seqlens), bool)
+    off = 0
+    for l in seqlens:
+        pmask[off : off + max(1, l // 4)] = True
+        off += l
+    return SequenceSample(
+        keys={"packed_input_ids", "prompt_mask"},
+        ids=[f"p{i}" for i in range(bs)],
+        seqlens={
+            "packed_input_ids": [[l] for l in seqlens],
+            "prompt_mask": [[l] for l in seqlens],
+        },
+        data={"packed_input_ids": tokens, "prompt_mask": pmask},
+    )
+
+
+def run_profile(cfg: ProfileConfig) -> List[Dict[str, Any]]:
+    import jax
+
+    from areal_tpu.engines.generator import GeneratorEngine
+    from areal_tpu.engines.inference import InferenceEngine
+    from areal_tpu.engines.train import TrainEngine
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.ops import functional as F
+
+    devices = jax.devices()[: cfg.n_devices]
+    if len(devices) < cfg.n_devices:
+        raise ValueError(
+            f"need {cfg.n_devices} devices, have {len(jax.devices())}"
+        )
+    layouts = list(cfg.parallel_configs or decompose_parallel_configs(
+        cfg.n_devices
+    ))
+    mcfg = cfg.model_config
+    rows: List[Dict[str, Any]] = []
+    sample = _synthetic_batch(mcfg, cfg.batch_size, cfg.seqlen, cfg.seed)
+    n_tokens = cfg.batch_size * cfg.seqlen
+    sum_sq = float(cfg.batch_size * cfg.seqlen * cfg.seqlen)
+
+    for pc in layouts:
+        mesh = make_mesh(pc, devices)
+
+        def _time(fn) -> float:
+            fn()  # warmup / compile
+            t0 = time.perf_counter()
+            for _ in range(cfg.n_iters):
+                fn()
+            return (time.perf_counter() - t0) / cfg.n_iters
+
+        for mfc in cfg.mfcs:
+            # Fresh params per engine: TrainEngine donates the incoming
+            # tree to its master copy, deleting the caller's arrays.
+            params = tfm.init_params(mcfg, jax.random.PRNGKey(cfg.seed))
+            try:
+                if mfc == "train_step":
+                    engine = TrainEngine(
+                        mcfg, params, mesh,
+                        optimizer_config=OptimizerConfig(
+                            lr=1e-4, warmup_steps_proportion=0.0
+                        ),
+                        ftspec=FinetuneSpec(1, 1000, 1000),
+                    )
+                    t = _time(lambda: engine.train_batch(
+                        sample, MicroBatchSpec(),
+                        loss_fn=F.sft_loss,
+                        loss_weight_fn=F.sft_label_count,
+                        token_key="packed_input_ids",
+                        extra_keys=("prompt_mask",),
+                    ))
+                    flops = monitor.flops_train(mcfg, n_tokens, sum_sq)
+                elif mfc == "inference":
+                    from areal_tpu.interfaces.ppo import _logprob_post
+
+                    engine = InferenceEngine(mcfg, params, mesh)
+                    t = _time(lambda: engine.forward(
+                        sample, MicroBatchSpec(),
+                        post_fn=_logprob_post, output_key="logprobs",
+                    ))
+                    flops = monitor.flops_forward(mcfg, n_tokens, sum_sq)
+                elif mfc == "generate":
+                    engine = GeneratorEngine(
+                        mcfg, params, mesh,
+                        eos_token_id=mcfg.vocab_size - 1,
+                        max_decode_batch=cfg.batch_size,
+                    )
+                    g = GenerationHyperparameters(
+                        n=1, max_new_tokens=cfg.gen_new_tokens,
+                        temperature=1.0, top_p=1.0, greedy=True,
+                    )
+                    prompts = SequenceSample(
+                        keys={"packed_prompts"},
+                        ids=list(sample.ids),
+                        seqlens={
+                            "packed_prompts": sample.seqlens[
+                                "packed_input_ids"
+                            ]
+                        },
+                        data={
+                            "packed_prompts": sample.data[
+                                "packed_input_ids"
+                            ]
+                        },
+                    )
+                    t = _time(lambda: engine.generate(
+                        prompts, MicroBatchSpec(), g, seed=cfg.seed
+                    ))
+                    flops = monitor.flops_generate(
+                        mcfg,
+                        [cfg.seqlen] * cfg.batch_size,
+                        [cfg.gen_new_tokens] * cfg.batch_size,
+                    )
+                else:
+                    raise ValueError(f"unknown mfc {mfc!r}")
+            except Exception as e:  # noqa: BLE001 — layout may not fit
+                logger.warning(f"profile {mfc} @ {pc.to_str()} failed: {e!r}")
+                rows.append(
+                    {"mfc": mfc, "parallel": pc.to_str(), "error": repr(e)}
+                )
+                continue
+            rows.append(
+                {
+                    "mfc": mfc,
+                    "parallel": pc.to_str(),
+                    "time_s": round(t, 5),
+                    "tflops_per_device": round(
+                        flops / t / cfg.n_devices / 1e12, 3
+                    ),
+                }
+            )
+            logger.info(f"profiled {mfc} @ {pc.to_str()}: {t:.4f}s")
+
+    os.makedirs(cfg.fileroot, exist_ok=True)
+    out_path = os.path.join(cfg.fileroot, "profile.json")
+    with open(out_path, "w") as f:
+        json.dump(rows, f, indent=2)
+    logger.info(f"profile table written to {out_path}")
+    return rows

@@ -1,0 +1,87 @@
+"""Fused inference interface: run several sub-interfaces in one MFC.
+
+Capability parity: realhf/impl/model/interface/fused_interface.py
+(`FusedThreadingForwardInterface`, registered "fused-threading") — the
+reference fuses reward verification and reference-model inference into a
+single MFC so the CPU-bound reward grading overlaps the device-bound ref
+forward pass (ppo_math_exp.py:132-136).  Same shape here: each
+sub-interface's `inference` runs on its own thread; JAX dispatch releases
+the GIL while the TPU computes, so the math verifier's process pool grades
+concurrently.
+
+Results merge with `SequenceSample.update_` in sorted-name order (the
+sub-interfaces produce disjoint keys, so order only matters for
+determinism of error attribution).
+"""
+
+import dataclasses
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional, Union
+
+from areal_tpu.api.config import ModelInterfaceAbstraction
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model_api import (
+    Model,
+    ModelInterface,
+    make_interface,
+    register_interface,
+)
+from areal_tpu.base import logging
+
+logger = logging.getLogger("fused")
+
+
+class FusedThreadingInterface(ModelInterface):
+    def __init__(
+        self,
+        interfaces: Dict[
+            str, Union[ModelInterfaceAbstraction, Dict[str, Any]]
+        ],
+    ):
+        self.sub_interfaces: Dict[str, ModelInterface] = {}
+        for key, spec in interfaces.items():
+            if isinstance(spec, dict):
+                spec = ModelInterfaceAbstraction(
+                    spec["type_"], spec.get("args", {})
+                )
+            self.sub_interfaces[key] = make_interface(
+                spec.type_, **spec.args
+            )
+
+    def inference(
+        self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Optional[SequenceSample]:
+        def run_one(name: str):
+            import time
+
+            t0 = time.monotonic()
+            res = self.sub_interfaces[name].inference(model, sample, mb_spec)
+            logger.info(
+                f"fused sub-interface {name} took {time.monotonic() - t0:.3f}s"
+            )
+            return res
+
+        with ThreadPoolExecutor(
+            max_workers=len(self.sub_interfaces)
+        ) as pool:
+            futures = {
+                name: pool.submit(run_one, name)
+                for name in self.sub_interfaces
+            }
+            results = {
+                name: fut.result() for name, fut in sorted(futures.items())
+            }
+
+        merged: Optional[SequenceSample] = None
+        for name in sorted(results):
+            res = results[name]
+            if res is None:
+                continue
+            if merged is None:
+                merged = res
+            else:
+                merged.update_(res)
+        return merged
+
+
+register_interface("fused", FusedThreadingInterface)

@@ -1,0 +1,46 @@
+"""No-op interface for system tests (reference: model_api.py:719-760
+`NullInterface`, registered "null", used by null_exp.py).
+
+`inference` fabricates one random reward per sequence — shaped exactly like
+the math reward interface's output — so the full runtime (dispatch, data
+plane, buffer readiness) can be exercised with zero device compute.
+`train_step` consumes its batch and returns empty stats.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model_api import Model, ModelInterface, register_interface
+
+
+class NullInterface(ModelInterface):
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+
+    def inference(
+        self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        key = (
+            "packed_prompts"
+            if "packed_prompts" in sample.keys
+            else "packed_input_ids"
+        )
+        groups = [len(row) for row in sample.seqlens[key]]
+        scores = self._rng.standard_normal(sum(groups)).astype(np.float32)
+        return SequenceSample(
+            keys={"rewards"},
+            ids=list(sample.ids),
+            seqlens={"rewards": [[1] * g for g in groups]},
+            data={"rewards": scores},
+        )
+
+    def train_step(
+        self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        n_seqs = len(sample.ids)
+        return {"null/n_seqs": float(n_seqs)}
+
+
+register_interface("null", NullInterface)

@@ -45,6 +45,8 @@ import areal_tpu.data.datasets  # noqa: F401
 import areal_tpu.interfaces.sft  # noqa: F401
 import areal_tpu.interfaces.ppo  # noqa: F401
 import areal_tpu.interfaces.reward  # noqa: F401
+import areal_tpu.interfaces.fused  # noqa: F401
+import areal_tpu.interfaces.null  # noqa: F401
 
 logger = logging.getLogger("model_worker")
 

@@ -125,9 +125,13 @@ class WorkerServer:
             self.state = WorkerState.RUNNING
             return {"state": self.state.value}
         if command == "exit":
+            # Only flips state: the owning worker's serve loop observes
+            # EXITING, drains in-flight work, and then calls stop().  The
+            # command thread stays up meanwhile so ping/status/keepalive
+            # keep answering during the drain (a draining worker must not
+            # read as dead).
             self.state = WorkerState.EXITING
             self._not_paused.set()  # never leave the serve loop stuck
-            self._stop.set()
             return {"state": self.state.value}
         if command in self._handlers:
             return self._handlers[command](payload)
@@ -256,13 +260,27 @@ class WorkerControlPanel:
     ) -> Dict[str, Any]:
         """Send `command` to every connected worker, then gather replies —
         group latency is max-of-workers, not sum (each worker has its own
-        REQ socket, so the sends all go out before any reply is awaited)."""
+        REQ socket, so the sends all go out before any reply is awaited).
+
+        Every socket is drained (or replaced, on timeout) even when some
+        workers fail, so one slow worker cannot poison the channel to the
+        rest; failures are re-raised together afterwards."""
         for wn in self._socks:
             self._send(wn, command, (payloads or {}).get(wn))
         deadline = time.time() + timeout
-        return {
-            wn: self._recv(wn, command, deadline) for wn in self._socks
-        }
+        results: Dict[str, Any] = {}
+        errors: Dict[str, Exception] = {}
+        for wn in self._socks:
+            try:
+                results[wn] = self._recv(wn, command, deadline)
+            except Exception as e:  # noqa: BLE001 — aggregated below
+                errors[wn] = e
+        if errors:
+            raise RuntimeError(
+                f"group {command!r} failed on {sorted(errors)}: "
+                + "; ".join(f"{wn}: {e!r}" for wn, e in errors.items())
+            )
+        return results
 
     def check_liveness(self) -> Dict[str, bool]:
         """TTL-keepalive liveness per worker (reference: name_resolve

@@ -1,0 +1,103 @@
+"""Null experiments (reference: null_exp.py) and the profiling experiment
+(reference: experiments/benchmark/profile_exp.py)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.data_api import DatasetAbstraction
+from areal_tpu.experiments.common import run_experiment
+from areal_tpu.experiments.null import (
+    NullSFTConfig,
+    build_null_ppo,
+    build_null_sft,
+)
+from areal_tpu.experiments.profile import (
+    ProfileConfig,
+    decompose_parallel_configs,
+    run_profile,
+)
+from areal_tpu.models.config import tiny_config
+from areal_tpu.system.master import ExperimentSaveEvalControl
+
+from tests import fixtures
+
+
+def _null_cfg(tmp_path, rows, **kw):
+    return NullSFTConfig(
+        dataset=DatasetAbstraction(
+            "prompt_answer",
+            {"dataset_builder": lambda: rows, "max_length": 64},
+        ),
+        batch_size=4,
+        total_train_epochs=1,
+        ctrl=ExperimentSaveEvalControl(benchmark_steps=2),
+        fileroot=str(tmp_path),
+        **kw,
+    )
+
+
+def test_null_sft_runs(tmp_path):
+    """The no-op trial exercises master dispatch/epoch accounting with an
+    engine-less model."""
+    tok = fixtures.make_tokenizer()
+    rows = fixtures.build_sft_rows(8, seed=3)
+    plan = build_null_sft(_null_cfg(tmp_path, rows))
+    _, stats = run_experiment(plan, tokenizer=tok)
+    assert len(stats) == 2
+    assert stats[0]["null/n_seqs"] == 4.0
+
+
+def test_null_ppo_two_mfc_graph(tmp_path):
+    """rew_inf -> actor_train over prompt data: random rewards flow through
+    the buffer into the train MFC."""
+    tok = fixtures.make_tokenizer()
+    rows = fixtures.build_math_rows(8, seed=3)
+    plan = build_null_ppo(
+        NullSFTConfig(
+            dataset=DatasetAbstraction(
+                "math_code_prompt",
+                {"dataset_builder": lambda: rows, "max_length": 64},
+            ),
+            batch_size=4,
+            ctrl=ExperimentSaveEvalControl(benchmark_steps=2),
+            fileroot=str(tmp_path),
+        )
+    )
+    assert {n.name for n in plan.dfg.nodes} == {"rew_inf", "actor_train"}
+    _, stats = run_experiment(plan, tokenizer=tok)
+    assert len(stats) == 2
+    assert stats[0]["actor_train/null/n_seqs"] == 4.0
+
+
+def test_decompose_parallel_configs():
+    pcs = decompose_parallel_configs(8)
+    assert len(pcs) == 10  # ordered factor triples of 8: C(3+2,2)=10
+    assert all(p.data * p.fsdp * p.model == 8 for p in pcs)
+    assert len({p.to_str() for p in pcs}) == len(pcs)
+
+
+@pytest.mark.parametrize("n_devices", [1, 4])
+def test_profile_exp(tmp_path, n_devices):
+    rows = run_profile(
+        ProfileConfig(
+            model_config=tiny_config(),
+            n_devices=n_devices,
+            mfcs=("train_step", "inference", "generate"),
+            batch_size=4,
+            seqlen=32,
+            gen_new_tokens=8,
+            n_iters=1,
+            fileroot=str(tmp_path),
+        )
+    )
+    ok = [r for r in rows if "time_s" in r]
+    # Every layout must profile cleanly on the fake cluster.
+    assert len(ok) == len(rows), [r for r in rows if "error" in r]
+    assert all(r["time_s"] > 0 and np.isfinite(r["tflops_per_device"])
+               for r in ok)
+    kinds = {r["mfc"] for r in ok}
+    assert kinds == {"train_step", "inference", "generate"}
+    with open(tmp_path / "profile.json") as f:
+        assert json.load(f) == rows

@@ -52,7 +52,13 @@ def test_lifecycle_commands(panel_and_servers):
     assert not servers[0].paused
 
     panel.group_request("exit")
+    # 'exit' only flips state; the command server keeps answering (so a
+    # draining worker stays pingable) until the worker calls stop().
     for s in servers:
+        assert s.state == WorkerState.EXITING
+    assert panel.request(servers[0].worker_name, "ping")["state"] == "exiting"
+    for s in servers:
+        s.stop()
         assert s.exited.wait(timeout=5.0)
 
 
@@ -96,6 +102,16 @@ def test_timeout_recovers_req_socket(panel_and_servers):
         with pytest.raises(TimeoutError):
             panel.request(servers[0].worker_name, "ping", timeout=0.3)
     # The healthy worker is unaffected.
+    assert panel.request(servers[1].worker_name, "ping")["state"] == "ready"
+
+
+def test_group_timeout_does_not_poison_others(panel_and_servers):
+    """One stalled worker in a group request must not brick the channel to
+    the healthy workers (their replies are still drained)."""
+    panel, servers = panel_and_servers
+    servers[0].stop()
+    with pytest.raises(RuntimeError, match="model_worker/0"):
+        panel.group_request("ping", timeout=0.5)
     assert panel.request(servers[1].worker_name, "ping")["state"] == "ready"
 
 
