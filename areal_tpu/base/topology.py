@@ -123,6 +123,28 @@ class ParallelConfig:
         return "".join(parts)
 
 
+def fold_pipe_into_model(mesh: Mesh) -> Mesh:
+    """Same devices, pipe axis folded into model: a (pipe=P, ..., model=M)
+    mesh becomes (pipe=1, ..., model=P*M).
+
+    This is how generation runs under a pipelined allocation: decode is
+    latency-bound and token-at-a-time, so instead of the reference's
+    cross-stage token feedback loop (GenerateSchedule,
+    realhf/impl/model/parallelism/pipeline_parallel/static_schedule.py:199)
+    the generator re-lays the SAME chips out as a wider tensor-parallel
+    group — params stay sharded 1/(P*M) per chip (no memory increase) and
+    every chip works every token (no pipeline bubble), with XLA inserting
+    the per-layer collectives over ICI."""
+    dev = mesh.devices  # AXIS_ORDER = (pipe, data, fsdp, seq, model)
+    p = dev.shape[0]
+    if p == 1:
+        return mesh
+    folded = np.moveaxis(dev, 0, 3).reshape(
+        1, dev.shape[1], dev.shape[2], dev.shape[3], p * dev.shape[4]
+    )
+    return Mesh(folded, AXIS_ORDER)
+
+
 def make_mesh(
     parallel: ParallelConfig,
     devices: Optional[Sequence] = None,

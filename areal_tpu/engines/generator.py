@@ -66,16 +66,24 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             compute_dtype = jnp.float32
         self.compute_dtype = compute_dtype
         self.max_decode_batch = max_decode_batch
-        self.batch_shard = batch_sharding_degree(mesh)
         # Generation has no CP/PP path (decode is token-at-a-time and
         # latency-bound); only the flash half of the shared dispatch policy
-        # applies to prefill.
+        # applies to prefill.  A pipelined allocation is accepted by folding
+        # its pipe axis into model: same chips, params stay sharded, no
+        # bubble — the TPU answer to the reference's pipelined generation
+        # (GenerateSchedule, static_schedule.py:199; see
+        # topology.fold_pipe_into_model).
         self._use_flash, _, pp_mesh, _, _ = sharding.attn_dispatch(mesh, cfg)
         if pp_mesh is not None:
-            raise NotImplementedError(
-                "GeneratorEngine on a pipe>1 mesh; use a pipe=1 layout for "
-                "generation (decoupled gen/train meshes + param realloc)"
+            from areal_tpu.base.topology import fold_pipe_into_model
+
+            mesh = fold_pipe_into_model(mesh)
+            self.mesh = mesh
+            self._use_flash, _, pp_mesh, _, _ = sharding.attn_dispatch(
+                mesh, cfg
             )
+            assert pp_mesh is None
+        self.batch_shard = batch_sharding_degree(mesh)
         self._gen_fns: Dict[Tuple, Any] = {}
         self.set_params(params)
 

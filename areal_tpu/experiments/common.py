@@ -226,6 +226,11 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
             pre_hooks=[],
         ),
     ]
+    if cfg.fuse_rew_ref and ref is None:
+        raise ValueError(
+            "fuse_rew_ref=True requires a ref model (the fused MFC runs on "
+            "the ref worker); set PPOMathConfig.ref or disable fusion"
+        )
     fuse = cfg.fuse_rew_ref and ref is not None
     fused_if = ModelInterfaceAbstraction(
         "fused",

@@ -126,13 +126,14 @@ def build_null_ppo(cfg: NullSFTConfig) -> ExperimentPlan:
     )
     worker_configs = [
         WorkerConfig(
-            worker_index=0,
-            shards=shards,
-            datasets=[cfg.dataset],
+            worker_index=w,
+            shards=shards if w == 0 else [],
+            datasets=[cfg.dataset] if w == 0 else [],
             batch_size=cfg.batch_size,
             seed=cfg.seed,
             ftspec=ftspec,
         )
+        for w in range(cfg.n_workers)
     ]
     cfg.ctrl.total_train_epochs = cfg.total_train_epochs
     return ExperimentPlan(

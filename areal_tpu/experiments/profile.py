@@ -34,9 +34,17 @@ from areal_tpu.models.config import ModelConfig
 logger = logging.getLogger("profile_exp")
 
 
-def decompose_parallel_configs(n_devices: int) -> List[ParallelConfig]:
-    """All (data, fsdp, model) factorizations of n_devices (reference:
-    base/topology.py decompose_to_three_factors feeding profile_exp)."""
+def decompose_parallel_configs(
+    n_devices: int, model_config: Optional[ModelConfig] = None
+) -> List[ParallelConfig]:
+    """(data, fsdp, model) factorizations of n_devices (reference:
+    base/topology.py decompose_to_three_factors feeding profile_exp).
+    With a model config, infeasible layouts (head/hidden-dim divisibility)
+    are filtered up front — same rules the allocation search uses."""
+    if model_config is not None:
+        from areal_tpu.search_engine.search import _factorizations
+
+        return _factorizations(n_devices, model_config, allow_pipe=False)
     out = []
     for data in range(1, n_devices + 1):
         if n_devices % data:
@@ -102,7 +110,7 @@ def run_profile(cfg: ProfileConfig) -> List[Dict[str, Any]]:
             f"need {cfg.n_devices} devices, have {len(jax.devices())}"
         )
     layouts = list(cfg.parallel_configs or decompose_parallel_configs(
-        cfg.n_devices
+        cfg.n_devices, cfg.model_config
     ))
     mcfg = cfg.model_config
     rows: List[Dict[str, Any]] = []
@@ -120,9 +128,13 @@ def run_profile(cfg: ProfileConfig) -> List[Dict[str, Any]]:
                 fn()
             return (time.perf_counter() - t0) / cfg.n_iters
 
+        engine = None
         for mfc in cfg.mfcs:
             # Fresh params per engine: TrainEngine donates the incoming
-            # tree to its master copy, deleting the caller's arrays.
+            # tree to its master copy, deleting the caller's arrays.  The
+            # PREVIOUS engine is dropped first so its params/opt-state free
+            # before the next allocation (peak HBM = one engine, not two).
+            engine = None
             params = tfm.init_params(mcfg, jax.random.PRNGKey(cfg.seed))
             try:
                 if mfc == "train_step":
@@ -201,6 +213,7 @@ def run_profile(cfg: ProfileConfig) -> List[Dict[str, Any]]:
                 }
             )
             logger.info(f"profiled {mfc} @ {pc.to_str()}: {t:.4f}s")
+        engine = None  # free the last engine before the next layout
 
     os.makedirs(cfg.fileroot, exist_ok=True)
     out_path = os.path.join(cfg.fileroot, "profile.json")

@@ -11,6 +11,7 @@ trials) and the ZMQ stream runtime.
 
 import dataclasses
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -47,6 +48,9 @@ import areal_tpu.interfaces.ppo  # noqa: F401
 import areal_tpu.interfaces.reward  # noqa: F401
 import areal_tpu.interfaces.fused  # noqa: F401
 import areal_tpu.interfaces.null  # noqa: F401
+
+# One xprof trace at a time per process (see _handle_mfc).
+_TRACE_LOCK = threading.Lock()
 
 logger = logging.getLogger("model_worker")
 
@@ -275,7 +279,27 @@ class ModelWorker:
         interface = self.interfaces[model_key]
         fn = getattr(interface, itype.value)
         t0 = time.monotonic()
-        result = fn(model, sample, mb_spec)
+        # Env-gated xprof capture per MFC (reference: REAL_DUMP_TRACE torch
+        # profiler export, model_worker.py:84-99,788-869).  Each MFC call
+        # writes a TensorBoard-viewable trace under
+        # $AREAL_DUMP_TRACE/<model>_<itype>/.
+        trace_root = os.environ.get("AREAL_DUMP_TRACE")
+        # JAX allows ONE active trace per process; concurrent MFCs (the
+        # in-process runner overlaps independent graph nodes) contend, so
+        # whoever holds the lock traces and the rest run untraced.
+        if trace_root and _TRACE_LOCK.acquire(blocking=False):
+            import jax
+
+            tdir = os.path.join(
+                trace_root, f"{model_key.replace('/', '-')}_{itype.value}"
+            )
+            try:
+                with jax.profiler.trace(tdir):
+                    result = fn(model, sample, mb_spec)
+            finally:
+                _TRACE_LOCK.release()
+        else:
+            result = fn(model, sample, mb_spec)
         mfc_seconds = time.monotonic() - t0
         if itype == ModelInterfaceType.GENERATE:
             model.inc_version()  # advances the sampling seed per step

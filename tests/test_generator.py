@@ -212,3 +212,33 @@ class TestGenerate:
         a = np.asarray(out1.data["packed_input_ids"])
         b = np.asarray(out2.data["packed_input_ids"])
         assert a.shape != b.shape or not np.array_equal(a, b)
+
+
+class TestPipeFoldedGeneration:
+    """Generation under a pipelined allocation: the engine folds the pipe
+    axis into model (topology.fold_pipe_into_model) — the TPU equivalent of
+    the reference's pipelined GenerateSchedule (static_schedule.py:199)."""
+
+    @pytest.mark.parametrize("layout", ["p2", "d2p2"])
+    def test_greedy_parity_vs_single_device(self, cfg, params, rng, layout):
+        pc = ParallelConfig.from_str(layout)
+        mesh = make_mesh(pc, jax.devices()[: pc.world_size])
+        eng = GeneratorEngine(cfg, params, mesh, eos_token_id=EOS)
+        assert eng.mesh.shape["pipe"] == 1
+        assert (
+            eng.mesh.shape["model"] == pc.pipe * pc.model
+        ), dict(eng.mesh.shape)
+        sample = _prompt_sample(rng, cfg, lens=(6, 9, 4, 7))
+        g = GenerationHyperparameters(n=1, max_new_tokens=6, greedy=True)
+        out = eng.generate(sample, MicroBatchSpec(), g)
+
+        ref_eng = GeneratorEngine(
+            cfg, params, make_mesh(ParallelConfig.from_str("d1"),
+                                   jax.devices()[:1]),
+            eos_token_id=EOS,
+        )
+        ref = ref_eng.generate(sample, MicroBatchSpec(), g)
+        np.testing.assert_array_equal(
+            np.asarray(out.data["packed_input_ids"]),
+            np.asarray(ref.data["packed_input_ids"]),
+        )

@@ -116,3 +116,83 @@ def test_master_emits_perf_stats(tmp_path):
     assert s["time/step_s"] > 0
     rows = monitor.read_stats(str(tmp_path), "perftest", "trial")
     assert len(rows) == 1 and rows[0]["perf/tflops"] == s["perf/tflops"]
+
+
+def test_mfc_trace_dump(tmp_path, monkeypatch):
+    """AREAL_DUMP_TRACE exports an xprof trace per MFC (reference:
+    REAL_DUMP_TRACE, model_worker.py:84-99)."""
+    import os
+
+    from areal_tpu.api.config import ModelAbstraction
+    from areal_tpu.api.data_api import DatasetAbstraction
+    from areal_tpu.experiments.common import SFTConfig, build_sft, run_experiment
+    from areal_tpu.api.model_api import OptimizerConfig
+    from areal_tpu.api.data_api import MicroBatchSpec
+    from areal_tpu.base.topology import ParallelConfig
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.system.master import ExperimentSaveEvalControl
+    from tests import fixtures
+
+    monkeypatch.setenv("AREAL_DUMP_TRACE", str(tmp_path / "traces"))
+    tok = fixtures.make_tokenizer()
+    rows = fixtures.build_sft_rows(8, seed=3)
+    cfg = SFTConfig(
+        model=ModelAbstraction("random", {"config": tiny_config()}),
+        dataset=DatasetAbstraction(
+            "prompt_answer", {"dataset_builder": lambda: rows, "max_length": 64}
+        ),
+        optimizer=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0),
+        batch_size=8,
+        mb_spec=MicroBatchSpec(n_mbs=2),
+        ctrl=ExperimentSaveEvalControl(benchmark_steps=1),
+        fileroot=str(tmp_path / "trial"),
+    )
+    _, stats = run_experiment(build_sft(cfg, tok), tokenizer=tok)
+    assert len(stats) == 1
+    trace_dir = tmp_path / "traces" / "default@0_train_step"
+    # jax.profiler.trace writes plugins/profile/<ts>/*.xplane.pb
+    found = list(trace_dir.rglob("*.xplane.pb"))
+    assert found, list(trace_dir.rglob("*"))
+
+
+def test_mfc_trace_dump_concurrent_mfcs(tmp_path, monkeypatch):
+    """Tracing must survive MFCs that overlap in one process (JAX allows a
+    single active trace; contenders run untraced instead of crashing)."""
+    from areal_tpu.api.config import ModelAbstraction
+    from areal_tpu.api.data_api import DatasetAbstraction
+    from areal_tpu.api.model_api import (
+        GenerationHyperparameters,
+        OptimizerConfig,
+    )
+    from areal_tpu.experiments.common import (
+        PPOMathConfig,
+        build_ppo_math,
+        run_experiment,
+    )
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.system.master import ExperimentSaveEvalControl
+    from tests import fixtures
+
+    monkeypatch.setenv("AREAL_DUMP_TRACE", str(tmp_path / "traces"))
+    tok = fixtures.make_tokenizer()
+    rows = fixtures.build_math_rows(8, seed=4)
+    cfg = PPOMathConfig(
+        actor=ModelAbstraction("random", {"config": tiny_config()}),
+        ref=ModelAbstraction("random", {"config": tiny_config()}),
+        dataset=DatasetAbstraction(
+            "math_code_prompt",
+            {"dataset_builder": lambda: rows, "max_length": 64},
+        ),
+        reward_interface_args={"id2info": {r["query_id"]: r for r in rows}},
+        gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+        ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+        optimizer=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
+        batch_size=4,
+        ctrl=ExperimentSaveEvalControl(benchmark_steps=2),
+        fileroot=str(tmp_path / "trial"),
+    )
+    # rew_inf and ref_inf share no edge -> the in-process runner overlaps
+    # them; without the trace lock the second trace raises.
+    _, stats = run_experiment(build_ppo_math(cfg, tok), tokenizer=tok)
+    assert len(stats) == 2
+    assert list((tmp_path / "traces").rglob("*.xplane.pb"))
