@@ -68,6 +68,152 @@ class FinetuneSpec:
         return self.total_train_epochs * self.steps_per_epoch
 
 
+@dataclasses.dataclass
+class APIGenerateInput:
+    """One generation request to a generation server (reference:
+    model_api.py:37 `APIGenerateInput` for the SGLang HTTP client)."""
+
+    qid: str
+    prompt_ids: list  # List[int]
+    gconfig: GenerationHyperparameters
+
+
+@dataclasses.dataclass
+class APIGenerateOutput:
+    """Grouped responses for one request (reference: model_api.py:48
+    `APIGenerateOutput` / :55 `BundledGenerationOutputs`)."""
+
+    qid: str
+    prompt_ids: list  # List[int]
+    output_ids: list  # List[List[int]] — gconfig.n responses
+    output_logprobs: list  # List[List[float]]
+    no_eos: list  # List[bool] — hit max_new_tokens without EOS
+    version: int = 0  # server weight version that produced this
+
+    @classmethod
+    def from_input(cls, inp: "APIGenerateInput") -> "APIGenerateOutput":
+        return cls(
+            qid=inp.qid, prompt_ids=list(inp.prompt_ids),
+            output_ids=[], output_logprobs=[], no_eos=[],
+        )
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt_ids)
+
+    @property
+    def output_lens(self) -> list:
+        return [len(x) for x in self.output_ids]
+
+
+class LLMAPIClient:
+    """Client for a GenerationServer (reference: model_api.py:83
+    `LLMAPIClient` — async HTTP to SGLang; here stdlib urllib with a thread
+    pool for concurrency and asyncio wrappers on top).
+
+    Usage:
+        client = LLMAPIClient("http://host:8091")
+        out = client.generate(APIGenerateInput(...))
+        outs = client.generate_batch([inp1, inp2, ...])
+        await client.agenerate(inp)
+    """
+
+    def __init__(self, url: str, timeout_s: float = 7200.0, token: str = ""):
+        import os as _os
+
+        self.url = url.rstrip("/")
+        self.timeout_s = timeout_s
+        self.token = token or _os.environ.get("AREAL_GEN_TOKEN", "")
+
+    def _post(self, path: str, payload: Dict) -> Dict:
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-Areal-Token"] = self.token
+        req = urllib.request.Request(
+            self.url + path, data=_json.dumps(payload).encode(),
+            headers=headers,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                out = _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            # Surface the server's error body (it sends {"error": repr(exc)}
+            # with the failure status) instead of a bare status line.
+            try:
+                detail = _json.loads(e.read()).get("error", "")
+            except Exception:
+                detail = ""
+            raise RuntimeError(
+                f"generation server {path} failed: HTTP {e.code} {detail}"
+            ) from e
+        if "error" in out:
+            raise RuntimeError(f"generation server error: {out['error']}")
+        return out
+
+    def health(self) -> Dict:
+        import json as _json
+        import urllib.request
+
+        with urllib.request.urlopen(
+            self.url + "/health", timeout=30.0
+        ) as r:
+            return _json.loads(r.read())
+
+    def generate(self, inp: APIGenerateInput) -> APIGenerateOutput:
+        g = inp.gconfig
+        out = self._post(
+            "/generate",
+            {
+                "qid": inp.qid,
+                "prompt_ids": list(map(int, inp.prompt_ids)),
+                "n": g.n,
+                "max_new_tokens": g.max_new_tokens,
+                "min_new_tokens": g.min_new_tokens,
+                "greedy": g.greedy,
+                "top_p": g.top_p,
+                "top_k": g.top_k,
+                "temperature": g.temperature,
+            },
+        )
+        return APIGenerateOutput(
+            qid=inp.qid,
+            prompt_ids=list(inp.prompt_ids),
+            output_ids=out["output_ids"],
+            output_logprobs=out["output_logprobs"],
+            no_eos=out["no_eos"],
+            version=int(out.get("version", 0)),
+        )
+
+    def generate_batch(
+        self, inps: "list[APIGenerateInput]", max_concurrency: int = 64
+    ) -> "list[APIGenerateOutput]":
+        """Issue requests concurrently; the server batches them into shared
+        decode steps (continuous batching)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        if not inps:
+            return []
+        with ThreadPoolExecutor(
+            max_workers=min(max_concurrency, len(inps))
+        ) as ex:
+            return list(ex.map(self.generate, inps))
+
+    async def agenerate(self, inp: APIGenerateInput) -> APIGenerateOutput:
+        import asyncio
+
+        return await asyncio.to_thread(self.generate, inp)
+
+    def update_weights_from_disk(self, path: str) -> int:
+        """Hot-swap server weights from an HF checkpoint dir; returns the
+        new weight version (reference: sglang.py:383
+        update_weights_from_disk)."""
+        return int(self._post("/update_weights", {"path": path})["version"])
+
+
 class Engine(abc.ABC):
     """The PipelinableEngine contract: packed-batch train/forward/generate.
 

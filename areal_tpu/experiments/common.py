@@ -170,6 +170,12 @@ class PPOMathConfig:
     # ppo_math_exp.py:132-136) — CPU reward grading overlaps the device
     # forward.  Requires a ref model.
     fuse_rew_ref: bool = False
+    # Decoupled serving: URL of a standalone GenerationServer
+    # (areal_tpu/system/gen_server.py).  actor_gen then uses the
+    # remote_generator backend — this worker holds NO generation weights,
+    # and the weight-sync hook ships checkpoints to the server (reference:
+    # sglang decoupled allocations, backend/sglang.py).
+    gen_server_url: Optional[str] = None
     # Model role -> worker index (e.g. {"actor_gen": 1} puts generation on a
     # second worker; the data/param planes move bytes between them) or a
     # LIST of worker indices (independent replicas: generate/inference
@@ -192,6 +198,36 @@ class PPOMathConfig:
     experiment_name: str = "ppo-math"
     trial_name: str = "trial"
     fileroot: str = "/tmp/areal_tpu/trial"
+
+
+def _remote_gen_shard(cfg: "PPOMathConfig", actor_gen, actor_if):
+    """actor_gen as a weightless client of a GenerationServer."""
+    model_type = "qwen2"
+    if cfg.actor.type_ == "random":
+        model_cfg = cfg.actor.args["config"]
+        model_type = cfg.actor.args.get("model_type", model_type)
+    elif cfg.actor.type_ == "hf":
+        from areal_tpu.models.hf import registry as hf
+
+        path = cfg.actor.args["path"]
+        model_cfg = hf.load_model_config(path)
+        # Weight-sync checkpoints must round-trip through the actor's OWN
+        # HF family converter, not a default one.
+        model_type = hf.load_hf_config(path)["model_type"]
+    else:
+        raise ValueError(
+            f"gen_server_url with actor abstraction {cfg.actor.type_!r}"
+        )
+    return ModelShardSpec(
+        name=actor_gen,
+        model=ModelAbstraction("config", {"config": model_cfg}),
+        backend=ModelBackendAbstraction(
+            "remote_generator",
+            {"url": cfg.gen_server_url, "model_type": model_type},
+        ),
+        interface=actor_if,
+        parallel=ParallelConfig(),
+    )
 
 
 def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
@@ -364,13 +400,17 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
             optimizer=cfg.optimizer,
             device_offset=cfg.actor_device_offset,
         ),
-        ModelShardSpec(
-            name=actor_gen,
-            model=cfg.actor,
-            backend=ModelBackendAbstraction("generator"),
-            interface=actor_if,
-            parallel=cfg.gen_parallel or cfg.actor_parallel,
-            device_offset=cfg.gen_device_offset,
+        (
+            _remote_gen_shard(cfg, actor_gen, actor_if)
+            if cfg.gen_server_url
+            else ModelShardSpec(
+                name=actor_gen,
+                model=cfg.actor,
+                backend=ModelBackendAbstraction("generator"),
+                interface=actor_if,
+                parallel=cfg.gen_parallel or cfg.actor_parallel,
+                device_offset=cfg.gen_device_offset,
+            )
         ),
     ]
     if not fuse:

@@ -570,6 +570,14 @@ def load_hf_config(path: str) -> dict:
         return json.load(f)
 
 
+def load_model_config(path: str, is_critic: bool = False) -> ModelConfig:
+    """Config-only load (no weights) — e.g. remote-generator workers that
+    hold no local params."""
+    hf_cfg = load_hf_config(path)
+    cfg = HF_FAMILIES[hf_cfg["model_type"]].config_from_hf(hf_cfg)
+    return cfg.as_critic() if is_critic else cfg
+
+
 def load_hf_checkpoint(
     path: str, is_critic: bool = False, dtype=None
 ) -> "tuple[ModelConfig, Dict[str, Any]]":

@@ -1,0 +1,445 @@
+"""Decoupled generation service: HTTP server around a GeneratorEngine.
+
+Capability parity: realhf/impl/model/backend/sglang.py — the reference
+spawns one SGLang HTTP server per generation DP rank (:161-226), streams
+per-request generation with logprobs over REST (:267-352), and refreshes
+weights from disk after each train step (:383 `update_weights_from_disk`).
+TPU version: the in-repo continuous-batching GeneratorEngine IS the
+inference runtime, so the server is a thin stdlib-HTTP shell around it:
+
+- POST /generate  — one prompt (+ sampling params) per request; concurrent
+  requests are MERGED by a collector thread into shared engine calls, so
+  client-side fan-out gets true cross-request batching.
+- POST /update_weights — hot-swap from an HF checkpoint dir.
+- GET  /health — liveness + current weight version.
+
+`RemoteGeneratorEngine` (backend "remote_generator") makes a model worker
+talk to such a server instead of holding generation weights itself — the
+reference's decoupled `sglang.dXpYmZ+...` allocation shape, with the
+param-sync hook saving a checkpoint and POSTing /update_weights exactly
+like the reference's disk-based weight refresh (model_worker.py:1040-1067).
+"""
+
+import dataclasses
+import json
+import os
+import queue
+import tempfile
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model_api import (
+    APIGenerateInput,
+    APIGenerateOutput,
+    Engine,
+    GenerationHyperparameters,
+    LLMAPIClient,
+    register_backend,
+)
+from areal_tpu.base import logging
+
+logger = logging.getLogger("gen_server")
+
+
+@dataclasses.dataclass
+class _Pending:
+    qid: str
+    prompt_ids: List[int]
+    gconfig: GenerationHyperparameters
+    done: threading.Event
+    result: Optional[Dict[str, Any]] = None
+    error: Optional[str] = None
+
+
+def _gkey(g: GenerationHyperparameters):
+    return (g.n, g.max_new_tokens, g.min_new_tokens, g.greedy, g.top_p,
+            g.top_k, g.temperature)
+
+
+class GenerationServer:
+    """Batching HTTP front-end over one GeneratorEngine."""
+
+    def __init__(
+        self,
+        engine,  # GeneratorEngine
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_wait_ms: float = 5.0,
+        max_batch: int = 256,
+        token: str = "",
+    ):
+        self.engine = engine
+        self.version = 0
+        self.max_wait_ms = max_wait_ms
+        self.max_batch = max_batch
+        self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        self._stop = threading.Event()
+        self._seed = 0
+        # Serializes weight swaps against in-flight generation: a batch
+        # must run wholly under one weight version, and its outputs must be
+        # stamped with that version.
+        self._engine_lock = threading.Lock()
+
+        srv = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                logger.debug(fmt % args)
+
+            def _send(self, code: int, payload: Dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._send(
+                        200, {"status": "ok", "version": srv.version}
+                    )
+                else:
+                    self._send(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if srv._token and (
+                    self.headers.get("X-Areal-Token") != srv._token
+                ):
+                    self._send(403, {"error": "bad token"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    if self.path == "/generate":
+                        self._send(200, srv._handle_generate(req))
+                    elif self.path == "/update_weights":
+                        self._send(200, srv._handle_update(req))
+                    else:
+                        self._send(404, {"error": "unknown path"})
+                except Exception as e:  # noqa: BLE001 — report to client
+                    self._send(500, {"error": repr(e)})
+
+        self._token = token or os.environ.get("AREAL_GEN_TOKEN", "")
+        self._http = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self._http.server_port
+        self.url = f"http://{host}:{self.port}"
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True
+        )
+        self._collector_thread = threading.Thread(
+            target=self._collect_loop, daemon=True
+        )
+        self._http_thread.start()
+        self._collector_thread.start()
+        logger.info(f"generation server at {self.url}")
+
+    # ---------------- request handling ----------------
+
+    def _handle_generate(self, req: Dict) -> Dict:
+        g = GenerationHyperparameters(
+            n=int(req.get("n", 1)),
+            max_new_tokens=int(req.get("max_new_tokens", 256)),
+            min_new_tokens=int(req.get("min_new_tokens", 0)),
+            greedy=bool(req.get("greedy", False)),
+            top_p=float(req.get("top_p", 1.0)),
+            top_k=int(req.get("top_k", 0)),
+            temperature=float(req.get("temperature", 1.0)),
+        )
+        p = _Pending(
+            qid=str(req["qid"]),
+            prompt_ids=[int(t) for t in req["prompt_ids"]],
+            gconfig=g,
+            done=threading.Event(),
+        )
+        self._queue.put(p)
+        p.done.wait()
+        if p.error:
+            raise RuntimeError(p.error)
+        return p.result
+
+    def _handle_update(self, req: Dict) -> Dict:
+        from areal_tpu.models.hf import registry as hf
+
+        _, params = hf.load_hf_checkpoint(req["path"])
+        with self._engine_lock:
+            self.engine.set_params(params)
+            self.version += 1
+        logger.info(
+            f"weights updated from {req['path']} -> version {self.version}"
+        )
+        return {"version": self.version}
+
+    # ---------------- batching collector ----------------
+
+    def _collect_loop(self):
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            batch = [first]
+            # Linger briefly so concurrent clients land in one engine call.
+            time.sleep(self.max_wait_ms / 1000.0)
+            while len(batch) < self.max_batch:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            by_g: Dict[Any, List[_Pending]] = {}
+            for p in batch:
+                by_g.setdefault(_gkey(p.gconfig), []).append(p)
+            for group in by_g.values():
+                self._run_group(group)
+
+    def _run_group(self, group: List[_Pending]):
+        try:
+            g = group[0].gconfig
+            sample = SequenceSample(
+                keys={"packed_prompts"},
+                ids=[p.qid for p in group],
+                seqlens={
+                    "packed_prompts": [[len(p.prompt_ids)] for p in group]
+                },
+                data={
+                    "packed_prompts": np.concatenate(
+                        [np.asarray(p.prompt_ids, np.int32) for p in group]
+                    )
+                },
+            )
+            self._seed += 1
+            with self._engine_lock:
+                version = self.version
+                out = self.engine.generate(
+                    sample, MicroBatchSpec(), g, seed=self._seed
+                )
+            per_id = {s.ids[0]: s for s in out.unpack()}
+            for p in group:
+                p.result = _extract_output(
+                    per_id[p.qid], len(p.prompt_ids), g.n, version
+                )
+        except Exception as e:  # noqa: BLE001 — fail the whole group
+            logger.error(f"generation batch failed: {e!r}")
+            for p in group:
+                p.error = repr(e)
+        finally:
+            for p in group:
+                p.done.set()
+
+    def close(self):
+        self._stop.set()
+        self._http.shutdown()
+        self._http.server_close()
+
+
+def _extract_output(
+    s: SequenceSample, prompt_len: int, n: int, version: int
+) -> Dict[str, Any]:
+    """Slice one request's SequenceSample (GeneratorEngine._assemble
+    layout) back into API JSON: per-response generated ids + logprobs."""
+    toks = np.asarray(s.data["packed_input_ids"])
+    lps = np.asarray(s.data["packed_logprobs"])
+    noe = np.asarray(s.data["seq_no_eos_mask"])
+    lens = s.seqlens["packed_input_ids"][0]
+    out_ids, out_lps = [], []
+    t_off = lp_off = 0
+    for r in range(n):
+        full_len = int(lens[r])
+        row = toks[t_off : t_off + full_len]
+        row_lp = lps[lp_off : lp_off + full_len - 1]
+        out_ids.append([int(x) for x in row[prompt_len:]])
+        out_lps.append(
+            [float(x) for x in row_lp[prompt_len - 1 : full_len - 1]]
+        )
+        t_off += full_len
+        lp_off += full_len - 1
+    return {
+        "output_ids": out_ids,
+        "output_logprobs": out_lps,
+        "no_eos": [bool(x) for x in noe[:n]],
+        "version": version,
+    }
+
+
+class RemoteGeneratorEngine(Engine):
+    """Generation engine backed by a remote GenerationServer (backend
+    "remote_generator") — the decoupled allocation: this worker holds NO
+    generation weights; `set_params` ships a checkpoint to the server
+    (reference: sglang backend + disk-based weight refresh,
+    model_worker.py:1040-1067)."""
+
+    def __init__(
+        self,
+        cfg,
+        url: str,
+        model_type: str = "qwen2",
+        sync_dir: Optional[str] = None,
+    ):
+        self.cfg = cfg
+        self.client = LLMAPIClient(url)
+        self.model_type = model_type
+        # Unique per engine instance: two trials on one host must never
+        # interleave checkpoint shards in a shared dir.
+        self.sync_dir = sync_dir or tempfile.mkdtemp(
+            prefix="areal_tpu_gen_sync_"
+        )
+
+    def train_batch(self, *a, **k):
+        raise NotImplementedError("RemoteGeneratorEngine is generation-only")
+
+    def forward(self, *a, **k):
+        raise NotImplementedError("RemoteGeneratorEngine is generation-only")
+
+    def generate(
+        self,
+        sample: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        gconfig: GenerationHyperparameters,
+        prompt_key: str = "packed_prompts",
+        seed: int = 0,
+    ) -> SequenceSample:
+        prompts = np.asarray(sample.data[prompt_key])
+        bounds = sample.cu_seqlens(prompt_key)
+        inps = [
+            APIGenerateInput(
+                qid=sample.ids[i],
+                prompt_ids=[int(t) for t in prompts[bounds[i]:bounds[i + 1]]],
+                gconfig=gconfig,
+            )
+            for i in range(sample.bs)
+        ]
+        outs = {o.qid: o for o in self.client.generate_batch(inps)}
+        return _assemble_from_api(sample, prompt_key, gconfig.n, outs)
+
+    def get_params(self):
+        raise NotImplementedError(
+            "remote generator weights live on the server"
+        )
+
+    def set_params(self, params) -> None:
+        """Persist -> POST /update_weights (the reference's disk path)."""
+        from areal_tpu.models.hf import registry as hf
+
+        os.makedirs(self.sync_dir, exist_ok=True)
+        hf.save_hf_checkpoint(
+            self.sync_dir, self.cfg, params, model_type=self.model_type
+        )
+        self.client.update_weights_from_disk(self.sync_dir)
+
+
+def _assemble_from_api(
+    sample: SequenceSample,
+    prompt_key: str,
+    n: int,
+    outs: Dict[str, APIGenerateOutput],
+) -> SequenceSample:
+    """Rebuild the rollout SequenceSample (same layout as
+    GeneratorEngine._assemble) from per-request API outputs."""
+    prompts = np.asarray(sample.data[prompt_key])
+    bounds = sample.cu_seqlens(prompt_key)
+    seq_ids, seq_logps, seq_masks = [], [], []
+    seqlens_full, seqlens_lp, no_eos = [], [], []
+    for i in range(sample.bs):
+        o = outs[sample.ids[i]]
+        ptoks = prompts[bounds[i] : bounds[i + 1]]
+        pl = len(ptoks)
+        lens_i, lens_lp_i, noeos_i = [], [], []
+        for r in range(n):
+            gtoks = np.asarray(o.output_ids[r], np.int32)
+            glogps = np.asarray(o.output_logprobs[r], np.float32)
+            full = np.concatenate([ptoks, gtoks]).astype(np.int32)
+            seq_ids.append(full)
+            mask = np.zeros(len(full), bool)
+            mask[:pl] = True
+            seq_masks.append(mask)
+            lp = np.zeros(max(len(full) - 1, 0), np.float32)
+            lp[pl - 1 : pl - 1 + len(gtoks)] = glogps
+            seq_logps.append(lp)
+            lens_i.append(len(full))
+            lens_lp_i.append(max(len(full) - 1, 0))
+            noeos_i.append(1.0 if o.no_eos[r] else 0.0)
+        seqlens_full.append(lens_i)
+        seqlens_lp.append(lens_lp_i)
+        no_eos.append(noeos_i)
+    return SequenceSample(
+        keys={
+            "packed_input_ids", "packed_logprobs", "prompt_mask",
+            "seq_no_eos_mask",
+        },
+        ids=list(sample.ids),
+        seqlens={
+            "packed_input_ids": seqlens_full,
+            "prompt_mask": [list(x) for x in seqlens_full],
+            "packed_logprobs": seqlens_lp,
+            "seq_no_eos_mask": [[1] * n for _ in range(sample.bs)],
+        },
+        data={
+            "packed_input_ids": np.concatenate(seq_ids),
+            "prompt_mask": np.concatenate(seq_masks),
+            "packed_logprobs": (
+                np.concatenate(seq_logps)
+                if seq_logps else np.zeros(0, np.float32)
+            ),
+            "seq_no_eos_mask": np.asarray(
+                [x for row in no_eos for x in row], np.float32
+            ),
+        },
+    )
+
+
+register_backend(
+    "remote_generator",
+    lambda cfg, url, **kw: RemoteGeneratorEngine(cfg, url, **kw),
+)
+
+
+def main():
+    """Standalone server: python -m areal_tpu.system.gen_server
+    --path <hf_ckpt_dir> [--parallel d1] [--port 8091]"""
+    import argparse
+
+    import jax
+
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.engines.generator import GeneratorEngine
+    from areal_tpu.models.hf import registry as hf
+
+    p = argparse.ArgumentParser(prog="areal_tpu.system.gen_server")
+    p.add_argument("--path", required=True, help="HF checkpoint dir")
+    p.add_argument("--parallel", default="d1")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8091)
+    p.add_argument("--eos-token-id", type=int, default=None)
+    p.add_argument("--max-decode-batch", type=int, default=64)
+    p.add_argument("--token", default="",
+                   help="shared secret (or AREAL_GEN_TOKEN)")
+    args = p.parse_args()
+
+    cfg, params = hf.load_hf_checkpoint(args.path)
+    pc = ParallelConfig.from_str(args.parallel)
+    mesh = make_mesh(pc, jax.devices()[: pc.world_size])
+    eos = args.eos_token_id
+    if eos is None:
+        with open(os.path.join(args.path, "config.json")) as f:
+            eos = json.load(f).get("eos_token_id")
+    engine = GeneratorEngine(
+        cfg, params, mesh, eos_token_id=eos,
+        max_decode_batch=args.max_decode_batch,
+    )
+    server = GenerationServer(
+        engine, host=args.host, port=args.port, token=args.token
+    )
+    logger.info(f"serving {args.path} at {server.url}; Ctrl-C to stop")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -98,6 +98,9 @@ def _build_params_and_config(spec: ModelAbstraction, seed: int):
 
     if spec.type_ == "null":
         return None, None  # engine-less models (e.g. verification rewards)
+    if spec.type_ == "config":
+        # Config-only: no local weights (remote_generator workers).
+        return spec.args["config"], None
     if spec.type_ == "random":
         cfg: ModelConfig = spec.args["config"]
         params = tfm.init_params(cfg, jax.random.PRNGKey(seed))
@@ -175,6 +178,13 @@ class ModelWorker:
                     pad_token_id=getattr(self.tokenizer, "pad_token_id", None),
                     **shard.backend.args,
                 )
+            elif btype == "remote_generator":
+                # Decoupled allocation: generation served by a standalone
+                # GenerationServer; this worker holds no gen weights
+                # (reference: sglang backend, backend/sglang.py:354).
+                from areal_tpu.system.gen_server import RemoteGeneratorEngine
+
+                engine = RemoteGeneratorEngine(cfg, **shard.backend.args)
             elif btype == "null":
                 engine = None
             else:
