@@ -76,6 +76,9 @@ class APIGenerateInput:
     qid: str
     prompt_ids: list  # List[int]
     gconfig: GenerationHyperparameters
+    # Optional PRNG seed: seeded requests only co-batch with same-seed
+    # requests server-side, keeping trainer rollouts reproducible.
+    seed: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -177,6 +180,7 @@ class LLMAPIClient:
                 "top_p": g.top_p,
                 "top_k": g.top_k,
                 "temperature": g.temperature,
+                "seed": inp.seed,
             },
         )
         return APIGenerateOutput(

@@ -261,6 +261,100 @@ class PackedDataLoader:
             yield SequenceSample.gather([self.dataset[j] for j in idx])
 
 
+class RewardPairedDataset(_DatasetBase):
+    """Reward-modeling dataset: per prompt, interleaved (pos, neg) answer
+    pairs (reference: rw_paired_dataset.py `RewardModelingPairedDataset`).
+
+    Rows: {"id", "prompt", "pos_answers": [...], "neg_answers": [...]}
+    with pos/neg one-to-one.  Each item packs up to `max_pairs_per_prompt`
+    randomly-chosen pairs as [pos_i, neg_i, ...] sequences under
+    `packed_input_ids`, plus `prompt_lens` (one entry per item) so a
+    pairwise-loss interface can split prompt from answer.
+    """
+
+    def __init__(
+        self,
+        seed: int,
+        dp_rank: int,
+        world_size: int,
+        tokenizer,
+        max_length: int = 1024,
+        max_pairs_per_prompt: int = 2,
+        dataset_path: Optional[str] = None,
+        dataset_builder: Optional[Callable[[], List[Dict]]] = None,
+    ):
+        super().__init__(seed, dp_rank, world_size, tokenizer)
+        rows = self._load_rows(dataset_path, dataset_builder)
+        self.max_pairs_per_prompt = max_pairs_per_prompt
+        self._rng = np.random.default_rng(seed + 17)
+        eos = tokenizer.eos_token_id
+        self.ids: List[str] = []
+        self.prompt_lens: List[int] = []
+        self.pos_tokens: List[List[np.ndarray]] = []
+        self.neg_tokens: List[List[np.ndarray]] = []
+
+        def _tok(text: str) -> np.ndarray:
+            ids = list(tokenizer.encode(text))[: max_length - 1] + [eos]
+            return np.asarray(ids, np.int32)
+
+        n_dropped = 0
+        for x in rows:
+            pos, neg = x["pos_answers"], x["neg_answers"]
+            if len(pos) != len(neg) or not pos:
+                raise ValueError(
+                    f"row {x.get('id')}: pos/neg answers must be non-empty "
+                    "one-to-one pairs"
+                )
+            plen = len(tokenizer.encode(x["prompt"]))
+            if plen >= max_length - 1:
+                # Truncation would leave a zero-length answer span: pos and
+                # neg become identical, a zero-margin pair that silently
+                # pollutes the pairwise loss.
+                n_dropped += 1
+                continue
+            self.ids.append(str(x["id"]))
+            self.prompt_lens.append(plen)
+            self.pos_tokens.append([_tok(x["prompt"] + a) for a in pos])
+            self.neg_tokens.append([_tok(x["prompt"] + a) for a in neg])
+        if n_dropped:
+            logger.warning(
+                f"RewardPairedDataset: dropped {n_dropped} rows whose prompt "
+                f"alone reaches max_length={max_length}"
+            )
+        logger.info(
+            f"RewardPairedDataset: {len(self.ids)} prompts on dp_rank "
+            f"{dp_rank}/{world_size}"
+        )
+
+    def __len__(self):
+        return len(self.ids)
+
+    def __getitem__(self, idx: int) -> SequenceSample:
+        n_pairs = len(self.pos_tokens[idx])
+        k = min(self.max_pairs_per_prompt, n_pairs)
+        picks = self._rng.choice(n_pairs, size=k, replace=False)
+        seqs, lens = [], []
+        for i in picks:
+            seqs += [self.pos_tokens[idx][i], self.neg_tokens[idx][i]]
+            lens += [len(self.pos_tokens[idx][i]),
+                     len(self.neg_tokens[idx][i])]
+        return SequenceSample(
+            keys={"packed_input_ids", "prompt_lens"},
+            ids=[self.ids[idx]],
+            seqlens={
+                "packed_input_ids": [lens],
+                "prompt_lens": [[1]],
+            },
+            data={
+                "packed_input_ids": np.concatenate(seqs),
+                "prompt_lens": np.asarray(
+                    [self.prompt_lens[idx]], np.int32
+                ),
+            },
+        )
+
+
 data_api.register_dataset("prompt_answer", PromptAnswerDataset)
 data_api.register_dataset("prompt", PromptDataset)
 data_api.register_dataset("math_code_prompt", MathCodePromptDataset)
+data_api.register_dataset("rw_paired", RewardPairedDataset)

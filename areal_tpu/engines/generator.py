@@ -496,53 +496,76 @@ class GeneratorEngine(HostOffloadMixin, Engine):
     # -- output assembly --
 
     def _assemble(self, sample, prompt_key, prompt_lens, results, n):
-        bs = sample.bs
-        seq_ids, seq_logps, seq_masks = [], [], []
-        seqlens_full: List[List[int]] = []
-        seqlens_lp: List[List[int]] = []
-        no_eos: List[List[float]] = []
-        prompts = np.asarray(sample.data[prompt_key])
-        bounds = sample.cu_seqlens(prompt_key)
-        for i in range(bs):
-            lens_i, lens_lp_i, noeos_i = [], [], []
-            ptoks = prompts[bounds[i] : bounds[i + 1]]
-            pl = prompt_lens[i]
-            for r in range(n):
-                gtoks, glogps, ne = results[(i, r)]
-                full = np.concatenate([ptoks, gtoks]).astype(np.int32)
-                seq_ids.append(full)
-                mask = np.zeros(len(full), bool)
-                mask[:pl] = True
-                seq_masks.append(mask)
-                lp = np.zeros(max(len(full) - 1, 0), np.float32)
-                lp[pl - 1 : pl - 1 + len(gtoks)] = glogps
-                seq_logps.append(lp)
-                lens_i.append(len(full))
-                lens_lp_i.append(max(len(full) - 1, 0))
-                noeos_i.append(1.0 if ne else 0.0)
-            seqlens_full.append(lens_i)
-            seqlens_lp.append(lens_lp_i)
-            no_eos.append(noeos_i)
-        return SequenceSample(
-            keys={
-                "packed_input_ids", "packed_logprobs", "prompt_mask",
-                "seq_no_eos_mask",
-            },
-            ids=list(sample.ids),
-            seqlens={
-                "packed_input_ids": seqlens_full,
-                "prompt_mask": [list(x) for x in seqlens_full],
-                "packed_logprobs": seqlens_lp,
-                "seq_no_eos_mask": [[1] * n for _ in range(bs)],
-            },
-            data={
-                "packed_input_ids": np.concatenate(seq_ids),
-                "prompt_mask": np.concatenate(seq_masks),
-                "packed_logprobs": np.concatenate(seq_logps)
-                if seq_logps
-                else np.zeros(0, np.float32),
-                "seq_no_eos_mask": np.asarray(
-                    [x for row in no_eos for x in row], np.float32
-                ),
-            },
+        return assemble_rollout(
+            sample, prompt_key, n,
+            lambda i, r: results[(i, r)],
+            prompt_lens=prompt_lens,
         )
+
+
+def assemble_rollout(
+    sample: SequenceSample,
+    prompt_key: str,
+    n: int,
+    fetch,  # (prompt_idx, response_idx) -> (gen_tokens, gen_logprobs, no_eos)
+    prompt_lens: "Optional[List[int]]" = None,
+) -> SequenceSample:
+    """THE rollout packing layout, shared by the in-process generator and
+    the remote generation client (system/gen_server.py) so the two can
+    never drift: per response, full = prompt + generated tokens;
+    prompt_mask covers the prompt; packed_logprobs is length len(full)-1
+    with the generated-token logprobs at [pl-1, pl-1+len(gen))."""
+    bs = sample.bs
+    prompts = np.asarray(sample.data[prompt_key])
+    bounds = sample.cu_seqlens(prompt_key)
+    if prompt_lens is None:
+        prompt_lens = [int(bounds[i + 1] - bounds[i]) for i in range(bs)]
+    seq_ids, seq_logps, seq_masks = [], [], []
+    seqlens_full: List[List[int]] = []
+    seqlens_lp: List[List[int]] = []
+    no_eos: List[List[float]] = []
+    for i in range(bs):
+        lens_i, lens_lp_i, noeos_i = [], [], []
+        ptoks = prompts[bounds[i] : bounds[i + 1]]
+        pl = prompt_lens[i]
+        for r in range(n):
+            gtoks, glogps, ne = fetch(i, r)
+            gtoks = np.asarray(gtoks, np.int32)
+            glogps = np.asarray(glogps, np.float32)
+            full = np.concatenate([ptoks, gtoks]).astype(np.int32)
+            seq_ids.append(full)
+            mask = np.zeros(len(full), bool)
+            mask[:pl] = True
+            seq_masks.append(mask)
+            lp = np.zeros(max(len(full) - 1, 0), np.float32)
+            lp[pl - 1 : pl - 1 + len(gtoks)] = glogps
+            seq_logps.append(lp)
+            lens_i.append(len(full))
+            lens_lp_i.append(max(len(full) - 1, 0))
+            noeos_i.append(1.0 if ne else 0.0)
+        seqlens_full.append(lens_i)
+        seqlens_lp.append(lens_lp_i)
+        no_eos.append(noeos_i)
+    return SequenceSample(
+        keys={
+            "packed_input_ids", "packed_logprobs", "prompt_mask",
+            "seq_no_eos_mask",
+        },
+        ids=list(sample.ids),
+        seqlens={
+            "packed_input_ids": seqlens_full,
+            "prompt_mask": [list(x) for x in seqlens_full],
+            "packed_logprobs": seqlens_lp,
+            "seq_no_eos_mask": [[1] * n for _ in range(bs)],
+        },
+        data={
+            "packed_input_ids": np.concatenate(seq_ids),
+            "prompt_mask": np.concatenate(seq_masks),
+            "packed_logprobs": np.concatenate(seq_logps)
+            if seq_logps
+            else np.zeros(0, np.float32),
+            "seq_no_eos_mask": np.asarray(
+                [x for row in no_eos for x in row], np.float32
+            ),
+        },
+    )

@@ -170,6 +170,10 @@ class PPOMathConfig:
     # ppo_math_exp.py:132-136) — CPU reward grading overlaps the device
     # forward.  Requires a ref model.
     fuse_rew_ref: bool = False
+    # EMA reference policy: after each actor train step, ref <-
+    # eta*actor + (1-eta)*ref (reference: ppo_math_exp.py:345-364
+    # ref_ema_eta option via ParamReallocHook).  None = frozen ref.
+    ref_ema_eta: Optional[float] = None
     # Decoupled serving: URL of a standalone GenerationServer
     # (areal_tpu/system/gen_server.py).  actor_gen then uses the
     # remote_generator backend — this worker holds NO generation weights,
@@ -348,6 +352,13 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
             )
         )
         train_inputs.append("values")
+    train_post_hooks = [ParamReallocHook(target=actor_gen)]
+    if cfg.ref_ema_eta is not None:
+        if ref is None:
+            raise ValueError("ref_ema_eta requires a ref model")
+        train_post_hooks.append(
+            ParamReallocHook(target=ref, eta=cfg.ref_ema_eta)
+        )
     nodes.append(
         MFCDef(
             name="actor_train",
@@ -358,8 +369,9 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
             n_seqs=cfg.batch_size,
             mb_spec=cfg.mb_spec,
             # After training, push fresh weights into the generator
-            # (reference: param_realloc post-hook / update_weights_from_disk).
-            post_hooks=[ParamReallocHook(target=actor_gen)],
+            # (reference: param_realloc post-hook / update_weights_from_disk);
+            # optionally EMA-update the reference policy.
+            post_hooks=train_post_hooks,
         )
     )
     if critic is not None:

@@ -52,13 +52,18 @@ class _Pending:
     prompt_ids: List[int]
     gconfig: GenerationHyperparameters
     done: threading.Event
+    seed: Optional[int] = None
     result: Optional[Dict[str, Any]] = None
     error: Optional[str] = None
 
 
-def _gkey(g: GenerationHyperparameters):
+def _gkey(p: _Pending):
+    g = p.gconfig
+    # Seed is part of the key: requests merged into one engine call share
+    # one PRNG stream, so only same-seed (or unseeded) requests co-batch —
+    # keeps a seeded trainer's rollouts reproducible.
     return (g.n, g.max_new_tokens, g.min_new_tokens, g.greedy, g.top_p,
-            g.top_k, g.temperature)
+            g.top_k, g.temperature, p.seed)
 
 
 class GenerationServer:
@@ -156,9 +161,12 @@ class GenerationServer:
             prompt_ids=[int(t) for t in req["prompt_ids"]],
             gconfig=g,
             done=threading.Event(),
+            seed=(int(req["seed"]) if req.get("seed") is not None else None),
         )
         self._queue.put(p)
-        p.done.wait()
+        while not p.done.wait(timeout=1.0):
+            if self._stop.is_set():
+                raise RuntimeError("generation server shutting down")
         if p.error:
             raise RuntimeError(p.error)
         return p.result
@@ -193,16 +201,27 @@ class GenerationServer:
                     break
             by_g: Dict[Any, List[_Pending]] = {}
             for p in batch:
-                by_g.setdefault(_gkey(p.gconfig), []).append(p)
+                by_g.setdefault(_gkey(p), []).append(p)
             for group in by_g.values():
                 self._run_group(group)
+        # Shutdown: fail anything still queued so no client hangs.
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            p.error = "generation server shutting down"
+            p.done.set()
 
     def _run_group(self, group: List[_Pending]):
         try:
             g = group[0].gconfig
+            # Internal ids are positional: client qids may collide across
+            # concurrent trainers sharing this server.
+            uids = [f"u{i}" for i in range(len(group))]
             sample = SequenceSample(
                 keys={"packed_prompts"},
-                ids=[p.qid for p in group],
+                ids=uids,
                 seqlens={
                     "packed_prompts": [[len(p.prompt_ids)] for p in group]
                 },
@@ -213,15 +232,16 @@ class GenerationServer:
                 },
             )
             self._seed += 1
+            seed = group[0].seed if group[0].seed is not None else self._seed
             with self._engine_lock:
                 version = self.version
                 out = self.engine.generate(
-                    sample, MicroBatchSpec(), g, seed=self._seed
+                    sample, MicroBatchSpec(), g, seed=seed
                 )
             per_id = {s.ids[0]: s for s in out.unpack()}
-            for p in group:
+            for uid, p in zip(uids, group):
                 p.result = _extract_output(
-                    per_id[p.qid], len(p.prompt_ids), g.n, version
+                    per_id[uid], len(p.prompt_ids), g.n, version
                 )
         except Exception as e:  # noqa: BLE001 — fail the whole group
             logger.error(f"generation batch failed: {e!r}")
@@ -303,6 +323,8 @@ class RemoteGeneratorEngine(Engine):
         prompt_key: str = "packed_prompts",
         seed: int = 0,
     ) -> SequenceSample:
+        from areal_tpu.engines.generator import assemble_rollout
+
         prompts = np.asarray(sample.data[prompt_key])
         bounds = sample.cu_seqlens(prompt_key)
         inps = [
@@ -310,11 +332,17 @@ class RemoteGeneratorEngine(Engine):
                 qid=sample.ids[i],
                 prompt_ids=[int(t) for t in prompts[bounds[i]:bounds[i + 1]]],
                 gconfig=gconfig,
+                seed=seed,
             )
             for i in range(sample.bs)
         ]
         outs = {o.qid: o for o in self.client.generate_batch(inps)}
-        return _assemble_from_api(sample, prompt_key, gconfig.n, outs)
+
+        def fetch(i, r):
+            o = outs[sample.ids[i]]
+            return o.output_ids[r], o.output_logprobs[r], o.no_eos[r]
+
+        return assemble_rollout(sample, prompt_key, gconfig.n, fetch)
 
     def get_params(self):
         raise NotImplementedError(
@@ -330,66 +358,6 @@ class RemoteGeneratorEngine(Engine):
             self.sync_dir, self.cfg, params, model_type=self.model_type
         )
         self.client.update_weights_from_disk(self.sync_dir)
-
-
-def _assemble_from_api(
-    sample: SequenceSample,
-    prompt_key: str,
-    n: int,
-    outs: Dict[str, APIGenerateOutput],
-) -> SequenceSample:
-    """Rebuild the rollout SequenceSample (same layout as
-    GeneratorEngine._assemble) from per-request API outputs."""
-    prompts = np.asarray(sample.data[prompt_key])
-    bounds = sample.cu_seqlens(prompt_key)
-    seq_ids, seq_logps, seq_masks = [], [], []
-    seqlens_full, seqlens_lp, no_eos = [], [], []
-    for i in range(sample.bs):
-        o = outs[sample.ids[i]]
-        ptoks = prompts[bounds[i] : bounds[i + 1]]
-        pl = len(ptoks)
-        lens_i, lens_lp_i, noeos_i = [], [], []
-        for r in range(n):
-            gtoks = np.asarray(o.output_ids[r], np.int32)
-            glogps = np.asarray(o.output_logprobs[r], np.float32)
-            full = np.concatenate([ptoks, gtoks]).astype(np.int32)
-            seq_ids.append(full)
-            mask = np.zeros(len(full), bool)
-            mask[:pl] = True
-            seq_masks.append(mask)
-            lp = np.zeros(max(len(full) - 1, 0), np.float32)
-            lp[pl - 1 : pl - 1 + len(gtoks)] = glogps
-            seq_logps.append(lp)
-            lens_i.append(len(full))
-            lens_lp_i.append(max(len(full) - 1, 0))
-            noeos_i.append(1.0 if o.no_eos[r] else 0.0)
-        seqlens_full.append(lens_i)
-        seqlens_lp.append(lens_lp_i)
-        no_eos.append(noeos_i)
-    return SequenceSample(
-        keys={
-            "packed_input_ids", "packed_logprobs", "prompt_mask",
-            "seq_no_eos_mask",
-        },
-        ids=list(sample.ids),
-        seqlens={
-            "packed_input_ids": seqlens_full,
-            "prompt_mask": [list(x) for x in seqlens_full],
-            "packed_logprobs": seqlens_lp,
-            "seq_no_eos_mask": [[1] * n for _ in range(sample.bs)],
-        },
-        data={
-            "packed_input_ids": np.concatenate(seq_ids),
-            "prompt_mask": np.concatenate(seq_masks),
-            "packed_logprobs": (
-                np.concatenate(seq_logps)
-                if seq_logps else np.zeros(0, np.float32)
-            ),
-            "seq_no_eos_mask": np.asarray(
-                [x for row in no_eos for x in row], np.float32
-            ),
-        },
-    )
 
 
 register_backend(

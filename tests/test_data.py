@@ -180,3 +180,46 @@ class TestDatasets:
         assert sorted(sum(e1, [])) == sorted(sum(e2, []))
         assert e1 != e2  # reshuffled
         assert [len(i) for i in e1] == [5, 5, 2]
+
+
+def test_rw_paired_dataset():
+    """Paired RM dataset (reference: rw_paired_dataset.py): interleaved
+    pos/neg sequences, pair sampling capped, prompt_lens carried."""
+    from areal_tpu.api.data_api import DatasetAbstraction, make_dataset
+
+    tok = fixtures.make_tokenizer()
+    rows = [
+        {
+            "id": f"r{i}",
+            "prompt": f"question {i} ",
+            "pos_answers": [f"good answer {j}" for j in range(3)],
+            "neg_answers": [f"bad answer {j}" for j in range(3)],
+        }
+        for i in range(6)
+    ]
+    ds = make_dataset(
+        DatasetAbstraction(
+            "rw_paired",
+            {"dataset_builder": lambda: rows, "max_length": 64,
+             "max_pairs_per_prompt": 2},
+        ),
+        seed=3, dp_rank=0, world_size=1, tokenizer=tok,
+    )
+    assert len(ds) == 6
+    s = ds[0]
+    lens = s.seqlens["packed_input_ids"][0]
+    assert len(lens) == 4  # 2 pairs -> [pos, neg, pos, neg]
+    assert sum(lens) == len(s.data["packed_input_ids"])
+    assert s.seqlens["prompt_lens"] == [[1]]
+    assert int(s.data["prompt_lens"][0]) > 0
+
+    # One-to-one validation.
+    bad = [{"id": "b", "prompt": "p", "pos_answers": ["a"],
+            "neg_answers": []}]
+    with pytest.raises(ValueError, match="one-to-one"):
+        make_dataset(
+            DatasetAbstraction(
+                "rw_paired", {"dataset_builder": lambda: bad}
+            ),
+            seed=0, dp_rank=0, world_size=1, tokenizer=tok,
+        )

@@ -325,3 +325,51 @@ class TestPPOMathExperiment:
             assert np.isclose(stats[-1][k], v, rtol=1e-3, atol=1e-5), (
                 k, stats[-1][k], v,
             )
+
+
+class TestEMARef:
+    def test_ref_ema_tracks_actor(self, tmp_path):
+        """ref_ema_eta adds an EMA ParamReallocHook on actor_train
+        (reference: ppo_math_exp.py:345-364): with eta=1.0 the ref equals
+        the actor after each step; with eta=None it stays frozen."""
+        import jax
+
+        tok = fixtures.make_tokenizer()
+        rows = fixtures.build_math_rows(8, seed=4)
+
+        def run(eta, sub):
+            cfg = PPOMathConfig(
+                actor=ModelAbstraction("random", {"config": tiny_config()}),
+                ref=ModelAbstraction("random", {"config": tiny_config()}),
+                dataset=DatasetAbstraction(
+                    "math_code_prompt",
+                    {"dataset_builder": lambda: rows, "max_length": 64},
+                ),
+                reward_interface_args={
+                    "id2info": {r["query_id"]: r for r in rows}
+                },
+                gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+                ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+                optimizer=OptimizerConfig(
+                    lr=1e-3, warmup_steps_proportion=0.0
+                ),
+                ref_ema_eta=eta,
+                batch_size=4,
+                ctrl=ExperimentSaveEvalControl(benchmark_steps=2),
+                fileroot=str(tmp_path / sub),
+            )
+            master, _ = run_experiment(build_ppo_math(cfg, tok), tokenizer=tok)
+            workers = master.pool._workers if hasattr(
+                master.pool, "_workers") else master.pool.workers
+            w = workers[0]
+            actor_p = w.models["actor@0"].engine.get_params()
+            ref_p = w.models["ref@0"].engine.get_params()
+            diffs = jax.tree.map(
+                lambda a, b: float(np.abs(np.asarray(a, np.float32)
+                                          - np.asarray(b, np.float32)).max()),
+                actor_p, ref_p,
+            )
+            return max(jax.tree.leaves(diffs))
+
+        assert run(1.0, "ema") < 1e-5      # ref snapped onto the actor
+        assert run(None, "frozen") > 1e-5  # frozen ref drifted from actor
