@@ -29,7 +29,13 @@ class ParamReallocHook:
 
 @dataclasses.dataclass
 class OffloadHook:
-    """Move a model's params to host memory after the call."""
+    """Move a model's params to host memory after the call.
+
+    `target` defaults to the MFC's own model; set it to offload a DIFFERENT
+    model (e.g. re-offload an EMA-updated ref right after the train step
+    that touched it)."""
+
+    target: Optional[ModelName] = None
 
 
 @dataclasses.dataclass

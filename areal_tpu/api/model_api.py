@@ -77,7 +77,9 @@ class APIGenerateInput:
     prompt_ids: list  # List[int]
     gconfig: GenerationHyperparameters
     # Optional PRNG seed: seeded requests only co-batch with same-seed
-    # requests server-side, keeping trainer rollouts reproducible.
+    # requests server-side (PRNG-stream isolation from other clients;
+    # bitwise replay across runs is not guaranteed — batching follows
+    # arrival timing).
     seed: Optional[int] = None
 
 

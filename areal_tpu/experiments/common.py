@@ -359,6 +359,10 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
         train_post_hooks.append(
             ParamReallocHook(target=ref, eta=cfg.ref_ema_eta)
         )
+        if cfg.offload_ref:
+            # The EMA update reloads the ref onto device; push it back to
+            # host so offload_ref keeps its HBM freed between steps.
+            train_post_hooks.append(OffloadHook(target=ref))
     nodes.append(
         MFCDef(
             name="actor_train",

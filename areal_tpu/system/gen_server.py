@@ -60,8 +60,11 @@ class _Pending:
 def _gkey(p: _Pending):
     g = p.gconfig
     # Seed is part of the key: requests merged into one engine call share
-    # one PRNG stream, so only same-seed (or unseeded) requests co-batch —
-    # keeps a seeded trainer's rollouts reproducible.
+    # one PRNG stream, so a seeded trainer's batch never co-samples with
+    # other clients' requests (stream ISOLATION).  Bitwise replay across
+    # runs is NOT guaranteed — group composition still follows HTTP
+    # arrival timing; exact-replay trainers should use the in-process
+    # generator.
     return (g.n, g.max_new_tokens, g.min_new_tokens, g.greedy, g.top_p,
             g.top_k, g.temperature, p.seed)
 

@@ -401,16 +401,18 @@ class MasterWorker:
 
     async def _run_hook(self, hook, node: MFCDef, group: List[int]):
         if isinstance(hook, OffloadHook):
+            target = str(hook.target or node.model_name)
+            targets = (
+                self.replicas.get(target)
+                or (self._hook_target_set(target) if hook.target else group)
+            )
             await asyncio.gather(
                 *[
                     self.pool.request(
                         w,
-                        {
-                            "type": "offload",
-                            "model_name": str(node.model_name),
-                        },
+                        {"type": "offload", "model_name": target},
                     )
-                    for w in self.replicas.get(str(node.model_name)) or group
+                    for w in targets
                 ]
             )
         elif isinstance(hook, ParamReallocHook):

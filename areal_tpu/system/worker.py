@@ -377,6 +377,18 @@ class ModelWorker:
                 u = monitor.mfu(flops, seconds, n_dev)
                 if u is not None:
                     perf["perf/mfu"] = u
+            # Device memory after the MFC (reference: per-worker GPU
+            # mem/util tables, model_worker.py:1434-1537).  TPU runtimes
+            # expose bytes_in_use/bytes_limit via memory_stats(); CPU
+            # devices return None.
+            if getattr(model.engine, "mesh", None) is not None:
+                stats = model.engine.mesh.devices.flat[0].memory_stats()
+                if stats and "bytes_in_use" in stats:
+                    perf["perf/hbm_gb"] = stats["bytes_in_use"] / 1e9
+                    if stats.get("bytes_limit"):
+                        perf["perf/hbm_frac"] = (
+                            stats["bytes_in_use"] / stats["bytes_limit"]
+                        )
         except Exception as e:  # perf accounting must never fail the MFC
             logger.warning(f"perf accounting failed: {e!r}")
         return perf

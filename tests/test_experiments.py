@@ -373,3 +373,34 @@ class TestEMARef:
 
         assert run(1.0, "ema") < 1e-5      # ref snapped onto the actor
         assert run(None, "frozen") > 1e-5  # frozen ref drifted from actor
+
+    def test_ref_ema_with_offload_stays_offloaded(self, tmp_path):
+        """offload_ref + ref_ema_eta: the EMA update reloads the ref, and
+        the builder's trailing OffloadHook pushes it back to host."""
+        tok = fixtures.make_tokenizer()
+        rows = fixtures.build_math_rows(8, seed=4)
+        cfg = PPOMathConfig(
+            actor=ModelAbstraction("random", {"config": tiny_config()}),
+            ref=ModelAbstraction("random", {"config": tiny_config()}),
+            dataset=DatasetAbstraction(
+                "math_code_prompt",
+                {"dataset_builder": lambda: rows, "max_length": 64},
+            ),
+            reward_interface_args={
+                "id2info": {r["query_id"]: r for r in rows}
+            },
+            gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+            ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+            optimizer=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0),
+            ref_ema_eta=0.5,
+            offload_ref=True,
+            batch_size=4,
+            ctrl=ExperimentSaveEvalControl(benchmark_steps=2),
+            fileroot=str(tmp_path),
+        )
+        master, stats = run_experiment(build_ppo_math(cfg, tok), tokenizer=tok)
+        assert len(stats) == 2
+        w = master.pool.workers[0]
+        ref_eng = w.models["ref@0"].engine
+        # After the trial's last train step, the ref sits offloaded on host.
+        assert ref_eng._host_offload is not None
