@@ -28,6 +28,12 @@ class GenerationHyperparameters:
     top_p: float = 1.0
     top_k: int = 0  # 0 = disabled
     temperature: float = 1.0
+    # Speculative decoding (inflight generator): draft this many tokens per
+    # step by self n-gram lookup and verify with exact rejection sampling —
+    # emitted distribution is unchanged; decode steps amortize one weight
+    # stream over up to k+1 tokens.  0 = off.
+    spec_decode_k: int = 0
+    spec_ngram: int = 3  # gram length for the lookup proposal
 
     def new(self, **kwargs):
         return dataclasses.replace(self, **kwargs)
@@ -182,6 +188,8 @@ class LLMAPIClient:
                 "top_p": g.top_p,
                 "top_k": g.top_k,
                 "temperature": g.temperature,
+                "spec_decode_k": g.spec_decode_k,
+                "spec_ngram": g.spec_ngram,
                 "seed": inp.seed,
             },
         )

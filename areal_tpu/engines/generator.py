@@ -163,7 +163,9 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         results: Dict[Tuple[int, int], Tuple[np.ndarray, np.ndarray, bool]] = {}
         key = jax.random.PRNGKey(seed)
         b_cap = max(self.batch_shard, self.max_decode_batch)
-        if inflight is None:
+        if gconfig.spec_decode_k > 0:
+            inflight = True  # spec decoding lives on the inflight path
+        elif inflight is None:
             # Static chunks win when every request fits one pool (uniform
             # lengths, no refills, zero per-chunk host round-trips);
             # inflight wins when stragglers would otherwise stall retired
@@ -186,6 +188,11 @@ class GeneratorEngine(HostOffloadMixin, Engine):
     def _generate_inflight(self, reqs, gconfig, key, results) -> None:
         """Fixed slot pool; retire finished rows and admit pending requests
         between jitted T-token decode chunks."""
+        if gconfig.spec_decode_k > 0:
+            return self._generate_inflight_spec(reqs, gconfig, key, results)
+        return self._generate_inflight_plain(reqs, gconfig, key, results)
+
+    def _generate_inflight_plain(self, reqs, gconfig, key, results) -> None:
         n_slots = min(max(self.batch_shard, self.max_decode_batch), len(reqs))
         while n_slots % self.batch_shard:
             n_slots += 1
@@ -262,36 +269,47 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             new_done = to_host(new_done)
 
             # Host bookkeeping: append tokens, retire finished slots.
-            for s in range(n_slots):
-                if active[s] is None:
-                    continue
-                for t in range(chunk_t):
-                    if len(toks_acc[s]) >= gconfig.max_new_tokens:
-                        break
-                    tok = int(out_toks[s, t])
-                    if tok < 0:  # was already done within the chunk
-                        break
-                    toks_acc[s].append(tok)
-                    logps_acc[s].append(float(out_logps[s, t]))
-                    if tok == self.eos_token_id:
-                        break
-                finished = (
-                    len(toks_acc[s]) >= gconfig.max_new_tokens
-                    or (toks_acc[s] and toks_acc[s][-1] == self.eos_token_id)
-                )
-                if finished:
-                    i, rep = active[s]
-                    gtoks = np.asarray(toks_acc[s], np.int32)
-                    glogps = np.asarray(logps_acc[s], np.float32)
-                    no_eos = not (
-                        len(gtoks) and gtoks[-1] == self.eos_token_id
-                    )
-                    results[(i, rep)] = (gtoks, glogps, no_eos)
-                    active[s] = None
-                    done_host[s] = True
-                    cache_len[s] = 0  # dead slot must not drive growth
-                else:
-                    done_host[s] = bool(new_done[s])
+            self._drain_chunk_outputs(
+                out_toks, out_logps, new_done, active, toks_acc, logps_acc,
+                results, done_host, cache_len, gconfig.max_new_tokens,
+            )
+
+    def _drain_chunk_outputs(
+        self, out_toks, out_logps, new_done, active, toks_acc, logps_acc,
+        results, done_host, cache_len, max_new: int,
+    ) -> None:
+        """Shared inflight bookkeeping (plain + speculative loops): append
+        each live slot's chunk output (rows are contiguous, -1-terminated),
+        finish on EOS or the token budget, retire finished slots (a dead
+        slot must not drive cache growth)."""
+        for s in range(len(active)):
+            if active[s] is None:
+                continue
+            for t in range(out_toks.shape[1]):
+                if len(toks_acc[s]) >= max_new:
+                    break
+                tok = int(out_toks[s, t])
+                if tok < 0:  # slot was already done within the chunk
+                    break
+                toks_acc[s].append(tok)
+                logps_acc[s].append(float(out_logps[s, t]))
+                if tok == self.eos_token_id:
+                    break
+            finished = (
+                len(toks_acc[s]) >= max_new
+                or (toks_acc[s] and toks_acc[s][-1] == self.eos_token_id)
+            )
+            if finished:
+                i, rep = active[s]
+                gtoks = np.asarray(toks_acc[s], np.int32)
+                glogps = np.asarray(logps_acc[s], np.float32)
+                no_eos = not (len(gtoks) and gtoks[-1] == self.eos_token_id)
+                results[(i, rep)] = (gtoks, glogps, no_eos)
+                active[s] = None
+                done_host[s] = True
+                cache_len[s] = 0
+            else:
+                done_host[s] = bool(new_done[s])
 
     def _get_prefill_slot_fn(self, sp: int):
         sig = ("prefill_slot", sp)
@@ -383,6 +401,247 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         logger.info(
             f"compiled inflight decoder n_slots={n_slots} s_max={s_max} "
             f"chunk={chunk_t}"
+        )
+        return fn
+
+    # -- speculative inflight (n-gram drafts + exact verification) --
+
+    def _generate_inflight_spec(self, reqs, g, key, results) -> None:
+        """Continuous batching with speculative decoding: each jitted step
+        consumes [pending, K drafts] in ONE forward (weight stream amortized
+        over up to K+1 emitted tokens); drafts come from self n-gram lookup
+        (ops/ngram.py) and are verified by exact rejection sampling
+        (ops/sampling.py spec_accept), so the emitted distribution equals
+        plain sampling.  Reference role: the SGLang server's speculative
+        decode config; correctness contract from ops/sampling tests."""
+        K = g.spec_decode_k
+        n_slots = min(max(self.batch_shard, self.max_decode_batch), len(reqs))
+        while n_slots % self.batch_shard:
+            n_slots += 1
+        max_prompt = max(len(t) for (_, _, t) in reqs)
+        n_steps = max(1, min(32, g.max_new_tokens) // (K + 1))
+        step_cap = n_steps * (K + 1)
+
+        cur_w = bucket_len(max_prompt + step_cap + K + 1)
+        cache = tfm.init_kv_cache(
+            self.cfg, n_slots, cur_w, dtype=self.compute_dtype
+        )
+        # History buffer: prompt + emitted tokens per row (device-resident;
+        # the in-chunk n-gram proposal reads it).
+        tokens_buf = jnp.zeros((n_slots, cur_w + K + 2), jnp.int32)
+        pending = jnp.zeros((n_slots,), jnp.int32)
+        cache_len = np.zeros((n_slots,), np.int32)
+        gen_count = np.zeros((n_slots,), np.int32)
+        done_host = np.ones((n_slots,), bool)
+        active: List[Optional[Tuple[int, int]]] = [None] * n_slots
+        toks_acc: Dict[int, List[int]] = {}
+        logps_acc: Dict[int, List[float]] = {}
+        pending_list = list(reversed(reqs))
+
+        while pending_list or any(a is not None for a in active):
+            for s in range(n_slots):
+                if active[s] is None and pending_list:
+                    i, rep, toks = pending_list.pop()
+                    sp = bucket_len(len(toks))
+                    row = np.full((1, sp), self.pad_token_id, np.int32)
+                    row[0, : len(toks)] = toks
+                    key, sub = jax.random.split(key)
+                    tok0, logp0, cache, tokens_buf, pending = (
+                        self._get_spec_admit_fn(sp, tokens_buf.shape[1], g)(
+                            self.params, jnp.asarray(row),
+                            jnp.int32(len(toks)), cache, tokens_buf,
+                            pending, jnp.int32(s), sub,
+                        )
+                    )
+                    cache_len[s] = len(toks)
+                    gen_count[s] = 1  # the sampled pending token
+                    t0 = int(tok0)
+                    done_host[s] = t0 == self.eos_token_id
+                    active[s] = (i, rep)
+                    toks_acc[s] = [t0]
+                    logps_acc[s] = [float(logp0)]
+
+            # Growth: a chunk can add up to step_cap entries (+K scratch).
+            need = int(cache_len.max()) + step_cap + K + 1
+            if need > cur_w:
+                new_w = bucket_len(max(need, 2 * cur_w))
+                pad = [(0, 0), (0, 0), (0, new_w - cur_w), (0, 0), (0, 0)]
+                cache = tfm.KVCache(
+                    k=jnp.pad(cache.k, pad), v=jnp.pad(cache.v, pad)
+                )
+                tokens_buf = jnp.pad(
+                    tokens_buf,
+                    [(0, 0), (0, new_w + K + 2 - tokens_buf.shape[1])],
+                )
+                cur_w = new_w
+
+            fn = self._get_spec_decode_fn(n_slots, cur_w, n_steps, g)
+            key, sub = jax.random.split(key)
+            (
+                out_toks, out_logps, tokens_buf, cache, pending,
+                new_cache_len, new_gen_count, new_done,
+            ) = fn(
+                self.params, cache, tokens_buf, pending,
+                jnp.asarray(cache_len), jnp.asarray(gen_count),
+                jnp.asarray(done_host), sub,
+            )
+            out_toks = to_host(out_toks)
+            out_logps = to_host(out_logps)
+            cache_len = to_host(new_cache_len).copy()
+            gen_count = to_host(new_gen_count).copy()
+
+            self._drain_chunk_outputs(
+                out_toks, out_logps, to_host(new_done), active, toks_acc,
+                logps_acc, results, done_host, cache_len, g.max_new_tokens,
+            )
+
+    def _get_spec_admit_fn(self, sp: int, buf_w: int, g):
+        sig = ("spec_admit", sp, buf_w, g.greedy, g.top_p, g.top_k,
+               g.temperature, g.min_new_tokens)
+        if sig in self._gen_fns:
+            return self._gen_fns[sig]
+        cfg = self.cfg
+        eos = self.eos_token_id
+        use_flash = (
+            False if isinstance(self._use_flash, Mesh) else self._use_flash
+        )
+
+        @functools.partial(jax.jit, donate_argnums=(3, 4, 5))
+        def fn(params, row, plen, cache, tokens_buf, pending, slot, key):
+            logits_row, cache = tfm.prefill_into_slot(
+                params, cfg, row, plen, cache, slot, use_flash=use_flash
+            )
+            lg = logits_row[None]
+            if g.min_new_tokens > 0:
+                lg = jnp.where(
+                    (jnp.arange(cfg.vocab_size) == eos)[None, :], -1e10, lg
+                )
+            tok, logp = sample_token(
+                lg, key, temperature=g.temperature, top_k=g.top_k,
+                top_p=g.top_p, greedy=g.greedy,
+            )
+            tokens_buf = jax.lax.dynamic_update_slice(
+                tokens_buf, row, (slot, 0)
+            )
+            tokens_buf = tokens_buf.at[slot, plen].set(tok[0])
+            pending = pending.at[slot].set(tok[0])
+            return tok[0], logp[0], cache, tokens_buf, pending
+
+        self._gen_fns[sig] = fn
+        return fn
+
+    def _get_spec_decode_fn(
+        self, n_slots: int, s_max: int, n_steps: int,
+        g: GenerationHyperparameters,
+    ):
+        K = g.spec_decode_k
+        sig = (
+            "spec_decode", n_slots, s_max, n_steps, K, g.spec_ngram,
+            g.min_new_tokens, g.greedy, g.top_p, g.top_k, g.temperature,
+        )
+        if sig in self._gen_fns:
+            return self._gen_fns[sig]
+        cfg = self.cfg
+        eos = self.eos_token_id
+        from areal_tpu.ops.ngram import propose_ngram
+        from areal_tpu.ops.sampling import spec_accept
+
+        out_w = n_steps * (K + 1)
+        rows = jnp.arange(n_slots)
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def fn(params, cache, tokens_buf, pending, cache_len, gen_count,
+               done, key):
+            out_toks = jnp.full((n_slots, out_w), -1, jnp.int32)
+            out_logps = jnp.zeros((n_slots, out_w), jnp.float32)
+            out_fill = jnp.zeros((n_slots,), jnp.int32)
+
+            def body(t, st):
+                (cache, tokens_buf, pending, cache_len, gen_count, done,
+                 out_toks, out_logps, out_fill) = st
+                drafts = propose_ngram(
+                    tokens_buf, cache_len + 1, K, g.spec_ngram
+                )  # [B, K]
+                inputs = jnp.concatenate(
+                    [pending[:, None], drafts], axis=1
+                )  # [B, K+1]
+                slots0 = jnp.minimum(cache_len, s_max - 1 - K)
+                positions = slots0[:, None] + jnp.arange(K + 1)[None, :]
+                logits, cache2 = tfm.decode_step_spec(
+                    params, cfg,
+                    jnp.where(done[:, None], eos, inputs),
+                    positions, cache, slots0,
+                )  # [B, K+1, V]
+                if g.min_new_tokens > 0:
+                    not_enough = (
+                        gen_count[:, None] + jnp.arange(K + 1)[None, :]
+                    ) < g.min_new_tokens
+                    logits = jnp.where(
+                        not_enough[:, :, None]
+                        & (jnp.arange(cfg.vocab_size) == eos)[None, None, :],
+                        -1e10,
+                        logits,
+                    )
+                sub = jax.random.fold_in(key, t)
+                emitted, logps, n_emit = spec_accept(
+                    logits, drafts, sub,
+                    temperature=g.temperature, top_k=g.top_k, top_p=g.top_p,
+                    greedy=g.greedy,
+                )
+                n_emit = jnp.where(done, 0, n_emit)
+                # Truncate at the first EOS (inclusive).
+                j_idx = jnp.arange(K + 1)[None, :]
+                is_eos = (emitted == eos) & (j_idx < n_emit[:, None])
+                eos_pos = jnp.min(
+                    jnp.where(is_eos, j_idx, K + 1), axis=1
+                )
+                n_emit = jnp.minimum(n_emit, eos_pos + 1)
+                new_done = done | jnp.any(is_eos, axis=1)
+                valid = j_idx < n_emit[:, None]
+                # Append to the output buffers at per-row fill offsets.
+                cols = out_fill[:, None] + j_idx
+                out_toks = out_toks.at[rows[:, None], cols].set(
+                    jnp.where(valid, emitted, -1)
+                )
+                out_logps = out_logps.at[rows[:, None], cols].set(
+                    jnp.where(valid, logps, 0.0)
+                )
+                out_fill = out_fill + n_emit
+                # History: emitted tokens live at positions L+1..L+n_emit.
+                bcols = jnp.minimum(
+                    cache_len[:, None] + 1 + j_idx, tokens_buf.shape[1] - 1
+                )
+                cur = tokens_buf[rows[:, None], bcols]
+                tokens_buf = tokens_buf.at[rows[:, None], bcols].set(
+                    jnp.where(valid, emitted, cur)
+                )
+                new_pending = jnp.take_along_axis(
+                    emitted, jnp.clip(n_emit - 1, 0, K)[:, None], axis=1
+                )[:, 0]
+                pending2 = jnp.where(
+                    done | (n_emit == 0), pending, new_pending
+                )
+                cache_len2 = cache_len + n_emit
+                gen_count2 = gen_count + n_emit
+                return (
+                    cache2, tokens_buf, pending2, cache_len2, gen_count2,
+                    new_done, out_toks, out_logps, out_fill,
+                )
+
+            st = (cache, tokens_buf, pending, cache_len, gen_count, done,
+                  out_toks, out_logps, out_fill)
+            st = jax.lax.fori_loop(0, n_steps, body, st)
+            (cache, tokens_buf, pending, cache_len, gen_count, done,
+             out_toks, out_logps, _) = st
+            return (
+                out_toks, out_logps, tokens_buf, cache, pending,
+                cache_len, gen_count, done,
+            )
+
+        self._gen_fns[sig] = fn
+        logger.info(
+            f"compiled spec decoder n_slots={n_slots} s_max={s_max} "
+            f"steps={n_steps} K={K}"
         )
         return fn
 

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from areal_tpu.models.config import ModelConfig
 from areal_tpu.ops.attention import (
     decode_attention,
+    decode_attention_chunk,
     packed_attention,
     repeat_kv,
 )
@@ -663,6 +664,60 @@ def decode_step_inflight(
     )
     x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
     logits = _head(params, cfg, x)[:, 0]
+    return logits, KVCache(k=kc, v=vc)
+
+
+def decode_step_spec(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [B, Q] int32 — pending token + Q-1 drafts per row
+    positions: jax.Array,  # [B, Q] int32 — RoPE positions
+    cache: KVCache,
+    slots0: jax.Array,  # [B] int32 — write slot of tokens[:, 0]
+) -> Tuple[jax.Array, KVCache]:
+    """Speculative decode step: consume Q consecutive tokens per row in ONE
+    forward, writing their k/v at slots0..slots0+Q-1 and returning fp32
+    logits [B, Q, V] (logits[:, j] = next-token distribution after
+    tokens[:, :j+1]).  The Q-1 drafted inputs amortize a full weight stream
+    over up to Q accepted tokens — the decode-bandwidth win speculative
+    decoding exists for.  Rejected drafts leave stale cache entries past
+    the accepted prefix; they are overwritten when those positions are
+    consumed for real (left-aligned per-row layout, as
+    `decode_step_inflight`)."""
+    b, q_len = tokens.shape
+    x = _embed(params, cfg, tokens.reshape(-1), positions.reshape(-1))
+    x = x.reshape(b, q_len, cfg.hidden_dim)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    rows = jnp.arange(b)
+    col_idx = slots0[:, None] + jnp.arange(q_len)[None, :]  # [B, Q]
+
+    def body(carry, blk):
+        y, kc, vc, li = carry
+        h = _norm(y, blk["ln1"], blk.get("ln1_b"), cfg)
+        q, k, v = _block_kv(h, blk, cfg, cos, sin)  # [B, Q, h, d]
+        kc = kc.at[li, rows[:, None], col_idx].set(k.astype(kc.dtype))
+        vc = vc.at[li, rows[:, None], col_idx].set(v.astype(vc.dtype))
+        k_layer = jax.lax.dynamic_index_in_dim(kc, li, axis=0, keepdims=False)
+        v_layer = jax.lax.dynamic_index_in_dim(vc, li, axis=0, keepdims=False)
+        attn = decode_attention_chunk(
+            q, k_layer, v_layer,
+            jnp.zeros((b,), jnp.int32), slots0 + 1,
+        )
+        ao = attn.reshape(b, q_len, cfg.q_dim) @ blk["wo"]
+        if cfg.proj_bias:
+            ao = ao + blk["bo"]
+        y = y + ao
+        h2 = _norm(y, blk["ln2"], blk.get("ln2_b"), cfg)
+        y = y + (
+            _mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk, cfg)
+        )
+        return (y, kc, vc, li + 1), None
+
+    (x, kc, vc, _), _ = jax.lax.scan(
+        body, (x, cache.k, cache.v, jnp.int32(0)), params["blocks"]
+    )
+    x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
+    logits = _head(params, cfg, x)  # [B, Q, V]
     return logits, KVCache(k=kc, v=vc)
 
 

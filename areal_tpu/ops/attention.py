@@ -131,6 +131,44 @@ def decode_attention(
     return out.reshape(b, 1, n_q, d).astype(q.dtype)
 
 
+def decode_attention_chunk(
+    q: jax.Array,  # [B, Q, n_q, d] — Q consecutive new tokens per row
+    k_cache: jax.Array,  # [B, S_max, n_kv, d]
+    v_cache: jax.Array,  # [B, S_max, n_kv, d]
+    valid_from: jax.Array,  # [B] int — first valid cache slot per row
+    valid_to0: jax.Array,  # [B] int — one past query 0's last visible slot
+) -> jax.Array:
+    """Multi-query decode attention for speculative decoding: query i
+    attends the window [valid_from, valid_to0 + i) — the causal extension
+    of `decode_attention` to a chunk of Q drafted positions (each draft
+    sees the cache up to and including its own just-written slot).
+    Same GQA-grouped, bf16-operand/fp32-accumulate formulation."""
+    b, nq_tok, n_q, d = q.shape
+    n_kv = k_cache.shape[2]
+    n_rep = n_q // n_kv
+    qh = q.reshape(b, nq_tok, n_kv, n_rep, d)
+    scale = d**-0.5
+    logits = (
+        jnp.einsum(
+            "bqgrd,bsgd->bgqrs", qh, k_cache.astype(q.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # [B, n_kv, Q, n_rep, S]
+    idx = jnp.arange(k_cache.shape[1])
+    valid = (idx[None, None, :] >= valid_from[:, None, None]) & (
+        idx[None, None, :]
+        < (valid_to0[:, None] + jnp.arange(nq_tok)[None, :])[:, :, None]
+    )  # [B, Q, S]
+    logits = jnp.where(valid[:, None, :, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bgqrs,bsgd->bqgrd", probs.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, nq_tok, n_q, d).astype(q.dtype)
+
+
 @functools.partial(jax.jit, static_argnames=("causal",))
 def _dispatch_ref(q, k, v, segment_ids, causal):
     return packed_attention_reference(q, k, v, segment_ids, causal=causal)

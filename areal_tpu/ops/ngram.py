@@ -1,0 +1,72 @@
+"""N-gram draft proposal for speculative decoding (prompt-lookup).
+
+Drafts the next K tokens by matching the sequence's trailing m-gram
+against its own earlier history (prompt + generated prefix) and copying
+the continuation of the most recent match.  Long-CoT math rollouts repeat
+aggressively (restated equations, names, formulas), so self-lookup gets
+useful acceptance rates with zero draft-model cost.  Proposal quality only
+affects SPEED — the rejection-sampling verifier (ops/sampling.py
+spec_accept) keeps the emitted distribution exactly the model's.
+
+Static shapes throughout: jit-pure, vectorized over rows with masks.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def propose_ngram(
+    tokens: jax.Array,  # [B, S] int32 — history buffer (garbage past lens)
+    lens: jax.Array,  # [B] int32 — valid history length per row
+    k: int,  # number of draft tokens
+    m: int = 3,  # gram length to match
+) -> jax.Array:
+    """Returns drafts [B, k] int32 continuing each row's history.
+
+    Rows with history shorter than m, or with no earlier occurrence of
+    their trailing m-gram, draft a repeat of their last token (cheap
+    fallback; typically rejected).
+    """
+    b, s = tokens.shape
+    pos = jnp.arange(s)
+    # Trailing m-gram per row: tokens[lens-m .. lens).
+    gram_idx = lens[:, None] - m + jnp.arange(m)[None, :]  # [B, m]
+    gram = jnp.take_along_axis(
+        tokens, jnp.clip(gram_idx, 0, s - 1), axis=1
+    )  # [B, m]
+
+    # Window starting at i matches iff tokens[i+j] == gram[j] for all j,
+    # with the window fully inside history and strictly before the
+    # trailing gram itself (i + m <= lens - m ... allow overlap up to
+    # i < lens - m so the trivial self-match is excluded).
+    def window_eq(j, acc):
+        t_j = jnp.take_along_axis(
+            tokens, jnp.clip(pos[None, :] + j, 0, s - 1), axis=1
+        )  # [B, S] — tokens shifted left by j
+        return acc & (t_j == gram[:, j][:, None])
+
+    match = jax.lax.fori_loop(
+        0, m, window_eq, jnp.ones((b, s), bool)
+    )  # [B, S]
+    # Window inside history, excluding the trailing gram's own position
+    # (i < lens - m).
+    valid_start = pos[None, :] < lens[:, None] - m
+    match = match & valid_start & (lens[:, None] >= m + 1)
+    # Most recent match wins (largest start index).
+    best = jnp.argmax(
+        jnp.where(match, pos[None, :], -1), axis=1
+    )  # [B]
+    has_match = jnp.any(match, axis=1)
+
+    # Drafts: continuation after the matched gram, clamped into history;
+    # fallback = repeat the last token.
+    cont_idx = best[:, None] + m + jnp.arange(k)[None, :]  # [B, k]
+    cont = jnp.take_along_axis(
+        tokens, jnp.clip(cont_idx, 0, s - 1), axis=1
+    )
+    last = jnp.take_along_axis(
+        tokens, jnp.clip(lens - 1, 0, s - 1)[:, None], axis=1
+    )  # [B, 1]
+    in_hist = cont_idx < lens[:, None]
+    cont = jnp.where(in_hist, cont, last)
+    return jnp.where(has_match[:, None], cont, last).astype(jnp.int32)

@@ -64,19 +64,94 @@ def sample_token(
         # gumbel-max trick (jax.random.categorical) generates B*V threefry
         # values — ~3.4 ms/step at a 152k vocab on v5e, the single largest
         # decode-step cost outside the weight streaming.
-        m = jnp.max(warped, axis=-1, keepdims=True)
-        p = jnp.exp(warped - m)
-        cdf = jnp.cumsum(p, axis=-1)
         u = jax.random.uniform(key, (logits.shape[0],), jnp.float32)
-        r = u * cdf[:, -1]
-        # Keep r strictly below the total mass: u*total can round UP to
-        # total in fp32, which would select past the last in-support token
-        # (and the position clamp would then emit a top-k/top-p-masked
-        # token).
-        r = jnp.minimum(r, cdf[:, -1] * (1.0 - 1e-6))
-        tok = jnp.sum(cdf <= r[:, None], axis=-1).astype(jnp.int32)
-        tok = jnp.minimum(tok, logits.shape[-1] - 1)
+        tok = _inverse_cdf_draw(warped, u)
     # Chosen-token logprob via logsumexp (no full-vocab log_softmax write).
     lse = jax.nn.logsumexp(scaled, axis=-1)
     chosen = jnp.take_along_axis(scaled, tok[:, None], axis=-1)[:, 0]
     return tok, chosen - lse
+
+
+def _inverse_cdf_draw(warped: jax.Array, u: jax.Array) -> jax.Array:
+    """One inverse-CDF draw per row from warped logits [B, V], u in [0,1).
+
+    `r` is kept strictly below the total mass: u*total can round UP to
+    total in fp32, which would select past the last in-support token (and
+    the position clamp would then emit a warper-masked token)."""
+    m = jnp.max(warped, axis=-1, keepdims=True)
+    p = jnp.exp(warped - m)
+    cdf = jnp.cumsum(p, axis=-1)
+    r = jnp.minimum(u * cdf[:, -1], cdf[:, -1] * (1.0 - 1e-6))
+    tok = jnp.sum(cdf <= r[:, None], axis=-1).astype(jnp.int32)
+    return jnp.minimum(tok, warped.shape[-1] - 1)
+
+
+def spec_accept(
+    logits: jax.Array,  # [B, K+1, V] fp32 — model dists after each draft
+    drafts: jax.Array,  # [B, K] int32 — proposed tokens
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    greedy: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Exact speculative verification of K deterministic drafts.
+
+    logits[:, j] is the model's next-token distribution AFTER consuming
+    drafts[:, :j] (logits[:, K] is the bonus position).  Returns
+    (emitted [B, K+1], logps [B, K+1], n_emitted [B]) where per row the
+    first n_emitted entries are valid: accepted drafts followed by one
+    closing token (the rejection resample, or the bonus draw when all K
+    drafts were accepted).  The emitted sequence is distributed EXACTLY as
+    K+1 sequential draws from the warped distribution (standard
+    speculative rejection sampling with a point-mass proposal: accept
+    draft d w.p. p(d); on reject, resample from p with d's mass removed).
+    Logps follow `sample_token`'s convention: the unwarped
+    temperature-scaled distribution's log-density of the emitted token.
+    """
+    b, k1, v = logits.shape
+    k = k1 - 1
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    if greedy:
+        argm = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+        acc = drafts == argm[:, :k]  # [B, K]
+        n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+        # Closing token = argmax at the first rejected position (or bonus).
+        close = jnp.take_along_axis(argm, n_acc[:, None], axis=1)[:, 0]
+        emitted = jnp.concatenate([drafts, close[:, None]], axis=1)
+        emitted = emitted.at[jnp.arange(b), n_acc].set(close)
+    else:
+        warped = apply_top_p(apply_top_k(scaled, top_k), top_p)
+        logZ = jax.nn.logsumexp(warped, axis=-1)  # [B, K+1]
+        d_logit = jnp.take_along_axis(
+            warped[:, :k], drafts[:, :, None], axis=-1
+        )[..., 0]
+        p_draft = jnp.exp(d_logit - logZ[:, :k])  # [B, K] accept probs
+        key, k_acc, k_res = jax.random.split(key, 3)
+        u_acc = jax.random.uniform(k_acc, (b, k))
+        acc = u_acc < p_draft
+        n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+        # Closing draw at position n_acc: from the residual (draft masked
+        # out) on rejection, from the untouched dist on the bonus position.
+        close_logits = jnp.take_along_axis(
+            warped, n_acc[:, None, None], axis=1
+        )[:, 0]  # [B, V]
+        rejected_draft = jnp.take_along_axis(
+            drafts, jnp.minimum(n_acc, k - 1)[:, None], axis=1
+        )[:, 0] if k > 0 else jnp.zeros((b,), jnp.int32)
+        mask_draft = (n_acc < k)  # rejection (not bonus)
+        onehot = (
+            jnp.arange(v)[None, :] == rejected_draft[:, None]
+        ) & mask_draft[:, None]
+        close_logits = jnp.where(onehot, NEG_INF, close_logits)
+        u_res = jax.random.uniform(k_res, (b,))
+        close = _inverse_cdf_draw(close_logits, u_res)
+        emitted = jnp.concatenate(
+            [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1
+        )
+        emitted = emitted.at[jnp.arange(b), n_acc].set(close)
+    # Unwarped temp-scaled logprob of every emitted token at its position.
+    lse = jax.nn.logsumexp(scaled, axis=-1)  # [B, K+1]
+    chosen = jnp.take_along_axis(scaled, emitted[:, :, None], axis=-1)[..., 0]
+    logps = chosen - lse
+    return emitted, logps, n_acc + 1

@@ -66,7 +66,7 @@ def _gkey(p: _Pending):
     # arrival timing; exact-replay trainers should use the in-process
     # generator.
     return (g.n, g.max_new_tokens, g.min_new_tokens, g.greedy, g.top_p,
-            g.top_k, g.temperature, p.seed)
+            g.top_k, g.temperature, g.spec_decode_k, g.spec_ngram, p.seed)
 
 
 class GenerationServer:
@@ -158,6 +158,8 @@ class GenerationServer:
             top_p=float(req.get("top_p", 1.0)),
             top_k=int(req.get("top_k", 0)),
             temperature=float(req.get("temperature", 1.0)),
+            spec_decode_k=int(req.get("spec_decode_k", 0)),
+            spec_ngram=int(req.get("spec_ngram", 3)),
         )
         p = _Pending(
             qid=str(req["qid"]),
