@@ -233,8 +233,20 @@ def cmd_ppo_math(args):
                 "(the search chooses the generation layout)"
             )
         searched = _searched_ppo_allocation(args)
+    ppo_kwargs = {}
+    if args.kl_ctl:
+        ppo_kwargs["kl_ctl"] = args.kl_ctl
     cfg = exps.PPOMathConfig(
         actor=ModelAbstraction("hf", {"path": args.model_path}),
+        ref=(
+            ModelAbstraction("hf", {"path": args.ref_path})
+            if args.ref_path else None
+        ),
+        ppo_kwargs=ppo_kwargs,
+        ref_ema_eta=args.ref_ema_eta,
+        fuse_rew_ref=args.fuse_rew_ref,
+        offload_ref=args.offload_ref,
+        gen_server_url=args.gen_server_url,
         dataset=DatasetAbstraction(
             "math_code_prompt", {"dataset_path": args.dataset_path}
         ),
@@ -257,6 +269,7 @@ def cmd_ppo_math(args):
             n=args.group_size,
             max_new_tokens=args.max_new_tokens,
             temperature=args.temperature,
+            spec_decode_k=args.spec_decode_k,
         ),
         batch_size=args.batch_size,
         total_train_epochs=args.epochs,
@@ -291,6 +304,21 @@ def main(argv=None):
     pp.add_argument("--temperature", type=float, default=1.0)
     pp.add_argument("--gen-allocation", default=None,
                     help="separate layout for generation (decoupled meshes)")
+    pp.add_argument("--gen-server-url", default=None,
+                    help="decoupled serving: URL of a running "
+                         "areal_tpu.system.gen_server (actor_gen becomes a "
+                         "weightless client; weight sync ships checkpoints)")
+    pp.add_argument("--ref-path", default=None,
+                    help="reference policy checkpoint (enables KL control)")
+    pp.add_argument("--kl-ctl", type=float, default=0.0)
+    pp.add_argument("--ref-ema-eta", type=float, default=None,
+                    help="EMA-update the ref toward the actor each step")
+    pp.add_argument("--fuse-rew-ref", action="store_true",
+                    help="one fused MFC for reward grading + ref inference")
+    pp.add_argument("--offload-ref", action="store_true",
+                    help="host-offload ref params between steps")
+    pp.add_argument("--spec-decode-k", type=int, default=0,
+                    help="speculative decoding drafts per step (0 = off)")
     pp.set_defaults(fn=cmd_ppo_math)
 
     # Install YAML defaults on whichever subcommand was chosen.

@@ -221,9 +221,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             for s in range(n_slots):
                 if active[s] is None and pending:
                     i, rep, toks = pending.pop()
-                    sp = bucket_len(len(toks))
-                    row = np.full((1, sp), self.pad_token_id, np.int32)
-                    row[0, : len(toks)] = toks
+                    sp, row = self._bucket_prompt_row(toks)
                     row_logits, cache = self._get_prefill_slot_fn(sp)(
                         self.params, jnp.asarray(row),
                         jnp.int32(len(toks)), cache, jnp.int32(s),
@@ -240,14 +238,9 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             # Geometric (doubling) growth bounds recompiles + cache copies
             # to O(log length); dead slots are excluded (cache_len resets
             # on retirement).
-            need = int(cache_len.max()) + chunk_t
-            if need > cur_w:
-                new_w = bucket_len(max(need, 2 * cur_w))
-                pad = [(0, 0), (0, 0), (0, new_w - cur_w), (0, 0), (0, 0)]
-                cache = tfm.KVCache(
-                    k=jnp.pad(cache.k, pad), v=jnp.pad(cache.v, pad)
-                )
-                cur_w = new_w
+            cache, cur_w = self._grow_kv_cache(
+                cache, cur_w, int(cache_len.max()) + chunk_t
+            )
 
             # One jitted chunk: up to chunk_t tokens for every live slot.
             decode_fn = self._get_inflight_decode_fn(
@@ -404,6 +397,28 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         )
         return fn
 
+    # -- shared inflight helpers --
+
+    def _bucket_prompt_row(self, toks) -> Tuple[int, np.ndarray]:
+        """Pad one prompt to its length bucket (shared admit step)."""
+        sp = bucket_len(len(toks))
+        row = np.full((1, sp), self.pad_token_id, np.int32)
+        row[0, : len(toks)] = toks
+        return sp, row
+
+    @staticmethod
+    def _grow_kv_cache(cache, cur_w: int, need: int):
+        """Geometric (doubling) window growth — bounds recompiles and cache
+        copies to O(log length); no-op when `need` fits."""
+        if need <= cur_w:
+            return cache, cur_w
+        new_w = bucket_len(max(need, 2 * cur_w))
+        pad = [(0, 0), (0, 0), (0, new_w - cur_w), (0, 0), (0, 0)]
+        return (
+            tfm.KVCache(k=jnp.pad(cache.k, pad), v=jnp.pad(cache.v, pad)),
+            new_w,
+        )
+
     # -- speculative inflight (n-gram drafts + exact verification) --
 
     def _generate_inflight_spec(self, reqs, g, key, results) -> None:
@@ -442,9 +457,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             for s in range(n_slots):
                 if active[s] is None and pending_list:
                     i, rep, toks = pending_list.pop()
-                    sp = bucket_len(len(toks))
-                    row = np.full((1, sp), self.pad_token_id, np.int32)
-                    row[0, : len(toks)] = toks
+                    sp, row = self._bucket_prompt_row(toks)
                     key, sub = jax.random.split(key)
                     tok0, logp0, cache, tokens_buf, pending = (
                         self._get_spec_admit_fn(sp, tokens_buf.shape[1], g)(
@@ -455,6 +468,9 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                     )
                     cache_len[s] = len(toks)
                     gen_count[s] = 1  # the sampled pending token
+                    # Host sync per admission (reads the sampled token): the
+                    # eos/done flag must be exact BEFORE the next chunk, and
+                    # the read is tiny next to the prefill it follows.
                     t0 = int(tok0)
                     done_host[s] = t0 == self.eos_token_id
                     active[s] = (i, rep)
@@ -463,12 +479,8 @@ class GeneratorEngine(HostOffloadMixin, Engine):
 
             # Growth: a chunk can add up to step_cap entries (+K scratch).
             need = int(cache_len.max()) + step_cap + K + 1
-            if need > cur_w:
-                new_w = bucket_len(max(need, 2 * cur_w))
-                pad = [(0, 0), (0, 0), (0, new_w - cur_w), (0, 0), (0, 0)]
-                cache = tfm.KVCache(
-                    k=jnp.pad(cache.k, pad), v=jnp.pad(cache.v, pad)
-                )
+            cache, new_w = self._grow_kv_cache(cache, cur_w, need)
+            if new_w != cur_w:
                 tokens_buf = jnp.pad(
                     tokens_buf,
                     [(0, 0), (0, new_w + K + 2 - tokens_buf.shape[1])],
