@@ -329,6 +329,15 @@ class ModelInterface(abc.ABC):
     def save(self, model: Model, save_dir: str) -> None:
         pass
 
+    # Algorithm-state checkpointing (e.g. the critic's value-norm running
+    # moments): included in recover checkpoints so a restarted trial
+    # resumes with identical statistics.  Empty dict = stateless.
+    def state_dict(self) -> Dict[str, Any]:
+        return {}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        pass
+
 
 def register_interface(name: str, cls: type) -> None:
     if name in ALL_INTERFACES:

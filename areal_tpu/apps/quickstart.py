@@ -235,6 +235,11 @@ def cmd_ppo_math(args):
         searched = _searched_ppo_allocation(args)
     ppo_kwargs = {}
     if args.kl_ctl:
+        if not args.ref_path:
+            raise SystemExit(
+                "--kl-ctl needs --ref-path: the KL penalty is computed "
+                "against a reference policy's logprobs"
+            )
         ppo_kwargs["kl_ctl"] = args.kl_ctl
     cfg = exps.PPOMathConfig(
         actor=ModelAbstraction("hf", {"path": args.model_path}),

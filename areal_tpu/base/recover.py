@@ -40,6 +40,11 @@ class RecoverInfo:
     # Data-worker id -> per-dataloader (epoch, cursor) positions; replayed
     # on restart so recovered trials do not resample consumed batches.
     data_states: Dict[int, List[Any]] = dataclasses.field(default_factory=dict)
+    # Worker id -> {model key -> interface.state_dict()} (e.g. value-norm
+    # running moments); restored so algorithm statistics survive recovery.
+    interface_states: Dict[int, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict
+    )
 
 
 def recover_root(fileroot: str, experiment_name: str, trial_name: str) -> str:

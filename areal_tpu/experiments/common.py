@@ -150,6 +150,11 @@ class PPOMathConfig:
     actor_device_offset: Optional[int] = None
     gen_device_offset: Optional[int] = None
     critic_parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    # Extra kwargs for the critic interface (e.g. value_norm=True,
+    # value_norm_type="exp" — reference ppo_interface.py:175-210).
+    critic_interface_args: Dict[str, Any] = dataclasses.field(
+        default_factory=dict
+    )
     optimizer: OptimizerConfig = dataclasses.field(
         default_factory=lambda: OptimizerConfig(lr=2e-5)
     )
@@ -250,6 +255,16 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
     actor_if = ModelInterfaceAbstraction(
         "ppo_actor", {"gconfig": cfg.gconfig, **ppo_kwargs}
     )
+    critic_if = ModelInterfaceAbstraction(
+        "ppo_critic",
+        {
+            **{
+                k: v for k, v in ppo_kwargs.items()
+                if k in ("n_minibatches", "kl_ctl")
+            },
+            **cfg.critic_interface_args,
+        },
+    )
     nodes = [
         MFCDef(
             name="actor_gen",
@@ -344,7 +359,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                 name="critic_inf",
                 model_name=critic,
                 interface_type=ModelInterfaceType.INFERENCE,
-                interface_impl=ModelInterfaceAbstraction("ppo_critic"),
+                interface_impl=critic_if,
                 input_keys=("packed_input_ids", "prompt_mask"),
                 output_keys=("values",),
                 n_seqs=cfg.batch_size,
@@ -384,14 +399,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                 name="critic_train",
                 model_name=critic,
                 interface_type=ModelInterfaceType.TRAIN_STEP,
-                interface_impl=ModelInterfaceAbstraction(
-                    "ppo_critic",
-                    {
-                        k: v
-                        for k, v in ppo_kwargs.items()
-                        if k in ("n_minibatches", "kl_ctl")
-                    },
-                ),
+                interface_impl=critic_if,
                 input_keys=(
                     "packed_input_ids", "prompt_mask", "packed_logprobs",
                     "seq_no_eos_mask", "rewards", "values",
@@ -460,7 +468,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                 name=critic,
                 model=cfg.critic,
                 backend=ModelBackendAbstraction("train"),
-                interface=ModelInterfaceAbstraction("ppo_critic"),
+                interface=critic_if,
                 parallel=cfg.critic_parallel,
                 optimizer=cfg.optimizer,
             )

@@ -356,14 +356,61 @@ class PPOCriticInterface(ModelInterface):
     gae_lambda: float = 1.0
     max_reward_clip: float = 5.0
     kl_ctl: float = 0.0
+    # Running-mean/std normalization of returns (reference:
+    # ppo_interface.py:175-210 + modules/rms.py): the critic head learns
+    # normalized targets; predictions are denormalized before GAE.
+    value_norm: bool = False
+    value_norm_type: str = "exp"  # "exp" | "ma"
+    value_norm_beta: float = 0.99995
+    value_norm_eps: float = 1e-5
+
+    def _rms(self):
+        if getattr(self, "_rms_inst", None) is None:
+            from areal_tpu.interfaces.value_norm import make_value_norm
+
+            object.__setattr__(
+                self,
+                "_rms_inst",
+                make_value_norm(
+                    self.value_norm_type,
+                    self.value_norm_beta,
+                    self.value_norm_eps,
+                ),
+            )
+        return self._rms_inst
+
+    def state_dict(self) -> Dict[str, float]:
+        # Running moments ride recover checkpoints: a restored critic head
+        # (trained on normalized targets) must keep its statistics or
+        # inference denormalizes with the identity.
+        return self._rms().state_dict() if self.value_norm else {}
+
+    def load_state_dict(self, sd) -> None:
+        if self.value_norm and sd:
+            self._rms().load_state_dict(sd)
+
+    def save(self, model: Model, save_dir: str) -> None:
+        # Critic checkpoints (incl. the trained value head) roundtrip via
+        # the HF registry — without this, value-mode recover restores a
+        # fresh critic (the bug the recover test pins down).
+        from areal_tpu.interfaces.sft import SFTInterface
+
+        SFTInterface().save(model, save_dir)
 
     def inference(
         self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
     ) -> SequenceSample:
-        return model.engine.forward(
+        out = model.engine.forward(
             sample, mb_spec, post_fn=_value_post, output_key="values",
             token_key="packed_input_ids",
         )
+        if self.value_norm:
+            # Head outputs live in normalized-return space; hand real-scale
+            # values to the consumers (actor GAE, our own train_step).
+            out.data["values"] = self._rms().denormalize(
+                np.asarray(out.data["values"], np.float32)
+            )
+        return out
 
     def train_step(
         self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
@@ -423,6 +470,15 @@ class PPOCriticInterface(ModelInterface):
             for (lo, hi) in seq_slices:
                 returns_full[lo:hi] = ret1[off : off + (hi - lo)]
                 off += hi - lo
+
+        if self.value_norm:
+            # Update running moments with this batch's real-scale returns,
+            # then train the head against NORMALIZED targets (old values
+            # re-normalized so the clip window lives in the same space).
+            rms = self._rms()
+            rms.update(returns_full, mask=loss_mask)
+            returns_full = rms.normalize(returns_full)
+            values = rms.normalize(values)
 
         train_sample = sample.select_keys({"packed_input_ids", "prompt_mask"})
         _add_aligned_keys(

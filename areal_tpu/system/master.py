@@ -582,6 +582,13 @@ class MasterWorker:
                     for w in self.data_worker_ids
                 ]
             )
+            # Algorithm state (e.g. value-norm moments) from every worker.
+            iface_states = await asyncio.gather(
+                *[
+                    self.pool.request(w, {"type": "interface_state"})
+                    for w in range(self.pool.n_workers)
+                ]
+            )
             info = recover.RecoverInfo(
                 last_step_info=self.step_info,
                 save_ctl_states={
@@ -592,6 +599,11 @@ class MasterWorker:
                 data_states={
                     w: s["states"]
                     for w, s in zip(self.data_worker_ids, states)
+                },
+                interface_states={
+                    w: s["states"]
+                    for w, s in enumerate(iface_states)
+                    if s["states"]
                 },
                 used_data_ids=list(self._filtered_ids),
             )
@@ -680,5 +692,14 @@ class MasterWorker:
                     w, {"type": "load_data_state", "states": states}
                 )
                 for w, states in data_states.items()
+            ]
+        )
+        iface_states = getattr(info, "interface_states", None) or {}
+        await asyncio.gather(
+            *[
+                self.pool.request(
+                    w, {"type": "load_interface_state", "states": states}
+                )
+                for w, states in iface_states.items()
             ]
         )

@@ -544,6 +544,22 @@ class ModelWorker:
     def _handle_data_state(self, req):
         return {"states": [dl.state_dict() for dl in self.dataloaders]}
 
+    def _handle_interface_state(self, req):
+        """Algorithm state per model (e.g. value-norm moments) for recover
+        checkpoints."""
+        out = {}
+        for key, iface in self.interfaces.items():
+            sd = iface.state_dict()
+            if sd:
+                out[key] = sd
+        return {"states": out}
+
+    def _handle_load_interface_state(self, req):
+        for key, sd in (req.get("states") or {}).items():
+            if key in self.interfaces:
+                self.interfaces[key].load_state_dict(sd)
+        return {}
+
     def _handle_load_data_state(self, req):
         for dl, sd in zip(self.dataloaders, req["states"]):
             dl.load_state_dict(sd)

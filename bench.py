@@ -23,6 +23,8 @@ Prints ONE json line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -31,7 +33,44 @@ import numpy as np
 BASELINE_SAMPLES_PER_SEC_CHIP = 0.30
 
 
+def _probe_backend(attempts: int = 10, timeout_s: int = 90) -> None:
+    """Fail fast (with retries) if the TPU tunnel is wedged: jax backend
+    init blocks forever in C land when the device lease is stuck, which
+    would hang the whole bench run.  Probe in a subprocess with a timeout;
+    give the tunnel a few minutes to recover before giving up."""
+    code = "import jax; jax.devices(); print('ok')"
+    for i in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=timeout_s,
+                capture_output=True,
+                env=os.environ,
+            )
+            if out.returncode == 0 and b"ok" in out.stdout:
+                return
+            # Fast failure (import error, broken install): not a hang —
+            # surface the real traceback immediately.
+            raise SystemExit(
+                "[bench] backend probe failed:\n"
+                + out.stderr.decode(errors="replace")[-2000:]
+            )
+        except subprocess.TimeoutExpired:
+            pass
+        print(
+            f"[bench] accelerator backend not responding "
+            f"(attempt {i + 1}/{attempts}); retrying in 60s",
+            file=sys.stderr,
+        )
+        time.sleep(60)
+    raise SystemExit(
+        "[bench] accelerator backend unreachable: jax.devices() hangs "
+        "(device tunnel wedged?) — aborting instead of hanging"
+    )
+
+
 def main(size: str = "1.5b"):
+    _probe_backend()
     import jax
     import jax.numpy as jnp
 

@@ -237,3 +237,166 @@ def test_ppo_full_step(disable_value):
     if critic is not None:
         cstats = critic_if.train_step(critic, rollout, mb)
         assert np.isfinite(cstats["value_loss"])
+
+
+class TestValueNorm:
+    def test_running_mean_std_oracles(self):
+        from areal_tpu.interfaces.value_norm import (
+            ExponentialRunningMeanStd,
+            MovingAverageRunningMeanStd,
+        )
+
+        rng = np.random.default_rng(0)
+        xs = [rng.normal(3.0, 2.0, size=64) for _ in range(50)]
+        masks = [rng.random(64) < 0.7 for _ in range(50)]
+
+        ma = MovingAverageRunningMeanStd()
+        for x, m in zip(xs, masks):
+            ma.update(x, m)
+        flat = np.concatenate([x[m] for x, m in zip(xs, masks)])
+        mean, std = ma.mean_std()
+        assert abs(mean - flat.mean()) < 1e-9
+        assert abs(std - np.sqrt(flat.var() + 1e-5)) < 1e-9
+
+        # Exponential: with beta close to 0 it tracks the last batch.
+        exp = ExponentialRunningMeanStd(beta=1e-12)
+        for x, m in zip(xs, masks):
+            exp.update(x, m)
+        last = xs[-1][masks[-1]]
+        mean, std = exp.mean_std()
+        assert abs(mean - last.mean()) < 1e-6
+        # Round trip + state dict.
+        y = rng.normal(size=16)
+        np.testing.assert_allclose(
+            exp.denormalize(exp.normalize(y)), y, rtol=1e-5, atol=1e-5
+        )
+        exp2 = ExponentialRunningMeanStd()
+        exp2.load_state_dict(exp.state_dict())
+        assert exp2.mean_std() == exp.mean_std()
+
+        # Empty masked update is a no-op.
+        before = ma.mean_std()
+        ma.update(np.ones(8), np.zeros(8))
+        assert ma.mean_std() == before
+
+    def test_value_norm_critic_e2e(self, tmp_path):
+        """PPO value mode with value_norm=True: trains, moments track the
+        reward scale, and critic_inf emits denormalized (real-scale)
+        values."""
+        from areal_tpu.api.config import (
+            ModelAbstraction,
+        )
+        from areal_tpu.api.data_api import DatasetAbstraction
+        from areal_tpu.api.model_api import (
+            GenerationHyperparameters,
+            OptimizerConfig,
+        )
+        from areal_tpu.experiments.common import (
+            PPOMathConfig,
+            build_ppo_math,
+            run_experiment,
+        )
+        from areal_tpu.models.config import tiny_config
+        from areal_tpu.system.master import ExperimentSaveEvalControl
+        from tests import fixtures
+
+        tok = fixtures.make_tokenizer()
+        rows = fixtures.build_math_rows(16, seed=4)
+        cfg = PPOMathConfig(
+            actor=ModelAbstraction("random", {"config": tiny_config()}),
+            critic=ModelAbstraction(
+                "random", {"config": tiny_config(is_critic=True)}
+            ),
+            dataset=DatasetAbstraction(
+                "math_code_prompt",
+                {"dataset_builder": lambda: rows, "max_length": 64},
+            ),
+            reward_interface_args={
+                "id2info": {r["query_id"]: r for r in rows}
+            },
+            gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+            ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+            critic_interface_args={
+                "value_norm": True, "value_norm_type": "ma",
+            },
+            optimizer=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0),
+            batch_size=4,
+            ctrl=ExperimentSaveEvalControl(benchmark_steps=3),
+            fileroot=str(tmp_path),
+        )
+        master, stats = run_experiment(build_ppo_math(cfg, tok), tokenizer=tok)
+        assert len(stats) == 3
+        assert np.isfinite(stats[-1]["critic_train/value_loss"])
+        w = master.pool.workers[0]
+        rms = w.interfaces["critic@0"]._rms()
+        mean, std = rms.mean_std()
+        # Rewards are +-5-ish; the return moments must reflect that scale.
+        assert 0.5 < std < 20.0, (mean, std)
+
+    def test_value_norm_survives_recover(self, tmp_path):
+        """Recover checkpoints carry the interface state: the restored
+        critic resumes with the SAME running moments (otherwise inference
+        denormalizes with the identity and GAE sees mis-scaled values)."""
+        from areal_tpu.api.config import ModelAbstraction
+        from areal_tpu.api.data_api import DatasetAbstraction
+        from areal_tpu.api.model_api import (
+            GenerationHyperparameters,
+            OptimizerConfig,
+        )
+        from areal_tpu.experiments.common import (
+            PPOMathConfig,
+            build_ppo_math,
+            run_experiment,
+        )
+        from areal_tpu.models.config import tiny_config
+        from areal_tpu.system.master import ExperimentSaveEvalControl
+        from tests import fixtures
+
+        tok = fixtures.make_tokenizer()
+        rows = fixtures.build_math_rows(16, seed=4)
+
+        def make(epochs, ctrl):
+            return PPOMathConfig(
+                actor=ModelAbstraction("random", {"config": tiny_config()}),
+                critic=ModelAbstraction(
+                    "random", {"config": tiny_config(is_critic=True)}
+                ),
+                dataset=DatasetAbstraction(
+                    "math_code_prompt",
+                    {"dataset_builder": lambda: rows, "max_length": 64},
+                ),
+                reward_interface_args={
+                    "id2info": {r["query_id"]: r for r in rows}
+                },
+                gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+                ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+                critic_interface_args={
+                    "value_norm": True, "value_norm_type": "ma",
+                },
+                optimizer=OptimizerConfig(
+                    lr=1e-3, warmup_steps_proportion=0.0
+                ),
+                batch_size=8,
+                total_train_epochs=epochs,
+                ctrl=ctrl,
+                fileroot=str(tmp_path),
+            )
+
+        m1, s1 = run_experiment(
+            build_ppo_math(
+                make(1, ExperimentSaveEvalControl(ckpt_freq_steps=1)), tok
+            ),
+            tokenizer=tok,
+        )
+        rms1 = m1.pool.workers[0].interfaces["critic@0"]._rms().state_dict()
+        assert rms1["count"] > 0
+
+        m2, s2 = run_experiment(
+            build_ppo_math(make(2, ExperimentSaveEvalControl()), tok),
+            tokenizer=tok,
+        )
+        # The restored critic started from m1's moments (then kept
+        # updating: count strictly grows, never resets).
+        rms2 = m2.pool.workers[0].interfaces["critic@0"]._rms().state_dict()
+        assert rms2["count"] > rms1["count"]
+        assert len(s2) == 2  # resumed at step 2 of 4
