@@ -105,6 +105,10 @@ class TrainEngine(HostOffloadMixin, Engine):
         # bytes/param) for memory-bound single-chip configs — the tradeoff
         # large-model recipes make when HBM, not accuracy, binds.
         master_dtype=jnp.float32,
+        # Activation rematerialization per layer: "full" (save nothing),
+        # "dots" (save matmul outputs; ~zero recompute when activations
+        # fit), "none".  See models/transformer.py _backbone.
+        remat_policy: str = "full",
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -115,6 +119,7 @@ class TrainEngine(HostOffloadMixin, Engine):
             compute_dtype = jnp.float32
         self.compute_dtype = compute_dtype
         self.master_dtype = master_dtype
+        self.remat_policy = remat_policy
 
         self.param_specs = sharding.param_pspecs(params)
         self.param_shardings = sharding.tree_named(mesh, self.param_specs)
@@ -150,6 +155,7 @@ class TrainEngine(HostOffloadMixin, Engine):
         use_flash = self._use_flash
         cp_mesh = self._cp_mesh
         pp_mesh, pp_mbs = self._pp_mesh, self._pp_microbatches
+        remat = self.remat_policy
 
         def _value_and_grad(params, batch, loss_scale):
             def losswrap(p):
@@ -160,7 +166,7 @@ class TrainEngine(HostOffloadMixin, Engine):
                     batch["tokens"],
                     batch["segment_ids"],
                     positions=batch["positions"],
-                    remat=True,
+                    remat=remat,
                     use_flash=use_flash,
                     cp_mesh=cp_mesh,
                     pp_mesh=pp_mesh,

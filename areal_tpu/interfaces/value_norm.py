@@ -7,10 +7,11 @@ PPO interfaces via `value_norm*` options (ppo_interface.py:175-210,
 are denormalized before GAE.  Host-side numpy with float64 accumulators
 and debiasing (the reference keeps these as fp64 torch buffers).
 
-State is per critic worker.  With DP replicas of the critic each replica
-tracks its own shard's statistics (the reference all-reduces the batch
-moments across DP; single-critic placements — the common case here — are
-identical).
+State lives on the critic's training primary; with DP replicas the master
+broadcasts the primary's moments to inference-only replicas after every
+train step (system/master.py _sync_interface_state), so all replicas
+denormalize with the same statistics (the reference instead all-reduces
+batch moments across DP during update).
 """
 
 from typing import Dict, Optional

@@ -347,8 +347,24 @@ def _backbone(
         )
         return y, aux
 
-    if remat:
-        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+    # Remat policy per scanned layer (HBM vs recompute-FLOPs tradeoff):
+    #   "full"/True — save nothing, recompute the whole layer in backward
+    #     (minimum activation memory; ~1/3 extra forward FLOPs);
+    #   "dots" — save matmul outputs, recompute elementwise/norms only
+    #     (more memory, near-zero recompute — the right default when the
+    #     activations fit);
+    #   "none"/False — plain autodiff residuals.
+    if remat is True or remat == "full":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable
+        )
+    elif remat == "dots":
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    elif remat not in (False, None, "none"):
+        raise ValueError(f"unknown remat policy {remat!r}")
     x, auxes = jax.lax.scan(body, x, params["blocks"])
     x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
     return x, jnp.sum(auxes)

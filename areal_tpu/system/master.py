@@ -341,8 +341,42 @@ class MasterWorker:
         else:
             resp = await self._dispatch_mfc(node, list(batch.ids), group)
             results[node.name] = resp.get("stats") or {}
+        if (
+            node.interface_type == ModelInterfaceType.TRAIN_STEP
+            and replicas
+            and len(replicas) > 1
+        ):
+            # Algorithm state (e.g. value-norm moments) only advanced on the
+            # training primary; broadcast it so inference-only replicas
+            # denormalize with the same statistics.
+            await self._sync_interface_state(
+                str(node.model_name), group[0], replicas
+            )
         for hook in node.post_hooks:
             await self._run_hook(hook, node, group)
+
+    async def _sync_interface_state(
+        self, model_key: str, primary: int, replicas: List[int]
+    ):
+        state = await self.pool.request(
+            primary, {"type": "interface_state"}
+        )
+        sd = (state.get("states") or {}).get(model_key)
+        if not sd:
+            return
+        await asyncio.gather(
+            *[
+                self.pool.request(
+                    w,
+                    {
+                        "type": "load_interface_state",
+                        "states": {model_key: sd},
+                    },
+                )
+                for w in replicas
+                if w != primary
+            ]
+        )
 
     async def _run_mfc_split(self, node: MFCDef, batch, replicas: List[int]):
         """DP dispatch: token-balance-split the batch over independent

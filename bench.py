@@ -57,12 +57,13 @@ def _probe_backend(attempts: int = 10, timeout_s: int = 90) -> None:
             )
         except subprocess.TimeoutExpired:
             pass
-        print(
-            f"[bench] accelerator backend not responding "
-            f"(attempt {i + 1}/{attempts}); retrying in 60s",
-            file=sys.stderr,
-        )
-        time.sleep(60)
+        if i < attempts - 1:
+            print(
+                f"[bench] accelerator backend not responding "
+                f"(attempt {i + 1}/{attempts}); retrying in 60s",
+                file=sys.stderr,
+            )
+            time.sleep(60)
     raise SystemExit(
         "[bench] accelerator backend unreachable: jax.devices() hangs "
         "(device tunnel wedged?) — aborting instead of hanging"
@@ -117,6 +118,8 @@ def main(size: str = "1.5b"):
         optimizer_config=OptimizerConfig(lr=2e-5, warmup_steps_proportion=0.0),
         ftspec=FinetuneSpec(1, 64, 64),
         master_dtype=jnp.bfloat16,
+        # Sweepable without edits: AREAL_BENCH_REMAT=dots|none|full.
+        remat_policy=os.environ.get("AREAL_BENCH_REMAT", "full"),
     )
     del params
     gen_engine = GeneratorEngine(
@@ -147,7 +150,10 @@ def main(size: str = "1.5b"):
     )
     # Token-budget micro-batches: the fused logprob head avoids the dense
     # [B,S,V] logits, leaving attention/MLP activations as the peak term.
-    mb = MicroBatchSpec(max_tokens_per_mb=4096)
+    # Sweepable: AREAL_BENCH_MB_TOKENS.
+    mb = MicroBatchSpec(
+        max_tokens_per_mb=int(os.environ.get("AREAL_BENCH_MB_TOKENS", 4096))
+    )
 
     timers = {"gen": 0.0, "train": 0.0, "sync": 0.0}
     flops = {"gen": 0.0, "train": 0.0}

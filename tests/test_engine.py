@@ -295,3 +295,63 @@ def test_forward_returns_aligned_logprobs(rng):
     lp = out.data["logprobs"]
     assert lp.shape[0] == sample.total_len("packed_input_ids")
     assert (lp <= 0).all()
+
+
+@pytest.mark.parametrize("policy", ["full", "dots", "none"])
+def test_remat_policy_grad_parity(policy):
+    """Rematerialization changes memory/FLOPs, never math: every policy
+    yields the same loss and gradients."""
+    import jax
+
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model_api import FinetuneSpec, OptimizerConfig
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.engines.train import TrainEngine
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.ops import functional as F
+
+    cfg = tiny_config()
+    mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+    rng = np.random.default_rng(0)
+    lens = [12, 20, 9]
+    toks = rng.integers(0, cfg.vocab_size, size=sum(lens)).astype(np.int32)
+    pmask = np.zeros(sum(lens), bool)
+    off = 0
+    for l in lens:
+        pmask[off : off + 3] = True
+        off += l
+    sample = SequenceSample(
+        keys={"packed_input_ids", "prompt_mask"},
+        ids=[f"s{i}" for i in range(3)],
+        seqlens={
+            "packed_input_ids": [[l] for l in lens],
+            "prompt_mask": [[l] for l in lens],
+        },
+        data={"packed_input_ids": toks, "prompt_mask": pmask},
+    )
+
+    def run(pol):
+        eng = TrainEngine(
+            cfg,
+            tfm.init_params(cfg, jax.random.PRNGKey(3)),
+            mesh,
+            optimizer_config=OptimizerConfig(
+                lr=1e-3, warmup_steps_proportion=0.0
+            ),
+            ftspec=FinetuneSpec(1, 16, 16),
+            remat_policy=pol,
+        )
+        return eng.train_batch(
+            sample,
+            MicroBatchSpec(),
+            loss_fn=F.sft_loss,
+            loss_weight_fn=F.sft_label_count,
+            token_key="packed_input_ids",
+            extra_keys=("prompt_mask",),
+        )
+
+    ref = run("full")
+    got = run(policy)
+    assert np.isclose(got["loss"], ref["loss"], rtol=1e-6), (got, ref)
+    assert np.isclose(got["grad_norm"], ref["grad_norm"], rtol=1e-5)

@@ -400,3 +400,54 @@ class TestValueNorm:
         rms2 = m2.pool.workers[0].interfaces["critic@0"]._rms().state_dict()
         assert rms2["count"] > rms1["count"]
         assert len(s2) == 2  # resumed at step 2 of 4
+
+    def test_value_norm_synced_to_replicas(self, tmp_path):
+        """Critic DP replicas: the training primary's running moments are
+        broadcast to inference-only replicas after each train step, so
+        every replica denormalizes identically."""
+        from areal_tpu.api.config import ModelAbstraction
+        from areal_tpu.api.data_api import DatasetAbstraction
+        from areal_tpu.api.model_api import (
+            GenerationHyperparameters,
+            OptimizerConfig,
+        )
+        from areal_tpu.experiments.common import (
+            PPOMathConfig,
+            build_ppo_math,
+            run_experiment,
+        )
+        from areal_tpu.models.config import tiny_config
+        from areal_tpu.system.master import ExperimentSaveEvalControl
+        from tests import fixtures
+
+        tok = fixtures.make_tokenizer()
+        rows = fixtures.build_math_rows(16, seed=4)
+        cfg = PPOMathConfig(
+            actor=ModelAbstraction("random", {"config": tiny_config()}),
+            critic=ModelAbstraction(
+                "random", {"config": tiny_config(is_critic=True)}
+            ),
+            dataset=DatasetAbstraction(
+                "math_code_prompt",
+                {"dataset_builder": lambda: rows, "max_length": 64},
+            ),
+            reward_interface_args={
+                "id2info": {r["query_id"]: r for r in rows}
+            },
+            gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+            ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+            critic_interface_args={
+                "value_norm": True, "value_norm_type": "ma",
+            },
+            placement={"critic": [0, 1]},
+            optimizer=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0),
+            batch_size=8,
+            ctrl=ExperimentSaveEvalControl(benchmark_steps=2),
+            fileroot=str(tmp_path),
+        )
+        master, stats = run_experiment(build_ppo_math(cfg, tok), tokenizer=tok)
+        assert len(stats) == 2
+        sd0 = master.pool.workers[0].interfaces["critic@0"]._rms().state_dict()
+        sd1 = master.pool.workers[1].interfaces["critic@0"]._rms().state_dict()
+        assert sd0["count"] > 0
+        assert sd0 == sd1
