@@ -160,6 +160,12 @@ class PPOActorInterface(ModelInterface):
     adv_norm: bool = True
     group_adv_norm: bool = False
     mask_no_eos_with_zero: bool = False
+    # Per-token rewards (reference: ppo_interface.py use_dense_reward +
+    # get_packed_reward_dense): read key "dense_rewards" (one score per
+    # token, aligned with packed_input_ids) instead of a terminal scalar;
+    # reward_delta uses consecutive-score differences (potential shaping).
+    use_dense_reward: bool = False
+    reward_delta: bool = True
 
     def generate(
         self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
@@ -214,11 +220,48 @@ class PPOActorInterface(ModelInterface):
         if ref_logp is not None and self.kl_ctl != 0.0:
             rewards -= self.kl_ctl * (old_logp - ref_logp)
 
+        dense = None
+        if self.use_dense_reward:
+            if self.disable_value:
+                raise ValueError(
+                    "use_dense_reward requires the value (critic) mode — "
+                    "GRPO group advantages are defined on scalar scores"
+                )
+            if "dense_rewards" not in sample.keys:
+                raise ValueError(
+                    "use_dense_reward needs a 'dense_rewards' key (one "
+                    "score per token, aligned with packed_input_ids)"
+                )
+            dense = np.asarray(sample.data["dense_rewards"], np.float32)
+            if len(dense) != total:
+                raise ValueError(
+                    f"dense_rewards must align with packed_input_ids: got "
+                    f"{len(dense)} scores for {total} tokens"
+                )
+            # Same transform as scalar scores (bias/scale/clip); no-EOS
+            # masking zeroes the whole truncated sequence's rewards.
+            dense = np.clip(
+                (dense + self.reward_bias) * self.reward_scaling,
+                -self.max_reward_clip,
+                self.max_reward_clip,
+            )
+
         seq_slices = []
         for si, (s, L, pl) in enumerate(layout):
             lo, hi = s + max(pl - 1, 0), s + L - 1  # predict positions
             loss_mask[lo:hi] = 1.0
-            rewards[hi - 1] += scores[si] if hi > lo else 0.0
+            if dense is not None:
+                # Transition t (predicting token t+1) earns token t+1's
+                # score — or the score DELTA (potential-based shaping) when
+                # reward_delta (reference: get_packed_reward_dense).
+                gain = dense[lo + 1 : hi + 1]
+                if self.reward_delta:
+                    gain = gain - dense[lo:hi]
+                if self.mask_no_eos_with_zero:
+                    gain = gain * (1.0 - no_eos[si])
+                rewards[lo:hi] += gain
+            else:
+                rewards[hi - 1] += scores[si] if hi > lo else 0.0
             seq_slices.append((lo, hi))
         rewards *= loss_mask
 

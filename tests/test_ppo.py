@@ -451,3 +451,79 @@ class TestValueNorm:
         sd1 = master.pool.workers[1].interfaces["critic@0"]._rms().state_dict()
         assert sd0["count"] > 0
         assert sd0 == sd1
+
+
+class TestDenseRewards:
+    def test_terminal_only_dense_matches_scalar(self):
+        """Dense rewards concentrated at the terminal token (reward_delta
+        off) must reproduce the scalar-terminal reward path exactly."""
+        actor, gen, critic, tok = _ppo_setup(disable_value=False)
+        prompts, id2info = _prompt_batch(tok)
+        g = GenerationHyperparameters(n=2, max_new_tokens=12, temperature=1.0)
+        mb = MicroBatchSpec()
+        base_if = PPOActorInterface(
+            gconfig=g, n_minibatches=1, disable_value=False, adv_norm=True,
+            kl_ctl=0.0,
+        )
+        rollout = base_if.generate(gen, prompts, mb)
+        rollout.update_(
+            MultiTaskRewardInterface(id2info=id2info).inference(
+                actor, rollout, mb
+            )
+        )
+        critic_if = PPOCriticInterface(n_minibatches=1)
+        rollout.update_(critic_if.inference(critic, rollout, mb))
+
+        # Dense scores: zero everywhere except each sequence's last token,
+        # which carries the (scaled) scalar score.
+        lens = [l for row in rollout.seqlens["packed_input_ids"] for l in row]
+        scores = np.asarray(rollout.data["rewards"], np.float32)
+        dense = np.zeros(sum(lens), np.float32)
+        off = 0
+        for si, L in enumerate(lens):
+            dense[off + L - 1] = scores[si]
+            off += L
+        rollout.update_(
+            SequenceSample(
+                keys={"dense_rewards"},
+                ids=list(rollout.ids),
+                seqlens={
+                    "dense_rewards": [
+                        list(r) for r in rollout.seqlens["packed_input_ids"]
+                    ]
+                },
+                data={"dense_rewards": dense},
+            )
+        )
+
+        # Fresh identical actor (same seeds): train_step mutates weights,
+        # so the two paths must start from the same state.
+        actor2, _, _, _ = _ppo_setup(disable_value=False)
+        dense_if = PPOActorInterface(
+            gconfig=g, n_minibatches=1, disable_value=False, adv_norm=True,
+            kl_ctl=0.0, use_dense_reward=True, reward_delta=False,
+        )
+        s_scalar = base_if.train_step(actor, rollout, mb)
+        s_dense = dense_if.train_step(actor2, rollout, mb)
+        for k in ("actor_loss", "advantage_abs", "importance_weight"):
+            assert np.isclose(s_dense[k], s_scalar[k], rtol=1e-5), (
+                k, s_dense[k], s_scalar[k],
+            )
+
+    def test_dense_requires_value_mode_and_key(self):
+        actor, gen, critic, tok = _ppo_setup(disable_value=True)
+        prompts, id2info = _prompt_batch(tok)
+        g = GenerationHyperparameters(n=2, max_new_tokens=8)
+        mb = MicroBatchSpec()
+        iface = PPOActorInterface(
+            gconfig=g, n_minibatches=1, disable_value=True,
+            use_dense_reward=True,
+        )
+        rollout = iface.generate(gen, prompts, mb)
+        rollout.update_(
+            MultiTaskRewardInterface(id2info=id2info).inference(
+                actor, rollout, mb
+            )
+        )
+        with pytest.raises(ValueError, match="value .critic. mode"):
+            iface.train_step(actor, rollout, mb)
