@@ -44,6 +44,7 @@ def _make_master(plan: ExperimentPlan, pool) -> MasterWorker:
         model_groups=plan.model_groups,
         model_replicas=plan.model_replicas,
         difficulty_filter=plan.difficulty_filter,
+        rollout_ahead=plan.rollout_ahead,
     )
 
 

@@ -56,6 +56,9 @@ class ExperimentPlan:
     # {"min_accuracy": .., "max_accuracy": ..} -> dynamic difficulty
     # filtering of prompts by per-step group accuracy.
     difficulty_filter: Optional[Dict[str, float]] = None
+    # Asynchronous rollout: generate step t+1's rollouts while step t
+    # trains (one-step-stale behavior policy; see master._execute_step_async).
+    rollout_ahead: int = 0
 
 
 @dataclasses.dataclass
@@ -143,6 +146,10 @@ class PPOMathConfig:
     critic: Optional[ModelAbstraction] = None
     ref: Optional[ModelAbstraction] = None
     reward_interface_args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Override the reward interface entirely (default: "rw-math-code" with
+    # reward_interface_args).  A custom interface emitting per-token
+    # "dense_rewards" pairs with ppo_kwargs={"use_dense_reward": True}.
+    reward_interface: Optional[ModelInterfaceAbstraction] = None
     actor_parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     gen_parallel: Optional[ParallelConfig] = None  # None = same as actor
     # Device placement within the worker's local devices (None = worker
@@ -167,6 +174,9 @@ class PPOMathConfig:
     # model_worker.py:574-639).  e.g. {"min_accuracy": 0.05,
     # "max_accuracy": 0.95}.
     dataset_filter: Optional[Dict[str, float]] = None
+    # Asynchronous rollout: overlap next-step generation with training
+    # (one-step-stale behavior policy, PPO-ratio-corrected).
+    rollout_ahead: int = 0
     # Host-offload the reference model's params after each ref_inf call
     # (OffloadHook; frees its HBM between steps).
     offload_ref: bool = False
@@ -252,6 +262,13 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
 
     ppo_kwargs = dict(cfg.ppo_kwargs)
     ppo_kwargs.setdefault("disable_value", disable_value)
+    use_dense = bool(ppo_kwargs.get("use_dense_reward"))
+    rew_if = cfg.reward_interface or ModelInterfaceAbstraction(
+        "rw-math-code", cfg.reward_interface_args
+    )
+    rew_outputs = (
+        ("rewards", "dense_rewards") if use_dense else ("rewards",)
+    )
     actor_if = ModelInterfaceAbstraction(
         "ppo_actor", {"gconfig": cfg.gconfig, **ppo_kwargs}
     )
@@ -291,10 +308,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
         "fused",
         {
             "interfaces": {
-                "rew": {
-                    "type_": "rw-math-code",
-                    "args": cfg.reward_interface_args,
-                },
+                "rew": {"type_": rew_if.type_, "args": rew_if.args},
                 "ref": {"type_": "ppo_actor", "args": {}},
             }
         },
@@ -310,7 +324,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                 interface_type=ModelInterfaceType.INFERENCE,
                 interface_impl=fused_if,
                 input_keys=("packed_input_ids", "prompt_mask"),
-                output_keys=("rewards", "packed_ref_logprobs"),
+                output_keys=rew_outputs + ("packed_ref_logprobs",),
                 output_key_remap={"logprobs": "packed_ref_logprobs"},
                 n_seqs=cfg.batch_size,
                 mb_spec=cfg.mb_spec,
@@ -323,11 +337,9 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                 name="rew_inf",
                 model_name=reward,
                 interface_type=ModelInterfaceType.INFERENCE,
-                interface_impl=ModelInterfaceAbstraction(
-                    "rw-math-code", cfg.reward_interface_args
-                ),
+                interface_impl=rew_if,
                 input_keys=("packed_input_ids", "prompt_mask"),
-                output_keys=("rewards",),
+                output_keys=rew_outputs,
                 n_seqs=cfg.batch_size,
                 mb_spec=cfg.mb_spec,
             )
@@ -336,6 +348,8 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
         "packed_input_ids", "prompt_mask", "packed_logprobs",
         "seq_no_eos_mask", "rewards",
     ]
+    if use_dense:
+        train_inputs.append("dense_rewards")
     if ref is not None:
         if not fuse:
             nodes.append(
@@ -443,9 +457,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                 name=reward,
                 model=ModelAbstraction("null"),
                 backend=ModelBackendAbstraction("null"),
-                interface=ModelInterfaceAbstraction(
-                    "rw-math-code", cfg.reward_interface_args
-                ),
+                interface=rew_if,
             )
         )
     if ref is not None:
@@ -512,6 +524,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
         fileroot=cfg.fileroot,
         model_replicas=replicas or None,
         difficulty_filter=cfg.dataset_filter,
+        rollout_ahead=cfg.rollout_ahead,
     )
 
 
@@ -543,6 +556,7 @@ def run_experiment(plan: ExperimentPlan, tokenizer=None):
         model_groups=plan.model_groups,
         model_replicas=plan.model_replicas,
         difficulty_filter=plan.difficulty_filter,
+        rollout_ahead=plan.rollout_ahead,
     )
     master.load_recover_info()
     stats = asyncio.run(master.run())

@@ -86,6 +86,13 @@ class MasterWorker:
         # accuracy falls outside [min_accuracy, max_accuracy] are removed
         # from the datasets (reference: model_worker.py:574-639).
         difficulty_filter: Optional[Dict[str, float]] = None,
+        # Asynchronous rollout: 1 = generate step t+1's rollouts WHILE step
+        # t trains (one-step-stale behavior policy, corrected by the PPO
+        # ratio).  The weight-sync hook orders itself after any in-flight
+        # generation, so every rollout batch uses a single weight version.
+        # Step wall-clock becomes ~max(gen, train) instead of gen + train
+        # on disjoint gen/train placements.
+        rollout_ahead: int = 0,
     ):
         self.dfg = dfg
         self.pool = pool
@@ -125,6 +132,24 @@ class MasterWorker:
             for n in dfg.nodes
             if n.interface_type == ModelInterfaceType.TRAIN_STEP
         ]
+        if rollout_ahead not in (0, 1):
+            raise ValueError(
+                "rollout_ahead supports 0 (synchronous) or 1 (one-step "
+                "overlap); deeper pipelines would need staleness control "
+                "beyond the PPO ratio"
+            )
+        self.rollout_ahead = rollout_ahead
+        # Prefetchable sources: GENERATE nodes fed purely by the dataset.
+        self._source_nodes = [
+            n
+            for n in dfg.nodes
+            if n.interface_type == ModelInterfaceType.GENERATE
+            and all(
+                dfg.data_producers.get(k) is None for k in n.input_keys
+            )
+        ]
+        self._ahead_task: Optional[asyncio.Task] = None
+        self._total_steps: Optional[int] = None
         # Cross-worker data plane bookkeeping: which workers hold which
         # (data id, key) — the master's equivalent of the reference's
         # GlobalStorageTracker (realhf/system/redistributor.py:12).
@@ -157,6 +182,7 @@ class MasterWorker:
         total_steps = self.ctrl.total_train_epochs * self._steps_per_epoch
         if self.ctrl.benchmark_steps is not None:
             total_steps = min(total_steps, self.ctrl.benchmark_steps)
+        self._total_steps = total_steps
         logger.info(
             f"master: {total_steps} steps "
             f"({self.ctrl.total_train_epochs} epochs x {self._steps_per_epoch})"
@@ -189,11 +215,14 @@ class MasterWorker:
     # ---------------- one step ----------------
 
     async def execute_step(self) -> Dict[str, float]:
-        coros = [self._load_data()]
         results: Dict[str, Dict[str, float]] = {}
-        for node in self.dfg.nodes:
-            coros.append(self._run_mfc(node, results))
-        await asyncio.gather(*coros)
+        if self.rollout_ahead > 0 and self._source_nodes:
+            await self._execute_step_async(results)
+        else:
+            coros = [self._load_data()]
+            for node in self.dfg.nodes:
+                coros.append(self._run_mfc(node, results))
+            await asyncio.gather(*coros)
         if self.difficulty_filter:
             await self._apply_difficulty_filter()
         await self._clear_worker_caches()
@@ -202,6 +231,46 @@ class MasterWorker:
             for k, v in stats.items():
                 merged[f"{name}/{k}" if len(results) > 1 else k] = v
         return merged
+
+    async def _execute_step_async(self, results: Dict) -> None:
+        """One step with one-step-ahead rollouts (reference capability:
+        AReaL's asynchronous RL — decoupled generation overlapping
+        training; our DFG equivalent of overlapping the source GENERATE
+        nodes of step t+1 with the rest of step t's graph).
+
+        Steady state per step: (a) take this step's rollouts from the
+        prefetch task started last step; (b) register the NEXT batch's data
+        (synchronously — cache clearing must see its ids) and launch the
+        next prefetch; (c) run the rest of this step's graph concurrently
+        with that prefetch.  The weight-sync hook awaits the in-flight
+        generation (see _run_hook), so rollouts never mix weight versions
+        and the behavior policy is exactly one step stale.
+
+        Recover note: a crash loses the in-flight prefetch batch (its data
+        cursor already advanced) — one skipped batch per recovery, the
+        async-RL tradeoff."""
+        if self._ahead_task is not None:
+            results.update(await self._ahead_task)
+            self._ahead_task = None
+        else:
+            # First step (or restart): no prefetch yet — run sources inline.
+            await self._load_data()
+            await asyncio.gather(
+                *[self._run_mfc(n, results) for n in self._source_nodes]
+            )
+        nxt = self.step_info.global_step + 1
+        if self._total_steps is None or nxt < self._total_steps:
+            await self._load_data()
+            self._ahead_task = asyncio.create_task(self._prefetch_rollouts())
+        rest = [n for n in self.dfg.nodes if n not in self._source_nodes]
+        await asyncio.gather(*[self._run_mfc(n, results) for n in rest])
+
+    async def _prefetch_rollouts(self) -> Dict[str, Dict[str, float]]:
+        results: Dict[str, Dict[str, float]] = {}
+        await asyncio.gather(
+            *[self._run_mfc(n, results) for n in self._source_nodes]
+        )
+        return results
 
     async def _load_data(self):
         resps = await asyncio.gather(
@@ -450,6 +519,13 @@ class MasterWorker:
                 ]
             )
         elif isinstance(hook, ParamReallocHook):
+            if self._ahead_task is not None and str(hook.target) in {
+                str(n.model_name) for n in self._source_nodes
+            }:
+                # Async rollout: never swap a generator's weights while its
+                # prefetch is mid-flight — the sync applies between batches
+                # (one-step staleness, single weight version per batch).
+                await self._ahead_task
             target_group = self._hook_target_set(str(hook.target))
             if target_group == group:
                 # Colocated (same member set): every process holds both

@@ -335,6 +335,13 @@ class ModelWorker:
         from areal_tpu.base import monitor
 
         perf = {"perf/time_s": seconds}
+        if os.environ.get("AREAL_MFC_WALL_MARKERS"):
+            # Debug-only overlap markers (async rollout vs training).  Raw
+            # monotonic values: only comparable within ONE process — off by
+            # default so distributed runs don't log cross-process garbage.
+            now = time.monotonic()
+            perf["perf/t_start"] = now - seconds
+            perf["perf/t_end"] = now
         cfg = model.config
         if cfg is None:
             return perf

@@ -297,7 +297,10 @@ def test_forward_returns_aligned_logprobs(rng):
     assert (lp <= 0).all()
 
 
-@pytest.mark.parametrize("policy", ["dots", "none"])
+_REMAT_REF = {}
+
+
+@pytest.mark.parametrize("policy", ["full", "dots", "none"])
 def test_remat_policy_grad_parity(policy):
     """Rematerialization changes memory/FLOPs, never math: every policy
     yields the same loss and gradients."""
@@ -351,7 +354,12 @@ def test_remat_policy_grad_parity(policy):
             extra_keys=("prompt_mask",),
         )
 
-    ref = run("full")
     got = run(policy)
+    # First parametrized case establishes the reference; later cases
+    # compare against it (one compile per policy, not per pair).
+    if not _REMAT_REF:
+        _REMAT_REF.update(got)
+        return
+    ref = _REMAT_REF
     assert np.isclose(got["loss"], ref["loss"], rtol=1e-6), (got, ref)
     assert np.isclose(got["grad_norm"], ref["grad_norm"], rtol=1e-5)

@@ -527,3 +527,86 @@ class TestDenseRewards:
         )
         with pytest.raises(ValueError, match="value .critic. mode"):
             iface.train_step(actor, rollout, mb)
+
+    def test_dense_rewards_e2e_via_custom_reward_interface(self, tmp_path):
+        """Full-trial wiring: a custom reward interface emits per-token
+        dense_rewards; the builder routes the key through the DFG into
+        actor_train (use_dense_reward)."""
+        from areal_tpu.api.config import (
+            ModelAbstraction,
+            ModelInterfaceAbstraction,
+        )
+        from areal_tpu.api.data_api import DatasetAbstraction
+        from areal_tpu.api.model_api import (
+            OptimizerConfig,
+            register_interface,
+        )
+        from areal_tpu.experiments.common import (
+            PPOMathConfig,
+            build_ppo_math,
+            run_experiment,
+        )
+        from areal_tpu.interfaces.reward import MultiTaskRewardInterface
+        from areal_tpu.models.config import tiny_config
+        from areal_tpu.system.master import ExperimentSaveEvalControl
+        from tests import fixtures
+
+        class DenseRewardInterface(MultiTaskRewardInterface):
+            """Scalar verification + a flat per-token score trail."""
+
+            def inference(self, model, sample, mb_spec):
+                out = super().inference(model, sample, mb_spec)
+                lens = [
+                    l for row in sample.seqlens["packed_input_ids"]
+                    for l in row
+                ]
+                scores = np.asarray(out.data["rewards"], np.float32)
+                dense = np.concatenate(
+                    [
+                        np.full(L, s / max(L, 1), np.float32)
+                        for L, s in zip(lens, scores)
+                    ]
+                )
+                out.keys.add("dense_rewards")
+                out.seqlens["dense_rewards"] = [
+                    list(r) for r in sample.seqlens["packed_input_ids"]
+                ]
+                out.data["dense_rewards"] = dense
+                return out
+
+        try:
+            register_interface("test-dense-rw", DenseRewardInterface)
+        except ValueError:
+            pass  # already registered by a previous parametrization
+
+        tok = fixtures.make_tokenizer()
+        rows = fixtures.build_math_rows(8, seed=4)
+        cfg = PPOMathConfig(
+            actor=ModelAbstraction("random", {"config": tiny_config()}),
+            critic=ModelAbstraction(
+                "random", {"config": tiny_config(is_critic=True)}
+            ),
+            dataset=DatasetAbstraction(
+                "math_code_prompt",
+                {"dataset_builder": lambda: rows, "max_length": 64},
+            ),
+            reward_interface=ModelInterfaceAbstraction(
+                "test-dense-rw",
+                {"id2info": {r["query_id"]: r for r in rows}},
+            ),
+            gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+            ppo_kwargs={
+                "n_minibatches": 2, "use_dense_reward": True,
+                "reward_delta": False,
+            },
+            optimizer=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
+            batch_size=4,
+            ctrl=ExperimentSaveEvalControl(benchmark_steps=2),
+            fileroot=str(tmp_path),
+        )
+        plan = build_ppo_math(cfg, tok)
+        train = next(n for n in plan.dfg.nodes if n.name == "actor_train")
+        assert "dense_rewards" in train.input_keys
+        _, stats = run_experiment(plan, tokenizer=tok)
+        assert len(stats) == 2
+        assert np.isfinite(stats[-1]["actor_train/actor_loss"])
