@@ -100,8 +100,15 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         # New weights supersede any host-offloaded copy.
         self._host_offload = None
         self._offload_shardings = None
-        self.params = jax.device_put(
+        placed = jax.device_put(
             cast, sharding.tree_named(self.mesh, sharding.param_pspecs(cast))
+        )
+        # Donation safety: same-dtype/same-sharding astype+device_put can
+        # ALIAS the source engine's live buffers, which its optimizer step
+        # later DONATES — async rollout would then decode from deleted
+        # buffers.  Copy any leaf still aliasing the input.
+        self.params = jax.tree.map(
+            lambda p, orig: jnp.copy(p) if p is orig else p, placed, params
         )
 
     def get_params(self):

@@ -56,8 +56,13 @@ class InferenceEngine(HostOffloadMixin, Engine):
         # New weights supersede any host-offloaded copy (params-only).
         self._host_offload = None
         self._offload_shardings = None
-        self.params = jax.device_put(
+        placed = jax.device_put(
             cast, sharding.tree_named(self.mesh, sharding.param_pspecs(cast))
+        )
+        # Donation safety (see GeneratorEngine.set_params): never alias the
+        # source engine's live, later-donated buffers.
+        self.params = jax.tree.map(
+            lambda p, orig: jnp.copy(p) if p is orig else p, placed, params
         )
 
     def get_params(self):

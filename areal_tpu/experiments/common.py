@@ -263,6 +263,11 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
     ppo_kwargs = dict(cfg.ppo_kwargs)
     ppo_kwargs.setdefault("disable_value", disable_value)
     use_dense = bool(ppo_kwargs.get("use_dense_reward"))
+    if use_dense and cfg.reward_interface is None:
+        raise ValueError(
+            "use_dense_reward needs a custom reward_interface that emits "
+            "'dense_rewards' (the default rw-math-code grades scalars only)"
+        )
     rew_if = cfg.reward_interface or ModelInterfaceAbstraction(
         "rw-math-code", cfg.reward_interface_args
     )

@@ -223,6 +223,12 @@ class MasterWorker:
             for node in self.dfg.nodes:
                 coros.append(self._run_mfc(node, results))
             await asyncio.gather(*coros)
+        if self._ahead_task is not None:
+            # Cache clearing snapshots the buffer's keep-ids: the prefetch
+            # must have amended its outputs first or they'd be dropped (the
+            # shipped PPO graph already serializes via the weight-sync
+            # hook; this keeps arbitrary graphs safe).
+            await self._ahead_task
         if self.difficulty_filter:
             await self._apply_difficulty_filter()
         await self._clear_worker_caches()
@@ -255,9 +261,7 @@ class MasterWorker:
         else:
             # First step (or restart): no prefetch yet — run sources inline.
             await self._load_data()
-            await asyncio.gather(
-                *[self._run_mfc(n, results) for n in self._source_nodes]
-            )
+            results.update(await self._prefetch_rollouts())
         nxt = self.step_info.global_step + 1
         if self._total_steps is None or nxt < self._total_steps:
             await self._load_data()
@@ -519,9 +523,12 @@ class MasterWorker:
                 ]
             )
         elif isinstance(hook, ParamReallocHook):
-            if self._ahead_task is not None and str(hook.target) in {
-                str(n.model_name) for n in self._source_nodes
-            }:
+            if (
+                self._ahead_task is not None
+                and self._ahead_task is not asyncio.current_task()
+                and str(hook.target)
+                in {str(n.model_name) for n in self._source_nodes}
+            ):
                 # Async rollout: never swap a generator's weights while its
                 # prefetch is mid-flight — the sync applies between batches
                 # (one-step staleness, single weight version per batch).
