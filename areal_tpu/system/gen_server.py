@@ -301,12 +301,19 @@ class RemoteGeneratorEngine(Engine):
     def __init__(
         self,
         cfg,
-        url: str,
+        url,  # str | List[str] — one client per serving rank
         model_type: str = "qwen2",
         sync_dir: Optional[str] = None,
     ):
         self.cfg = cfg
-        self.client = LLMAPIClient(url)
+        # Multiple URLs = the reference's one-server-per-DP-rank shape
+        # (sglang.py:161-226): prompts round-robin across servers, weight
+        # updates broadcast to all.
+        urls = [url] if isinstance(url, str) else list(url)
+        if not urls:
+            raise ValueError("remote generator needs at least one URL")
+        self.clients = [LLMAPIClient(u) for u in urls]
+        self.client = self.clients[0]
         self.model_type = model_type
         # Unique per engine instance: two trials on one host must never
         # interleave checkpoint shards in a shared dir.
@@ -341,7 +348,26 @@ class RemoteGeneratorEngine(Engine):
             )
             for i in range(sample.bs)
         ]
-        outs = {o.qid: o for o in self.client.generate_batch(inps)}
+        # Round-robin across serving ranks; each client's batch still
+        # co-batches server-side.
+        outs: Dict[str, APIGenerateOutput] = {}
+        if len(self.clients) == 1:
+            for o in self.client.generate_batch(inps):
+                outs[o.qid] = o
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            shards = [
+                inps[k :: len(self.clients)]
+                for k in range(len(self.clients))
+            ]
+            with ThreadPoolExecutor(len(self.clients)) as pool:
+                for batch in pool.map(
+                    lambda cs: cs[0].generate_batch(cs[1]),
+                    zip(self.clients, shards),
+                ):
+                    for o in batch:
+                        outs[o.qid] = o
 
         def fetch(i, r):
             o = outs[sample.ids[i]]
@@ -362,7 +388,18 @@ class RemoteGeneratorEngine(Engine):
         hf.save_hf_checkpoint(
             self.sync_dir, self.cfg, params, model_type=self.model_type
         )
-        self.client.update_weights_from_disk(self.sync_dir)
+        if len(self.clients) == 1:
+            self.client.update_weights_from_disk(self.sync_dir)
+        else:
+            # Broadcast concurrently: sync latency stays ~one checkpoint
+            # load, not one per serving rank.
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(len(self.clients)) as pool:
+                list(pool.map(
+                    lambda c: c.update_weights_from_disk(self.sync_dir),
+                    self.clients,
+                ))
 
 
 register_backend(

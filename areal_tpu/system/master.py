@@ -8,6 +8,7 @@ to the worker hosting its model, and amends the buffer with the outputs.
 """
 
 import asyncio
+import contextvars
 import dataclasses
 import os
 import time
@@ -21,6 +22,12 @@ from areal_tpu.base.stats import merge_stats
 from areal_tpu.system.buffer import SequenceBuffer
 
 logger = logging.getLogger("master")
+
+# True within the async-rollout prefetch task (and its children); hooks use
+# it to avoid self-awaiting the prefetch.
+_IN_PREFETCH: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "areal_in_prefetch", default=False
+)
 
 
 class WorkerPool:
@@ -270,6 +277,10 @@ class MasterWorker:
         await asyncio.gather(*[self._run_mfc(n, results) for n in rest])
 
     async def _prefetch_rollouts(self) -> Dict[str, Dict[str, float]]:
+        # Mark this task's context (inherited by its gather children) so a
+        # hook running INSIDE the prefetch never awaits the prefetch task —
+        # task-identity checks can't see through gather's child tasks.
+        _IN_PREFETCH.set(True)
         results: Dict[str, Dict[str, float]] = {}
         await asyncio.gather(
             *[self._run_mfc(n, results) for n in self._source_nodes]
@@ -525,7 +536,7 @@ class MasterWorker:
         elif isinstance(hook, ParamReallocHook):
             if (
                 self._ahead_task is not None
-                and self._ahead_task is not asyncio.current_task()
+                and not _IN_PREFETCH.get()
                 and str(hook.target)
                 in {str(n.model_name) for n in self._source_nodes}
             ):

@@ -216,3 +216,33 @@ def test_ppo_e2e_with_remote_gen_server(tmp_path):
         assert server.version >= 1
     finally:
         server.close()
+
+
+def test_multi_server_dp_ranks(cfg):
+    """Multiple serving ranks (reference: one SGLang server per DP rank):
+    requests round-robin across servers, weight updates broadcast to all,
+    and greedy outputs match the single-server path."""
+    mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+    fresh = tfm.init_params(cfg, jax.random.PRNGKey(21))
+    eng1 = GeneratorEngine(cfg, fresh, mesh, eos_token_id=EOS)
+    eng2 = GeneratorEngine(cfg, fresh, mesh, eos_token_id=EOS)
+    s1 = GenerationServer(eng1, max_wait_ms=2.0)
+    s2 = GenerationServer(eng2, max_wait_ms=2.0)
+    try:
+        rng = np.random.default_rng(9)
+        sample = _prompt_sample(rng, cfg, lens=(5, 8, 11, 6))
+        g = GenerationHyperparameters(n=1, max_new_tokens=5, greedy=True)
+        multi = RemoteGeneratorEngine(cfg, [s1.url, s2.url])
+        single = RemoteGeneratorEngine(cfg, s1.url)
+        got = multi.generate(sample, MicroBatchSpec(), g)
+        want = single.generate(sample, MicroBatchSpec(), g)
+        np.testing.assert_array_equal(
+            np.asarray(got.data["packed_input_ids"]),
+            np.asarray(want.data["packed_input_ids"]),
+        )
+        # set_params broadcasts the checkpoint to every serving rank.
+        multi.set_params(tfm.init_params(cfg, jax.random.PRNGKey(123)))
+        assert s1.version == 1 and s2.version == 1
+    finally:
+        s1.close()
+        s2.close()
