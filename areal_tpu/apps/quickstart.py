@@ -252,6 +252,7 @@ def cmd_ppo_math(args):
         fuse_rew_ref=args.fuse_rew_ref,
         offload_ref=args.offload_ref,
         gen_server_url=args.gen_server_url,
+        rollout_ahead=args.rollout_ahead,
         dataset=DatasetAbstraction(
             "math_code_prompt", {"dataset_path": args.dataset_path}
         ),
@@ -310,9 +311,11 @@ def main(argv=None):
     pp.add_argument("--gen-allocation", default=None,
                     help="separate layout for generation (decoupled meshes)")
     pp.add_argument("--gen-server-url", default=None,
-                    help="decoupled serving: URL of a running "
-                         "areal_tpu.system.gen_server (actor_gen becomes a "
-                         "weightless client; weight sync ships checkpoints)")
+                    help="decoupled serving: URL(s) of running "
+                         "areal_tpu.system.gen_server instances, comma-"
+                         "separated for one server per DP rank (actor_gen "
+                         "becomes a weightless client; weight sync ships "
+                         "checkpoints to every rank)")
     pp.add_argument("--ref-path", default=None,
                     help="reference policy checkpoint (enables KL control)")
     pp.add_argument("--kl-ctl", type=float, default=0.0)
@@ -324,6 +327,9 @@ def main(argv=None):
                     help="host-offload ref params between steps")
     pp.add_argument("--spec-decode-k", type=int, default=0,
                     help="speculative decoding drafts per step (0 = off)")
+    pp.add_argument("--rollout-ahead", type=int, default=0, choices=(0, 1),
+                    help="1 = generate step t+1's rollouts while step t "
+                         "trains (one-step-stale async rollout)")
     pp.set_defaults(fn=cmd_ppo_math)
 
     # Install YAML defaults on whichever subcommand was chosen.

@@ -242,7 +242,14 @@ def _remote_gen_shard(cfg: "PPOMathConfig", actor_gen, actor_if):
         model=ModelAbstraction("config", {"config": model_cfg}),
         backend=ModelBackendAbstraction(
             "remote_generator",
-            {"url": cfg.gen_server_url, "model_type": model_type},
+            {
+                # Comma-separated = one GenerationServer per DP rank
+                # (requests round-robin, weight updates broadcast).
+                "url": [
+                    u.strip() for u in cfg.gen_server_url.split(",")
+                ],
+                "model_type": model_type,
+            },
         ),
         interface=actor_if,
         parallel=ParallelConfig(),

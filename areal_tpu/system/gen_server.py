@@ -350,24 +350,19 @@ class RemoteGeneratorEngine(Engine):
         ]
         # Round-robin across serving ranks; each client's batch still
         # co-batches server-side.
-        outs: Dict[str, APIGenerateOutput] = {}
-        if len(self.clients) == 1:
-            for o in self.client.generate_batch(inps):
-                outs[o.qid] = o
-        else:
-            from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import ThreadPoolExecutor
 
-            shards = [
-                inps[k :: len(self.clients)]
-                for k in range(len(self.clients))
-            ]
-            with ThreadPoolExecutor(len(self.clients)) as pool:
-                for batch in pool.map(
-                    lambda cs: cs[0].generate_batch(cs[1]),
-                    zip(self.clients, shards),
-                ):
-                    for o in batch:
-                        outs[o.qid] = o
+        outs: Dict[str, APIGenerateOutput] = {}
+        shards = [
+            inps[k :: len(self.clients)] for k in range(len(self.clients))
+        ]
+        with ThreadPoolExecutor(len(self.clients)) as pool:
+            for batch in pool.map(
+                lambda cs: cs[0].generate_batch(cs[1]),
+                zip(self.clients, shards),
+            ):
+                for o in batch:
+                    outs[o.qid] = o
 
         def fetch(i, r):
             o = outs[sample.ids[i]]
@@ -388,18 +383,15 @@ class RemoteGeneratorEngine(Engine):
         hf.save_hf_checkpoint(
             self.sync_dir, self.cfg, params, model_type=self.model_type
         )
-        if len(self.clients) == 1:
-            self.client.update_weights_from_disk(self.sync_dir)
-        else:
-            # Broadcast concurrently: sync latency stays ~one checkpoint
-            # load, not one per serving rank.
-            from concurrent.futures import ThreadPoolExecutor
+        # Broadcast concurrently: sync latency stays ~one checkpoint
+        # load, not one per serving rank.
+        from concurrent.futures import ThreadPoolExecutor
 
-            with ThreadPoolExecutor(len(self.clients)) as pool:
-                list(pool.map(
-                    lambda c: c.update_weights_from_disk(self.sync_dir),
-                    self.clients,
-                ))
+        with ThreadPoolExecutor(len(self.clients)) as pool:
+            list(pool.map(
+                lambda c: c.update_weights_from_disk(self.sync_dir),
+                self.clients,
+            ))
 
 
 register_backend(

@@ -277,15 +277,20 @@ class MasterWorker:
         await asyncio.gather(*[self._run_mfc(n, results) for n in rest])
 
     async def _prefetch_rollouts(self) -> Dict[str, Dict[str, float]]:
-        # Mark this task's context (inherited by its gather children) so a
-        # hook running INSIDE the prefetch never awaits the prefetch task —
+        # Mark this context (inherited by the gather children) so a hook
+        # running INSIDE the prefetch never awaits the prefetch task —
         # task-identity checks can't see through gather's child tasks.
-        _IN_PREFETCH.set(True)
-        results: Dict[str, Dict[str, float]] = {}
-        await asyncio.gather(
-            *[self._run_mfc(n, results) for n in self._source_nodes]
-        )
-        return results
+        # Token-reset matters: the first step awaits this coroutine INLINE
+        # in the run-loop's own context, which must not stay marked.
+        token = _IN_PREFETCH.set(True)
+        try:
+            results: Dict[str, Dict[str, float]] = {}
+            await asyncio.gather(
+                *[self._run_mfc(n, results) for n in self._source_nodes]
+            )
+            return results
+        finally:
+            _IN_PREFETCH.reset(token)
 
     async def _load_data(self):
         resps = await asyncio.gather(

@@ -52,6 +52,20 @@ import areal_tpu.interfaces.null  # noqa: F401
 # One xprof trace at a time per process (see _handle_mfc).
 _TRACE_LOCK = threading.Lock()
 
+
+def _check_hbm_kill(perf: Dict[str, float]) -> None:
+    """Fail the worker when device memory crosses a configured watermark
+    (reference: model_worker.py:1434-1537 GPU-mem kill threshold) — a
+    deliberate crash into the recover path beats an unpredictable OOM mid
+    optimizer step."""
+    kill = os.environ.get("AREAL_HBM_KILL_FRAC")
+    frac = perf.get("perf/hbm_frac")
+    if kill and frac is not None and frac > float(kill):
+        raise MemoryError(
+            f"device memory {frac:.1%} exceeds AREAL_HBM_KILL_FRAC={kill}; "
+            "failing fast for the recover loop"
+        )
+
 logger = logging.getLogger("model_worker")
 
 
@@ -398,6 +412,7 @@ class ModelWorker:
                         )
         except Exception as e:  # perf accounting must never fail the MFC
             logger.warning(f"perf accounting failed: {e!r}")
+        _check_hbm_kill(perf)
         return perf
 
     # ---------------- cross-worker transfer plane ----------------

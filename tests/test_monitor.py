@@ -196,3 +196,17 @@ def test_mfc_trace_dump_concurrent_mfcs(tmp_path, monkeypatch):
     _, stats = run_experiment(build_ppo_math(cfg, tok), tokenizer=tok)
     assert len(stats) == 2
     assert list((tmp_path / "traces").rglob("*.xplane.pb"))
+
+
+def test_hbm_kill_threshold(monkeypatch):
+    """AREAL_HBM_KILL_FRAC fails the MFC when device memory crosses the
+    watermark (reference: model_worker.py:1434-1537 mem kill)."""
+    from areal_tpu.system.worker import _check_hbm_kill
+
+    monkeypatch.setenv("AREAL_HBM_KILL_FRAC", "0.9")
+    _check_hbm_kill({"perf/hbm_frac": 0.85})  # under: fine
+    _check_hbm_kill({})  # no stats (CPU): fine
+    with pytest.raises(MemoryError, match="0.9"):
+        _check_hbm_kill({"perf/hbm_frac": 0.95})
+    monkeypatch.delenv("AREAL_HBM_KILL_FRAC")
+    _check_hbm_kill({"perf/hbm_frac": 0.99})  # disabled: fine
