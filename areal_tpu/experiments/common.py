@@ -246,7 +246,9 @@ def _remote_gen_shard(cfg: "PPOMathConfig", actor_gen, actor_if):
                 # Comma-separated = one GenerationServer per DP rank
                 # (requests round-robin, weight updates broadcast).
                 "url": [
-                    u.strip() for u in cfg.gen_server_url.split(",")
+                    u.strip()
+                    for u in cfg.gen_server_url.split(",")
+                    if u.strip()
                 ],
                 "model_type": model_type,
             },

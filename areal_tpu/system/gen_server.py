@@ -313,7 +313,6 @@ class RemoteGeneratorEngine(Engine):
         if not urls:
             raise ValueError("remote generator needs at least one URL")
         self.clients = [LLMAPIClient(u) for u in urls]
-        self.client = self.clients[0]
         self.model_type = model_type
         # Unique per engine instance: two trials on one host must never
         # interleave checkpoint shards in a shared dir.

@@ -293,3 +293,44 @@ class TestSpecGeneratorE2E:
                 t_off += L
                 lp_off += L - 1
         assert set(np.unique(noe)).issubset({0.0, 1.0})
+
+
+def test_spec_decoding_on_sharded_mesh():
+    """Spec decoding under a d2 mesh (batch-sharded inflight pool) matches
+    the single-device greedy output."""
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.engines.generator import GeneratorEngine
+
+    cfg = tiny_config()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(11))
+    rng = np.random.default_rng(4)
+    lens = (6, 9, 5, 11)
+    data = np.concatenate(
+        [rng.integers(8, cfg.vocab_size, size=l) for l in lens]
+    ).astype(np.int32)
+    sample = SequenceSample(
+        keys={"packed_prompts"},
+        ids=[f"p{i}" for i in range(len(lens))],
+        seqlens={"packed_prompts": [[l] for l in lens]},
+        data={"packed_prompts": data},
+    )
+    g = GenerationHyperparameters(
+        n=1, max_new_tokens=10, greedy=True, spec_decode_k=2, spec_ngram=2
+    )
+
+    def run(layout, n_dev):
+        eng = GeneratorEngine(
+            cfg, params,
+            make_mesh(ParallelConfig.from_str(layout), jax.devices()[:n_dev]),
+            eos_token_id=7, max_decode_batch=4,
+        )
+        return eng.generate(sample, MicroBatchSpec(), g)
+
+    want = run("d1", 1)
+    got = run("d2", 2)
+    np.testing.assert_array_equal(
+        np.asarray(got.data["packed_input_ids"]),
+        np.asarray(want.data["packed_input_ids"]),
+    )
