@@ -282,12 +282,16 @@ class WorkerControlPanel:
             )
         return results
 
-    def check_liveness(self) -> Dict[str, bool]:
+    def check_liveness(
+        self, worker_names: Optional[List[str]] = None
+    ) -> Dict[str, bool]:
         """TTL-keepalive liveness per worker (reference: name_resolve
         keepalive keys; a worker whose server thread stalls past the TTL
-        reads as dead)."""
+        reads as dead).  Needs no control connection — pass explicit
+        `worker_names` to probe workers without connect()."""
         alive = {}
-        for wn in self._socks:
+        for wn in (worker_names if worker_names is not None
+                   else self._socks):
             key = names.worker_keepalive(
                 self.experiment_name, self.trial_name, wn
             )
@@ -302,3 +306,66 @@ class WorkerControlPanel:
         for sock in self._socks.values():
             sock.close(linger=0)
         self._ctx.term()
+
+
+def main():
+    """Operator CLI: inspect or control a running trial's workers.
+
+        python -m areal_tpu.system.worker_control \
+            --experiment ppo-math --trial trial0 --root <name_resolve_root> \
+            status|ping|pause|resume|exit [--workers model_worker/0,...]
+
+    (Reference: the controller's worker control panel commands,
+    system/controller.py:60-345.)
+    """
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(prog="areal_tpu.system.worker_control")
+    p.add_argument("command",
+                   choices=["status", "ping", "pause", "resume", "exit",
+                            "liveness"])
+    p.add_argument("--experiment", required=True)
+    p.add_argument("--trial", required=True)
+    p.add_argument("--root", default=None,
+                   help="file name-resolve root (default: "
+                        "$AREAL_NAME_RESOLVE_ROOT)")
+    p.add_argument("--workers", default=None,
+                   help="comma-separated worker names (default: discover "
+                        "all under the trial's control registry)")
+    p.add_argument("--timeout", type=float, default=10.0)
+    args = p.parse_args()
+
+    # Trials use the FILE backend; an operator shell won't have
+    # AREAL_NAME_RESOLVE set, so default to the file repo (at --root or
+    # $AREAL_NAME_RESOLVE_ROOT) rather than the in-memory backend that
+    # could never see a running trial.
+    name_resolve.set_default(
+        name_resolve.FileNameResolveRepository(args.root)
+    )
+    if args.workers:
+        workers = [w.strip() for w in args.workers.split(",") if w.strip()]
+    else:
+        prefix = f"{names.trial_root(args.experiment, args.trial)}/control"
+        keys = name_resolve.find_subtree(prefix)
+        workers = [k[len(prefix) + 1 :] for k in keys]
+        if not workers:
+            raise SystemExit(f"no workers registered under {prefix}")
+
+    panel = WorkerControlPanel(args.experiment, args.trial)
+    try:
+        if args.command == "liveness":
+            # Keepalive keys only — no connect(): a dead worker must read
+            # as alive=false, not a connection timeout.
+            out = panel.check_liveness(workers)
+        else:
+            panel.connect(workers, timeout=args.timeout)
+            cmd = "ping" if args.command == "status" else args.command
+            out = panel.group_request(cmd, timeout=args.timeout)
+        print(json.dumps(out, indent=2, default=str))
+    finally:
+        panel.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -127,3 +127,45 @@ def test_keepalive_liveness(panel_and_servers):
     alive = panel.check_liveness()
     assert alive[servers[0].worker_name] is False
     assert alive[servers[1].worker_name] is True
+
+
+def test_cli_status_and_pause(capsys, tmp_path):
+    """Operator CLI: discover workers from name-resolve, group status +
+    pause/resume.  The CLI always uses the FILE backend (what trials
+    publish to), so the servers register there too."""
+    import json
+
+    from areal_tpu.system import worker_control as wc
+
+    name_resolve.set_default(
+        name_resolve.FileNameResolveRepository(str(tmp_path))
+    )
+    servers = [
+        WorkerServer("clicontrol", "t0", f"model_worker/{i}")
+        for i in range(2)
+    ]
+    try:
+        import sys
+        from unittest import mock
+
+        def run(cmd):
+            with mock.patch.object(
+                sys, "argv",
+                ["worker_control", cmd, "--experiment", "clicontrol",
+                 "--trial", "t0", "--root", str(tmp_path)],
+            ):
+                wc.main()
+            return json.loads(capsys.readouterr().out)
+
+        out = run("status")
+        assert set(out) == {"model_worker/0", "model_worker/1"}
+        assert all(v["state"] == "ready" for v in out.values())
+        run("pause")
+        assert all(s.paused for s in servers)
+        run("resume")
+        assert not any(s.paused for s in servers)
+        alive = run("liveness")
+        assert all(alive.values())
+    finally:
+        for s in servers:
+            s.stop()
