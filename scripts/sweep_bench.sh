@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# One-chip perf sweep for bench.py (run when the TPU is reachable).
+#
+# Sweeps the two train-side knobs that were prepared offline while the
+# device tunnel was down (r2): remat policy and micro-batch token budget.
+# Each run prints bench.py's single JSON line; pick the best config and
+# bake it into bench.py's defaults.
+#
+# Usage: bash scripts/sweep_bench.sh [size]   (default 1.5b)
+set -u
+size="${1:-1.5b}"
+cd "$(dirname "$0")/.."
+for remat in full dots none; do
+  for mb in 4096 8192 16384; do
+    echo "=== remat=$remat mb_tokens=$mb ===" >&2
+    AREAL_BENCH_REMAT="$remat" AREAL_BENCH_MB_TOKENS="$mb" \
+      timeout 1800 python bench.py "$size" || echo "(failed: $remat/$mb)" >&2
+  done
+done
