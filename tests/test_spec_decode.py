@@ -334,3 +334,43 @@ def test_spec_decoding_on_sharded_mesh():
         np.asarray(got.data["packed_input_ids"]),
         np.asarray(want.data["packed_input_ids"]),
     )
+
+
+def test_spec_budget_smaller_than_draft_window():
+    """max_new_tokens < K+1: the host truncates the overshoot and the
+    output still matches plain greedy decoding."""
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.engines.generator import GeneratorEngine
+
+    cfg = tiny_config()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(11))
+    eng = GeneratorEngine(
+        cfg, params,
+        make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1]),
+        eos_token_id=7, max_decode_batch=4,
+    )
+    rng = np.random.default_rng(2)
+    lens = (6, 9)
+    sample = SequenceSample(
+        keys={"packed_prompts"},
+        ids=["a", "b"],
+        seqlens={"packed_prompts": [[l] for l in lens]},
+        data={"packed_prompts": np.concatenate(
+            [rng.integers(8, cfg.vocab_size, size=l) for l in lens]
+        ).astype(np.int32)},
+    )
+    g_spec = GenerationHyperparameters(
+        n=1, max_new_tokens=2, greedy=True, spec_decode_k=4, spec_ngram=2
+    )
+    g_plain = GenerationHyperparameters(n=1, max_new_tokens=2, greedy=True)
+    spec = eng.generate(sample, MicroBatchSpec(), g_spec)
+    plain = eng.generate(sample, MicroBatchSpec(), g_plain, inflight=True)
+    assert (
+        spec.seqlens["packed_input_ids"] == plain.seqlens["packed_input_ids"]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(spec.data["packed_input_ids"]),
+        np.asarray(plain.data["packed_input_ids"]),
+    )
