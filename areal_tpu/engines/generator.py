@@ -85,6 +85,10 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             assert pp_mesh is None
         self.batch_shard = batch_sharding_degree(mesh)
         self._gen_fns: Dict[Tuple, Any] = {}
+        # Device dispatches spent admitting requests into freed slots —
+        # tests assert batching (one dispatch per refill cycle, not one
+        # per admission).
+        self.prefill_dispatches = 0
         self.set_params(params)
 
     # ---------------- weights ----------------
@@ -224,16 +228,18 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         pending = list(reversed(reqs))  # pop() takes the longest first
 
         while pending or any(a is not None for a in active):
-            # Refill free slots (prefill one request per free slot).
-            for s in range(n_slots):
-                if active[s] is None and pending:
-                    i, rep, toks = pending.pop()
-                    sp, row = self._bucket_prompt_row(toks)
-                    row_logits, cache = self._get_prefill_slot_fn(sp)(
-                        self.params, jnp.asarray(row),
-                        jnp.int32(len(toks)), cache, jnp.int32(s),
-                    )
-                    logits_buf = logits_buf.at[s].set(row_logits)
+            # Refill ALL free slots with ONE jitted multi-row prefill
+            # (serial batch-1 admissions would cost ~2k device round-trips
+            # at 512 prompts × 4 samples before steady state).
+            admits = self._take_admits(active, pending, n_slots)
+            if admits:
+                rows, plens, slots = self._pack_admits(admits, n_slots)
+                logits_buf, cache = self._get_prefill_slots_fn()(
+                    self.params, jnp.asarray(rows), jnp.asarray(plens),
+                    cache, logits_buf, jnp.asarray(slots),
+                )
+                self.prefill_dispatches += 1
+                for s, i, rep, toks in admits:
                     cache_len[s] = len(toks)
                     gen_count[s] = 0
                     done_host[s] = False
@@ -311,24 +317,59 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             else:
                 done_host[s] = bool(new_done[s])
 
-    def _get_prefill_slot_fn(self, sp: int):
-        sig = ("prefill_slot", sp)
+    def _take_admits(self, active, pending, n_slots):
+        """Assign pending requests to free slots (longest-prompt first —
+        `pending` is kept sorted ascending so pop() takes the longest)."""
+        admits = []
+        for s in range(n_slots):
+            if active[s] is None and pending:
+                i, rep, toks = pending.pop()
+                admits.append((s, i, rep, toks))
+        return admits
+
+    def _pack_admits(self, admits, n_slots):
+        """Pack one refill cycle's admissions into fixed-shape arrays.
+
+        SP buckets to the longest admitted prompt; M buckets to the next
+        power of two so only O(log slots × log prompt) admission shapes
+        ever compile.  Padding rows carry one pad token (NaN-safe through
+        attention) and an out-of-range slot id — the device-side scatters
+        drop them (`prefill_into_slots`)."""
+        sp = bucket_len(max(len(t) for (_, _, _, t) in admits))
+        m = 1
+        while m < len(admits):
+            m *= 2
+        rows = np.full((m, sp), self.pad_token_id, np.int32)
+        plens = np.ones((m,), np.int32)
+        slots = np.full((m,), n_slots, np.int32)
+        for j, (s, _, _, toks) in enumerate(admits):
+            rows[j, : len(toks)] = toks
+            plens[j] = len(toks)
+            slots[j] = s
+        return rows, plens, slots
+
+    def _get_prefill_slots_fn(self):
+        sig = ("prefill_slots",)
         if sig in self._gen_fns:
             return self._gen_fns[sig]
         cfg = self.cfg
-        # Slot prefill is batch-1: a Mesh (shard_map'd flash) cannot shard
-        # one row over data/fsdp — fall back to dense for this path only.
+        # Admission batches are ragged (1..n_slots rows): a Mesh
+        # (shard_map'd flash) cannot shard them over data/fsdp — fall back
+        # to dense for this path only.
         use_flash = (
             False if isinstance(self._use_flash, Mesh) else self._use_flash
         )
 
-        # Cache donated: the caller rebinds it from the output, and a
-        # non-donated multi-GB cache would be COPIED on every admission.
-        @functools.partial(jax.jit, donate_argnums=(3,))
-        def fn(params, row, plen, cache, slot_row):
-            return tfm.prefill_into_slot(
-                params, cfg, row, plen, cache, slot_row, use_flash=use_flash
+        # Cache/logits donated: the caller rebinds both from the outputs,
+        # and a non-donated multi-GB cache would be COPIED every refill.
+        @functools.partial(jax.jit, donate_argnums=(3, 4))
+        def fn(params, rows, plens, cache, logits_buf, slot_rows):
+            logits, cache = tfm.prefill_into_slots(
+                params, cfg, rows, plens, cache, slot_rows,
+                use_flash=use_flash,
             )
+            logits_buf = logits_buf.at[slot_rows].set(logits, mode="drop")
+            return logits_buf, cache
 
         self._gen_fns[sig] = fn
         return fn
@@ -406,13 +447,6 @@ class GeneratorEngine(HostOffloadMixin, Engine):
 
     # -- shared inflight helpers --
 
-    def _bucket_prompt_row(self, toks) -> Tuple[int, np.ndarray]:
-        """Pad one prompt to its length bucket (shared admit step)."""
-        sp = bucket_len(len(toks))
-        row = np.full((1, sp), self.pad_token_id, np.int32)
-        row[0, : len(toks)] = toks
-        return sp, row
-
     @staticmethod
     def _grow_kv_cache(cache, cur_w: int, need: int):
         """Geometric (doubling) window growth — bounds recompiles and cache
@@ -461,28 +495,29 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         pending_list = list(reversed(reqs))
 
         while pending_list or any(a is not None for a in active):
-            for s in range(n_slots):
-                if active[s] is None and pending_list:
-                    i, rep, toks = pending_list.pop()
-                    sp, row = self._bucket_prompt_row(toks)
-                    key, sub = jax.random.split(key)
-                    tok0, logp0, cache, tokens_buf, pending = (
-                        self._get_spec_admit_fn(sp, tokens_buf.shape[1], g)(
-                            self.params, jnp.asarray(row),
-                            jnp.int32(len(toks)), cache, tokens_buf,
-                            pending, jnp.int32(s), sub,
-                        )
+            admits = self._take_admits(active, pending_list, n_slots)
+            if admits:
+                rows, plens, slots = self._pack_admits(admits, n_slots)
+                key, sub = jax.random.split(key)
+                toks0, logps0, cache, tokens_buf, pending = (
+                    self._get_spec_admit_fn(g)(
+                        self.params, jnp.asarray(rows), jnp.asarray(plens),
+                        cache, tokens_buf, pending, jnp.asarray(slots), sub,
                     )
+                )
+                self.prefill_dispatches += 1
+                # ONE host sync per refill cycle (the eos/done flag must be
+                # exact before the next chunk) — not one per admission.
+                toks0 = to_host(toks0)
+                logps0 = to_host(logps0)
+                for j, (s, i, rep, toks) in enumerate(admits):
+                    t0 = int(toks0[j])
                     cache_len[s] = len(toks)
                     gen_count[s] = 1  # the sampled pending token
-                    # Host sync per admission (reads the sampled token): the
-                    # eos/done flag must be exact BEFORE the next chunk, and
-                    # the read is tiny next to the prefill it follows.
-                    t0 = int(tok0)
                     done_host[s] = t0 == self.eos_token_id
                     active[s] = (i, rep)
                     toks_acc[s] = [t0]
-                    logps_acc[s] = [float(logp0)]
+                    logps_acc[s] = [float(logps0[j])]
 
             # Growth: a chunk can add up to step_cap entries (+K scratch).
             need = int(cache_len.max()) + step_cap + K + 1
@@ -514,9 +549,9 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 logps_acc, results, done_host, cache_len, g.max_new_tokens,
             )
 
-    def _get_spec_admit_fn(self, sp: int, buf_w: int, g):
-        sig = ("spec_admit", sp, buf_w, g.greedy, g.top_p, g.top_k,
-               g.temperature, g.min_new_tokens)
+    def _get_spec_admit_fn(self, g):
+        sig = ("spec_admit", g.greedy, g.top_p, g.top_k, g.temperature,
+               g.min_new_tokens)
         if sig in self._gen_fns:
             return self._gen_fns[sig]
         cfg = self.cfg
@@ -525,12 +560,20 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             False if isinstance(self._use_flash, Mesh) else self._use_flash
         )
 
+        # Batched admission (see _pack_admits): prefill every admitted
+        # prompt, sample its first pending token, and record prompt+token
+        # into the device-resident history buffer — all in one dispatch.
+        # jit re-specializes per (M, SP, buf_w) shape; padding rows scatter
+        # out of range and are dropped.
         @functools.partial(jax.jit, donate_argnums=(3, 4, 5))
-        def fn(params, row, plen, cache, tokens_buf, pending, slot, key):
-            logits_row, cache = tfm.prefill_into_slot(
-                params, cfg, row, plen, cache, slot, use_flash=use_flash
+        def fn(params, rows, plens, cache, tokens_buf, pending, slot_rows,
+               key):
+            sp = rows.shape[1]
+            logits, cache = tfm.prefill_into_slots(
+                params, cfg, rows, plens, cache, slot_rows,
+                use_flash=use_flash,
             )
-            lg = logits_row[None]
+            lg = logits
             if g.min_new_tokens > 0:
                 lg = jnp.where(
                     (jnp.arange(cfg.vocab_size) == eos)[None, :], -1e10, lg
@@ -539,12 +582,10 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 lg, key, temperature=g.temperature, top_k=g.top_k,
                 top_p=g.top_p, greedy=g.greedy,
             )
-            tokens_buf = jax.lax.dynamic_update_slice(
-                tokens_buf, row, (slot, 0)
-            )
-            tokens_buf = tokens_buf.at[slot, plen].set(tok[0])
-            pending = pending.at[slot].set(tok[0])
-            return tok[0], logp[0], cache, tokens_buf, pending
+            tokens_buf = tokens_buf.at[slot_rows, :sp].set(rows, mode="drop")
+            tokens_buf = tokens_buf.at[slot_rows, plens].set(tok, mode="drop")
+            pending = pending.at[slot_rows].set(tok, mode="drop")
+            return tok, logp, cache, tokens_buf, pending
 
         self._gen_fns[sig] = fn
         return fn

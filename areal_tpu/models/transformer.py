@@ -737,36 +737,40 @@ def decode_step_spec(
     return logits, KVCache(k=kc, v=vc)
 
 
-def prefill_into_slot(
+def prefill_into_slots(
     params: Params,
     cfg: ModelConfig,
-    tokens: jax.Array,  # [1, SP] left-aligned prompt (padding right)
-    prompt_len: jax.Array,  # scalar int32
+    tokens: jax.Array,  # [M, SP] left-aligned prompts (padding right)
+    prompt_lens: jax.Array,  # [M] int32
     cache: KVCache,  # [L, n_slots, s_max, h, d]
-    slot_row: jax.Array,  # scalar int32 — which cache row to fill
+    slot_rows: jax.Array,  # [M] int32 — target cache row per prompt
     use_flash: "bool | None" = None,
 ) -> Tuple[jax.Array, KVCache]:
-    """Prefill ONE request into cache row `slot_row` columns [0, SP); returns
-    fp32 logits [V] at the last prompt token.  Used by the inflight generator
-    to admit a new request into a freed slot."""
-    seg = (jnp.arange(tokens.shape[1])[None, :] < prompt_len).astype(jnp.int32)
+    """Prefill M requests into their cache rows in ONE forward; returns fp32
+    logits [M, V] at each row's last prompt token.  The inflight generator
+    admits every freed slot of a refill cycle through one call here instead
+    of M serial batch-1 prefills (the reference batches admissions the same
+    way inside SGLang's scheduler, sglang.py:267-352).  Rows whose
+    `slot_rows` entry is out of range (>= n_slots) are compile-shape padding:
+    their cache/notebook scatters are dropped (`mode="drop"`) and their
+    logits are garbage the caller ignores."""
+    m, sp = tokens.shape
+    seg = (
+        jnp.arange(sp)[None, :] < prompt_lens[:, None]
+    ).astype(jnp.int32)
     row_cache = KVCache(
         k=jnp.zeros(
-            (cfg.n_layers, 1, tokens.shape[1], cfg.n_kv_heads, cfg.head_dim),
+            (cfg.n_layers, m, sp, cfg.n_kv_heads, cfg.head_dim),
             cache.k.dtype,
         ),
         v=jnp.zeros(
-            (cfg.n_layers, 1, tokens.shape[1], cfg.n_kv_heads, cfg.head_dim),
+            (cfg.n_layers, m, sp, cfg.n_kv_heads, cfg.head_dim),
             cache.v.dtype,
         ),
     )
     logits, row_cache = prefill(
         params, cfg, tokens, seg, row_cache, use_flash=use_flash
     )
-    new_k = jax.lax.dynamic_update_slice(
-        cache.k, row_cache.k, (0, slot_row, 0, 0, 0)
-    )
-    new_v = jax.lax.dynamic_update_slice(
-        cache.v, row_cache.v, (0, slot_row, 0, 0, 0)
-    )
-    return logits[0], KVCache(k=new_k, v=new_v)
+    new_k = cache.k.at[:, slot_rows, :sp].set(row_cache.k, mode="drop")
+    new_v = cache.v.at[:, slot_rows, :sp].set(row_cache.v, mode="drop")
+    return logits, KVCache(k=new_k, v=new_v)

@@ -201,6 +201,45 @@ class TestGenerate:
                 np.testing.assert_array_equal(seq[: len(prompt)], prompt)
                 si += 1
 
+    def test_inflight_admissions_are_batched(self, cfg, params, rng):
+        """One jitted prefill dispatch per refill cycle — NOT one per
+        admitted request.  12 uniform requests through 4 slots with a
+        uniform token budget retire in lockstep: exactly ⌈12/4⌉ = 3 refill
+        cycles, so exactly 3 prefill dispatches (the serial-admission
+        formulation paid 12)."""
+        mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+        eng = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, max_decode_batch=4
+        )
+        sample = _prompt_sample(rng, cfg, lens=(6,) * 12)
+        # min_new == max_new masks EOS for the whole budget, so every slot
+        # retires at exactly max_new tokens (lockstep cycles).
+        g = GenerationHyperparameters(
+            n=1, max_new_tokens=8, min_new_tokens=8, greedy=True
+        )
+        eng.generate(sample, MicroBatchSpec(), g, inflight=True)
+        assert eng.prefill_dispatches == 3
+
+    def test_spec_admissions_are_batched(self, cfg, params, rng):
+        """Same contract on the speculative path (which previously also
+        paid one host sync per admission).  Spec retirement is not lockstep
+        (per-row draft acceptance varies), so bound the dispatch count
+        instead of pinning it: the first wave fills all 4 slots in ONE
+        dispatch, and each later wave admits every slot freed since the
+        last chunk — far fewer dispatches than the 8 a serial admission
+        loop would pay."""
+        mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+        eng = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, max_decode_batch=4
+        )
+        sample = _prompt_sample(rng, cfg, lens=(6,) * 8)
+        g = GenerationHyperparameters(
+            n=1, max_new_tokens=8, min_new_tokens=8, greedy=True,
+            spec_decode_k=2,
+        )
+        eng.generate(sample, MicroBatchSpec(), g)
+        assert 2 <= eng.prefill_dispatches < 8
+
     def test_weight_hotswap_changes_output(self, cfg, params, engine, rng):
         sample = _prompt_sample(rng, cfg, lens=(6,))
         g = GenerationHyperparameters(n=1, max_new_tokens=4, greedy=True)
