@@ -85,9 +85,9 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             assert pp_mesh is None
         self.batch_shard = batch_sharding_degree(mesh)
         self._gen_fns: Dict[Tuple, Any] = {}
-        # Device dispatches spent admitting requests into freed slots —
-        # tests assert batching (one dispatch per refill cycle, not one
-        # per admission).
+        # Device dispatches spent admitting requests into freed slots
+        # during the LAST generate() call — tests assert batching (one
+        # dispatch per refill cycle, not one per admission).
         self.prefill_dispatches = 0
         self.set_params(params)
 
@@ -158,6 +158,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
           seq_no_eos_mask   — 1.0 per sequence iff truncated (no EOS)
         """
         self._ensure_loaded()
+        self.prefill_dispatches = 0
         prompt_lens = sample.seqlens_of(prompt_key)
         bounds = sample.cu_seqlens(prompt_key)
         prompts = np.asarray(sample.data[prompt_key])
