@@ -109,6 +109,7 @@ def run_experiment(
     name_resolve_root: Optional[str] = None,
     scheduler_mode: str = "local",
     worker_env: Optional[Dict[str, str]] = None,
+    scheduler_kwargs: Optional[Dict] = None,
 ):
     """Multi-process trial: spawn workers, run the master, wait, recover."""
     root = name_resolve_root or os.path.join(
@@ -143,20 +144,24 @@ def run_experiment(
                 f"{pkg_root}{os.pathsep}{pythonpath}" if pythonpath
                 else pkg_root
             )
+        env = {
+            "PYTHONPATH": pythonpath,
+            "AREAL_NAME_RESOLVE": "file",
+            "AREAL_NAME_RESOLVE_ROOT": root,
+        }
+        if scheduler_mode != "tpu-pod":
+            # Colocated workers default to CPU: one process owns the TPU
+            # runtime (apps/worker.py applies this via jax.config, since
+            # a site PJRT plugin may ignore JAX_PLATFORMS).  On a TPU pod
+            # each worker runs on its OWN host and must claim its chips.
+            env["AREAL_WORKER_PLATFORM"] = "cpu"
+        env.update(worker_env or {})
         sched = make_scheduler(
             scheduler_mode,
             plan.experiment_name,
             plan.trial_name,
-            env={
-                "PYTHONPATH": pythonpath,
-                "AREAL_NAME_RESOLVE": "file",
-                "AREAL_NAME_RESOLVE_ROOT": root,
-                # Colocated workers default to CPU: one process owns the TPU
-                # runtime (apps/worker.py applies this via jax.config, since
-                # a site PJRT plugin may ignore JAX_PLATFORMS).
-                "AREAL_WORKER_PLATFORM": "cpu",
-                **(worker_env or {}),
-            },
+            env=env,
+            **(scheduler_kwargs or {}),
         )
         sched.submit_array(
             "model_worker",

@@ -66,6 +66,19 @@ def _add_common(p: argparse.ArgumentParser):
     p.add_argument("--save-freq-steps", type=int, default=None)
     p.add_argument("--ckpt-freq-steps", type=int, default=None)
     p.add_argument("--benchmark-steps", type=int, default=None)
+    p.add_argument("--launcher", default="local",
+                   choices=("local", "slurm", "tpu-pod"),
+                   help="where workers run: this host (local), sbatch jobs "
+                        "(slurm), or one process per TPU-VM host via "
+                        "gcloud ssh (tpu-pod; needs a shared --fileroot, "
+                        "e.g. GCS fuse)")
+    p.add_argument("--tpu-name", default=None,
+                   help="tpu-pod: TPU VM / pod-slice name")
+    p.add_argument("--tpu-zone", default=None)
+    p.add_argument("--tpu-project", default=None)
+    p.add_argument("--tpu-num-hosts", type=int, default=1,
+                   help="tpu-pod: hosts in the slice (worker i runs on "
+                        "host i %% num-hosts)")
     p.add_argument("--multiprocess", action="store_true",
                    help="spawn workers as subprocesses over ZMQ (default: "
                         "in-process)")
@@ -151,9 +164,22 @@ def _run(plan, args):
     compilation_cache.enable()
     from areal_tpu.apps import main as runner
 
-    if args.multiprocess:
+    if args.multiprocess or args.launcher != "local":
+        kwargs = {}
+        if args.launcher == "tpu-pod":
+            if not args.tpu_name:
+                raise SystemExit("--launcher tpu-pod needs --tpu-name")
+            kwargs = dict(
+                tpu_name=args.tpu_name,
+                zone=args.tpu_zone,
+                project=args.tpu_project,
+                num_hosts=args.tpu_num_hosts,
+            )
         return runner.run_experiment(
-            plan, recover_retries=args.recover_retries
+            plan,
+            recover_retries=args.recover_retries,
+            scheduler_mode=args.launcher,
+            scheduler_kwargs=kwargs,
         )
     return runner.run_experiment_inproc(plan)
 
@@ -241,6 +267,19 @@ def cmd_ppo_math(args):
                 "against a reference policy's logprobs"
             )
         ppo_kwargs["kl_ctl"] = args.kl_ctl
+    if args.kl_adaptive:
+        if not args.kl_ctl:
+            # The controller is multiplicative: a 0.0 start can never
+            # leave 0, so silently "enabling" it would do nothing.
+            raise SystemExit(
+                "--kl-adaptive needs a nonzero --kl-ctl as the initial "
+                "coefficient"
+            )
+        ppo_kwargs["kl_adaptive"] = True
+        ppo_kwargs["adaptive_kl_target"] = args.adaptive_kl_target
+        ppo_kwargs["adaptive_kl_horizon"] = args.adaptive_kl_horizon
+    if args.generation_size is not None:
+        ppo_kwargs["generation_size"] = args.generation_size
     cfg = exps.PPOMathConfig(
         actor=ModelAbstraction("hf", {"path": args.model_path}),
         ref=(
@@ -319,6 +358,16 @@ def main(argv=None):
     pp.add_argument("--ref-path", default=None,
                     help="reference policy checkpoint (enables KL control)")
     pp.add_argument("--kl-ctl", type=float, default=0.0)
+    pp.add_argument("--kl-adaptive", action="store_true",
+                    help="adapt the KL coefficient to hold the measured "
+                         "policy-ref KL at --adaptive-kl-target "
+                         "(Ziegler controller; --kl-ctl is the initial "
+                         "value)")
+    pp.add_argument("--adaptive-kl-target", type=float, default=6.0)
+    pp.add_argument("--adaptive-kl-horizon", type=float, default=10000.0)
+    pp.add_argument("--generation-size", type=int, default=None,
+                    help="best-of-k: sample this many responses per prompt "
+                         "but train on only the top --group-size by reward")
     pp.add_argument("--ref-ema-eta", type=float, default=None,
                     help="EMA-update the ref toward the actor each step")
     pp.add_argument("--fuse-rew-ref", action="store_true",

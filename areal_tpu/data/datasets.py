@@ -293,8 +293,22 @@ class RewardPairedDataset(_DatasetBase):
         self.pos_tokens: List[List[np.ndarray]] = []
         self.neg_tokens: List[List[np.ndarray]] = []
 
-        def _tok(text: str) -> np.ndarray:
-            ids = list(tokenizer.encode(text))[: max_length - 1] + [eos]
+        def _encode_continuation(text: str):
+            # Answers continue the prompt mid-sequence: BOS-adding
+            # tokenizers must not inject specials at the join.
+            try:
+                return list(tokenizer.encode(text, add_special_tokens=False))
+            except TypeError:  # tokenizer without the kwarg (tests)
+                return list(tokenizer.encode(text))
+
+        def _tok(prompt_ids, answer: str) -> np.ndarray:
+            # Tokenize prompt and answer SEPARATELY and concatenate ids:
+            # encoding the joined string lets BPE merge across the
+            # prompt/answer boundary, desynchronizing the stored prompt
+            # length from the packed tokens and skewing the pairwise
+            # loss's prompt/answer split.
+            ids = list(prompt_ids) + _encode_continuation(answer)
+            ids = ids[: max_length - 1] + [eos]
             return np.asarray(ids, np.int32)
 
         n_dropped = 0
@@ -305,7 +319,8 @@ class RewardPairedDataset(_DatasetBase):
                     f"row {x.get('id')}: pos/neg answers must be non-empty "
                     "one-to-one pairs"
                 )
-            plen = len(tokenizer.encode(x["prompt"]))
+            prompt_ids = list(tokenizer.encode(x["prompt"]))
+            plen = len(prompt_ids)
             if plen >= max_length - 1:
                 # Truncation would leave a zero-length answer span: pos and
                 # neg become identical, a zero-margin pair that silently
@@ -314,8 +329,8 @@ class RewardPairedDataset(_DatasetBase):
                 continue
             self.ids.append(str(x["id"]))
             self.prompt_lens.append(plen)
-            self.pos_tokens.append([_tok(x["prompt"] + a) for a in pos])
-            self.neg_tokens.append([_tok(x["prompt"] + a) for a in neg])
+            self.pos_tokens.append([_tok(prompt_ids, a) for a in pos])
+            self.neg_tokens.append([_tok(prompt_ids, a) for a in neg])
         if n_dropped:
             logger.warning(
                 f"RewardPairedDataset: dropped {n_dropped} rows whose prompt "
@@ -358,3 +373,6 @@ data_api.register_dataset("prompt_answer", PromptAnswerDataset)
 data_api.register_dataset("prompt", PromptDataset)
 data_api.register_dataset("math_code_prompt", MathCodePromptDataset)
 data_api.register_dataset("rw_paired", RewardPairedDataset)
+
+# Registers "stream" (rows pushed at runtime over ZMQ; online verification).
+from areal_tpu.data import stream as _stream  # noqa: E402,F401

@@ -1,0 +1,229 @@
+"""Streaming dataset: training rows arrive over a ZMQ PUSH/PULL plane.
+
+Capability parity: realhf/system/push_pull_stream.py (ZMQJsonPusher /
+ZMQJsonPuller with name-resolve discovery, tests/system/
+test_push_pull_stream.py) and the online-verification dataflow it exists
+for: a producer outside the trial — a code-verification service, a data
+crawler, a curriculum filter — pushes fresh rows WHILE training runs, and
+the data worker's dataset grows between batches instead of being frozen
+at launch.
+
+Wire format is JSON lines (one row per message), so producers need
+nothing from this package — any language with a ZMQ binding can feed a
+trial.  The dataset binds the PULL side, publishes its endpoint under the
+trial's name-resolve tree, and drains pending rows non-blockingly every
+time the loader asks for its length (i.e. at every batch boundary —
+PackedDataLoader re-reads len() per epoch and tolerates mid-epoch size
+changes, the same contract dynamic difficulty filtering relies on).
+
+Rows are materialized through any registered row-level dataset (`inner`,
+default "math_code_prompt"): each drained chunk is tokenized by a
+throwaway inner instance and its items appended, so tokenization cost is
+O(new rows), and `id2info` accumulates row metadata for reward grading.
+"""
+
+import json
+from typing import Any, Dict, List, Optional
+
+import zmq
+
+from areal_tpu.api import data_api
+from areal_tpu.api.data_api import SequenceSample
+from areal_tpu.base import logging, name_resolve, names, network
+
+logger = logging.getLogger("stream_data")
+
+
+def stream_name(experiment: str, trial: str, dp_rank: int) -> str:
+    return (
+        names.trial_root(experiment, trial) + f"/stream_dataset/{dp_rank}"
+    )
+
+
+class RowPusher:
+    """Producer side: connect to one dp_rank's stream and push row dicts.
+
+    Discovery via name_resolve (same rendezvous as every other plane) or
+    an explicit address.
+    """
+
+    def __init__(
+        self,
+        experiment: str = "",
+        trial: str = "",
+        dp_rank: int = 0,
+        addr: Optional[str] = None,
+        timeout: float = 30.0,
+        hwm: int = 1000,
+    ):
+        if addr is None:
+            addr = name_resolve.wait(
+                stream_name(experiment, trial, dp_rank), timeout=timeout
+            )
+        self._sock = zmq.Context.instance().socket(zmq.PUSH)
+        self._sock.setsockopt(zmq.SNDHWM, hwm)
+        self._sock.connect(f"tcp://{addr}")
+
+    def push(self, row: Dict[str, Any]) -> None:
+        self._sock.send(json.dumps(row).encode())
+
+    def push_many(self, rows: List[Dict[str, Any]]) -> None:
+        for r in rows:
+            self.push(r)
+
+    def close(self) -> None:
+        self._sock.close(linger=200)
+
+
+class StreamDataset:
+    """Map-style dataset fed at runtime by RowPushers.
+
+    Args:
+      inner: registered dataset type used to tokenize drained rows.
+      inner_args: extra ctor kwargs for the inner dataset.
+      min_rows: block at construction until this many rows arrived (a
+        trial cannot plan its first step over an empty dataset).
+      max_rows: ring-buffer cap — oldest items retire past it (a
+        week-long online trial must not grow without bound).
+      experiment/trial: name-resolve publication; omit both to bind
+        anonymously and read `.addr` directly (tests, single process).
+    """
+
+    def __init__(
+        self,
+        seed: int,
+        dp_rank: int,
+        world_size: int,
+        tokenizer=None,
+        inner: str = "math_code_prompt",
+        inner_args: Optional[Dict[str, Any]] = None,
+        min_rows: int = 1,
+        max_rows: int = 1_000_000,
+        startup_timeout_s: float = 300.0,
+        experiment: str = "",
+        trial: str = "",
+    ):
+        self.seed = seed
+        self.dp_rank = dp_rank
+        self.world_size = world_size
+        self.tokenizer = tokenizer
+        self.inner = inner
+        self.inner_args = dict(inner_args or {})
+        self.max_rows = max_rows
+        self.id2info: Dict[str, Dict[str, Any]] = {}
+        self._items: List[SequenceSample] = []
+        self._ids: List[str] = []
+        self._dropped: set = set()  # difficulty-filtered ids
+        self._sock = zmq.Context.instance().socket(zmq.PULL)
+        port = network.find_free_port()
+        self._sock.bind(f"tcp://0.0.0.0:{port}")
+        self.addr = f"{network.gethostip()}:{port}"
+        if experiment and trial:
+            name_resolve.add(
+                stream_name(experiment, trial, dp_rank),
+                self.addr,
+                replace=True,
+            )
+        logger.info(
+            f"stream dataset (dp {dp_rank}) listening at {self.addr}"
+        )
+        if min_rows > 0:
+            if not self._drain(block_ms=int(startup_timeout_s * 1000),
+                               until=min_rows):
+                raise TimeoutError(
+                    f"stream dataset: <{min_rows} rows arrived within "
+                    f"{startup_timeout_s}s"
+                )
+
+    # -- ingestion --
+
+    def _drain(self, block_ms: int = 0, until: int = 0) -> bool:
+        """Pull every pending row (optionally blocking until `until` rows
+        total exist or the FULL `block_ms` deadline passes); tokenize new
+        rows through a throwaway inner dataset."""
+        import time
+
+        deadline = time.monotonic() + block_ms / 1000.0
+        rows: List[Dict[str, Any]] = []
+        while True:
+            try:
+                rows.append(json.loads(self._sock.recv(zmq.NOBLOCK)))
+            except zmq.Again:
+                if until and len(self._items) + len(rows) < until:
+                    left = deadline - time.monotonic()
+                    if left > 0 and self._sock.poll(
+                        min(int(left * 1000) + 1, 500)
+                    ):
+                        continue
+                    if left > 0:
+                        continue  # poll timed out but budget remains
+                break
+        if rows:
+            self._ingest(rows)
+        return not until or len(self._items) >= until
+
+    def _ingest(self, rows: List[Dict[str, Any]]) -> None:
+        rows = [
+            r for r in rows
+            if str(r.get("query_id", r.get("id"))) not in self._dropped
+        ]
+        if not rows:
+            return
+        ds = data_api.make_dataset(
+            data_api.DatasetAbstraction(
+                self.inner,
+                {"dataset_builder": lambda: rows, **self.inner_args},
+            ),
+            seed=self.seed,
+            dp_rank=0,  # producers already address one dp_rank's stream
+            world_size=1,
+            tokenizer=self.tokenizer,
+        )
+        # Inner datasets shuffle (and may drop) rows; restore ARRIVAL
+        # order so the ring buffer retires oldest-first.
+        by_id = {str(ds[i].ids[0]): ds[i] for i in range(len(ds))}
+        for r in rows:
+            qid = str(r.get("query_id", r.get("id")))
+            if qid not in by_id:
+                continue  # dropped by the inner dataset (e.g. too long)
+            self._items.append(by_id[qid])
+            self._ids.append(qid)
+            self.id2info[qid] = r
+        if len(self._items) > self.max_rows:
+            cut = len(self._items) - self.max_rows
+            for qid in self._ids[:cut]:
+                self.id2info.pop(qid, None)
+            del self._items[:cut]
+            del self._ids[:cut]
+        logger.info(
+            f"stream dataset: +{len(rows)} rows ({len(self._items)} live)"
+        )
+
+    # -- dataset surface --
+
+    def __len__(self):
+        self._drain()
+        return len(self._items)
+
+    def __getitem__(self, idx: int) -> SequenceSample:
+        return self._items[idx]
+
+    def filter(self, to_remove_ids) -> int:
+        """Difficulty filtering: drop live items AND remember the ids so a
+        late-arriving duplicate does not resurrect them."""
+        drop = {str(x) for x in to_remove_ids}
+        self._dropped |= drop
+        keep = [i for i, qid in enumerate(self._ids) if qid not in drop]
+        removed = len(self._items) - len(keep)
+        if removed:
+            self._items = [self._items[i] for i in keep]
+            self._ids = [self._ids[i] for i in keep]
+            for qid in drop:
+                self.id2info.pop(qid, None)
+        return removed
+
+    def close(self) -> None:
+        self._sock.close(linger=0)
+
+
+data_api.register_dataset("stream", StreamDataset)

@@ -110,9 +110,13 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         # Donation safety: same-dtype/same-sharding astype+device_put can
         # ALIAS the source engine's live buffers, which its optimizer step
         # later DONATES — async rollout would then decode from deleted
-        # buffers.  Copy any leaf still aliasing the input.
+        # buffers.  Copy any leaf whose BUFFERS still alias the input
+        # (object identity alone misses distinct Arrays sharing storage).
+        from areal_tpu.engines.offload import buffers_alias
+
         self.params = jax.tree.map(
-            lambda p, orig: jnp.copy(p) if p is orig else p, placed, params
+            lambda p, orig: jnp.copy(p) if buffers_alias(p, orig) else p,
+            placed, params,
         )
 
     def get_params(self):

@@ -60,9 +60,13 @@ class InferenceEngine(HostOffloadMixin, Engine):
             cast, sharding.tree_named(self.mesh, sharding.param_pspecs(cast))
         )
         # Donation safety (see GeneratorEngine.set_params): never alias the
-        # source engine's live, later-donated buffers.
+        # source engine's live, later-donated buffers — compared by buffer
+        # pointer, not object identity.
+        from areal_tpu.engines.offload import buffers_alias
+
         self.params = jax.tree.map(
-            lambda p, orig: jnp.copy(p) if p is orig else p, placed, params
+            lambda p, orig: jnp.copy(p) if buffers_alias(p, orig) else p,
+            placed, params,
         )
 
     def get_params(self):

@@ -9,6 +9,22 @@ drops the device buffers; `_ensure_loaded()` restores them on the next use.
 from typing import Any, Optional, Tuple
 
 
+def buffers_alias(a, b) -> bool:
+    """True when two arrays share any device buffer.  Object identity is
+    NOT enough: `device_put`/`astype` can return a DISTINCT Array that
+    still aliases the source's buffers (no-op cast, partial reshard), and
+    decoding from a buffer the source engine later donates reads freed
+    memory.  Compare the underlying per-shard buffer pointers instead."""
+    if a is b:
+        return True
+    try:
+        pa = {s.data.unsafe_buffer_pointer() for s in a.addressable_shards}
+        pb = {s.data.unsafe_buffer_pointer() for s in b.addressable_shards}
+        return bool(pa & pb)
+    except Exception:  # non-Array leaves / backends without pointer access
+        return False
+
+
 class HostOffloadMixin:
     """Params-only offload; TrainEngine extends with optimizer state."""
 

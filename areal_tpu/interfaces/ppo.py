@@ -141,6 +141,41 @@ def _add_aligned_keys(sample: SequenceSample, arrays: Dict[str, np.ndarray]):
     sample.update_(add)
 
 
+def _select_group_seqs(sample: SequenceSample, keep) -> SequenceSample:
+    """Rebuild a packed sample keeping only sequences `keep[gi]` (indices
+    into each group) for every key carrying one entry per group sequence.
+    Keys with a different per-group arity (e.g. a single prompt per group)
+    pass through whole.  Host-side slicing — used once per train step by
+    best-of-k selection."""
+    k = max(len(g) for g in sample.seqlens["packed_input_ids"])
+    new_seqlens: Dict[str, list] = {}
+    new_data: Dict[str, np.ndarray] = {}
+    for key in sample.keys:
+        sl = sample.seqlens[key]
+        bounds = sample.cu_seqlens(key)
+        arr = np.asarray(sample.data[key])
+        slices, new_sl = [], []
+        si = 0
+        for gi, group in enumerate(sl):
+            idxs = keep[gi] if len(group) == k else range(len(group))
+            new_sl.append([group[j] for j in idxs])
+            for j in idxs:
+                slices.append((int(bounds[si + j]), int(bounds[si + j + 1])))
+            si += len(group)
+        new_data[key] = (
+            np.concatenate([arr[a:b] for a, b in slices])
+            if slices
+            else arr[:0]
+        )
+        new_seqlens[key] = new_sl
+    return SequenceSample(
+        keys=set(sample.keys),
+        ids=list(sample.ids),
+        seqlens=new_seqlens,
+        data=new_data,
+    )
+
+
 @dataclasses.dataclass
 class PPOActorInterface(ModelInterface):
     """Reference defaults follow blog/AReaL_v0_2.md:85-103."""
@@ -151,6 +186,18 @@ class PPOActorInterface(ModelInterface):
     n_minibatches: int = 4
     eps_clip: float = 0.2
     kl_ctl: float = 0.0
+    # Adaptive KL control (reference: ppo_functional.py AdaptiveKLController,
+    # enabled by ppo_interface.py adaptive_kl_ctl): `kl_ctl` becomes the
+    # INITIAL coefficient and drifts to hold the measured policy↔ref KL at
+    # `adaptive_kl_target` (interfaces/kl.py).  The live value rides recover
+    # checkpoints via state_dict.
+    kl_adaptive: bool = False
+    adaptive_kl_target: float = 6.0
+    adaptive_kl_horizon: float = 10000.0
+    # Best-of-k (reference: ppo_interface.py generation_size vs group_size):
+    # sample `generation_size` responses per prompt but train on only the
+    # top `gconfig.n` by reward (ties broken toward longer responses).
+    generation_size: Optional[int] = None
     discount: float = 1.0
     gae_lambda: float = 1.0
     max_reward_clip: float = 5.0
@@ -167,11 +214,42 @@ class PPOActorInterface(ModelInterface):
     use_dense_reward: bool = False
     reward_delta: bool = True
 
+    def _kl(self):
+        if getattr(self, "_kl_inst", None) is None:
+            from areal_tpu.interfaces.kl import make_kl_controller
+
+            object.__setattr__(
+                self,
+                "_kl_inst",
+                make_kl_controller(
+                    self.kl_ctl,
+                    self.kl_adaptive,
+                    self.adaptive_kl_target,
+                    self.adaptive_kl_horizon,
+                ),
+            )
+        return self._kl_inst
+
+    def state_dict(self) -> Dict[str, float]:
+        return self._kl().state_dict() if self.kl_adaptive else {}
+
+    def load_state_dict(self, sd) -> None:
+        if self.kl_adaptive and sd:
+            self._kl().load_state_dict(sd)
+
     def generate(
         self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
     ) -> SequenceSample:
+        g = self.gconfig
+        if self.generation_size is not None:
+            if self.generation_size < g.n:
+                raise ValueError(
+                    f"generation_size={self.generation_size} must be >= "
+                    f"group size n={g.n}"
+                )
+            g = dataclasses.replace(g, n=self.generation_size)
         return model.engine.generate(
-            sample, mb_spec, self.gconfig, prompt_key="packed_prompts",
+            sample, mb_spec, g, prompt_key="packed_prompts",
             seed=model.version,
         )
 
@@ -184,9 +262,38 @@ class PPOActorInterface(ModelInterface):
         )
         return out
 
+    def _filter_best_of_k(self, sample: SequenceSample) -> SequenceSample:
+        """Keep the top `gconfig.n` of `generation_size` responses per
+        prompt by reward, ties toward longer responses (reference topk,
+        ppo_interface.py:43-48).  Runs before any advantage math so GRPO
+        groups and GAE windows see only the kept sequences."""
+        scores = np.asarray(sample.data["rewards"], np.float32)
+        layout, _ = _extract_layout(sample)
+        keep = []
+        si = 0
+        for group in sample.seqlens["packed_input_ids"]:
+            k = len(group)
+            resp_lens = [
+                layout[si + j][1] - layout[si + j][2] for j in range(k)
+            ]
+            order = sorted(
+                range(k),
+                key=lambda j: (scores[si + j], resp_lens[j]),
+                reverse=True,
+            )[: self.gconfig.n]
+            keep.append(sorted(order))
+            si += k
+        return _select_group_seqs(sample, keep)
+
     def train_step(
         self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
     ) -> Dict[str, float]:
+        if (
+            self.generation_size is not None
+            and self.generation_size > self.gconfig.n
+        ):
+            sample = self._filter_best_of_k(sample)
+        klv = self._kl().value
         layout, group_of = _extract_layout(sample)
         total = sum(L for (_, L, _) in layout)
         tokens_np = np.asarray(sample.data["packed_input_ids"])
@@ -217,8 +324,8 @@ class PPOActorInterface(ModelInterface):
         rewards = np.zeros(total, np.float32)
         loss_mask = np.zeros(total, np.float32)
         adv_full = np.zeros(total, np.float32)
-        if ref_logp is not None and self.kl_ctl != 0.0:
-            rewards -= self.kl_ctl * (old_logp - ref_logp)
+        if ref_logp is not None and klv != 0.0:
+            rewards -= klv * (old_logp - ref_logp)
 
         dense = None
         if self.use_dense_reward:
@@ -279,8 +386,8 @@ class PPOActorInterface(ModelInterface):
             for si, (lo, hi) in enumerate(seq_slices):
                 adv_full[lo:hi] = adv_seq[si]
                 # KL penalty still contributes per-token if configured.
-            if ref_logp is not None and self.kl_ctl != 0.0:
-                adv_full += -self.kl_ctl * (old_logp - ref_logp) * loss_mask
+            if ref_logp is not None and klv != 0.0:
+                adv_full += -klv * (old_logp - ref_logp) * loss_mask
         else:
             # Pack response-only windows for GAE.
             r_parts, v_parts, seg_parts, boot_parts, lens_resp = (
@@ -366,6 +473,16 @@ class PPOActorInterface(ModelInterface):
             k: float(np.mean([s[k] for s in all_stats]))
             for k in all_stats[0]
         }
+        # Adaptive KL control: steer next step's coefficient by this
+        # batch's measured policy↔ref KL (reference updates inside the loss
+        # fn with the same post-reward timing, ppo_interface.py:105).
+        ref_kl = 0.0
+        if ref_logp is not None and loss_mask.sum() > 0:
+            ref_kl = float(
+                ((old_logp - ref_logp) * loss_mask).sum() / loss_mask.sum()
+            )
+            self._kl().update(ref_kl, n_steps=len(layout))
+
         out.update(
             task_reward=float(scores.mean()),
             no_eos_ratio=float(no_eos.mean()),
@@ -373,6 +490,8 @@ class PPOActorInterface(ModelInterface):
             if (loss_mask > 0).any()
             else 0.0,
             n_response_tokens=float(loss_mask.sum()),
+            kl_ctl_value=klv,
+            ref_kl=ref_kl,
         )
         return out
 

@@ -9,7 +9,7 @@ scores (one scalar per sequence, the reference's reward layout).
 
 import dataclasses
 import json
-import subprocess
+import os
 import sys
 import tempfile
 from typing import Any, Dict, List, Optional
@@ -117,8 +117,13 @@ class MultiTaskRewardInterface(ModelInterface):
         return False
 
     # -- code verification: run extracted program against input/output pairs
-    # in a subprocess with a timeout (reference: functioncall/code/local_verify)
+    # in a SANDBOXED subprocess — rlimits + tmpdir jail + (where available)
+    # a network namespace; see interfaces/sandbox.py for the trust model
+    # (reference: functioncall/code/local_verify, whose hostile-code path
+    # is the remote FaaS sandbox like our reward_service).
     def _verify_code(self, text: str, info: Dict[str, Any]) -> bool:
+        from areal_tpu.interfaces.sandbox import run_sandboxed
+
         m = _extract_code_block(text)
         if m is None:
             return False
@@ -128,24 +133,19 @@ class MultiTaskRewardInterface(ModelInterface):
             inputs, outputs = io_spec["inputs"], io_spec["outputs"]
         except (KeyError, TypeError, json.JSONDecodeError):
             return False
-        with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
-            f.write(m)
-            path = f.name
-        for inp, expected in zip(inputs, outputs):
-            try:
-                proc = subprocess.run(
+        with tempfile.TemporaryDirectory(prefix="areal_grade_") as jail:
+            path = os.path.join(jail, "prog.py")
+            with open(path, "w") as f:
+                f.write(m)
+            for inp, expected in zip(inputs, outputs):
+                rc, stdout = run_sandboxed(
                     [sys.executable, path],
-                    input=inp,
-                    capture_output=True,
-                    text=True,
-                    timeout=self.code_timeout_s,
+                    input_text=inp,
+                    timeout_s=self.code_timeout_s,
+                    cwd=jail,
                 )
-            except subprocess.TimeoutExpired:
-                return False
-            if proc.returncode != 0:
-                return False
-            if proc.stdout.strip() != expected.strip():
-                return False
+                if rc != 0 or stdout.strip() != expected.strip():
+                    return False
         return True
 
 

@@ -1,0 +1,115 @@
+"""Sandboxed execution of untrusted reward-verification programs.
+
+Capability parity: the reference offloads code grading to a remote FaaS
+sandbox (realhf/functioncall/code/verify.py); its local fallback
+(local_verify) is a bare subprocess.  Here the LOCAL path itself is
+fenced, since TPU trials routinely grade model-written code in-process:
+
+- rlimits (preexec, applied inside the child): CPU seconds, address
+  space, file size, process/thread count, open files, core dumps off;
+- a throwaway tmpdir jail as cwd (the program file lives there; the dir
+  is deleted after grading);
+- minimal environment and a fresh session (process group) so timeout
+  kills reach grandchildren;
+- a user+network namespace (`unshare -rn`) when the kernel allows it,
+  removing network access entirely — probed once and cached.
+
+Trust model: this blocks the accident class (fork bombs, memory bombs,
+giant files, stray network calls, clobbering the trial's cwd) but it is
+NOT a container boundary — a kernel exploit or writes to world-writable
+paths remain possible.  Grade genuinely hostile code only behind the
+remote reward service on an isolated machine (interfaces/reward_service).
+"""
+
+import os
+import resource
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("sandbox")
+
+_UNSHARE: Optional[List[str]] = None
+
+
+def _unshare_prefix() -> List[str]:
+    """`unshare -rn` argv prefix when user+net namespaces work here."""
+    global _UNSHARE
+    if _UNSHARE is None:
+        exe = shutil.which("unshare")
+        ok = False
+        if exe:
+            try:
+                ok = (
+                    subprocess.run(
+                        [exe, "-rn", "true"], capture_output=True, timeout=5
+                    ).returncode
+                    == 0
+                )
+            except Exception:
+                ok = False
+        _UNSHARE = [exe, "-rn"] if ok else []
+        if not _UNSHARE:
+            logger.warning(
+                "unshare -rn unavailable: sandboxed code keeps network "
+                "access (rlimits + tmpdir jail still apply)"
+            )
+    return _UNSHARE
+
+
+def _set_limits(cpu_s: int, mem_mb: int, fsize_mb: int, nproc: int):
+    def apply():
+        resource.setrlimit(resource.RLIMIT_CPU, (cpu_s, cpu_s + 1))
+        resource.setrlimit(
+            resource.RLIMIT_AS, (mem_mb << 20, mem_mb << 20)
+        )
+        resource.setrlimit(
+            resource.RLIMIT_FSIZE, (fsize_mb << 20, fsize_mb << 20)
+        )
+        # Threads count toward NPROC on Linux; generous enough for any
+        # legitimate solution, small enough to stop a fork bomb.
+        resource.setrlimit(resource.RLIMIT_NPROC, (nproc, nproc))
+        resource.setrlimit(resource.RLIMIT_NOFILE, (256, 256))
+        resource.setrlimit(resource.RLIMIT_CORE, (0, 0))
+
+    return apply
+
+
+def run_sandboxed(
+    argv: List[str],
+    input_text: str = "",
+    timeout_s: float = 8.0,
+    cwd: Optional[str] = None,
+    mem_mb: int = 1024,
+    fsize_mb: int = 32,
+    nproc: int = 512,
+) -> Tuple[int, str]:
+    """Run `argv` jailed; returns (returncode, stdout).  Timeouts and
+    resource kills surface as nonzero returncodes (-1 for wall timeout)."""
+    proc = subprocess.Popen(
+        _unshare_prefix() + argv,
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=cwd,
+        env={"PATH": "/usr/bin:/bin", "HOME": cwd or "/tmp"},
+        start_new_session=True,
+        preexec_fn=_set_limits(
+            max(1, int(timeout_s)), mem_mb, fsize_mb, nproc
+        ),
+    )
+    try:
+        stdout, _ = proc.communicate(input=input_text, timeout=timeout_s)
+        return proc.returncode, stdout
+    except subprocess.TimeoutExpired:
+        # Kill the whole session, not just the child: a graded program's
+        # own subprocesses must not outlive the timeout.
+        try:
+            os.killpg(proc.pid, 9)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+        return -1, ""

@@ -107,4 +107,8 @@ def make_scheduler(
         from areal_tpu.scheduler.slurm import SlurmSchedulerClient
 
         return SlurmSchedulerClient(expr_name, trial_name, **kwargs)
+    if mode == "tpu-pod":
+        from areal_tpu.scheduler.tpu_pod import TPUPodSchedulerClient
+
+        return TPUPodSchedulerClient(expr_name, trial_name, **kwargs)
     raise ValueError(f"unknown scheduler mode {mode!r}")

@@ -80,9 +80,15 @@ class GenerationServer:
         max_wait_ms: float = 5.0,
         max_batch: int = 256,
         token: str = "",
+        ckpt_root: str = "",
     ):
         self.engine = engine
         self.version = 0
+        # /update_weights loads an arbitrary path and hot-swaps serving
+        # weights: restrict it to a checkpoint root when configured.
+        self.ckpt_root = ckpt_root or os.environ.get(
+            "AREAL_GEN_CKPT_ROOT", ""
+        )
         self.max_wait_ms = max_wait_ms
         self.max_batch = max_batch
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
@@ -134,6 +140,19 @@ class GenerationServer:
                     self._send(500, {"error": repr(e)})
 
         self._token = token or os.environ.get("AREAL_GEN_TOKEN", "")
+        if not self._token and host not in ("127.0.0.1", "localhost", "::1"):
+            # An open bind without auth lets any peer repoint the serving
+            # weights via /update_weights.
+            if os.environ.get("AREAL_GEN_INSECURE") != "1":
+                raise ValueError(
+                    f"refusing to bind {host} without a token: set "
+                    "token=/AREAL_GEN_TOKEN, or AREAL_GEN_INSECURE=1 to "
+                    "serve an open network port anyway"
+                )
+            logger.warning(
+                f"INSECURE: serving on {host} with no auth token — any "
+                "process that can reach the port can swap the model"
+            )
         self._http = ThreadingHTTPServer((host, port), _Handler)
         self.port = self._http.server_port
         self.url = f"http://{host}:{self.port}"
@@ -172,6 +191,9 @@ class GenerationServer:
         while not p.done.wait(timeout=1.0):
             if self._stop.is_set():
                 raise RuntimeError("generation server shutting down")
+            if not self._collector_thread.is_alive():
+                # Never leave a client blocked on a dead collector.
+                raise RuntimeError("generation collector thread died")
         if p.error:
             raise RuntimeError(p.error)
         return p.result
@@ -179,7 +201,17 @@ class GenerationServer:
     def _handle_update(self, req: Dict) -> Dict:
         from areal_tpu.models.hf import registry as hf
 
-        _, params = hf.load_hf_checkpoint(req["path"])
+        path = os.path.realpath(str(req["path"]))
+        if self.ckpt_root and not path.startswith(
+            os.path.realpath(self.ckpt_root) + os.sep
+        ):
+            raise ValueError(
+                f"update path {path!r} outside checkpoint root "
+                f"{self.ckpt_root!r}"
+            )
+        # Load the RESOLVED path: loading the raw one would let a symlink
+        # swapped after the check escape the root.
+        _, params = hf.load_hf_checkpoint(path)
         with self._engine_lock:
             self.engine.set_params(params)
             self.version += 1
@@ -197,18 +229,29 @@ class GenerationServer:
             except queue.Empty:
                 continue
             batch = [first]
-            # Linger briefly so concurrent clients land in one engine call.
-            time.sleep(self.max_wait_ms / 1000.0)
-            while len(batch) < self.max_batch:
-                try:
-                    batch.append(self._queue.get_nowait())
-                except queue.Empty:
-                    break
-            by_g: Dict[Any, List[_Pending]] = {}
-            for p in batch:
-                by_g.setdefault(_gkey(p), []).append(p)
-            for group in by_g.values():
-                self._run_group(group)
+            # The loop body must never kill the collector thread: every
+            # /generate blocks on p.done, so an uncaught error here would
+            # hang all future clients.  _run_group guards per-group errors;
+            # this guards the batching glue and fails the batch loudly.
+            try:
+                # Linger briefly so concurrent clients land in one call.
+                time.sleep(self.max_wait_ms / 1000.0)
+                while len(batch) < self.max_batch:
+                    try:
+                        batch.append(self._queue.get_nowait())
+                    except queue.Empty:
+                        break
+                by_g: Dict[Any, List[_Pending]] = {}
+                for p in batch:
+                    by_g.setdefault(_gkey(p), []).append(p)
+                for group in by_g.values():
+                    self._run_group(group)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("collector batching error")
+                for p in batch:
+                    if not p.done.is_set():
+                        p.error = f"collector error: {e!r}"
+                        p.done.set()
         # Shutdown: fail anything still queued so no client hangs.
         while True:
             try:

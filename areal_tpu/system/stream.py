@@ -204,10 +204,15 @@ def run_worker_stream(
                 continue
             msg = pickle.loads(sock.recv())
             req = msg["request"]
-            # A paused worker holds requests until the controller resumes it
-            # (reference: worker_base.py PAUSED state gating _poll).
+            # A paused worker parks non-exit requests until the controller
+            # resumes it (pausing mid-step stalls the trial; reference:
+            # worker_base.py PAUSED state gating _poll).  Exit requests —
+            # master shutdown broadcast OR controller side channel — are
+            # never parked, so teardown cannot deadlock on a paused
+            # worker.
             if control is not None and req.get("type") != "exit":
-                control.wait_if_paused()
+                while control.paused and control.state.value != "exiting":
+                    control.wait_if_paused(timeout=0.5)
             if req.get("type") == "exit":
                 for t in threads:
                     t.join(timeout=timeout)

@@ -239,6 +239,170 @@ def test_ppo_full_step(disable_value):
         assert np.isfinite(cstats["value_loss"])
 
 
+class TestKLController:
+    """Controller dynamics (reference: ppo_functional.py:14-48)."""
+
+    def test_adaptive_moves_toward_target(self):
+        from areal_tpu.interfaces.kl import (
+            AdaptiveKLController,
+            FixedKLController,
+        )
+
+        # Observed KL far above target: proportional error clips at +0.2.
+        c = AdaptiveKLController(value=0.1, target=1.0, horizon=100.0)
+        c.update(5.0, n_steps=10)
+        assert np.isclose(c.value, 0.1 * (1 + 0.2 * 10 / 100))
+        # Far below target: clips at -0.2.
+        c = AdaptiveKLController(value=0.1, target=1.0, horizon=100.0)
+        c.update(0.0, n_steps=10)
+        assert np.isclose(c.value, 0.1 * (1 - 0.2 * 10 / 100))
+        # Within the clip band: proportional.
+        c = AdaptiveKLController(value=0.1, target=1.0, horizon=100.0)
+        c.update(1.05, n_steps=10)
+        assert np.isclose(c.value, 0.1 * (1 + 0.05 * 10 / 100))
+        # At target: no change; fixed controller never changes.
+        c.update(c.target, n_steps=10)
+        f = FixedKLController(value=0.3)
+        f.update(100.0, n_steps=10)
+        assert f.value == 0.3
+
+    def test_state_roundtrip(self):
+        from areal_tpu.interfaces.kl import AdaptiveKLController
+
+        c = AdaptiveKLController(value=0.1, target=1.0, horizon=100.0)
+        c.update(5.0, n_steps=10)
+        c2 = AdaptiveKLController(value=0.7, target=1.0, horizon=100.0)
+        c2.load_state_dict(c.state_dict())
+        assert c2.value == c.value
+
+    def test_adaptive_kl_in_train_step(self):
+        """E2E: train_step measures the policy↔ref KL, reports the value it
+        USED, and moves the controller for the next step."""
+        actor, gen, _, tok = _ppo_setup(disable_value=True)
+        prompts, id2info = _prompt_batch(tok)
+        g = GenerationHyperparameters(n=2, max_new_tokens=8, temperature=1.0)
+        actor_if = PPOActorInterface(
+            gconfig=g, n_minibatches=1, disable_value=True, kl_ctl=0.1,
+            kl_adaptive=True, adaptive_kl_target=0.05,
+            adaptive_kl_horizon=10.0,
+        )
+        mb = MicroBatchSpec()
+        rollout = actor_if.generate(gen, prompts, mb)
+        rollout.update_(
+            MultiTaskRewardInterface(id2info=id2info).inference(
+                actor, rollout, mb
+            )
+        )
+        # Synthetic ref logprobs offset by -0.2/token -> measured KL = 0.2.
+        lp = np.asarray(rollout.data["packed_logprobs"], np.float32)
+        rollout.update_(
+            SequenceSample(
+                keys={"packed_ref_logprobs"},
+                ids=list(rollout.ids),
+                seqlens={
+                    "packed_ref_logprobs": [
+                        list(x) for x in rollout.seqlens["packed_logprobs"]
+                    ]
+                },
+                data={"packed_ref_logprobs": lp - 0.2},
+            )
+        )
+        stats = actor_if.train_step(actor, rollout, mb)
+        assert np.isclose(stats["ref_kl"], 0.2, atol=1e-4)
+        assert stats["kl_ctl_value"] == 0.1
+        n_seqs = prompts.bs * g.n
+        # observed/target = 4 -> error clips at +0.2.
+        want = 0.1 * (1 + 0.2 * n_seqs / 10.0)
+        assert np.isclose(actor_if._kl().value, want)
+
+
+class TestBestOfK:
+    def test_filter_keeps_top_n_by_reward_then_length(self):
+        """Group best-of-k selection (reference topk,
+        ppo_interface.py:43-48): rank by reward, break ties toward the
+        LONGER response, keep gconfig.n per group."""
+        # 1 group, 4 seqs: prompt_len 2, response lens 2,3,4,5.
+        lens = [4, 5, 6, 7]
+        tokens = np.concatenate(
+            [np.full(l, j, np.int32) for j, l in enumerate(lens)]
+        )
+        pmask = np.concatenate(
+            [[True, True] + [False] * (l - 2) for l in lens]
+        )
+        sample = SequenceSample(
+            keys={
+                "packed_input_ids", "prompt_mask", "rewards",
+                "packed_logprobs",
+            },
+            ids=["q0"],
+            seqlens={
+                "packed_input_ids": [lens],
+                "prompt_mask": [list(lens)],
+                "packed_logprobs": [[l - 1 for l in lens]],
+                "rewards": [[1, 1, 1, 1]],
+            },
+            data={
+                "packed_input_ids": tokens,
+                "prompt_mask": pmask,
+                "packed_logprobs": np.concatenate(
+                    [np.full(l - 1, float(j), np.float32)
+                     for j, l in enumerate(lens)]
+                ),
+                "rewards": np.asarray([0.0, 1.0, 1.0, 0.5], np.float32),
+            },
+        )
+        iface = PPOActorInterface(
+            gconfig=GenerationHyperparameters(n=2), generation_size=4
+        )
+        got = iface._filter_best_of_k(sample)
+        # Top-2: rewards 1.0 (j=1) and 1.0 (j=2); tie -> longer (j=2) first,
+        # but selection keeps original order: j=1, j=2.
+        assert got.seqlens["packed_input_ids"] == [[5, 6]]
+        np.testing.assert_array_equal(
+            np.asarray(got.data["packed_input_ids"]),
+            np.concatenate([np.full(5, 1), np.full(6, 2)]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.data["rewards"]), [1.0, 1.0]
+        )
+        assert got.seqlens["packed_logprobs"] == [[4, 5]]
+
+    def test_best_of_k_e2e_alignment_survives(self):
+        """Full PPO step with generation_size=4 > n=2: train consumes only
+        the kept half, and the kept sequences' behavior logprobs stay
+        aligned with their tokens (ratio == 1 on the first update)."""
+        actor, gen, _, tok = _ppo_setup(disable_value=True)
+        prompts, id2info = _prompt_batch(tok)
+        g = GenerationHyperparameters(n=2, max_new_tokens=8, temperature=1.0)
+        actor_if = PPOActorInterface(
+            gconfig=g, n_minibatches=1, disable_value=True,
+            generation_size=4,
+        )
+        mb = MicroBatchSpec()
+        rollout = actor_if.generate(gen, prompts, mb)
+        # generate() samples generation_size per prompt...
+        assert all(
+            len(x) == 4 for x in rollout.seqlens["packed_input_ids"]
+        )
+        rollout.update_(
+            MultiTaskRewardInterface(id2info=id2info).inference(
+                actor, rollout, mb
+            )
+        )
+        full_resp = sum(
+            L - int(np.asarray(rollout.data["prompt_mask"])[s : s + L].sum())
+            for s, L in zip(
+                rollout.cu_seqlens("packed_input_ids")[:-1],
+                rollout.seqlens_of("packed_input_ids"),
+            )
+        )
+        stats = actor_if.train_step(actor, rollout, mb)
+        # ...but trains on strictly fewer response tokens (top n=2 kept).
+        assert 0 < stats["n_response_tokens"] < full_resp
+        assert abs(stats["importance_weight"] - 1.0) < 1e-2, stats
+        assert np.isfinite(stats["actor_loss"])
+
+
 class TestValueNorm:
     def test_running_mean_std_oracles(self):
         from areal_tpu.interfaces.value_norm import (

@@ -1,0 +1,103 @@
+"""Sandbox fences for code-reward grading (interfaces/sandbox.py).
+
+Models the boundary the reference delegates to its FaaS sandbox
+(realhf/functioncall/code/verify.py): runaway-resource programs must fail
+grading without harming the trial process.
+"""
+
+import os
+import sys
+
+import pytest
+
+from areal_tpu.interfaces.reward import MultiTaskRewardInterface
+from areal_tpu.interfaces.sandbox import _unshare_prefix, run_sandboxed
+
+
+class TestRunSandboxed:
+    def test_good_program_passes(self):
+        rc, out = run_sandboxed(
+            [sys.executable, "-c", "print(int(input()) * 2)"],
+            input_text="21\n",
+            timeout_s=10.0,
+        )
+        assert rc == 0
+        assert out.strip() == "42"
+
+    def test_wall_timeout_kills(self):
+        rc, _ = run_sandboxed(
+            [sys.executable, "-c", "while True: pass"], timeout_s=1.0
+        )
+        assert rc != 0
+
+    def test_memory_bomb_killed(self):
+        rc, _ = run_sandboxed(
+            [sys.executable, "-c", "x = bytearray(1 << 31); print('no')"],
+            timeout_s=10.0,
+            mem_mb=256,
+        )
+        assert rc != 0
+
+    def test_file_size_limited(self, tmp_path):
+        rc, _ = run_sandboxed(
+            [
+                sys.executable, "-c",
+                "open('big.bin','wb').write(b'x' * (8 << 20)); print('no')",
+            ],
+            timeout_s=10.0,
+            cwd=str(tmp_path),
+            fsize_mb=1,
+        )
+        assert rc != 0
+
+    def test_cwd_is_the_jail(self, tmp_path):
+        rc, out = run_sandboxed(
+            [sys.executable, "-c",
+             "import os; open('x','w').write('1'); print(os.getcwd())"],
+            timeout_s=10.0,
+            cwd=str(tmp_path),
+        )
+        assert rc == 0
+        assert out.strip() == str(tmp_path)
+        assert (tmp_path / "x").exists()
+
+    @pytest.mark.skipif(
+        not _unshare_prefix(), reason="no user+net namespace here"
+    )
+    def test_network_unreachable(self):
+        rc, _ = run_sandboxed(
+            [
+                sys.executable, "-c",
+                "import socket; s = socket.create_connection("
+                "('127.0.0.1', 9), timeout=2); print('no')",
+            ],
+            timeout_s=10.0,
+        )
+        assert rc != 0
+
+
+class TestCodeRewardUsesSandbox:
+    def _grade(self, code_body: str) -> bool:
+        iface = MultiTaskRewardInterface(code_timeout_s=6.0)
+        return iface._verify_code(
+            f"```python\n{code_body}\n```",
+            {"input_output": {"inputs": ["3\n"], "outputs": ["9"]}},
+        )
+
+    def test_correct_solution(self):
+        assert self._grade("print(int(input()) ** 2)") is True
+
+    def test_wrong_output(self):
+        assert self._grade("print(int(input()) + 1)") is False
+
+    def test_hanging_solution_times_out(self):
+        assert self._grade("while True: pass") is False
+
+    def test_jail_cleaned_up(self, tmp_path):
+        before = set(os.listdir(tmp_path.parent))
+        self._grade("open('leftover','w').write('x'); print(9)")
+        # The jail tmpdir (and anything the program wrote) is gone.
+        assert not [
+            d for d in os.listdir("/tmp") if d.startswith("areal_grade_")
+        ]
+        assert set(os.listdir(tmp_path.parent)) == before

@@ -1,0 +1,135 @@
+"""Streaming dataset tests: rows pushed over ZMQ land in a live dataset.
+
+Models the reference's tests/system/test_push_pull_stream.py (push/pull
+delivery, discovery) plus the online-dataset behavior built on top.
+"""
+
+import numpy as np
+import pytest
+
+from areal_tpu.api import data_api
+from areal_tpu.data.datasets import PackedDataLoader
+from areal_tpu.data.stream import RowPusher, StreamDataset
+from tests import fixtures
+
+
+def _rows(n, start=0):
+    return [
+        {
+            "query_id": f"s{start + i}",
+            "prompt": f"solve {start + i} + 1 =",
+            "task": "math",
+            "solutions": [f"\\boxed{{{start + i + 1}}}"],
+        }
+        for i in range(n)
+    ]
+
+
+@pytest.fixture
+def ds():
+    d = StreamDataset(
+        seed=0, dp_rank=0, world_size=1,
+        tokenizer=fixtures.make_tokenizer(),
+        min_rows=0, startup_timeout_s=1.0,
+    )
+    yield d
+    d.close()
+
+
+def _push(ds, rows):
+    p = RowPusher(addr=ds.addr)
+    p.push_many(rows)
+    p.close()
+    # PUSH/PULL is async: poll until delivered.
+    import time
+
+    for _ in range(100):
+        if len(ds) >= len(rows):
+            return
+        time.sleep(0.02)
+
+
+class TestStreamDataset:
+    def test_rows_arrive_and_tokenize(self, ds):
+        assert len(ds) == 0
+        _push(ds, _rows(4))
+        assert len(ds) == 4
+        item = ds[0]
+        assert item.ids == ["s0"]
+        assert len(np.asarray(item.data["packed_prompts"])) > 0
+        # Row metadata accumulates for reward grading.
+        assert ds.id2info["s2"]["solutions"] == ["\\boxed{3}"]
+
+    def test_growth_between_batches(self, ds):
+        _push(ds, _rows(4))
+        loader = PackedDataLoader(ds, batch_size=2, seed=0)
+        batches = list(loader)
+        assert sum(b.bs for b in batches) == 4
+        _push(ds, _rows(6, start=4))
+        batches = list(loader)  # next epoch sees the grown dataset
+        assert sum(b.bs for b in batches) == 10
+
+    def test_ring_buffer_cap(self):
+        d = StreamDataset(
+            seed=0, dp_rank=0, world_size=1,
+            tokenizer=fixtures.make_tokenizer(),
+            min_rows=0, max_rows=5,
+        )
+        try:
+            _push(d, _rows(8))
+            assert len(d) == 5
+            # Oldest retired, newest kept; id2info follows.
+            assert d[0].ids == ["s3"]
+            assert "s0" not in d.id2info and "s7" in d.id2info
+        finally:
+            d.close()
+
+    def test_difficulty_filter_blocks_resurrection(self, ds):
+        _push(ds, _rows(4))
+        assert ds.filter(["s1", "s2"]) == 2
+        assert len(ds) == 2
+        # The same ids pushed again must NOT come back.
+        _push(ds, _rows(1, start=1))
+        import time
+
+        time.sleep(0.2)
+        assert len(ds) == 2
+        assert "s1" not in ds.id2info
+
+    def test_min_rows_blocks_until_seeded(self):
+        import threading
+
+        holder = {}
+
+        def build():
+            holder["ds"] = StreamDataset(
+                seed=0, dp_rank=0, world_size=1,
+                tokenizer=fixtures.make_tokenizer(),
+                min_rows=3, startup_timeout_s=10.0,
+                experiment="e1", trial="t1",
+            )
+
+        t = threading.Thread(target=build)
+        t.start()
+        # Discover via name_resolve (the producer-side path).
+        p = RowPusher(experiment="e1", trial="t1", dp_rank=0, timeout=10.0)
+        p.push_many(_rows(3))
+        p.close()
+        t.join(timeout=15.0)
+        assert not t.is_alive()
+        ds = holder["ds"]
+        try:
+            assert len(ds) == 3
+        finally:
+            ds.close()
+
+    def test_min_rows_timeout(self):
+        with pytest.raises(TimeoutError):
+            StreamDataset(
+                seed=0, dp_rank=0, world_size=1,
+                tokenizer=fixtures.make_tokenizer(),
+                min_rows=1, startup_timeout_s=0.3,
+            )
+
+    def test_registered_in_registry(self):
+        assert "stream" in data_api.ALL_DATASET_CLASSES

@@ -1,0 +1,156 @@
+"""TPU-pod launcher tests over a mocked ssh transport.
+
+Models the role of the reference's Ray-controller tests (worker placement
++ lifecycle, realhf/system/controller.py:448) without a pod: the transport
+records every gcloud argv and serves canned probe replies.
+"""
+
+import pytest
+
+from areal_tpu.scheduler.client import (
+    JobException,
+    JobState,
+    make_scheduler,
+)
+from areal_tpu.scheduler.tpu_pod import TPUPodSchedulerClient
+
+
+class FakeTransport:
+    def __init__(self):
+        self.calls = []  # list of argv
+        self.replies = {}  # substring of remote cmd -> (rc, stdout)
+        self.default = (0, "")
+
+    def __call__(self, argv):
+        self.calls.append(list(argv))
+        remote = argv[argv.index("--command") + 1]
+        for key, reply in self.replies.items():
+            if key in remote:
+                return reply
+        return self.default
+
+
+def _client(**kw):
+    t = FakeTransport()
+    c = TPUPodSchedulerClient(
+        "exp", "t0", tpu_name="pod1", zone="us-east5-a",
+        project="proj", num_hosts=4, log_root="/gcs/logs",
+        env={"AREAL_NAME_RESOLVE": "file", "X": "a b"},
+        poll_interval=0.01, transport=t, **kw,
+    )
+    return c, t
+
+
+class TestSubmit:
+    def test_argv_and_placement(self):
+        c, t = _client()
+        c.submit("model_worker/6", ["python", "-m", "w", "--index", "6"])
+        argv = t.calls[0]
+        assert argv[:6] == [
+            "gcloud", "compute", "tpus", "tpu-vm", "ssh", "pod1"
+        ]
+        assert "--worker=2" in argv  # 6 % 4 hosts
+        assert ["--zone", "us-east5-a"] == argv[-4:-2]
+        assert ["--project", "proj"] == argv[-2:]
+        remote = argv[argv.index("--command") + 1]
+        # Detached launch with env, log, pid, and exit-code capture.
+        assert "nohup sh -c" in remote
+        assert "AREAL_NAME_RESOLVE=file" in remote
+        assert "X=" in remote and "a b" in remote  # value survives quoting
+        assert "/gcs/logs/exp_t0/model_worker_6.log" in remote
+        assert ".exit" in remote and ".pid" in remote
+
+    def test_submit_failure_raises(self):
+        c, t = _client()
+        t.default = (255, "ssh unreachable")
+        with pytest.raises(JobException):
+            c.submit("model_worker/0", ["python"])
+
+    def test_submit_array_spreads_hosts(self):
+        c, t = _client()
+        c.submit_array(
+            "model_worker", lambda i: ["python", str(i)], count=4
+        )
+        workers = [
+            next(a for a in argv if a.startswith("--worker="))
+            for argv in t.calls
+        ]
+        assert workers == [f"--worker={i}" for i in range(4)]
+
+
+class TestStates:
+    @pytest.mark.parametrize(
+        "reply,state,code",
+        [
+            ("RUNNING", JobState.RUNNING, None),
+            ("EXIT:0", JobState.COMPLETED, 0),
+            ("EXIT:9", JobState.FAILED, 9),
+            ("LOST", JobState.FAILED, None),
+        ],
+    )
+    def test_probe_mapping(self, reply, state, code):
+        c, t = _client()
+        c.submit("model_worker/0", ["python"])
+        t.replies["if [ -f"] = (0, reply + "\n")
+        info = c.find("model_worker/0")
+        assert info.state == state
+        assert info.exit_code == code
+        assert info.host == "pod1:0"
+        assert info.log_path.endswith("model_worker_0.log")
+
+    def test_transient_ssh_failure_is_pending(self):
+        c, t = _client()
+        c.submit("model_worker/0", ["python"])
+        t.replies["if [ -f"] = (255, "")
+        assert c.find("model_worker/0").state == JobState.PENDING
+
+    def test_unknown_worker_not_found(self):
+        c, _ = _client()
+        assert c.find("nope").state == JobState.NOT_FOUND
+
+
+class TestWaitStop:
+    def test_wait_drains_completed(self):
+        c, t = _client()
+        c.submit("model_worker/0", ["python"])
+        c.submit("model_worker/1", ["python"])
+        t.replies["if [ -f"] = (0, "EXIT:0\n")
+        c.wait(timeout=5.0)
+        assert not c._jobs
+
+    def test_wait_raises_on_failure_with_host(self):
+        c, t = _client()
+        c.submit("model_worker/1", ["python"])
+        t.replies["if [ -f"] = (0, "EXIT:137\n")
+        with pytest.raises(JobException) as ei:
+            c.wait(timeout=5.0)
+        assert ei.value.reason == JobState.FAILED
+        assert "host" not in ei.value.host  # real host name, pod1:1
+        assert ei.value.host == "pod1:1"
+
+    def test_wait_times_out_while_running(self):
+        c, t = _client()
+        c.submit("model_worker/0", ["python"])
+        t.replies["if [ -f"] = (0, "RUNNING\n")
+        with pytest.raises(TimeoutError):
+            c.wait(timeout=0.05)
+
+    def test_stop_all_kills_and_forgets(self):
+        c, t = _client()
+        c.submit("model_worker/0", ["python"])
+        c.submit("model_worker/1", ["python"])
+        n_submit = len(t.calls)
+        c.stop_all()
+        assert not c._jobs
+        kills = t.calls[n_submit:]
+        assert len(kills) == 2
+        for argv in kills:
+            remote = argv[argv.index("--command") + 1]
+            assert "kill -TERM" in remote and "pkill" in remote
+
+
+def test_make_scheduler_mode():
+    c = make_scheduler(
+        "tpu-pod", "e", "t", tpu_name="pod1", transport=lambda a: (0, "")
+    )
+    assert isinstance(c, TPUPodSchedulerClient)
