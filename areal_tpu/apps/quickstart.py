@@ -88,6 +88,9 @@ def _add_common(p: argparse.ArgumentParser):
                         "checkpoint is graded (pass@1) by the automatic "
                         "evaluator")
     p.add_argument("--eval-max-new-tokens", type=int, default=256)
+    p.add_argument("--eval-protocol", default="greedy",
+                   help="'greedy' or 'avg@K' (avg@32 = the AIME avg-of-32 "
+                        "pass@1 protocol at temperature 1.0)")
 
 
 def _apply_yaml_config(parser: argparse.ArgumentParser, argv):
@@ -142,6 +145,7 @@ def _maybe_eval(args, plan):
                 data_path=args.eval_data,
                 tokenizer_path=args.tokenizer_path or args.model_path,
                 max_new_tokens=args.eval_max_new_tokens,
+                protocol=args.eval_protocol,
             ),
         )
         steps = ev.step()

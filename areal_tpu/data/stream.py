@@ -138,29 +138,29 @@ class StreamDataset:
     # -- ingestion --
 
     def _drain(self, block_ms: int = 0, until: int = 0) -> bool:
-        """Pull every pending row (optionally blocking until `until` rows
-        total exist or the FULL `block_ms` deadline passes); tokenize new
-        rows through a throwaway inner dataset."""
+        """Pull every pending row (optionally blocking until `until` LIVE
+        items exist or the full `block_ms` deadline passes); tokenize new
+        rows through a throwaway inner dataset.  Ingestion happens inside
+        the wait loop so rows the inner dataset DROPS (too long, filtered)
+        never count toward `until`."""
         import time
 
         deadline = time.monotonic() + block_ms / 1000.0
-        rows: List[Dict[str, Any]] = []
         while True:
-            try:
-                rows.append(json.loads(self._sock.recv(zmq.NOBLOCK)))
-            except zmq.Again:
-                if until and len(self._items) + len(rows) < until:
-                    left = deadline - time.monotonic()
-                    if left > 0 and self._sock.poll(
-                        min(int(left * 1000) + 1, 500)
-                    ):
-                        continue
-                    if left > 0:
-                        continue  # poll timed out but budget remains
-                break
-        if rows:
-            self._ingest(rows)
-        return not until or len(self._items) >= until
+            rows: List[Dict[str, Any]] = []
+            while True:
+                try:
+                    rows.append(json.loads(self._sock.recv(zmq.NOBLOCK)))
+                except zmq.Again:
+                    break
+            if rows:
+                self._ingest(rows)
+            if not until or len(self._items) >= until:
+                return True
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return False
+            self._sock.poll(min(int(left * 1000) + 1, 500))
 
     def _ingest(self, rows: List[Dict[str, Any]]) -> None:
         rows = [
@@ -191,10 +191,15 @@ class StreamDataset:
             self.id2info[qid] = r
         if len(self._items) > self.max_rows:
             cut = len(self._items) - self.max_rows
-            for qid in self._ids[:cut]:
-                self.id2info.pop(qid, None)
+            evicted = self._ids[:cut]
             del self._items[:cut]
             del self._ids[:cut]
+            live = set(self._ids)
+            for qid in evicted:
+                # At-least-once producers can duplicate a qid: keep the
+                # metadata while ANY copy is still live.
+                if qid not in live:
+                    self.id2info.pop(qid, None)
         logger.info(
             f"stream dataset: +{len(rows)} rows ({len(self._items)} live)"
         )
@@ -218,8 +223,10 @@ class StreamDataset:
         if removed:
             self._items = [self._items[i] for i in keep]
             self._ids = [self._ids[i] for i in keep]
+            live = set(self._ids)
             for qid in drop:
-                self.id2info.pop(qid, None)
+                if qid not in live:
+                    self.id2info.pop(qid, None)
         return removed
 
     def close(self) -> None:

@@ -59,7 +59,7 @@ def _unshare_prefix() -> List[str]:
     return _UNSHARE
 
 
-def _set_limits(cpu_s: int, mem_mb: int, fsize_mb: int, nproc: int):
+def _set_limits(cpu_s: int, mem_mb: int, fsize_mb: int, nproc: Optional[int]):
     def apply():
         resource.setrlimit(resource.RLIMIT_CPU, (cpu_s, cpu_s + 1))
         resource.setrlimit(
@@ -68,9 +68,13 @@ def _set_limits(cpu_s: int, mem_mb: int, fsize_mb: int, nproc: int):
         resource.setrlimit(
             resource.RLIMIT_FSIZE, (fsize_mb << 20, fsize_mb << 20)
         )
-        # Threads count toward NPROC on Linux; generous enough for any
-        # legitimate solution, small enough to stop a fork bomb.
-        resource.setrlimit(resource.RLIMIT_NPROC, (nproc, nproc))
+        # NPROC is a PER-UID limit (threads included): the cap must sit
+        # above the trial user's existing task count — a busy JAX host
+        # easily holds hundreds — or legitimate solutions that fork/thread
+        # fail with EAGAIN and grade as wrong.  The default (4096) only
+        # stops runaway fork bombs; pass nproc=None to skip entirely.
+        if nproc is not None:
+            resource.setrlimit(resource.RLIMIT_NPROC, (nproc, nproc))
         resource.setrlimit(resource.RLIMIT_NOFILE, (256, 256))
         resource.setrlimit(resource.RLIMIT_CORE, (0, 0))
 
@@ -84,7 +88,7 @@ def run_sandboxed(
     cwd: Optional[str] = None,
     mem_mb: int = 1024,
     fsize_mb: int = 32,
-    nproc: int = 512,
+    nproc: Optional[int] = 4096,
 ) -> Tuple[int, str]:
     """Run `argv` jailed; returns (returncode, stdout).  Timeouts and
     resource kills surface as nonzero returncodes (-1 for wall timeout)."""

@@ -35,6 +35,31 @@ class EvalConfig:
     max_prompts: Optional[int] = None
     parallel: str = "d1"
     batch_size: int = 64
+    # "greedy": one greedy sample per prompt (cheap smoke eval).
+    # "avg@K" (e.g. "avg@32"): the reference's headline protocol — K
+    # temperature-1.0 samples per prompt, score = pass@1 AVERAGED over all
+    # K·P samples with boxed-answer extraction (AReaL README.md:46-55:
+    # "32 answers ... average pass@1", realhf/scheduler/evaluator.py).
+    protocol: str = "greedy"
+
+    def __post_init__(self):
+        # Validate at CONSTRUCTION (i.e. CLI parse time) — a typo must not
+        # silently grade under the wrong protocol, or crash an eval hours
+        # later at int() time.
+        parse_protocol(self.protocol)
+
+
+def parse_protocol(proto: str) -> Optional[int]:
+    """'greedy' -> None; 'avg@K' -> K.  Anything else raises."""
+    if proto == "greedy":
+        return None
+    m = re.fullmatch(r"avg@(\d+)", proto)
+    if not m or int(m.group(1)) < 1:
+        raise ValueError(
+            f"unknown eval protocol {proto!r}: use 'greedy' or 'avg@K' "
+            "(e.g. avg@32)"
+        )
+    return int(m.group(1))
 
 
 def _load_rows(path: str, limit: Optional[int]) -> List[Dict]:
@@ -80,17 +105,26 @@ def evaluate_checkpoint(
         eos_token_id=tokenizer.eos_token_id,
         pad_token_id=getattr(tokenizer, "pad_token_id", None),
     )
+    n, greedy, temperature = (
+        config.n_samples, config.greedy, config.temperature,
+    )
+    k = parse_protocol(config.protocol)
+    if k is not None:
+        # avg@K: K independent temp-1.0 samples per prompt; greedy would
+        # collapse them into K copies of one answer.
+        n, greedy, temperature = k, False, 1.0
     gconfig = GenerationHyperparameters(
-        n=config.n_samples,
+        n=n,
         max_new_tokens=config.max_new_tokens,
-        greedy=config.greedy,
-        temperature=config.temperature,
+        greedy=greedy,
+        temperature=temperature,
     )
 
     rows = _load_rows(config.data_path, config.max_prompts)
     n_correct = 0
     n_total = 0
     n_any = 0
+    prompt_acc: List[float] = []  # per-prompt mean correctness
     t0 = time.monotonic()
     for start in range(0, len(rows), config.batch_size):
         chunk = rows[start : start + config.batch_size]
@@ -119,18 +153,28 @@ def evaluate_checkpoint(
             toks_all = np.asarray(one.data["packed_input_ids"])
             pmask = np.asarray(one.data["prompt_mask"])
             any_ok = False
+            row_ok = 0
+            row_n = 0
             for s in range(len(bounds) - 1):
                 lo, hi = bounds[s], bounds[s + 1]
                 resp = toks_all[lo:hi][~pmask[lo:hi].astype(bool)]
                 text = tokenizer.decode(resp.tolist())
                 ok = bool(verify_math(text, solutions))
                 n_correct += ok
+                row_ok += ok
+                row_n += 1
                 n_total += 1
                 any_ok = any_ok or ok
             n_any += any_ok
+            prompt_acc.append(row_ok / max(row_n, 1))
+    # pass@1 is the SAMPLE mean — under avg@K this is exactly the
+    # reference's "average pass@1 over K samples" headline number.
+    acc = np.asarray(prompt_acc, np.float64)
     result = {
         "pass@1": n_correct / max(n_total, 1),
-        f"pass@{config.n_samples}": n_any / max(len(rows), 1),
+        f"pass@{n}": n_any / max(len(rows), 1),
+        "pass@1_prompt_std": float(acc.std()) if len(acc) else 0.0,
+        "samples_per_prompt": float(n),
         "n_prompts": float(len(rows)),
         "n_samples": float(n_total),
         "eval_seconds": time.monotonic() - t0,
@@ -204,6 +248,13 @@ class AutomaticEvaluator:
             with open(out + ".tmp", "w") as f:
                 json.dump(result, f, indent=2)
             os.replace(out + ".tmp", out)
+            # Rolling per-checkpoint score series (one line per eval) —
+            # the training-curve artifact the reference evaluator logs to
+            # wandb/tensorboard.
+            with open(
+                os.path.join(self.output_dir, "score_series.jsonl"), "a"
+            ) as f:
+                f.write(json.dumps(result) + "\n")
             logger.info(
                 f"step {step}: pass@1={result['pass@1']:.4f} "
                 f"({int(result['n_samples'])} samples)"
@@ -234,6 +285,9 @@ def main():
     p.add_argument("--n-samples", type=int, default=1)
     p.add_argument("--max-prompts", type=int, default=None)
     p.add_argument("--parallel", default="d1")
+    p.add_argument("--protocol", default="greedy",
+                   help="'greedy' or 'avg@K' (e.g. avg@32: the AIME "
+                        "avg-of-32 pass@1 protocol at temperature 1.0)")
     p.add_argument("--watch", action="store_true")
     p.add_argument("--interval", type=float, default=10.0)
     args = p.parse_args()
@@ -247,6 +301,7 @@ def main():
             n_samples=args.n_samples,
             max_prompts=args.max_prompts,
             parallel=args.parallel,
+            protocol=args.protocol,
         ),
     )
     if args.watch:

@@ -179,30 +179,37 @@ class TPUPodSchedulerClient(SchedulerClient):
             f"echo RUNNING; else echo LOST; fi"
         )
 
-    def find(self, worker_type: str) -> JobInfo:
-        if worker_type not in self._jobs:
-            return JobInfo(name=worker_type, state=JobState.NOT_FOUND)
+    @staticmethod
+    def _extract_token(out: str) -> Optional[str]:
+        """Last probe token in the output.  gcloud/ssh freely interleave
+        stderr warnings ('Permanently added ... known hosts'), so scan for
+        OUR tokens instead of trusting the last line."""
+        token = None
+        for line in out.splitlines():
+            line = line.strip()
+            if line in ("RUNNING", "LOST") or line.startswith("EXIT:"):
+                token = line
+        return token
+
+    def _info_from_token(
+        self, worker_type: str, token: Optional[str]
+    ) -> JobInfo:
         host, log, _ = self._jobs[worker_type]
-        rc, out = self.transport(
-            self.ssh_argv(host, self._probe_cmd(worker_type))
-        )
         state = JobState.PENDING  # transient ssh failure: stay optimistic
         exit_code = None
-        if rc == 0:
-            token = out.strip().splitlines()[-1] if out.strip() else ""
-            if token.startswith("EXIT:"):
-                try:
-                    exit_code = int(token.split(":", 1)[1])
-                except ValueError:
-                    exit_code = -1
-                state = (
-                    JobState.COMPLETED if exit_code == 0 else JobState.FAILED
-                )
-            elif token == "RUNNING":
-                state = JobState.RUNNING
-            elif token == "LOST":
-                # pid gone with no exit file: killed hard (OOM/host reboot).
-                state = JobState.FAILED
+        if token and token.startswith("EXIT:"):
+            try:
+                exit_code = int(token.split(":", 1)[1])
+            except ValueError:
+                exit_code = -1
+            state = (
+                JobState.COMPLETED if exit_code == 0 else JobState.FAILED
+            )
+        elif token == "RUNNING":
+            state = JobState.RUNNING
+        elif token == "LOST":
+            # pid gone with no exit file: killed hard (OOM/host reboot).
+            state = JobState.FAILED
         return JobInfo(
             name=worker_type,
             state=state,
@@ -211,10 +218,41 @@ class TPUPodSchedulerClient(SchedulerClient):
             log_path=log,
         )
 
+    def find(self, worker_type: str) -> JobInfo:
+        if worker_type not in self._jobs:
+            return JobInfo(name=worker_type, state=JobState.NOT_FOUND)
+        host, _, _ = self._jobs[worker_type]
+        rc, out = self.transport(
+            self.ssh_argv(host, self._probe_cmd(worker_type))
+        )
+        return self._info_from_token(
+            worker_type, self._extract_token(out) if rc == 0 else None
+        )
+
     def find_all(self, pattern: str = "") -> List[JobInfo]:
-        return [
-            self.find(wt) for wt in list(self._jobs) if pattern in wt
-        ]
+        """ONE ssh round trip per HOST per sweep (not per worker): each
+        host probes all its jobs in a single remote command emitting
+        '<worker_type> <token>' lines."""
+        wts = [wt for wt in list(self._jobs) if pattern in wt]
+        by_host: Dict[int, List[str]] = {}
+        for wt in wts:
+            by_host.setdefault(self._jobs[wt][0], []).append(wt)
+        infos: Dict[str, JobInfo] = {}
+        for host, group in by_host.items():
+            cmd = "; ".join(
+                f"printf '%s ' {shlex.quote(wt)}; {self._probe_cmd(wt)}"
+                for wt in group
+            )
+            rc, out = self.transport(self.ssh_argv(host, cmd))
+            tokens: Dict[str, str] = {}
+            if rc == 0:
+                for line in out.splitlines():
+                    parts = line.strip().rsplit(" ", 1)
+                    if len(parts) == 2 and self._extract_token(parts[1]):
+                        tokens[parts[0]] = parts[1]
+            for wt in group:
+                infos[wt] = self._info_from_token(wt, tokens.get(wt))
+        return [infos[wt] for wt in wts]
 
     def stop(self, worker_type: str) -> None:
         if worker_type not in self._jobs:

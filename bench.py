@@ -130,6 +130,15 @@ def main(size: str = "1.5b"):
     gen = Model("actor_gen", engine=gen_engine, tokenizer=tok, config=cfg)
 
     n_prompts, group, prompt_len, max_new = 8, 4, 128, 1024
+    n_iters = 3
+    mode = os.environ.get("AREAL_BENCH_MODE", "")
+    if mode == "longctx":
+        # Reference-scale decode budget (ppo-7B-distill-gpus-128.yaml
+        # decodes up to 27,648 new tokens with max_tokens_per_mb=30720):
+        # fewer samples, >=16k new tokens each, KV window growing through
+        # the inflight generator's buckets.
+        n_prompts, group, max_new, n_iters = 2, 2, 16384, 1
+        os.environ.setdefault("AREAL_BENCH_MB_TOKENS", "32768")
     rng = np.random.default_rng(0)
     prompts = SequenceSample(
         keys={"packed_prompts"},
@@ -201,7 +210,6 @@ def main(size: str = "1.5b"):
     one_step(0)
     warmup_s = time.time() - t0
 
-    n_iters = 3
     t0 = time.time()
     total_samples = 0
     total_gen_tokens = 0
@@ -221,7 +229,10 @@ def main(size: str = "1.5b"):
     print(
         json.dumps(
             {
-                "metric": f"ppo_samples_per_sec_chip_{size}",
+                "metric": (
+                    f"ppo_samples_per_sec_chip_{size}"
+                    + (f"_{mode}" if mode else "")
+                ),
                 "value": round(samples_per_sec, 4),
                 "unit": "samples/s/chip",
                 "vs_baseline": round(

@@ -17,3 +17,7 @@ for remat in full dots none; do
       timeout 1800 python bench.py "$size" || echo "(failed: $remat/$mb)" >&2
   done
 done
+# Long-context row: >=16k new tokens/sample (reference decodes up to 27,648).
+echo "=== longctx (16384 new tokens) ===" >&2
+AREAL_BENCH_MODE=longctx AREAL_BENCH_REMAT=full \
+  timeout 3600 python bench.py "$size" || echo "(failed: longctx)" >&2

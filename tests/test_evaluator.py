@@ -78,5 +78,55 @@ def test_automatic_evaluator_watches_and_dedupes(tmp_path):
     _write_ckpt(ckpt_root, 4)
     assert ev.step() == [4]
     assert sorted(os.listdir(out_dir)) == [
-        "eval_step_2.json", "eval_step_4.json",
+        "eval_step_2.json", "eval_step_4.json", "score_series.jsonl",
     ]
+
+
+def test_avg_at_k_protocol(tmp_path):
+    """The reference's headline protocol (AReaL README.md:46-55): K
+    temperature-1.0 samples per prompt, score = pass@1 averaged over all
+    K*P samples.  protocol='avg@K' must override n_samples/greedy."""
+    ckpt = _write_ckpt(tmp_path / "ckpts", 1)
+    data = tmp_path / "aime.jsonl"
+    _write_data(data)
+    res = evaluate_checkpoint(
+        ckpt,
+        EvalConfig(
+            data_path=str(data),
+            tokenizer_path="char:512",
+            max_new_tokens=8,
+            n_samples=1,       # ignored by the protocol
+            greedy=True,       # ignored by the protocol
+            protocol="avg@4",
+        ),
+    )
+    assert res["samples_per_prompt"] == 4.0
+    assert res["n_samples"] == 16.0  # 4 prompts x 4 samples
+    assert "pass@4" in res
+    assert 0.0 <= res["pass@1"] <= res["pass@4"] <= 1.0
+    assert res["pass@1_prompt_std"] >= 0.0
+
+
+def test_score_series_accumulates(tmp_path):
+    ckpt_root = tmp_path / "ckpts"
+    out_dir = tmp_path / "eval"
+    data = tmp_path / "aime.jsonl"
+    _write_data(data)
+    _write_ckpt(ckpt_root, 1)
+    _write_ckpt(ckpt_root, 2)
+    ev = AutomaticEvaluator(
+        str(ckpt_root),
+        str(out_dir),
+        EvalConfig(
+            data_path=str(data), tokenizer_path="char:512",
+            max_new_tokens=4, protocol="avg@2",
+        ),
+    )
+    assert ev.step() == [1, 2]
+    series = [
+        json.loads(l)
+        for l in open(out_dir / "score_series.jsonl")
+        if l.strip()
+    ]
+    assert [s["global_step"] for s in series] == [1.0, 2.0]
+    assert all("pass@1" in s for s in series)

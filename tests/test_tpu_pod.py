@@ -19,11 +19,23 @@ class FakeTransport:
     def __init__(self):
         self.calls = []  # list of argv
         self.replies = {}  # substring of remote cmd -> (rc, stdout)
+        # (rc, token): emulate the probe protocol — single probes answer
+        # the bare token, batched per-host probes answer one
+        # '<worker_type> <token>' line per job (mirrors the remote shell).
+        self.probe = None
         self.default = (0, "")
 
     def __call__(self, argv):
+        import re
+
         self.calls.append(list(argv))
         remote = argv[argv.index("--command") + 1]
+        if "if [ -f" in remote and self.probe is not None:
+            rc, token = self.probe
+            wts = re.findall(r"printf '%s ' '?([^';]+)'?;", remote)
+            if wts:
+                return rc, "".join(f"{w} {token}\n" for w in wts)
+            return rc, token + "\n"
         for key, reply in self.replies.items():
             if key in remote:
                 return reply
@@ -91,17 +103,42 @@ class TestStates:
     def test_probe_mapping(self, reply, state, code):
         c, t = _client()
         c.submit("model_worker/0", ["python"])
-        t.replies["if [ -f"] = (0, reply + "\n")
+        t.probe = (0, reply)
         info = c.find("model_worker/0")
         assert info.state == state
         assert info.exit_code == code
         assert info.host == "pod1:0"
         assert info.log_path.endswith("model_worker_0.log")
 
+    def test_probe_ignores_ssh_noise(self):
+        """gcloud/ssh interleave stderr warnings with stdout; the state
+        token must be found anywhere in the output, not on the last
+        line."""
+        c, t = _client()
+        c.submit("model_worker/0", ["python"])
+        t.replies["if [ -f"] = (
+            0,
+            "EXIT:3\nWarning: Permanently added 'tpu' to known hosts.\n",
+        )
+        info = c.find("model_worker/0")
+        assert info.state == JobState.FAILED and info.exit_code == 3
+
+    def test_find_all_batches_one_ssh_per_host(self):
+        """A poll sweep costs one ssh per HOST, not per worker."""
+        c, t = _client()
+        for i in range(8):  # 8 workers over 4 hosts
+            c.submit(f"model_worker/{i}", ["python"])
+        t.probe = (0, "RUNNING")
+        n0 = len(t.calls)
+        infos = c.find_all()
+        assert len(infos) == 8
+        assert all(i.state == JobState.RUNNING for i in infos)
+        assert len(t.calls) - n0 == 4
+
     def test_transient_ssh_failure_is_pending(self):
         c, t = _client()
         c.submit("model_worker/0", ["python"])
-        t.replies["if [ -f"] = (255, "")
+        t.probe = (255, "")
         assert c.find("model_worker/0").state == JobState.PENDING
 
     def test_unknown_worker_not_found(self):
@@ -114,14 +151,14 @@ class TestWaitStop:
         c, t = _client()
         c.submit("model_worker/0", ["python"])
         c.submit("model_worker/1", ["python"])
-        t.replies["if [ -f"] = (0, "EXIT:0\n")
+        t.probe = (0, "EXIT:0")
         c.wait(timeout=5.0)
         assert not c._jobs
 
     def test_wait_raises_on_failure_with_host(self):
         c, t = _client()
         c.submit("model_worker/1", ["python"])
-        t.replies["if [ -f"] = (0, "EXIT:137\n")
+        t.probe = (0, "EXIT:137")
         with pytest.raises(JobException) as ei:
             c.wait(timeout=5.0)
         assert ei.value.reason == JobState.FAILED
@@ -131,7 +168,7 @@ class TestWaitStop:
     def test_wait_times_out_while_running(self):
         c, t = _client()
         c.submit("model_worker/0", ["python"])
-        t.replies["if [ -f"] = (0, "RUNNING\n")
+        t.probe = (0, "RUNNING")
         with pytest.raises(TimeoutError):
             c.wait(timeout=0.05)
 
