@@ -33,6 +33,18 @@ from areal_tpu.system.master import ExperimentSaveEvalControl
 logger = logging.getLogger("quickstart")
 
 
+def _eval_protocol_arg(value: str) -> str:
+    """Reject a malformed protocol at PARSE time — a typo must not
+    surface as a crash only after the multi-hour trial finishes."""
+    from areal_tpu.scheduler.evaluator import parse_protocol
+
+    try:
+        parse_protocol(value)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e)) from None
+    return value
+
+
 def _add_common(p: argparse.ArgumentParser):
     p.add_argument("--config", default=None,
                    help="YAML file of option defaults (keys = flag names, "
@@ -89,6 +101,7 @@ def _add_common(p: argparse.ArgumentParser):
                         "evaluator")
     p.add_argument("--eval-max-new-tokens", type=int, default=256)
     p.add_argument("--eval-protocol", default="greedy",
+                   type=_eval_protocol_arg,
                    help="'greedy' or 'avg@K' (avg@32 = the AIME avg-of-32 "
                         "pass@1 protocol at temperature 1.0)")
 

@@ -109,6 +109,17 @@ class TrainEngine(HostOffloadMixin, Engine):
         # "dots" (save matmul outputs; ~zero recompute when activations
         # fit), "none".  See models/transformer.py _backbone.
         remat_policy: str = "full",
+        # Pipeline schedule (pipe>1 meshes only):
+        #   "gpipe"    — up to 4P in-flight microbatches; bubble
+        #                (P-1)/(5P-1), backward residuals for all of them;
+        #   "1f1b-mem" — P in-flight microbatches per jitted step: peak
+        #                activation memory drops to 1F1B's O(P) bound
+        #                (reference: static_schedule.py:323 TrainSchedule),
+        #                amortization comes from the engine's grad-
+        #                accumulation loop across micro-batches instead of
+        #                intra-schedule interleaving (more bubble ticks —
+        #                the memory/throughput trade is the caller's).
+        pipe_schedule: str = "gpipe",
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -145,8 +156,32 @@ class TrainEngine(HostOffloadMixin, Engine):
             self._pp_microbatches,
             self.batch_shard,
         ) = sharding.attn_dispatch(mesh, cfg)
+        if pipe_schedule not in ("gpipe", "1f1b-mem"):
+            raise ValueError(f"unknown pipe_schedule {pipe_schedule!r}")
+        self.pipe_schedule = pipe_schedule
+        if self._pp_mesh is not None and pipe_schedule == "1f1b-mem":
+            self._pp_microbatches = self._pp_mesh.shape[
+                sharding.PIPE_AXIS
+            ]
 
     # ---------------- core jitted fns ----------------
+
+    def _pack_row_chunks(self, arrays):
+        """1f1b-mem schedule: cap rows per jitted step at batch_shard
+        (= batch_axes x P, i.e. exactly P in-flight microbatches of
+        minimal size) so peak activation memory per step sits at the 1F1B
+        bound; the surrounding grad-accumulation loop supplies the
+        amortization GPipe gets from 4P in-flight microbatches."""
+        if self.pipe_schedule != "1f1b-mem" or self._pp_mesh is None:
+            return [arrays]
+        cap = self.batch_shard
+        b = next(iter(arrays.values())).shape[0]
+        if b <= cap:
+            return [arrays]
+        return [
+            {k: v[i : i + cap] for k, v in arrays.items()}
+            for i in range(0, b, cap)
+        ]
 
     def _get_grad_fn(self, loss_fn: Callable):
         if loss_fn in self._grad_fns:
@@ -248,15 +283,18 @@ class TrainEngine(HostOffloadMixin, Engine):
             )
             for mb in mbs
         ]
-        total_weight = float(sum(loss_weight_fn(p.arrays) for p in packs))
+        chunks = [
+            c for pk in packs for c in self._pack_row_chunks(pk.arrays)
+        ]
+        total_weight = float(sum(loss_weight_fn(c) for c in chunks))
         total_weight = max(total_weight, 1.0)
 
         grad_fn, grad_acc_fn = self._get_grad_fn(loss_fn)
         acc = None
         losses = []
         all_stats = []
-        for pk in packs:
-            batch = self._device_batch(pk.arrays)
+        for arrays in chunks:
+            batch = self._device_batch(arrays)
             scale = jnp.float32(1.0 / total_weight)
             if acc is None:
                 acc, loss, stats = grad_fn(self.params, batch, scale)
@@ -275,7 +313,7 @@ class TrainEngine(HostOffloadMixin, Engine):
         out: Dict[str, float] = {
             "loss": float(jnp.sum(jnp.stack(losses))),
             "grad_norm": float(gnorm),
-            "n_micro_batches": float(len(packs)),
+            "n_micro_batches": float(len(chunks)),
         }
         # Stats from loss_fn are summed across micro-batches then divided by
         # total weight where keys end in '_sum'; plain keys are averaged.

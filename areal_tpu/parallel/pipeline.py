@@ -61,14 +61,22 @@ def pipelined_blocks(
 ) -> Tuple[jax.Array, jax.Array]:
     """Transformer block stack under pipeline parallelism -> (y, aux_loss).
 
-    Requires B % n_microbatches == 0 and n_layers % pipe == 0 (the stacked
-    leading axis must divide evenly over stages).
+    `n_microbatches` is a REQUEST: the schedule uses the largest multiple
+    of `pipe` that divides B and is <= the request (padding rows only up
+    to B % pipe == 0 beats forcing B % 4P == 0 — the reference's
+    TrainSchedule likewise takes whatever microbatch count the batch
+    admits).  Requires B % pipe == 0 and n_layers % pipe == 0.
     """
     n_stages = mesh.shape[PIPE_AXIS]
     b = x.shape[0]
-    m = n_microbatches
-    if b % m:
-        raise ValueError(f"batch rows {b} not divisible by {m} microbatches")
+    if b % n_stages:
+        raise ValueError(
+            f"batch rows {b} not divisible by {n_stages} pipe stages"
+        )
+    m = max(n_stages, min(n_microbatches, b))
+    m -= m % n_stages
+    while b % m:
+        m -= n_stages
     if cfg.n_layers % n_stages:
         raise ValueError(
             f"{cfg.n_layers} layers not divisible by {n_stages} pipe stages"

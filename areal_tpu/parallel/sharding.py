@@ -142,10 +142,15 @@ def attn_dispatch(mesh: Mesh, cfg=None):
         use_flash = mesh if eligible else False
     cp_mesh = mesh if mesh.shape[SEQ_AXIS] > 1 else None
     pp_mesh = mesh if mesh.shape[PIPE_AXIS] > 1 else None
+    # REQUESTED in-flight microbatches: 4P amortizes the GPipe bubble to
+    # (P-1)/(5P-1).  The schedule steps down to the largest multiple of P
+    # that divides the actual row count (pipeline.py), so rows only need
+    # padding to batch_axes x P — small PPO minibatches no longer pad to
+    # 8P rows (the old rows_multiple = batch x 4P).
     pp_microbatches = 4 * mesh.shape[PIPE_AXIS]
     rows_multiple = int(np.prod([mesh.shape[a] for a in BATCH_AXES]))
     if pp_mesh is not None:
-        rows_multiple *= pp_microbatches
+        rows_multiple *= mesh.shape[PIPE_AXIS]
     return use_flash, cp_mesh, pp_mesh, pp_microbatches, rows_multiple
 
 

@@ -166,6 +166,10 @@ class MasterWorker:
         # concurrent MFC needing the same copy await it instead of
         # dispatching against data still in flight.
         self._inflight: Dict[tuple, asyncio.Future] = {}
+        # Per-step transfer-plane accounting (bytes/seconds per kind),
+        # surfaced as transfer/* step stats — the reference's data_manager
+        # redistribution timing made visible (blog/AReaL_v0_2.md:52-54).
+        self._xfer_acc: Dict[str, float] = {}
 
     # ---------------- lifecycle ----------------
 
@@ -223,6 +227,7 @@ class MasterWorker:
 
     async def execute_step(self) -> Dict[str, float]:
         results: Dict[str, Dict[str, float]] = {}
+        self._xfer_acc = {}
         if self.rollout_ahead > 0 and self._source_nodes:
             await self._execute_step_async(results)
         else:
@@ -243,6 +248,8 @@ class MasterWorker:
         for name, stats in results.items():
             for k, v in stats.items():
                 merged[f"{name}/{k}" if len(results) > 1 else k] = v
+        for k, v in self._xfer_acc.items():
+            merged[f"transfer/{k}"] = v
         return merged
 
     async def _execute_step_async(self, results: Dict) -> None:
@@ -364,7 +371,7 @@ class MasterWorker:
                 for keys, sids in groups.items():
                     xfer_id = self._xfer_id
                     self._xfer_id += 1
-                    await asyncio.gather(
+                    send_r, recv_r = await asyncio.gather(
                         self.pool.request(
                             src,
                             {
@@ -379,6 +386,7 @@ class MasterWorker:
                             dst, {"type": "data_recv", "xfer_id": xfer_id}
                         ),
                     )
+                    self._acc_xfer("data", send_r, recv_r)
         except BaseException as e:  # propagate to waiters, then re-raise
             err = e
             raise
@@ -395,6 +403,23 @@ class MasterWorker:
                     )
         if waits:
             await asyncio.gather(*waits)
+
+    def _acc_xfer(self, kind: str, send_r: Dict, recv_r: Optional[Dict] = None):
+        """Fold one transfer's reply metrics into this step's accounting."""
+        acc = self._xfer_acc
+        acc[f"{kind}_bytes"] = (
+            acc.get(f"{kind}_bytes", 0.0) + float(send_r.get("bytes", 0) or 0)
+        )
+        acc[f"{kind}_send_s"] = (
+            acc.get(f"{kind}_send_s", 0.0)
+            + float(send_r.get("seconds", 0.0) or 0.0)
+        )
+        if recv_r is not None:
+            acc[f"{kind}_recv_s"] = (
+                acc.get(f"{kind}_recv_s", 0.0)
+                + float(recv_r.get("seconds", 0.0) or 0.0)
+            )
+        acc[f"{kind}_count"] = acc.get(f"{kind}_count", 0.0) + 1.0
 
     def _group(self, model_key: str) -> List[int]:
         return self.groups.get(model_key, [self.placement[model_key]])
@@ -497,6 +522,11 @@ class MasterWorker:
     ) -> Dict:
         # Data-plane pre-hook: every group member executes the MFC
         # SPMD-symmetrically, so each needs the full input batch resident.
+        # (Known optimization once host counts grow: ship each member only
+        # the rows its local devices consume and assemble the global array
+        # with jax.make_array_from_process_local_data — requires the
+        # packer to agree on global row order from metadata alone.  The
+        # transfer/* step stats exist to show when that's worth doing.)
         await asyncio.gather(
             *[self._ensure_data(node, ids, w) for w in group]
         )
@@ -580,7 +610,7 @@ class MasterWorker:
                     range(self._xfer_id, self._xfer_id + len(target_group))
                 )
                 self._xfer_id += len(target_group)
-                await asyncio.gather(
+                resps = await asyncio.gather(
                     *[
                         self.pool.request(
                             w,
@@ -607,6 +637,8 @@ class MasterWorker:
                         for w, xid in zip(target_group, xfer_ids)
                     ],
                 )
+                for send_r in resps[: len(group)]:
+                    self._acc_xfer("param", send_r)
 
     async def _apply_difficulty_filter(self):
         """Remove prompts whose group accuracy this step falls outside the

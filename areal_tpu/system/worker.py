@@ -454,7 +454,10 @@ class ModelWorker:
                     self._xfer_cond.notify_all()
 
     def _handle_data_send(self, req):
-        """Ship cached entries (selected keys) to another worker."""
+        """Ship cached entries (selected keys) to another worker.  Replies
+        with wire bytes + send seconds so the master can surface per-step
+        transfer stats (reference: data_manager's redistribution timing)."""
+        t0 = time.monotonic()
         keys = set(req["keys"])
         parts = []
         for sid in req["ids"]:
@@ -466,10 +469,13 @@ class ModelWorker:
                     f"cached for id {sid}"
                 )
             parts.append(entry.select_keys(have))
-        self.transfer.send(req["dst"], req["xfer_id"], ("data", parts))
-        return {}
+        nbytes = self.transfer.send(
+            req["dst"], req["xfer_id"], ("data", parts)
+        )
+        return {"bytes": nbytes, "seconds": time.monotonic() - t0}
 
     def _handle_data_recv(self, req):
+        t0 = time.monotonic()
         kind, parts = self._recv_xfer(req["xfer_id"])
         assert kind == "data", kind
         for one in parts:
@@ -478,7 +484,7 @@ class ModelWorker:
                 self.data_cache[sid].update_(one)
             else:
                 self.data_cache[sid] = one
-        return {"n": len(parts)}
+        return {"n": len(parts), "seconds": time.monotonic() - t0}
 
     def _handle_param_send(self, req):
         """Ship a model's host-side param pytree to other workers (the
@@ -489,14 +495,16 @@ class ModelWorker:
 
         from areal_tpu.base.distributed import to_host
 
+        t0 = time.monotonic()
         params = self.models[req["model_name"]].engine.get_params()
         host = jax.tree.map(to_host, params)
+        nbytes = 0
         if req.get("sender", True):
             dsts = req.get("dsts") or [req["dst"]]
             xids = req.get("xfer_ids") or [req["xfer_id"]]
             for dst, xid in zip(dsts, xids):
-                self.transfer.send(dst, xid, ("params", host))
-        return {}
+                nbytes += self.transfer.send(dst, xid, ("params", host))
+        return {"bytes": nbytes, "seconds": time.monotonic() - t0}
 
     def _handle_param_recv(self, req):
         import jax

@@ -312,6 +312,19 @@ class TestPPOMathExperiment:
         assert len(stats) == 2
         assert np.isfinite(stats[-1]["actor_train/actor_loss"])
         assert abs(stats[0]["actor_train/importance_weight"] - 1.0) < 5e-2
+        # The transfer plane is measured: prompts/rollouts/rewards moved
+        # between the meshes (data) and fresh weights shipped (param),
+        # and moving the DATA costs a small fraction of the step.  (The
+        # param timer also covers the host gather — real compute — so
+        # only its presence is asserted; a CI scheduler stall inside that
+        # window must not flake the test.)
+        last = stats[-1]
+        assert last["transfer/data_bytes"] > 0
+        assert last["transfer/param_bytes"] > 0
+        assert last["transfer/data_count"] >= 1
+        assert last["transfer/param_send_s"] >= 0.0
+        data_s = last["transfer/data_send_s"] + last["transfer/data_recv_s"]
+        assert data_s < 0.05 * last["time/step_s"], (data_s, last)
 
         # Same trial colocated on one worker must agree: the transfer plane
         # only moves bytes, it must not change the math.
