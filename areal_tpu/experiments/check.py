@@ -1,0 +1,140 @@
+"""Experiment-config validation, run before any device work.
+
+Capability parity: realhf/experiments/common/check.py (+ the scattered
+asserts of api/cli_args.py) — fail a misconfigured trial at BUILD time
+with a sentence naming the knob, instead of deep in a worker after
+minutes of model loading.  Called by build_sft / build_ppo_math.
+"""
+
+import os
+from typing import Optional
+
+from areal_tpu.api.model_api import GenerationHyperparameters, OptimizerConfig
+from areal_tpu.base.topology import ParallelConfig
+
+
+def _fail(msg: str):
+    raise ValueError(f"invalid experiment config: {msg}")
+
+
+def check_optimizer(opt: OptimizerConfig) -> None:
+    if opt.lr <= 0:
+        _fail(f"optimizer.lr must be > 0, got {opt.lr}")
+    if not 0.0 <= opt.warmup_steps_proportion <= 1.0:
+        _fail(
+            "optimizer.warmup_steps_proportion must be in [0, 1], got "
+            f"{opt.warmup_steps_proportion}"
+        )
+    min_lr_ratio = getattr(opt, "min_lr_ratio", 0.0)
+    if not 0.0 <= min_lr_ratio <= 1.0:
+        _fail(f"optimizer.min_lr_ratio must be in [0, 1], got {min_lr_ratio}")
+
+
+def check_model_path(role: str, spec) -> None:
+    if spec is not None and spec.type_ == "hf":
+        path = spec.args.get("path", "")
+        if not os.path.exists(path):
+            _fail(
+                f"model path {path!r} for {role!r} does not exist locally "
+                "(download the checkpoint first)"
+            )
+
+
+def check_gconfig(g: GenerationHyperparameters) -> None:
+    if g.n < 1:
+        _fail(f"gconfig.n must be >= 1, got {g.n}")
+    if g.max_new_tokens < 1:
+        _fail(f"gconfig.max_new_tokens must be >= 1, got {g.max_new_tokens}")
+    if g.min_new_tokens > g.max_new_tokens:
+        _fail(
+            f"gconfig.min_new_tokens ({g.min_new_tokens}) > max_new_tokens "
+            f"({g.max_new_tokens})"
+        )
+    if not g.greedy and g.temperature <= 0:
+        _fail(f"gconfig.temperature must be > 0 when sampling, got "
+              f"{g.temperature}")
+    if not 0.0 < g.top_p <= 1.0:
+        _fail(f"gconfig.top_p must be in (0, 1], got {g.top_p}")
+
+
+def check_batch_vs_parallel(
+    role: str,
+    n_seqs: int,
+    parallel: ParallelConfig,
+    n_mbs: int = 1,
+) -> None:
+    """Every DP shard of every pipeline stage needs at least one sequence
+    per microbatch (reference: check_valid_parallel_batch_size)."""
+    need = parallel.dp_size * parallel.pipe * max(n_mbs, 1)
+    if n_seqs < need:
+        _fail(
+            f"{role}: batch of {n_seqs} sequences cannot fill "
+            f"dp={parallel.dp_size} x pipe={parallel.pipe} x "
+            f"n_mbs={n_mbs} (needs >= {need})"
+        )
+
+
+def check_ppo_math(cfg) -> None:
+    """Cross-field checks for PPOMathConfig (cheap, no jax import)."""
+    check_optimizer(cfg.optimizer)
+    check_gconfig(cfg.gconfig)
+    for role, spec in (
+        ("actor", cfg.actor), ("ref", cfg.ref), ("critic", cfg.critic),
+    ):
+        check_model_path(role, spec)
+
+    kw = cfg.ppo_kwargs
+    if kw.get("kl_adaptive") and not kw.get("kl_ctl"):
+        _fail(
+            "kl_adaptive with kl_ctl=0: the multiplicative controller can "
+            "never leave 0 — set a nonzero initial kl_ctl"
+        )
+    if (kw.get("kl_ctl") or kw.get("kl_adaptive")) and cfg.ref is None:
+        _fail("KL control (kl_ctl/kl_adaptive) needs a ref model")
+    if kw.get("use_dense_reward") and cfg.critic is None:
+        _fail("use_dense_reward needs the critic (value) mode")
+    gen_size: Optional[int] = kw.get("generation_size")
+    if gen_size is not None and gen_size < cfg.gconfig.n:
+        _fail(
+            f"generation_size ({gen_size}) must be >= group size "
+            f"gconfig.n ({cfg.gconfig.n})"
+        )
+    if cfg.fuse_rew_ref and cfg.ref is None:
+        _fail("fuse_rew_ref needs a ref model")
+    if cfg.rollout_ahead not in (0, 1):
+        _fail(f"rollout_ahead must be 0 or 1, got {cfg.rollout_ahead}")
+    if cfg.dataset_filter:
+        lo = cfg.dataset_filter.get("min_accuracy", 0.0)
+        hi = cfg.dataset_filter.get("max_accuracy", 1.0)
+        if not 0.0 <= lo < hi <= 1.0:
+            _fail(
+                f"dataset_filter accuracy band [{lo}, {hi}] must satisfy "
+                "0 <= min < max <= 1"
+            )
+    for role, widx in cfg.placement.items():
+        idxs = widx if isinstance(widx, list) else [widx]
+        if not idxs or any(
+            (not isinstance(i, int)) or i < 0 for i in idxs
+        ):
+            _fail(f"placement[{role!r}] must be a worker index or a "
+                  f"non-empty list of them, got {widx!r}")
+    n_seqs = cfg.batch_size * cfg.gconfig.n
+    check_batch_vs_parallel(
+        "actor train", n_seqs, cfg.actor_parallel, cfg.mb_spec.n_mbs
+    )
+    # Generation folds any pipe axis into model (generator.py
+    # fold_pipe_into_model), so only the data axes constrain its batch.
+    import dataclasses as _dc
+
+    gen_pc = cfg.gen_parallel or cfg.actor_parallel
+    check_batch_vs_parallel(
+        "generation", cfg.batch_size, _dc.replace(gen_pc, pipe=1)
+    )
+
+
+def check_sft(cfg) -> None:
+    check_optimizer(cfg.optimizer)
+    check_model_path("model", cfg.model)
+    check_batch_vs_parallel(
+        "train", cfg.batch_size, cfg.parallel, cfg.mb_spec.n_mbs
+    )

@@ -84,6 +84,9 @@ class SFTConfig:
 
 
 def build_sft(cfg: SFTConfig, tokenizer=None) -> ExperimentPlan:
+    from areal_tpu.experiments.check import check_sft
+
+    check_sft(cfg)
     model_name = ModelName("default", 0)
     node = MFCDef(
         name="trainDefault",
@@ -262,6 +265,9 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
     """The reference's ppo-math DFG (ppo_math_exp.py:335): generate ->
     {reward, ref, critic-inf} -> actor/critic train, with a weight-sync
     pre-hook on generation (train -> generator hot-swap)."""
+    from areal_tpu.experiments.check import check_ppo_math
+
+    check_ppo_math(cfg)
     disable_value = cfg.critic is None
     actor = ModelName("actor", 0)
     actor_gen = ModelName("actor_gen", 0)

@@ -91,7 +91,7 @@ class MultiTaskRewardInterface(ModelInterface):
             ).verify_batch(todo)
         else:
             oks = [
-                self._verify(it["task"], it["text"], it) for it in todo
+                self.verify(it["task"], it["text"], it) for it in todo
             ]
         n_correct = sum(map(int, oks))
         rewards = [
@@ -108,7 +108,9 @@ class MultiTaskRewardInterface(ModelInterface):
             metadata={},
         )
 
-    def _verify(self, task: str, text: str, info: Dict[str, Any]) -> bool:
+    def verify(self, task: str, text: str, info: Dict[str, Any]) -> bool:
+        """Grade one response for `task` ("math" | "code") — public so the
+        offline evaluator shares the exact training-reward graders."""
         if task == "math":
             return math_verify.verify_math(text, info.get("solutions", []))
         elif task == "code":

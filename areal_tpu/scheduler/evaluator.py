@@ -62,6 +62,21 @@ def parse_protocol(proto: str) -> Optional[int]:
     return int(m.group(1))
 
 
+_GRADER = None
+
+
+def _grader():
+    """Shared grader instance: the SAME math/code verification used for
+    training rewards (interfaces/reward.py), so offline scores and RL
+    rewards can never disagree on what counts as correct."""
+    global _GRADER
+    if _GRADER is None:
+        from areal_tpu.interfaces.reward import MultiTaskRewardInterface
+
+        _GRADER = MultiTaskRewardInterface()
+    return _GRADER
+
+
 def _load_rows(path: str, limit: Optional[int]) -> List[Dict]:
     rows = []
     with open(path) as f:
@@ -91,7 +106,6 @@ def evaluate_checkpoint(
     from areal_tpu.base.topology import ParallelConfig, make_mesh
     from areal_tpu.data.tokenizer import load_hf_tokenizer
     from areal_tpu.engines.generator import GeneratorEngine
-    from areal_tpu.interfaces.math_verify import verify_math
     from areal_tpu.models.hf import registry as hf
 
     cfg, params = hf.load_hf_checkpoint(ckpt_dir)
@@ -148,7 +162,16 @@ def evaluate_checkpoint(
             batch, MicroBatchSpec(), gconfig, seed=seed + start
         )
         for r, one in zip(chunk, out.unpack()):
-            solutions = r.get("solutions") or r.get("answers") or []
+            # Same task dispatch as training rewards: math rows grade via
+            # boxed-answer sympy verification, code rows run their test
+            # cases in the sandbox (interfaces/reward.py + sandbox.py) —
+            # the evaluator covers both halves of the reference's
+            # math+code evaluation surface.
+            task = r.get("task", "math")
+            info = {
+                "solutions": r.get("solutions") or r.get("answers") or [],
+                "input_output": r.get("input_output"),
+            }
             bounds = one.cu_seqlens("packed_input_ids")
             toks_all = np.asarray(one.data["packed_input_ids"])
             pmask = np.asarray(one.data["prompt_mask"])
@@ -159,7 +182,7 @@ def evaluate_checkpoint(
                 lo, hi = bounds[s], bounds[s + 1]
                 resp = toks_all[lo:hi][~pmask[lo:hi].astype(bool)]
                 text = tokenizer.decode(resp.tolist())
-                ok = bool(verify_math(text, solutions))
+                ok = bool(_grader().verify(task, text, info))
                 n_correct += ok
                 row_ok += ok
                 row_n += 1

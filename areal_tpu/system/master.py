@@ -227,7 +227,11 @@ class MasterWorker:
 
     async def execute_step(self) -> Dict[str, float]:
         results: Dict[str, Dict[str, float]] = {}
-        self._xfer_acc = {}
+        # clear(), never rebind: with rollout_ahead the NEXT step's
+        # prefetch transfers run concurrently and must keep landing in the
+        # live dict (wall-clock attribution — a transfer counts toward the
+        # step during which it actually moved bytes).
+        self._xfer_acc.clear()
         if self.rollout_ahead > 0 and self._source_nodes:
             await self._execute_step_async(results)
         else:

@@ -130,3 +130,43 @@ def test_score_series_accumulates(tmp_path):
     ]
     assert [s["global_step"] for s in series] == [1.0, 2.0]
     assert all("pass@1" in s for s in series)
+
+
+def test_code_task_rows_grade_through_sandbox(tmp_path):
+    """Evaluation rows with task='code' dispatch to the sandboxed code
+    grader (same verifier as training rewards); a random tiny model
+    cannot emit a passing program, so the protocol runs end-to-end with
+    score 0 and no crash."""
+    ckpt = _write_ckpt(tmp_path / "ckpts", 1)
+    data = tmp_path / "code.jsonl"
+    rows = [
+        {
+            "query_id": "c0",
+            "prompt": "write a doubler",
+            "task": "code",
+            "input_output": {"inputs": ["3\n"], "outputs": ["6"]},
+        }
+    ]
+    with open(data, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    res = evaluate_checkpoint(
+        ckpt,
+        EvalConfig(
+            data_path=str(data), tokenizer_path="char:512",
+            max_new_tokens=8,
+        ),
+    )
+    assert res["pass@1"] == 0.0 and res["n_prompts"] == 1.0
+
+
+def test_grader_is_shared_with_training_rewards():
+    from areal_tpu.scheduler.evaluator import _grader
+
+    g = _grader()
+    assert g.verify("math", "the answer is \\boxed{4}", {"solutions": ["\\boxed{4}"]})
+    assert g.verify(
+        "code",
+        "```python\nprint(int(input()) * 2)\n```",
+        {"input_output": {"inputs": ["3\n"], "outputs": ["6"]}},
+    )

@@ -479,7 +479,7 @@ class TestValueNorm:
                 "id2info": {r["query_id"]: r for r in rows}
             },
             gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
-            ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+            ppo_kwargs={"n_minibatches": 2},
             critic_interface_args={
                 "value_norm": True, "value_norm_type": "ma",
             },
@@ -533,7 +533,7 @@ class TestValueNorm:
                     "id2info": {r["query_id"]: r for r in rows}
                 },
                 gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
-                ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+                ppo_kwargs={"n_minibatches": 2},
                 critic_interface_args={
                     "value_norm": True, "value_norm_type": "ma",
                 },
@@ -599,7 +599,7 @@ class TestValueNorm:
                 "id2info": {r["query_id"]: r for r in rows}
             },
             gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
-            ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+            ppo_kwargs={"n_minibatches": 2},
             critic_interface_args={
                 "value_norm": True, "value_norm_type": "ma",
             },
