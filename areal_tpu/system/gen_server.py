@@ -13,6 +13,13 @@ inference runtime, so the server is a thin stdlib-HTTP shell around it:
 - POST /update_weights — hot-swap from an HF checkpoint dir.
 - GET  /health — liveness + current weight version.
 
+Two transports share that collector:
+- HTTP (ThreadingHTTPServer): the ops/debug surface — curl-able, JSON.
+- ZMQ ROUTER (`zmq_port`): the high-throughput trainer plane — JSON
+  frames, one DEALER connection per client pipelining any number of
+  in-flight requests with rid correlation, no thread-per-request.  The
+  `zmq://host:port` URL scheme selects it in RemoteGeneratorEngine.
+
 `RemoteGeneratorEngine` (backend "remote_generator") makes a model worker
 talk to such a server instead of holding generation weights itself — the
 reference's decoupled `sglang.dXpYmZ+...` allocation shape, with the
@@ -81,6 +88,7 @@ class GenerationServer:
         max_batch: int = 256,
         token: str = "",
         ckpt_root: str = "",
+        zmq_port: Optional[int] = None,  # 0 = random; None = HTTP only
     ):
         self.engine = engine
         self.version = 0
@@ -164,7 +172,138 @@ class GenerationServer:
         )
         self._http_thread.start()
         self._collector_thread.start()
-        logger.info(f"generation server at {self.url}")
+        self.zmq_port: Optional[int] = None
+        self.zmq_url: Optional[str] = None
+        if zmq_port is not None:
+            self._start_zmq(host, zmq_port)
+        logger.info(
+            f"generation server at {self.url}"
+            + (f" + {self.zmq_url}" if self.zmq_url else "")
+        )
+
+    # ---------------- ZMQ transport ----------------
+
+    def _start_zmq(self, host: str, port: int) -> None:
+        import zmq
+
+        router = zmq.Context.instance().socket(zmq.ROUTER)
+        # Bind the host the operator chose, VERBATIM: widening a narrow
+        # bind to 0.0.0.0 would bypass the constructor's no-token gate.
+        bind_host = {"localhost": "127.0.0.1"}.get(host, host)
+        if ":" in bind_host:  # IPv6 literal
+            router.setsockopt(zmq.IPV6, 1)
+            bind_host = f"[{bind_host}]"
+        if port == 0:
+            port = router.bind_to_random_port(f"tcp://{bind_host}")
+        else:
+            router.bind(f"tcp://{bind_host}:{port}")
+        self.zmq_port = port
+        self.zmq_url = f"zmq://{host}:{port}"
+        self._zmq_thread = threading.Thread(
+            target=self._zmq_loop, args=(router,), daemon=True
+        )
+        self._zmq_thread.start()
+
+    def _zmq_loop(self, router) -> None:
+        """ROUTER loop: parse requests into the SAME collector queue the
+        HTTP path feeds; park (identity, pending) pairs and reply as their
+        done events set.  The socket is touched by this thread only; any
+        number of in-flight requests per client, no thread-per-request.
+
+        Wire format is JSON (like the HTTP path), NOT pickle: frames
+        arrive from the network BEFORE authentication, and unpickling
+        untrusted bytes executes code — the token must gate everything a
+        payload can do."""
+        jobs: List = []  # (identity, rid, _Pending)
+
+        def reply(ident, rid, msg: Dict):
+            msg["rid"] = rid
+            router.send_multipart([ident, json.dumps(msg).encode()])
+
+        def handle(ident, payload: bytes):
+            try:
+                req = json.loads(payload)
+                rid = req.get("rid")
+            except Exception:
+                # No rid recoverable: send an uncorrelated error (clients
+                # fail fast on rid-less errors rather than timing out).
+                router.send_multipart(
+                    [ident, json.dumps({"error": "bad request"}).encode()]
+                )
+                return
+            try:
+                if self._token and req.get("token") != self._token:
+                    reply(ident, rid, {"error": "bad token"})
+                    return
+                cmd = req.get("cmd")
+                if cmd == "health":
+                    reply(ident, rid, {
+                        "status": "ok", "version": self.version,
+                    })
+                elif cmd == "generate":
+                    p = _Pending(
+                        qid=str(req["qid"]),
+                        prompt_ids=[int(t) for t in req["prompt_ids"]],
+                        gconfig=GenerationHyperparameters(
+                            **req.get("gconfig", {})
+                        ),
+                        done=threading.Event(),
+                        seed=req.get("seed"),
+                    )
+                    self._queue.put(p)
+                    jobs.append((ident, rid, p))
+                elif cmd == "update_weights":
+                    p = _Pending(
+                        qid="", prompt_ids=[],
+                        gconfig=GenerationHyperparameters(),
+                        done=threading.Event(),
+                    )
+
+                    def _upd(p=p, path=req.get("path")):
+                        try:
+                            p.result = self._handle_update({"path": path})
+                        except Exception as e:  # noqa: BLE001
+                            p.error = repr(e)
+                        p.done.set()
+
+                    threading.Thread(target=_upd, daemon=True).start()
+                    jobs.append((ident, rid, p))
+                else:
+                    reply(ident, rid, {"error": f"unknown cmd {cmd!r}"})
+            except Exception as e:  # noqa: BLE001 — malformed fields
+                # Always rid-correlated: the client must fail THIS request
+                # immediately, not block until its timeout.
+                reply(ident, rid, {"error": f"bad request: {e!r}"})
+
+        while not self._stop.is_set():
+            try:
+                # Short poll while replies are pending keeps added reply
+                # latency ~10ms; idle ticks stay cheap at 100ms.
+                while router.poll(10 if jobs else 100):
+                    ident, payload = router.recv_multipart()
+                    handle(ident, payload)
+                still = []
+                for ident, rid, p in jobs:
+                    if p.done.is_set():
+                        reply(
+                            ident, rid,
+                            {"error": p.error} if p.error else dict(p.result),
+                        )
+                    elif (
+                        p.qid and not self._collector_thread.is_alive()
+                    ):
+                        reply(ident, rid, {"error": "collector thread died"})
+                    else:
+                        still.append((ident, rid, p))
+                jobs = still
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("zmq transport error")
+        for ident, rid, p in jobs:
+            try:
+                reply(ident, rid, {"error": "server shutting down"})
+            except Exception:  # noqa: BLE001
+                pass
+        router.close(linger=200)
 
     # ---------------- request handling ----------------
 
@@ -334,6 +473,107 @@ def _extract_output(
     }
 
 
+class ZMQGenClient:
+    """High-throughput client for a GenerationServer's ZMQ transport.
+
+    One DEALER connection pipelines any number of in-flight requests
+    (correlated by client-assigned rids) — no per-request thread or TCP
+    connection, unlike the HTTP path's urllib fan-out.  Same surface as
+    LLMAPIClient where RemoteGeneratorEngine needs it."""
+
+    def __init__(self, url: str, timeout_s: float = 7200.0, token: str = ""):
+        import zmq
+
+        assert url.startswith("zmq://"), url
+        self.url = url
+        self.timeout_s = timeout_s
+        self.token = token or os.environ.get("AREAL_GEN_TOKEN", "")
+        self._sock = zmq.Context.instance().socket(zmq.DEALER)
+        self._sock.connect("tcp://" + url[len("zmq://"):])
+        self._rid = 0
+        # One socket, possibly called from pool threads: serialize.
+        self._lock = threading.Lock()
+
+    def _call_many(self, reqs: List[Dict]) -> List[Dict]:
+        with self._lock:
+            rids = []
+            for req in reqs:
+                self._rid += 1
+                req = dict(req, rid=self._rid, token=self.token)
+                rids.append(self._rid)
+                self._sock.send(json.dumps(req).encode())
+            want = set(rids)
+            got: Dict[int, Dict] = {}
+            deadline = time.monotonic() + self.timeout_s
+            while want:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._sock.poll(
+                    min(left, 1.0) * 1000
+                ):
+                    if time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            f"generation server {self.url}: "
+                            f"{len(want)} replies missing after "
+                            f"{self.timeout_s}s"
+                        )
+                    continue
+                msg = json.loads(self._sock.recv())
+                rid = msg.pop("rid", None)
+                if "error" in msg and (rid is None or rid in want):
+                    # rid-less errors (unparsable frame) also fail fast —
+                    # never sit out the timeout on a dead request.
+                    raise RuntimeError(
+                        f"generation server error: {msg['error']}"
+                    )
+                if rid in want:
+                    got[rid] = msg
+                    want.discard(rid)
+            return [got[r] for r in rids]
+
+    def health(self) -> Dict:
+        return self._call_many([{"cmd": "health"}])[0]
+
+    def generate_batch(
+        self, inps: List[APIGenerateInput], max_concurrency: int = 0
+    ) -> List[APIGenerateOutput]:
+        reqs = [
+            {
+                "cmd": "generate",
+                "qid": inp.qid,
+                "prompt_ids": list(map(int, inp.prompt_ids)),
+                "gconfig": dataclasses.asdict(inp.gconfig),
+                "seed": inp.seed,
+            }
+            for inp in inps
+        ]
+        outs = self._call_many(reqs)
+        return [
+            APIGenerateOutput(
+                qid=inp.qid,
+                prompt_ids=list(inp.prompt_ids),
+                output_ids=out["output_ids"],
+                output_logprobs=out["output_logprobs"],
+                no_eos=out["no_eos"],
+                version=int(out.get("version", 0)),
+            )
+            for inp, out in zip(inps, outs)
+        ]
+
+    def generate(self, inp: APIGenerateInput) -> APIGenerateOutput:
+        return self.generate_batch([inp])[0]
+
+    def update_weights_from_disk(self, path: str) -> int:
+        out = self._call_many([{"cmd": "update_weights", "path": path}])[0]
+        return int(out["version"])
+
+
+def make_gen_client(url: str, **kw):
+    """zmq:// URLs take the pipelined ZMQ transport; everything else HTTP."""
+    if url.startswith("zmq://"):
+        return ZMQGenClient(url, **kw)
+    return LLMAPIClient(url, **kw)
+
+
 class RemoteGeneratorEngine(Engine):
     """Generation engine backed by a remote GenerationServer (backend
     "remote_generator") — the decoupled allocation: this worker holds NO
@@ -355,7 +595,7 @@ class RemoteGeneratorEngine(Engine):
         urls = [url] if isinstance(url, str) else list(url)
         if not urls:
             raise ValueError("remote generator needs at least one URL")
-        self.clients = [LLMAPIClient(u) for u in urls]
+        self.clients = [make_gen_client(u) for u in urls]
         self.model_type = model_type
         # Unique per engine instance: two trials on one host must never
         # interleave checkpoint shards in a shared dir.
@@ -462,6 +702,9 @@ def main():
     p.add_argument("--max-decode-batch", type=int, default=64)
     p.add_argument("--token", default="",
                    help="shared secret (or AREAL_GEN_TOKEN)")
+    p.add_argument("--zmq-port", type=int, default=None,
+                   help="also serve the pipelined ZMQ transport on this "
+                        "port (0 = random); clients use zmq://host:port")
     args = p.parse_args()
 
     cfg, params = hf.load_hf_checkpoint(args.path)
@@ -476,9 +719,14 @@ def main():
         max_decode_batch=args.max_decode_batch,
     )
     server = GenerationServer(
-        engine, host=args.host, port=args.port, token=args.token
+        engine, host=args.host, port=args.port, token=args.token,
+        zmq_port=args.zmq_port,
     )
-    logger.info(f"serving {args.path} at {server.url}; Ctrl-C to stop")
+    logger.info(
+        f"serving {args.path} at {server.url}"
+        + (f" + {server.zmq_url}" if server.zmq_url else "")
+        + "; Ctrl-C to stop"
+    )
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

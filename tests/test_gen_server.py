@@ -199,7 +199,7 @@ def test_ppo_e2e_with_remote_gen_server(tmp_path):
                 "id2info": {r["query_id"]: r for r in rows}
             },
             gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
-            ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+            ppo_kwargs={"n_minibatches": 2},
             optimizer=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
             gen_server_url=server.url,
             batch_size=4,
@@ -246,3 +246,105 @@ def test_multi_server_dp_ranks(cfg):
     finally:
         s1.close()
         s2.close()
+
+
+class TestZMQTransport:
+    """The pipelined ZMQ plane shares the HTTP path's collector: parity,
+    pipelining, auth, and weight updates over one DEALER connection."""
+
+    @pytest.fixture()
+    def zserver(self, engine):
+        srv = GenerationServer(engine, max_wait_ms=2.0, zmq_port=0)
+        yield srv
+        srv.close()
+
+    def test_zmq_matches_http_greedy(self, zserver, cfg):
+        from areal_tpu.system.gen_server import ZMQGenClient
+
+        rng = np.random.default_rng(1)
+        sample = _prompt_sample(rng, cfg, lens=(6, 9, 5))
+        g = GenerationHyperparameters(n=1, max_new_tokens=6, greedy=True)
+        prompts = np.asarray(sample.data["packed_prompts"])
+        bounds = sample.cu_seqlens("packed_prompts")
+        inps = [
+            APIGenerateInput(
+                qid=sample.ids[i],
+                prompt_ids=[int(t) for t in prompts[bounds[i]:bounds[i+1]]],
+                gconfig=g,
+            )
+            for i in range(sample.bs)
+        ]
+        zc = ZMQGenClient(zserver.zmq_url)
+        assert zc.health()["status"] == "ok"
+        # All requests pipeline over ONE connection; replies correlate.
+        z_outs = {o.qid: o for o in zc.generate_batch(inps)}
+        h_outs = {
+            o.qid: o for o in LLMAPIClient(zserver.url).generate_batch(inps)
+        }
+        for qid in z_outs:
+            np.testing.assert_array_equal(
+                np.asarray(z_outs[qid].output_ids[0]),
+                np.asarray(h_outs[qid].output_ids[0]),
+            )
+            np.testing.assert_allclose(
+                np.asarray(z_outs[qid].output_logprobs[0]),
+                np.asarray(h_outs[qid].output_logprobs[0]),
+                rtol=1e-5, atol=1e-6,
+            )
+
+    def test_zmq_update_weights(self, zserver, cfg, tmp_path):
+        from areal_tpu.models.hf import registry as hf
+        from areal_tpu.system.gen_server import ZMQGenClient
+
+        new_params = tfm.init_params(cfg, jax.random.PRNGKey(123))
+        ckpt = tmp_path / "ck"
+        hf.save_hf_checkpoint(str(ckpt), cfg, new_params, model_type="qwen2")
+        zc = ZMQGenClient(zserver.zmq_url)
+        v0 = zc.health()["version"]
+        assert zc.update_weights_from_disk(str(ckpt)) == v0 + 1
+        assert zc.health()["version"] == v0 + 1
+
+    def test_zmq_bad_token_rejected(self, engine):
+        from areal_tpu.system.gen_server import ZMQGenClient
+
+        srv = GenerationServer(
+            engine, max_wait_ms=2.0, zmq_port=0, token="sekret"
+        )
+        try:
+            zc = ZMQGenClient(srv.zmq_url, token="wrong", timeout_s=10.0)
+            with pytest.raises(RuntimeError, match="bad token"):
+                zc.health()
+            ok = ZMQGenClient(srv.zmq_url, token="sekret")
+            assert ok.health()["status"] == "ok"
+        finally:
+            srv.close()
+
+    def test_remote_engine_routes_zmq_urls(self, zserver, cfg):
+        from areal_tpu.system.gen_server import (
+            RemoteGeneratorEngine,
+            ZMQGenClient,
+        )
+
+        eng = RemoteGeneratorEngine(cfg, zserver.zmq_url)
+        assert isinstance(eng.clients[0], ZMQGenClient)
+        rng = np.random.default_rng(2)
+        sample = _prompt_sample(rng, cfg, lens=(5, 7))
+        g = GenerationHyperparameters(n=2, max_new_tokens=4, greedy=True)
+        out = eng.generate(sample, MicroBatchSpec(), g)
+        assert all(len(x) == 2 for x in out.seqlens["packed_input_ids"])
+
+    def test_zmq_malformed_request_fails_fast(self, zserver):
+        """A malformed field must come back as a rid-correlated error
+        immediately — not leave the client blocked until its timeout."""
+        import time as _time
+
+        from areal_tpu.system.gen_server import ZMQGenClient
+
+        zc = ZMQGenClient(zserver.zmq_url, timeout_s=30.0)
+        t0 = _time.monotonic()
+        with pytest.raises(RuntimeError, match="bad request"):
+            zc._call_many([
+                {"cmd": "generate", "qid": "x", "prompt_ids": ["nan"],
+                 "gconfig": {}},
+            ])
+        assert _time.monotonic() - t0 < 5.0
