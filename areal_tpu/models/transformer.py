@@ -328,8 +328,23 @@ def _backbone(
 
     if pp_mesh is not None:
         if cp_mesh is not None:
+            # Deliberate fence.  The building blocks compose — a nested
+            # shard_map (pipe manual outside, ring attention's seq
+            # shard_map inside, via jax.sharding.get_abstract_mesh())
+            # passes forward AND gradient parity in isolation, including
+            # under jax.checkpoint, lax.scan, and ppermute-chained
+            # carries — but gradients through the FULL tick schedule
+            # (stage-dependent microbatch gathers + masked output buffer
+            # + final psum) come out wrong by orders of magnitude while
+            # the forward stays exact.  Until that transpose interaction
+            # is pinned down, long sequences under PP should use
+            # seq-within-stage layouts (e.g. fold seq into model) rather
+            # than silently mistrained ring attention.
             raise NotImplementedError(
-                "combined pipeline + ring context parallelism"
+                "combined pipeline + ring context parallelism (gradients "
+                "through the nested schedule are not yet trustworthy; "
+                "use a pipe-free mesh for ring attention, or tensor-"
+                "parallel attention inside pipeline stages)"
             )
         from areal_tpu.parallel.pipeline import pipelined_blocks
 

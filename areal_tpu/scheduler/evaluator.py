@@ -101,7 +101,6 @@ def evaluate_checkpoint(
 
     compilation_cache.enable()
 
-    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
     from areal_tpu.api.model_api import GenerationHyperparameters
     from areal_tpu.base.topology import ParallelConfig, make_mesh
     from areal_tpu.data.tokenizer import load_hf_tokenizer
@@ -134,7 +133,72 @@ def evaluate_checkpoint(
         temperature=temperature,
     )
 
-    rows = _load_rows(config.data_path, config.max_prompts)
+    # Multiple benchmarks per checkpoint (reference: comma-separated
+    # data_names shipped to its eval harness): per-dataset metrics are
+    # prefixed "<name>/"; flat keys stay the single-dataset aggregate /
+    # unweighted mean so existing consumers keep working.
+    datasets = _parse_datasets(config.data_path)
+    result: Dict[str, float] = {}
+    total_s = 0.0
+    for name, path in datasets:
+        one = _eval_one_dataset(
+            engine, tokenizer, config, gconfig, n, path, seed
+        )
+        total_s += one["eval_seconds"]
+        if len(datasets) == 1:
+            return one
+        for k_, v in one.items():
+            result[f"{name}/{k_}"] = v
+    for key in ("pass@1", f"pass@{n}", "pass@1_prompt_std"):
+        vals = [result[f"{nm}/{key}"] for nm, _ in datasets]
+        result[key] = float(np.mean(vals))
+    result["samples_per_prompt"] = float(n)
+    result["n_prompts"] = float(
+        sum(result[f"{nm}/n_prompts"] for nm, _ in datasets)
+    )
+    result["n_samples"] = float(
+        sum(result[f"{nm}/n_samples"] for nm, _ in datasets)
+    )
+    result["eval_seconds"] = total_s
+    return result
+
+
+def _parse_datasets(data_path: str):
+    """'aime=/d/aime.jsonl,/d/math500.jsonl' -> [(name, path), ...]
+    (name defaults to the file stem)."""
+    out = []
+    for part in data_path.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        # 'name=path' only when the prefix is a plain name — a '=' inside
+        # a path (hive-style '/data/date=2024/x.jsonl') is NOT a label.
+        if "=" in part and "/" not in part.split("=", 1)[0]:
+            name, path = part.split("=", 1)
+        else:
+            name = os.path.splitext(os.path.basename(part))[0]
+            path = part
+        out.append((name.strip(), path.strip()))
+    if not out:
+        raise ValueError(f"no datasets in data_path {data_path!r}")
+    names = [n for n, _ in out]
+    if len(set(names)) != len(names):
+        raise ValueError(
+            f"duplicate dataset names in data_path {data_path!r}: label "
+            "them apart with name=path"
+        )
+    return out
+
+
+def _eval_one_dataset(
+    engine, tokenizer, config: EvalConfig, gconfig, n: int, data_path: str,
+    seed: int,
+) -> Dict[str, float]:
+    import numpy as np
+
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+
+    rows = _load_rows(data_path, config.max_prompts)
     n_correct = 0
     n_total = 0
     n_any = 0

@@ -6,6 +6,7 @@ import os
 
 import jax
 import numpy as np
+import pytest
 
 from areal_tpu.models import transformer as tfm
 from areal_tpu.models.config import tiny_config
@@ -170,3 +171,42 @@ def test_grader_is_shared_with_training_rewards():
         "```python\nprint(int(input()) * 2)\n```",
         {"input_output": {"inputs": ["3\n"], "outputs": ["6"]}},
     )
+
+
+def test_multi_dataset_eval(tmp_path):
+    """Comma-separated data_path (reference: data_names) produces
+    per-dataset prefixed metrics plus aggregate flat keys."""
+    ckpt = _write_ckpt(tmp_path / "ckpts", 1)
+    d1 = tmp_path / "aime.jsonl"
+    _write_data(d1, n=3)
+    d2 = tmp_path / "math500.jsonl"
+    _write_data(d2, n=2)
+    res = evaluate_checkpoint(
+        ckpt,
+        EvalConfig(
+            data_path=f"aime24={d1},{d2}",
+            tokenizer_path="char:512",
+            max_new_tokens=4,
+        ),
+    )
+    assert res["aime24/n_prompts"] == 3.0
+    assert res["math500/n_prompts"] == 2.0
+    assert res["n_prompts"] == 5.0
+    assert 0.0 <= res["pass@1"] <= 1.0
+    assert res["eval_seconds"] > 0
+
+
+def test_dataset_path_parsing_edge_cases():
+    from areal_tpu.scheduler.evaluator import _parse_datasets
+
+    # '=' inside a PATH is not a label; stems name unlabeled datasets.
+    assert _parse_datasets("/data/date=2024/aime.jsonl") == [
+        ("aime", "/data/date=2024/aime.jsonl")
+    ]
+    assert _parse_datasets("aime24=/d/a.jsonl, /d/math500.jsonl") == [
+        ("aime24", "/d/a.jsonl"), ("math500", "/d/math500.jsonl")
+    ]
+    with pytest.raises(ValueError, match="duplicate"):
+        _parse_datasets("a/test.jsonl,b/test.jsonl")
+    with pytest.raises(ValueError, match="no datasets"):
+        _parse_datasets(" , ")
