@@ -278,6 +278,7 @@ def _block_forward(
     sin: jax.Array,
     use_flash: "bool | None" = None,
     cp_mesh=None,
+    cp_manual: "Optional[Tuple[str, int]]" = None,
 ) -> Tuple[jax.Array, jax.Array]:
     b, s, d = x.shape
     h = _norm(x, blk["ln1"], blk.get("ln1_b"), cfg)
@@ -291,7 +292,18 @@ def _block_forward(
     v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     if cfg.pos_emb == "rope":
         q, k = apply_rotary(q, k, cos, sin)
-    if cp_mesh is not None:
+    if cp_manual is not None:
+        # Already inside a manual region that includes the seq axis (the
+        # CP+PP pipeline): run the ring body DIRECTLY on this shard's
+        # chunk — nesting another shard_map over auto axes is not
+        # expressible once operands vary over the outer manual axis.
+        from areal_tpu.ops.ring_attention import _ring_shard
+
+        axis_name, axis_size = cp_manual
+        attn = _ring_shard(
+            q, k, v, segment_ids, axis_name, axis_size, causal=True
+        )
+    elif cp_mesh is not None:
         from areal_tpu.ops.ring_attention import ring_packed_attention
 
         attn = ring_packed_attention(q, k, v, segment_ids, cp_mesh, causal=True)
@@ -327,31 +339,17 @@ def _backbone(
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
     if pp_mesh is not None:
-        if cp_mesh is not None:
-            # Deliberate fence.  The building blocks compose — a nested
-            # shard_map (pipe manual outside, ring attention's seq
-            # shard_map inside, via jax.sharding.get_abstract_mesh())
-            # passes forward AND gradient parity in isolation, including
-            # under jax.checkpoint, lax.scan, and ppermute-chained
-            # carries — but gradients through the FULL tick schedule
-            # (stage-dependent microbatch gathers + masked output buffer
-            # + final psum) come out wrong by orders of magnitude while
-            # the forward stays exact.  Until that transpose interaction
-            # is pinned down, long sequences under PP should use
-            # seq-within-stage layouts (e.g. fold seq into model) rather
-            # than silently mistrained ring attention.
-            raise NotImplementedError(
-                "combined pipeline + ring context parallelism (gradients "
-                "through the nested schedule are not yet trustworthy; "
-                "use a pipe-free mesh for ring attention, or tensor-"
-                "parallel attention inside pipeline stages)"
-            )
         from areal_tpu.parallel.pipeline import pipelined_blocks
 
-        # The pipeline checkpoints each stage tick internally.
+        # The pipeline checkpoints each stage tick internally.  CP + PP
+        # compose by manualizing BOTH axes in the pipeline's shard_map
+        # (see pipelined_blocks: nesting a fresh seq shard_map per stage
+        # is rejected by jax once operands vary over the manual pipe
+        # axis, and silently mistrains under check_vma=False).
         x, aux = pipelined_blocks(
             params["blocks"], cfg, x, segment_ids, cos, sin,
             pp_mesh, pp_microbatches, use_flash,
+            cp=cp_mesh is not None,
         )
         x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
         return x, aux

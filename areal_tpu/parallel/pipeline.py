@@ -33,15 +33,17 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from areal_tpu.base.topology import PIPE_AXIS
+from areal_tpu.base.topology import PIPE_AXIS, SEQ_AXIS
 
 
-def _stage_scan(blocks_local, cfg, use_flash, x, seg, cos, sin):
+def _stage_scan(blocks_local, cfg, use_flash, cp_manual, x, seg, cos, sin):
     """Run this stage's local layer stack on one microbatch."""
     from areal_tpu.models.transformer import _block_forward
 
     def body(carry, blk):
-        y, aux = _block_forward(carry, blk, cfg, seg, cos, sin, use_flash)
+        y, aux = _block_forward(
+            carry, blk, cfg, seg, cos, sin, use_flash, cp_manual=cp_manual
+        )
         return y, aux
 
     y, auxes = jax.lax.scan(body, x, blocks_local)
@@ -58,8 +60,17 @@ def pipelined_blocks(
     mesh: Mesh,
     n_microbatches: int,
     use_flash: "bool | None" = False,
+    cp: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Transformer block stack under pipeline parallelism -> (y, aux_loss).
+
+    `cp=True` composes ring context parallelism INSIDE each stage: the
+    shard_map manualizes BOTH pipe and seq, every stage computes on its
+    local sequence chunk (all stage ops are per-token except attention),
+    and attention runs the ring body (`ops/ring_attention._ring_shard`)
+    directly on the chunk.  Nesting a fresh seq shard_map per stage is
+    NOT used — jax rejects that composition once operands vary over the
+    manual pipe axis (and silently mistrains under check_vma=False).
 
     `n_microbatches` is a REQUEST: the schedule uses the largest multiple
     of `pipe` that divides B and is <= the request (padding rows only up
@@ -81,6 +92,19 @@ def pipelined_blocks(
         raise ValueError(
             f"{cfg.n_layers} layers not divisible by {n_stages} pipe stages"
         )
+    cp_manual = None
+    if cp:
+        n_seq = mesh.shape[SEQ_AXIS]
+        if x.shape[1] % n_seq:
+            raise ValueError(
+                f"row length {x.shape[1]} not divisible by seq={n_seq}"
+            )
+        if cfg.is_moe:
+            # Per-chunk expert capacity would silently differ from the
+            # global dispatch the non-pipelined CP path computes.
+            raise NotImplementedError("MoE under combined CP + PP")
+        cp_manual = (SEQ_AXIS, n_seq)
+        use_flash = False  # dense ring blocks inside the manual region
 
     def to_mbs(t):
         return t.reshape(m, b // m, *t.shape[1:])
@@ -90,7 +114,9 @@ def pipelined_blocks(
 
     def pipe_body(blocks_local, x_mbs, seg_mbs, cos_mbs, sin_mbs):
         stage = jax.lax.axis_index(PIPE_AXIS)
-        fwd = functools.partial(_stage_scan, blocks_local, cfg, use_flash)
+        fwd = functools.partial(
+            _stage_scan, blocks_local, cfg, use_flash, cp_manual
+        )
         fwd = jax.checkpoint(
             fwd, policy=jax.checkpoint_policies.nothing_saveable
         )
@@ -134,15 +160,20 @@ def pipelined_blocks(
         outputs = jax.lax.psum(outputs, PIPE_AXIS)
         # Aux (MoE balancing) is an intensive per-layer statistic; average
         # over microbatches so it matches the non-pipelined scan's scale.
+        # (Under CP aux stays pipe-summed only: MoE is fenced there.)
         aux_sum = jax.lax.psum(aux_sum, PIPE_AXIS) / m
         return outputs, aux_sum
 
+    # Under CP the seq axis is manual too: activations/segments/rotary
+    # tables enter as per-chunk shards ([m, rows, S/n_seq, ...]).
+    seq = SEQ_AXIS if cp_manual else None
+    act = P(None, None, seq)
     fn = jax.shard_map(
         pipe_body,
         mesh=mesh,
-        in_specs=(P(PIPE_AXIS), P(), P(), P(), P()),
-        out_specs=(P(), P()),
-        axis_names={PIPE_AXIS},
+        in_specs=(P(PIPE_AXIS), act, act, act, act),
+        out_specs=(act, P()),
+        axis_names={PIPE_AXIS, SEQ_AXIS} if cp_manual else {PIPE_AXIS},
         check_vma=False,
     )
     y_mbs, aux = fn(blocks, x_mbs, seg_mbs, cos_mbs, sin_mbs)

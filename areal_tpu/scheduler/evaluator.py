@@ -171,9 +171,13 @@ def _parse_datasets(data_path: str):
         part = part.strip()
         if not part:
             continue
-        # 'name=path' only when the prefix is a plain name — a '=' inside
-        # a path (hive-style '/data/date=2024/x.jsonl') is NOT a label.
-        if "=" in part and "/" not in part.split("=", 1)[0]:
+        # 'name=path' only when the prefix is a plain label — a '=' after
+        # any '/' is part of the path (hive-style '/data/date=2024/x.jsonl').
+        # A bare relative filename containing '=' ('temp=0.7.jsonl') is
+        # ambiguous and parses as a label; write './temp=0.7.jsonl' to
+        # force path interpretation.
+        prefix = part.split("=", 1)[0]
+        if "=" in part and "/" not in prefix and "." not in prefix:
             name, path = part.split("=", 1)
         else:
             name = os.path.splitext(os.path.basename(part))[0]

@@ -210,3 +210,14 @@ def test_dataset_path_parsing_edge_cases():
         _parse_datasets("a/test.jsonl,b/test.jsonl")
     with pytest.raises(ValueError, match="no datasets"):
         _parse_datasets(" , ")
+
+
+def test_dataset_filename_with_equals_is_a_path():
+    from areal_tpu.scheduler.evaluator import _parse_datasets
+
+    # A bare 'x=y' is ambiguous and parses as a label; the documented
+    # escape ('./') forces path interpretation.
+    assert _parse_datasets("temp=0.7.jsonl") == [("temp", "0.7.jsonl")]
+    assert _parse_datasets("./temp=0.7.jsonl") == [
+        ("temp=0.7", "./temp=0.7.jsonl")
+    ]

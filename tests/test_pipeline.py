@@ -247,19 +247,58 @@ def test_train_engine_1f1b_mem_schedule_e2e():
     )
 
 
-def test_pipeline_plus_ring_is_fenced(rng):
-    """CP + PP stays a deliberate fence: gradients through ring attention
-    nested in the tick schedule are not yet trustworthy (the forward
-    composes; see models/transformer.py for the investigation notes), so
-    the combination must fail loudly instead of silently mistraining."""
+@pytest.mark.parametrize("pc", ["p2s2", "p2s2f2", "p2s4"])
+def test_pipeline_with_ring_attention(rng, pc):
+    """CP + PP composed: the pipeline manualizes BOTH pipe and seq and
+    each stage runs the ring-attention body on its sequence chunk — a
+    capability the reference lacks entirely (no CP).  Must match the
+    dense forward."""
+    pc = ParallelConfig.from_str(pc)
+    mesh = make_mesh(pc, jax.devices()[: pc.world_size])
+    cfg = tiny_config()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    b, s, m = 4, 64, 2
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (b, s)), jnp.int32)
+    seg = jnp.ones((b, s), jnp.int32)
+    want = jax.jit(lambda p, t, sg: tfm.forward(p, cfg, t, sg))(
+        params, toks, seg
+    )
+    on_mesh = sharding.shard_params(params, mesh)
+    got = jax.jit(
+        lambda p, t, sg: tfm.forward(
+            p, cfg, t, sg, pp_mesh=mesh, pp_microbatches=m, cp_mesh=mesh
+        )
+    )(on_mesh, toks, seg)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_pipeline_ring_gradients_match(rng):
+    """The numerics contract that forced the previous fence: gradients
+    through CP + PP must equal the dense model's."""
     pc = ParallelConfig.from_str("p2s2")
     mesh = make_mesh(pc, jax.devices()[:4])
     cfg = tiny_config()
-    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
-    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 32)), jnp.int32)
-    seg = jnp.ones((4, 32), jnp.int32)
-    with pytest.raises(NotImplementedError, match="ring context"):
-        tfm.forward(
-            params, cfg, toks, seg, pp_mesh=mesh, pp_microbatches=2,
-            cp_mesh=mesh,
+    params = tfm.init_params(cfg, jax.random.PRNGKey(1))
+    b, s, m = 2, 32, 2
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (b, s)), jnp.int32)
+    seg = jnp.ones((b, s), jnp.int32)
+
+    def loss_dense(p):
+        lg = tfm.forward(p, cfg, toks, seg)
+        return jnp.mean(jax.nn.log_softmax(lg)[..., 0])
+
+    def loss_pp_cp(p):
+        lg = tfm.forward(
+            p, cfg, toks, seg, pp_mesh=mesh, pp_microbatches=m, cp_mesh=mesh
+        )
+        return jnp.mean(jax.nn.log_softmax(lg)[..., 0])
+
+    g_ref = jax.grad(loss_dense)(params)
+    on_mesh = sharding.shard_params(params, mesh)
+    g_pp = jax.jit(jax.grad(loss_pp_cp))(on_mesh)
+    for a, b_ in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_pp)):
+        np.testing.assert_allclose(
+            np.asarray(b_), np.asarray(a), rtol=1e-3, atol=1e-4
         )
