@@ -297,6 +297,10 @@ def cmd_ppo_math(args):
         ppo_kwargs["adaptive_kl_horizon"] = args.adaptive_kl_horizon
     if args.generation_size is not None:
         ppo_kwargs["generation_size"] = args.generation_size
+    if args.early_stop_imp_ratio is not None:
+        ppo_kwargs["early_stop_imp_ratio"] = args.early_stop_imp_ratio
+    if args.early_stop_kl is not None:
+        ppo_kwargs["early_stop_kl"] = args.early_stop_kl
     cfg = exps.PPOMathConfig(
         actor=ModelAbstraction("hf", {"path": args.model_path}),
         ref=(
@@ -385,6 +389,12 @@ def main(argv=None):
     pp.add_argument("--generation-size", type=int, default=None,
                     help="best-of-k: sample this many responses per prompt "
                          "but train on only the top --group-size by reward")
+    pp.add_argument("--early-stop-imp-ratio", type=float, default=None,
+                    help="skip remaining minibatches of a step once the "
+                         "mean importance ratio exceeds this (e.g. 10.0)")
+    pp.add_argument("--early-stop-kl", type=float, default=None,
+                    help="skip remaining minibatches once |approx_kl| "
+                         "exceeds this (e.g. 0.1)")
     pp.add_argument("--ref-ema-eta", type=float, default=None,
                     help="EMA-update the ref toward the actor each step")
     pp.add_argument("--fuse-rew-ref", action="store_true",

@@ -93,6 +93,16 @@ def check_ppo_math(cfg) -> None:
         _fail("KL control (kl_ctl/kl_adaptive) needs a ref model")
     if kw.get("use_dense_reward") and cfg.critic is None:
         _fail("use_dense_reward needs the critic (value) mode")
+    for knob in ("early_stop_imp_ratio", "early_stop_kl"):
+        v = kw.get(knob)
+        if v is not None and v <= 0:
+            # 0.0 would mean "trip on every minibatch" — but in this
+            # ppo_kwargs dict 0.0 conventionally means "disabled"
+            # (kl_ctl): reject the ambiguity instead of silently
+            # collapsing every step to one minibatch.
+            _fail(
+                f"{knob} must be > 0 (omit it to disable early stopping)"
+            )
     gen_size: Optional[int] = kw.get("generation_size")
     if gen_size is not None and gen_size < cfg.gconfig.n:
         _fail(

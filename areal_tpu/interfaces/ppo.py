@@ -203,6 +203,15 @@ class PPOActorInterface(ModelInterface):
     max_reward_clip: float = 5.0
     reward_scaling: float = 1.0
     reward_bias: float = 0.0
+    # Early stopping (reference: ppo_interface.py early_stop_imp_ratio /
+    # early_stop_kl, checked inside the loss fn): when a minibatch's mean
+    # importance ratio or approx-KL crosses the threshold, the REMAINING
+    # minibatches of this step are skipped — the policy has drifted too
+    # far off the behavior policy for more clipped updates to be sound.
+    # (The reference aborts before applying the offending minibatch; the
+    # fused jitted update here applies it, then stops.)
+    early_stop_imp_ratio: Optional[float] = None  # e.g. 10.0
+    early_stop_kl: Optional[float] = None  # e.g. 0.1
     disable_value: bool = False  # GRPO mode
     adv_norm: bool = True
     group_adv_norm: bool = False
@@ -454,9 +463,11 @@ class PPOActorInterface(ModelInterface):
 
         loss_fn = self._get_loss_fn()
         all_stats = []
-        for mb in train_sample.split_balanced(
+        n_skipped = 0
+        mbs_list = train_sample.split_balanced(
             min(self.n_minibatches, train_sample.bs)
-        ):
+        )
+        for mi, mb in enumerate(mbs_list):
             stats = model.engine.train_batch(
                 mb,
                 mb_spec,
@@ -467,6 +478,22 @@ class PPOActorInterface(ModelInterface):
                 version_steps=model.version,
             )
             all_stats.append(stats)
+            imp = stats.get("importance_weight", 1.0)
+            akl = abs(stats.get("approx_kl", 0.0))
+            if (
+                self.early_stop_imp_ratio is not None
+                and imp > self.early_stop_imp_ratio
+            ) or (
+                self.early_stop_kl is not None and akl > self.early_stop_kl
+            ):
+                n_skipped = len(mbs_list) - (mi + 1)
+                logger.warning(
+                    f"early stop after minibatch {mi + 1}/{len(mbs_list)}: "
+                    f"importance_weight={imp:.3f} approx_kl={akl:.4f} "
+                    f"(thresholds {self.early_stop_imp_ratio}/"
+                    f"{self.early_stop_kl}); skipping {n_skipped} minibatches"
+                )
+                break
         model.inc_version()
 
         out = {
@@ -492,6 +519,7 @@ class PPOActorInterface(ModelInterface):
             n_response_tokens=float(loss_mask.sum()),
             kl_ctl_value=klv,
             ref_kl=ref_kl,
+            n_minibatches_skipped=float(n_skipped),
         )
         return out
 

@@ -95,6 +95,13 @@ class TestPPOChecks:
     def test_fuse_needs_ref(self):
         _expect("fuse_rew_ref", ref=None, fuse_rew_ref=True)
 
+    def test_nonpositive_early_stop_rejected(self):
+        _expect("early_stop_kl", ppo_kwargs={"early_stop_kl": 0.0})
+        _expect(
+            "early_stop_imp_ratio",
+            ppo_kwargs={"early_stop_imp_ratio": -1.0},
+        )
+
 
 class TestSFTChecks:
     def test_sft_batch_grid(self):

@@ -774,3 +774,43 @@ class TestDenseRewards:
         _, stats = run_experiment(plan, tokenizer=tok)
         assert len(stats) == 2
         assert np.isfinite(stats[-1]["actor_train/actor_loss"])
+
+
+class TestEarlyStop:
+    def test_kl_threshold_skips_remaining_minibatches(self):
+        """An impossible approx-KL threshold trips after the FIRST
+        minibatch; the remaining ones are skipped and reported
+        (reference: ppo_interface.py early_stop_kl)."""
+        actor, gen, _, tok = _ppo_setup(disable_value=True)
+        prompts, id2info = _prompt_batch(tok, n_prompts=4)
+        g = GenerationHyperparameters(n=2, max_new_tokens=8, temperature=1.0)
+        actor_if = PPOActorInterface(
+            gconfig=g, n_minibatches=4, disable_value=True,
+            early_stop_kl=-1.0,  # |kl| >= 0 always trips
+        )
+        mb = MicroBatchSpec()
+        rollout = actor_if.generate(gen, prompts, mb)
+        rollout.update_(
+            MultiTaskRewardInterface(id2info=id2info).inference(
+                actor, rollout, mb
+            )
+        )
+        stats = actor_if.train_step(actor, rollout, mb)
+        assert stats["n_minibatches_skipped"] == 3.0
+
+    def test_no_thresholds_no_skip(self):
+        actor, gen, _, tok = _ppo_setup(disable_value=True)
+        prompts, id2info = _prompt_batch(tok)
+        g = GenerationHyperparameters(n=2, max_new_tokens=8, temperature=1.0)
+        actor_if = PPOActorInterface(
+            gconfig=g, n_minibatches=2, disable_value=True,
+        )
+        mb = MicroBatchSpec()
+        rollout = actor_if.generate(gen, prompts, mb)
+        rollout.update_(
+            MultiTaskRewardInterface(id2info=id2info).inference(
+                actor, rollout, mb
+            )
+        )
+        stats = actor_if.train_step(actor, rollout, mb)
+        assert stats["n_minibatches_skipped"] == 0.0

@@ -190,7 +190,7 @@ def test_ppo_disjoint_workers_multiprocess(tmp_path):
             "id2info": {r["query_id"]: r for r in rows}
         },
         gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
-        ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+        ppo_kwargs={"n_minibatches": 2},
         optimizer=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
         actor_parallel=ParallelConfig.from_str("d2"),
         gen_parallel=ParallelConfig.from_str("d2"),
