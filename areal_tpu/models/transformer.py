@@ -279,6 +279,7 @@ def _block_forward(
     use_flash: "bool | None" = None,
     cp_mesh=None,
     cp_manual: "Optional[Tuple[str, int]]" = None,
+    cp_zigzag: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     b, s, d = x.shape
     h = _norm(x, blk["ln1"], blk.get("ln1_b"), cfg)
@@ -304,9 +305,22 @@ def _block_forward(
             q, k, v, segment_ids, axis_name, axis_size, causal=True
         )
     elif cp_mesh is not None:
-        from areal_tpu.ops.ring_attention import ring_packed_attention
+        if cp_zigzag:
+            # Inputs already zigzag-permuted by _backbone (ONCE per
+            # forward, not per layer).
+            from areal_tpu.ops.ring_attention import (
+                zigzag_ring_packed_attention_prepermuted,
+            )
 
-        attn = ring_packed_attention(q, k, v, segment_ids, cp_mesh, causal=True)
+            attn = zigzag_ring_packed_attention_prepermuted(
+                q, k, v, segment_ids, cp_mesh, causal=True
+            )
+        else:
+            from areal_tpu.ops.ring_attention import ring_packed_attention
+
+            attn = ring_packed_attention(
+                q, k, v, segment_ids, cp_mesh, causal=True
+            )
     else:
         attn = packed_attention(
             q, k, v, segment_ids, causal=True, use_flash=use_flash
@@ -339,8 +353,21 @@ def _backbone(
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
     if pp_mesh is not None:
+        import os
+
         from areal_tpu.parallel.pipeline import pipelined_blocks
 
+        if cp_mesh is not None and os.environ.get("AREAL_RING_ZIGZAG") == "1":
+            from areal_tpu.base import logging as _logging
+
+            # The CP+PP schedule keeps the contiguous layout: zigzag
+            # there needs the permutation threaded through the tick
+            # schedule's position bookkeeping — not built yet.  Say so
+            # instead of silently ignoring the knob.
+            _logging.getLogger("transformer").warning(
+                "AREAL_RING_ZIGZAG has no effect under combined CP+PP; "
+                "running the contiguous ring"
+            )
         # The pipeline checkpoints each stage tick internally.  CP + PP
         # compose by manualizing BOTH axes in the pipeline's shard_map
         # (see pipelined_blocks: nesting a fresh seq shard_map per stage
@@ -354,9 +381,32 @@ def _backbone(
         x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
         return x, aux
 
+    # Zigzag ring layout: permute the token order ONCE for the whole
+    # layer stack (every other op is per-token; attention sees original
+    # positions via cos/sin + segment ids traveling with the tokens) and
+    # invert after the final norm.
+    import os
+
+    from areal_tpu.base.topology import SEQ_AXIS as _SEQ
+
+    zz_inv = None
+    if (
+        cp_mesh is not None
+        and os.environ.get("AREAL_RING_ZIGZAG") == "1"
+        and x.shape[1] % (2 * cp_mesh.shape[_SEQ]) == 0
+    ):
+        from areal_tpu.ops.ring_attention import zigzag_indices
+
+        idx, zz_inv = zigzag_indices(x.shape[1], cp_mesh.shape[_SEQ])
+        x = jnp.take(x, idx, axis=1)
+        segment_ids = jnp.take(segment_ids, idx, axis=1)
+        cos = jnp.take(cos, idx, axis=1)
+        sin = jnp.take(sin, idx, axis=1)
+
     def body(carry, blk):
         y, aux = _block_forward(
-            carry, blk, cfg, segment_ids, cos, sin, use_flash, cp_mesh
+            carry, blk, cfg, segment_ids, cos, sin, use_flash, cp_mesh,
+            cp_zigzag=zz_inv is not None,
         )
         return y, aux
 
@@ -380,6 +430,8 @@ def _backbone(
         raise ValueError(f"unknown remat policy {remat!r}")
     x, auxes = jax.lax.scan(body, x, params["blocks"])
     x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
+    if zz_inv is not None:
+        x = jnp.take(x, zz_inv, axis=1)
     return x, jnp.sum(auxes)
 
 

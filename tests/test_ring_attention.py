@@ -85,3 +85,94 @@ def test_ring_long_segment_spans_chunks(rng):
         lambda q, k, v, seg: ring_packed_attention(q, k, v, seg, mesh)
     )(q, k, v, seg)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+class TestZigzag:
+    """Balanced-causal zigzag layout: identical numerics, ~45% fewer
+    attention FLOPs than the contiguous ring at seq=4 (every rank computes
+    2n+1 live half-blocks instead of 4n half-block equivalents)."""
+
+    @pytest.mark.parametrize("pc", ["d1s4", "d2s2m2", "d1s8"])
+    @pytest.mark.parametrize("gqa", [1, 2])
+    def test_matches_reference(self, rng, pc, gqa):
+        pc = ParallelConfig.from_str(pc)
+        mesh = make_mesh(pc, jax.devices()[: pc.world_size])
+        b, s, h, d = 2 * pc.dp_size, 64, 4, 8
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, h // gqa, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, s, h // gqa, d)), jnp.float32)
+        seg = jnp.asarray(_packed_segments(rng, b, s))
+        want = packed_attention_reference(q, k, v, seg, causal=True)
+        got = jax.jit(
+            lambda q, k, v, seg: ring_packed_attention(
+                q, k, v, seg, mesh, zigzag=True
+            )
+        )(q, k, v, seg)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5
+        )
+
+    def test_gradients_match(self, rng):
+        pc = ParallelConfig.from_str("d1s4")
+        mesh = make_mesh(pc, jax.devices()[:4])
+        b, s, h, d = 2, 32, 2, 8
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        seg = jnp.asarray(_packed_segments(rng, b, s))
+        w = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(packed_attention_reference(q, k, v, seg) * w)
+
+        def loss_zz(q, k, v):
+            return jnp.sum(
+                ring_packed_attention(q, k, v, seg, mesh, zigzag=True) * w
+            )
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_zz = jax.jit(jax.grad(loss_zz, argnums=(0, 1, 2)))(q, k, v)
+        for a, b_ in zip(g_ref, g_zz):
+            np.testing.assert_allclose(
+                np.asarray(b_), np.asarray(a), atol=2e-4
+            )
+
+    def test_fewer_flops_than_contiguous(self, rng):
+        """The point of the layout: compiled attention FLOPs drop to
+        ~(2n+1)/4n of the contiguous ring's (0.56 at n=4)."""
+        pc = ParallelConfig.from_str("d1s4")
+        mesh = make_mesh(pc, jax.devices()[:4])
+        b, s, h, d = 1, 1024, 4, 32
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        seg = jnp.ones((b, s), jnp.int32)
+
+        def fl(zz):
+            f = jax.jit(
+                lambda q, seg: ring_packed_attention(
+                    q, q, q, seg, mesh, zigzag=zz
+                )
+            )
+            an = f.lower(q, seg).compile().cost_analysis()
+            if isinstance(an, (list, tuple)):
+                an = an[0]
+            return float(an["flops"])
+
+        ratio = fl(True) / fl(False)
+        assert ratio < 0.75, ratio
+
+    def test_falls_back_when_indivisible(self, rng):
+        """S not divisible by 2n silently uses the contiguous ring."""
+        pc = ParallelConfig.from_str("d1s4")
+        mesh = make_mesh(pc, jax.devices()[:4])
+        b, s, h, d = 1, 36, 2, 8  # 36 % 8 != 0, but 36 % 4 == 0
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        seg = jnp.ones((b, s), jnp.int32)
+        want = packed_attention_reference(q, q, q, seg, causal=True)
+        got = jax.jit(
+            lambda q, seg: ring_packed_attention(
+                q, q, q, seg, mesh, zigzag=True
+            )
+        )(q, seg)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5
+        )
