@@ -160,6 +160,10 @@ class PPOMathConfig:
     actor_device_offset: Optional[int] = None
     gen_device_offset: Optional[int] = None
     critic_parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    # None = the actor's layout.  An independent ref layout makes every
+    # MFC re-parallelizable on its own (the reference's "global reshard"
+    # shape, tests/experiments/test_math_ppo.py:124-199).
+    ref_parallel: Optional[ParallelConfig] = None
     # Extra kwargs for the critic interface (e.g. value_norm=True,
     # value_norm_type="exp" — reference ppo_interface.py:175-210).
     critic_interface_args: Dict[str, Any] = dataclasses.field(
@@ -490,7 +494,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                     fused_if if fuse
                     else ModelInterfaceAbstraction("ppo_actor")
                 ),
-                parallel=cfg.actor_parallel,
+                parallel=cfg.ref_parallel or cfg.actor_parallel,
                 device_offset=cfg.actor_device_offset,
             )
         )

@@ -390,18 +390,24 @@ def _backbone(
     from areal_tpu.base.topology import SEQ_AXIS as _SEQ
 
     zz_inv = None
-    if (
-        cp_mesh is not None
-        and os.environ.get("AREAL_RING_ZIGZAG") == "1"
-        and x.shape[1] % (2 * cp_mesh.shape[_SEQ]) == 0
-    ):
-        from areal_tpu.ops.ring_attention import zigzag_indices
+    if cp_mesh is not None and os.environ.get("AREAL_RING_ZIGZAG") == "1":
+        if x.shape[1] % (2 * cp_mesh.shape[_SEQ]) == 0:
+            from areal_tpu.ops.ring_attention import zigzag_indices
 
-        idx, zz_inv = zigzag_indices(x.shape[1], cp_mesh.shape[_SEQ])
-        x = jnp.take(x, idx, axis=1)
-        segment_ids = jnp.take(segment_ids, idx, axis=1)
-        cos = jnp.take(cos, idx, axis=1)
-        sin = jnp.take(sin, idx, axis=1)
+            idx, zz_inv = zigzag_indices(x.shape[1], cp_mesh.shape[_SEQ])
+            x = jnp.take(x, idx, axis=1)
+            segment_ids = jnp.take(segment_ids, idx, axis=1)
+            cos = jnp.take(cos, idx, axis=1)
+            sin = jnp.take(sin, idx, axis=1)
+        else:
+            from areal_tpu.base import logging as _logging
+
+            # Never let a benchmark believe it measured zigzag when the
+            # shape quietly fell back to the contiguous ring.
+            _logging.getLogger("transformer").warning(
+                f"AREAL_RING_ZIGZAG ignored: row length {x.shape[1]} not "
+                f"divisible by 2*seq={2 * cp_mesh.shape[_SEQ]}"
+            )
 
     def body(carry, blk):
         y, aux = _block_forward(

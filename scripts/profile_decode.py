@@ -9,7 +9,11 @@ judged against the physical limit instead of guessed at.  Runs on the
 real chip; falls back to CPU for a smoke run.
 
 Usage: python scripts/profile_decode.py [--size 1.5b] [--batches 8,32]
-       [--windows 1280,4096] [--steps 64]
+       [--windows 1280,4096] [--steps 64] [--platform cpu]
+
+--platform cpu forces the CPU backend BEFORE backend init (a site PJRT
+plugin may ignore JAX_PLATFORMS, and a wedged device tunnel hangs any
+default-backend probe forever).
 """
 
 import argparse
@@ -28,9 +32,13 @@ def main():
     p.add_argument("--steps", type=int, default=64)
     # v5e: ~819 GB/s HBM. Override per chip (v5p ~2765, v4 ~1228).
     p.add_argument("--hbm-gbps", type=float, default=819.0)
+    p.add_argument("--platform", default="auto", choices=("auto", "cpu"))
     args = p.parse_args()
 
     import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 

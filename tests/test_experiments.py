@@ -496,3 +496,67 @@ class TestAsyncRollout:
         assert master.step_info.global_step == 4
         for s in stats:
             assert np.isfinite(s["actor_train/importance_weight"])
+
+
+class TestGlobalReshard:
+    def test_every_mfc_different_layout(self, tmp_path):
+        """The reference's 'global reshard' case (test_math_ppo.py:124-199):
+        every MFC runs under a DIFFERENT 3D layout on the same two devices
+        — actor trains d2 (pure DP), generation runs m2 (TP), the ref
+        scores f2 (ZeRO-sharded), the critic trains d1m2 — and the math
+        must equal a single-layout run (resharding moves bytes, never
+        values)."""
+        tok = fixtures.make_tokenizer()
+        rows = fixtures.build_math_rows(8, seed=4)
+        id2info = {r["query_id"]: r for r in rows}
+
+        def make_cfg(reshard: bool, root):
+            return PPOMathConfig(
+                actor=ModelAbstraction("random", {"config": tiny_config()}),
+                ref=ModelAbstraction("random", {"config": tiny_config()}),
+                critic=ModelAbstraction(
+                    "random", {"config": tiny_config(is_critic=True)}
+                ),
+                dataset=DatasetAbstraction(
+                    "math_code_prompt",
+                    {"dataset_builder": lambda: rows, "max_length": 64},
+                ),
+                reward_interface_args={"id2info": id2info},
+                gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+                ppo_kwargs={"n_minibatches": 2, "kl_ctl": 0.1},
+                optimizer=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
+                actor_parallel=ParallelConfig.from_str(
+                    "d2" if reshard else "d1"
+                ),
+                gen_parallel=ParallelConfig.from_str(
+                    "m2" if reshard else "d1"
+                ),
+                ref_parallel=ParallelConfig.from_str(
+                    "f2" if reshard else "d1"
+                ),
+                critic_parallel=ParallelConfig.from_str(
+                    "d1m2" if reshard else "d1"
+                ),
+                batch_size=4,
+                total_train_epochs=1,
+                ctrl=ExperimentSaveEvalControl(benchmark_steps=2),
+                fileroot=str(root),
+            )
+
+        _, stats = run_experiment(
+            build_ppo_math(make_cfg(True, tmp_path / "re"), tok),
+            tokenizer=tok,
+        )
+        assert np.isfinite(stats[-1]["actor_train/actor_loss"])
+        assert abs(stats[0]["actor_train/importance_weight"] - 1.0) < 5e-2
+
+        _, stats1 = run_experiment(
+            build_ppo_math(make_cfg(False, tmp_path / "solo"), tok),
+            tokenizer=tok,
+        )
+        for k, v in stats1[-1].items():
+            if "perf/" in k or "time/" in k:
+                continue
+            assert np.isclose(stats[-1][k], v, rtol=1e-3, atol=1e-5), (
+                k, stats[-1][k], v,
+            )
