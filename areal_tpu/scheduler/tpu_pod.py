@@ -144,11 +144,15 @@ class TPUPodSchedulerClient(SchedulerClient):
             f"{cd}{payload} >{shlex.quote(log)} 2>&1; "
             f"echo $? >{shlex.quote(log)}.exit"
         )
+        # The brace group is load-bearing: a bare `a && b && nohup ... &
+        # echo $!` backgrounds the WHOLE and-list (shell grammar binds `&`
+        # to the list), racing the pid-file write against mkdir and
+        # swallowing mkdir/rm failures into rc=0.
         return (
             f"mkdir -p {shlex.quote(self.log_root)} && "
             f"rm -f {shlex.quote(log)}.exit && "
-            f"nohup sh -c {shlex.quote(inner)} >/dev/null 2>&1 & "
-            f"echo $! >{shlex.quote(pid)} # {tag}"
+            f"{{ nohup sh -c {shlex.quote(inner)} >/dev/null 2>&1 & "
+            f"echo $! >{shlex.quote(pid)}; }} # {tag}"
         )
 
     # -------------- SchedulerClient surface --------------
