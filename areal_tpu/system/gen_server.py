@@ -482,53 +482,108 @@ class ZMQGenClient:
     LLMAPIClient where RemoteGeneratorEngine needs it."""
 
     def __init__(self, url: str, timeout_s: float = 7200.0, token: str = ""):
-        import zmq
-
         assert url.startswith("zmq://"), url
         self.url = url
         self.timeout_s = timeout_s
         self.token = token or os.environ.get("AREAL_GEN_TOKEN", "")
-        self._sock = zmq.Context.instance().socket(zmq.DEALER)
-        self._sock.connect("tcp://" + url[len("zmq://"):])
+        # ZMQ sockets are not thread-safe, so ONE IO thread owns the
+        # DEALER; callers enqueue frames and wait on per-rid futures.  A
+        # simple send+recv-under-lock design would serialize CONCURRENT
+        # callers (each holding the lock for a full generation round
+        # trip) — with futures, any number of threads/tasks pipeline
+        # their requests over the one connection.
+        import concurrent.futures as _cf
+
+        self._send_q: "queue.Queue[bytes]" = queue.Queue()
+        self._pending: Dict[int, _cf.Future] = {}
+        self._plock = threading.Lock()
         self._rid = 0
-        # One socket, possibly called from pool threads: serialize.
-        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._ready = threading.Event()
+        self._io = threading.Thread(
+            target=self._io_loop,
+            args=("tcp://" + url[len("zmq://"):],),
+            daemon=True,
+        )
+        self._io.start()
+
+    def _io_loop(self, addr: str) -> None:
+        import zmq
+
+        sock = zmq.Context.instance().socket(zmq.DEALER)
+        sock.connect(addr)
+        self._ready.set()
+        while not self._stop_evt.is_set():
+            try:
+                while True:
+                    sock.send(self._send_q.get_nowait())
+            except queue.Empty:
+                pass
+            if not sock.poll(10):
+                continue
+            msg = json.loads(sock.recv())
+            rid = msg.pop("rid", None)
+            with self._plock:
+                if rid is None:
+                    # Uncorrelated error (unparsable frame): fail every
+                    # outstanding request rather than letting any caller
+                    # sit out its timeout.
+                    failed = list(self._pending.values())
+                    self._pending.clear()
+                else:
+                    f = self._pending.pop(rid, None)
+                    failed = []
+            if rid is None:
+                for f in failed:
+                    f.set_exception(RuntimeError(
+                        f"generation server error: {msg.get('error')}"
+                    ))
+            elif f is not None:
+                if "error" in msg:
+                    f.set_exception(RuntimeError(
+                        f"generation server error: {msg['error']}"
+                    ))
+                else:
+                    f.set_result(msg)
+        sock.close(linger=200)
+
+    def close(self) -> None:
+        self._stop_evt.set()
 
     def _call_many(self, reqs: List[Dict]) -> List[Dict]:
-        with self._lock:
-            rids = []
+        import concurrent.futures as _cf
+
+        self._ready.wait(30)
+        futs = []
+        with self._plock:
             for req in reqs:
                 self._rid += 1
-                req = dict(req, rid=self._rid, token=self.token)
-                rids.append(self._rid)
-                self._sock.send(json.dumps(req).encode())
-            want = set(rids)
-            got: Dict[int, Dict] = {}
-            deadline = time.monotonic() + self.timeout_s
-            while want:
-                left = deadline - time.monotonic()
-                if left <= 0 or not self._sock.poll(
-                    min(left, 1.0) * 1000
-                ):
-                    if time.monotonic() >= deadline:
-                        raise TimeoutError(
-                            f"generation server {self.url}: "
-                            f"{len(want)} replies missing after "
-                            f"{self.timeout_s}s"
-                        )
-                    continue
-                msg = json.loads(self._sock.recv())
-                rid = msg.pop("rid", None)
-                if "error" in msg and (rid is None or rid in want):
-                    # rid-less errors (unparsable frame) also fail fast —
-                    # never sit out the timeout on a dead request.
-                    raise RuntimeError(
-                        f"generation server error: {msg['error']}"
-                    )
-                if rid in want:
-                    got[rid] = msg
-                    want.discard(rid)
-            return [got[r] for r in rids]
+                rid = self._rid
+                f: _cf.Future = _cf.Future()
+                self._pending[rid] = f
+                futs.append((rid, f))
+                self._send_q.put(
+                    json.dumps(
+                        dict(req, rid=rid, token=self.token)
+                    ).encode()
+                )
+        deadline = time.monotonic() + self.timeout_s
+        out = []
+        try:
+            for rid, f in futs:
+                left = max(deadline - time.monotonic(), 0.001)
+                try:
+                    out.append(f.result(timeout=left))
+                except _cf.TimeoutError:
+                    raise TimeoutError(
+                        f"generation server {self.url}: no reply for "
+                        f"request {rid} within {self.timeout_s}s"
+                    ) from None
+        finally:
+            with self._plock:
+                for rid, f in futs:
+                    self._pending.pop(rid, None)
+        return out
 
     def health(self) -> Dict:
         return self._call_many([{"cmd": "health"}])[0]
@@ -561,6 +616,11 @@ class ZMQGenClient:
 
     def generate(self, inp: APIGenerateInput) -> APIGenerateOutput:
         return self.generate_batch([inp])[0]
+
+    async def agenerate(self, inp: APIGenerateInput) -> APIGenerateOutput:
+        import asyncio
+
+        return await asyncio.to_thread(self.generate, inp)
 
     def update_weights_from_disk(self, path: str) -> int:
         out = self._call_many([{"cmd": "update_weights", "path": path}])[0]

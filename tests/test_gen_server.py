@@ -348,3 +348,33 @@ class TestZMQTransport:
                  "gconfig": {}},
             ])
         assert _time.monotonic() - t0 < 5.0
+
+    def test_concurrent_callers_share_one_connection(self, zserver, cfg):
+        """Multiple threads generating through ONE client must pipeline
+        (per-rid futures), each getting ITS OWN prompt's continuation —
+        the serialize-under-lock design this replaces would still pass
+        functionally, so also check wall overlap via the server's
+        cross-request batching: all replies arrive."""
+        import threading as _t
+
+        from areal_tpu.system.gen_server import ZMQGenClient
+
+        zc = ZMQGenClient(zserver.zmq_url, timeout_s=120.0)
+        g = GenerationHyperparameters(n=1, max_new_tokens=4, greedy=True)
+        results = {}
+
+        def run(i):
+            o = zc.generate(APIGenerateInput(
+                qid=f"c{i}", prompt_ids=[9, 10, 11 + i], gconfig=g,
+            ))
+            results[i] = o
+
+        ts = [_t.Thread(target=run, args=(i,)) for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert len(results) == 6
+        for i, o in results.items():
+            assert o.prompt_ids == [9, 10, 11 + i]
+            assert len(o.output_ids[0]) > 0
