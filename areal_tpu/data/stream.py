@@ -23,6 +23,7 @@ O(new rows), and `id2info` accumulates row metadata for reward grading.
 """
 
 import json
+import os
 from typing import Any, Dict, List, Optional
 
 import zmq
@@ -55,16 +56,20 @@ class RowPusher:
         addr: Optional[str] = None,
         timeout: float = 30.0,
         hwm: int = 1000,
+        token: str = "",
     ):
         if addr is None:
             addr = name_resolve.wait(
                 stream_name(experiment, trial, dp_rank), timeout=timeout
             )
+        self.token = token or os.environ.get("AREAL_STREAM_TOKEN", "")
         self._sock = zmq.Context.instance().socket(zmq.PUSH)
         self._sock.setsockopt(zmq.SNDHWM, hwm)
         self._sock.connect(f"tcp://{addr}")
 
     def push(self, row: Dict[str, Any]) -> None:
+        if self.token:
+            row = dict(row, __token=self.token)
         self._sock.send(json.dumps(row).encode())
 
     def push_many(self, rows: List[Dict[str, Any]]) -> None:
@@ -102,6 +107,8 @@ class StreamDataset:
         startup_timeout_s: float = 300.0,
         experiment: str = "",
         trial: str = "",
+        host: str = "127.0.0.1",
+        token: str = "",
     ):
         self.seed = seed
         self.dp_rank = dp_rank
@@ -114,16 +121,48 @@ class StreamDataset:
         self._items: List[SequenceSample] = []
         self._ids: List[str] = []
         self._dropped: set = set()  # difficulty-filtered ids
+        # Pushed rows become TRAINING DATA and grading metadata: an open
+        # unauthenticated bind would let any network peer poison rewards
+        # (supply its own 'solutions').  Same policy as the generation
+        # server: loopback by default; a wider bind needs a shared token
+        # (AREAL_STREAM_TOKEN) or an explicit insecure opt-in.
+        self.token = token or os.environ.get("AREAL_STREAM_TOKEN", "")
+        if not self.token and host not in ("127.0.0.1", "localhost"):
+            if os.environ.get("AREAL_GEN_INSECURE") != "1":
+                raise ValueError(
+                    f"refusing to bind stream dataset on {host} without a "
+                    "token: set token=/AREAL_STREAM_TOKEN, or "
+                    "AREAL_GEN_INSECURE=1 to accept rows from anyone"
+                )
+            logger.warning(
+                f"INSECURE: stream dataset on {host} with no token — any "
+                "peer can inject training rows and grading metadata"
+            )
         self._sock = zmq.Context.instance().socket(zmq.PULL)
+        bind_host = {"localhost": "127.0.0.1"}.get(host, host)
         port = network.find_free_port()
-        self._sock.bind(f"tcp://0.0.0.0:{port}")
-        self.addr = f"{network.gethostip()}:{port}"
+        self._sock.bind(f"tcp://{bind_host}:{port}")
+        self.addr = (
+            f"{network.gethostip()}:{port}"
+            if bind_host not in ("127.0.0.1",)
+            else f"127.0.0.1:{port}"
+        )
         if experiment and trial:
             name_resolve.add(
                 stream_name(experiment, trial, dp_rank),
                 self.addr,
                 replace=True,
             )
+            if bind_host == "127.0.0.1":
+                # Published for discovery but bound to loopback: remote
+                # producers would dial THEIR OWN localhost and stall
+                # silently.  Cross-host feeding needs host="0.0.0.0" plus
+                # a token.
+                logger.warning(
+                    "stream dataset published via name_resolve but bound "
+                    "to 127.0.0.1 — only same-host producers can reach "
+                    'it (pass host="0.0.0.0" and a token for cross-host)'
+                )
         logger.info(
             f"stream dataset (dp {dp_rank}) listening at {self.addr}"
         )
@@ -163,6 +202,19 @@ class StreamDataset:
             self._sock.poll(min(int(left * 1000) + 1, 500))
 
     def _ingest(self, rows: List[Dict[str, Any]]) -> None:
+        if self.token:
+            n0 = len(rows)
+            rows = [
+                r for r in rows if r.pop("__token", None) == self.token
+            ]
+            if len(rows) != n0:
+                logger.warning(
+                    f"stream dataset: dropped {n0 - len(rows)} rows with "
+                    "missing/bad token"
+                )
+        else:
+            for r in rows:
+                r.pop("__token", None)
         rows = [
             r for r in rows
             if str(r.get("query_id", r.get("id"))) not in self._dropped

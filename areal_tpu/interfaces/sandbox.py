@@ -5,7 +5,8 @@ sandbox (realhf/functioncall/code/verify.py); its local fallback
 (local_verify) is a bare subprocess.  Here the LOCAL path itself is
 fenced, since TPU trials routinely grade model-written code in-process:
 
-- rlimits (preexec, applied inside the child): CPU seconds, address
+- rlimits (a `sh -c 'ulimit ...'` wrapper — no preexec_fn, which is
+  fork-unsafe in threaded hosts): CPU seconds, address
   space, file size, process/thread count, open files, core dumps off;
 - a throwaway tmpdir jail as cwd (the program file lives there; the dir
   is deleted after grading);
@@ -22,7 +23,6 @@ remote reward service on an isolated machine (interfaces/reward_service).
 """
 
 import os
-import resource
 import shutil
 import subprocess
 from typing import List, Optional, Tuple
@@ -59,26 +59,40 @@ def _unshare_prefix() -> List[str]:
     return _UNSHARE
 
 
-def _set_limits(cpu_s: int, mem_mb: int, fsize_mb: int, nproc: Optional[int]):
-    def apply():
-        resource.setrlimit(resource.RLIMIT_CPU, (cpu_s, cpu_s + 1))
-        resource.setrlimit(
-            resource.RLIMIT_AS, (mem_mb << 20, mem_mb << 20)
-        )
-        resource.setrlimit(
-            resource.RLIMIT_FSIZE, (fsize_mb << 20, fsize_mb << 20)
-        )
-        # NPROC is a PER-UID limit (threads included): the cap must sit
-        # above the trial user's existing task count — a busy JAX host
-        # easily holds hundreds — or legitimate solutions that fork/thread
-        # fail with EAGAIN and grade as wrong.  The default (4096) only
-        # stops runaway fork bombs; pass nproc=None to skip entirely.
-        if nproc is not None:
-            resource.setrlimit(resource.RLIMIT_NPROC, (nproc, nproc))
-        resource.setrlimit(resource.RLIMIT_NOFILE, (256, 256))
-        resource.setrlimit(resource.RLIMIT_CORE, (0, 0))
+def _ulimit_wrapper(
+    cpu_s: int, mem_mb: int, fsize_mb: int, nproc: Optional[int]
+) -> List[str]:
+    """Apply rlimits via a `sh -c 'ulimit ...; exec "$@"'` wrapper rather
+    than preexec_fn: running Python between fork and exec is documented
+    deadlock-prone in multithreaded processes, and reward grading runs
+    inside model workers full of ZMQ/JAX threads — a child wedged in
+    _set_limits would burn the whole timeout and grade a correct solution
+    as wrong.  The shell applies limits post-exec (posix_spawn-safe).
 
-    return apply
+    NPROC is a PER-UID limit (threads included): the cap must sit above
+    the trial user's existing task count — a busy JAX host easily holds
+    hundreds — or legitimate solutions that fork/thread fail with EAGAIN
+    and grade as wrong.  The default (4096) only stops runaway fork
+    bombs; nproc=None skips it.  `ulimit -v` is in KiB, `-f` in 512-byte
+    blocks, `-t` in seconds."""
+    # Mandatory limits are &&-joined: if one fails to apply, the graded
+    # program must NOT run unlimited (fail closed, like the setrlimit
+    # error the old preexec_fn surfaced) — the run grades False via the
+    # nonzero shell exit.
+    parts = [
+        f"ulimit -t {cpu_s + 1}",
+        f"ulimit -v {mem_mb << 10}",
+        f"ulimit -f {(fsize_mb << 20) // 512}",
+        "ulimit -n 256",
+        "ulimit -c 0",
+    ]
+    script = " && ".join(parts)
+    if nproc is not None:
+        # Not all shells implement -u; failing to tighten this optional
+        # fork-bomb cap must not fail the grading run.
+        script += f" && {{ ulimit -u {nproc} 2>/dev/null || true; }}"
+    script += ' && exec "$@"'
+    return ["sh", "-c", script, "sh"]
 
 
 def run_sandboxed(
@@ -93,7 +107,9 @@ def run_sandboxed(
     """Run `argv` jailed; returns (returncode, stdout).  Timeouts and
     resource kills surface as nonzero returncodes (-1 for wall timeout)."""
     proc = subprocess.Popen(
-        _unshare_prefix() + argv,
+        _unshare_prefix()
+        + _ulimit_wrapper(max(1, int(timeout_s)), mem_mb, fsize_mb, nproc)
+        + argv,
         stdin=subprocess.PIPE,
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
@@ -101,9 +117,6 @@ def run_sandboxed(
         cwd=cwd,
         env={"PATH": "/usr/bin:/bin", "HOME": cwd or "/tmp"},
         start_new_session=True,
-        preexec_fn=_set_limits(
-            max(1, int(timeout_s)), mem_mb, fsize_mb, nproc
-        ),
     )
     try:
         stdout, _ = proc.communicate(input=input_text, timeout=timeout_s)

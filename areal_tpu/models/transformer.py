@@ -337,6 +337,22 @@ def _block_forward(
     return x + mlp_out, aux
 
 
+_ZIGZAG_SNAPSHOT: "Optional[bool]" = None
+
+
+def _zigzag_enabled() -> bool:
+    """AREAL_RING_ZIGZAG, read ONCE: the value is baked into traced
+    programs, and jit caches do not key on it — honoring later toggles
+    only sometimes (cache misses) would make layout comparisons silently
+    measure the same variant twice.  Set the env var before first use."""
+    global _ZIGZAG_SNAPSHOT
+    if _ZIGZAG_SNAPSHOT is None:
+        import os
+
+        _ZIGZAG_SNAPSHOT = os.environ.get("AREAL_RING_ZIGZAG") == "1"
+    return _ZIGZAG_SNAPSHOT
+
+
 def _backbone(
     params: Params,
     cfg: ModelConfig,
@@ -353,11 +369,9 @@ def _backbone(
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
     if pp_mesh is not None:
-        import os
-
         from areal_tpu.parallel.pipeline import pipelined_blocks
 
-        if cp_mesh is not None and os.environ.get("AREAL_RING_ZIGZAG") == "1":
+        if cp_mesh is not None and _zigzag_enabled():
             from areal_tpu.base import logging as _logging
 
             # The CP+PP schedule keeps the contiguous layout: zigzag
@@ -385,12 +399,10 @@ def _backbone(
     # layer stack (every other op is per-token; attention sees original
     # positions via cos/sin + segment ids traveling with the tokens) and
     # invert after the final norm.
-    import os
-
     from areal_tpu.base.topology import SEQ_AXIS as _SEQ
 
     zz_inv = None
-    if cp_mesh is not None and os.environ.get("AREAL_RING_ZIGZAG") == "1":
+    if cp_mesh is not None and _zigzag_enabled():
         if x.shape[1] % (2 * cp_mesh.shape[_SEQ]) == 0:
             from areal_tpu.ops.ring_attention import zigzag_indices
 

@@ -507,44 +507,73 @@ class ZMQGenClient:
         )
         self._io.start()
 
+    def _fail_all(self, err: str) -> None:
+        with self._plock:
+            failed = list(self._pending.values())
+            self._pending.clear()
+        for f in failed:
+            if not f.done():
+                f.set_exception(RuntimeError(err))
+
     def _io_loop(self, addr: str) -> None:
+        import collections
+
         import zmq
 
         sock = zmq.Context.instance().socket(zmq.DEALER)
         sock.connect(addr)
         self._ready.set()
+        outbox: "collections.deque[bytes]" = collections.deque()
         while not self._stop_evt.is_set():
+            # The loop must SURVIVE (a dead IO thread strands every
+            # pending and future request until its full timeout) and must
+            # never block uninterruptibly (a dead server + full SNDHWM
+            # would wedge a blocking send forever, making close() a no-op).
             try:
-                while True:
-                    sock.send(self._send_q.get_nowait())
-            except queue.Empty:
-                pass
-            if not sock.poll(10):
-                continue
-            msg = json.loads(sock.recv())
-            rid = msg.pop("rid", None)
-            with self._plock:
+                try:
+                    while True:
+                        outbox.append(self._send_q.get_nowait())
+                except queue.Empty:
+                    pass
+                while outbox:
+                    try:
+                        sock.send(outbox[0], zmq.NOBLOCK)
+                        outbox.popleft()
+                    except zmq.Again:
+                        break  # HWM full: retry next tick, stay stoppable
+                if not sock.poll(10):
+                    continue
+                try:
+                    msg = json.loads(sock.recv())
+                except (ValueError, UnicodeDecodeError):
+                    # One garbled frame cannot be correlated: fail all
+                    # outstanding (never silently kill the thread).
+                    self._fail_all("generation server sent a garbled frame")
+                    continue
+                rid = msg.pop("rid", None)
                 if rid is None:
-                    # Uncorrelated error (unparsable frame): fail every
-                    # outstanding request rather than letting any caller
-                    # sit out its timeout.
-                    failed = list(self._pending.values())
-                    self._pending.clear()
-                else:
-                    f = self._pending.pop(rid, None)
-                    failed = []
-            if rid is None:
-                for f in failed:
-                    f.set_exception(RuntimeError(
+                    self._fail_all(
                         f"generation server error: {msg.get('error')}"
-                    ))
-            elif f is not None:
-                if "error" in msg:
-                    f.set_exception(RuntimeError(
-                        f"generation server error: {msg['error']}"
-                    ))
-                else:
-                    f.set_result(msg)
+                    )
+                    continue
+                with self._plock:
+                    f = self._pending.pop(rid, None)
+                if f is not None and not f.done():
+                    if "error" in msg:
+                        f.set_exception(RuntimeError(
+                            f"generation server error: {msg['error']}"
+                        ))
+                    else:
+                        f.set_result(msg)
+            except zmq.ContextTerminated:
+                # Process/context teardown: nothing left to serve.
+                self._fail_all("generation client context terminated")
+                return
+            except Exception as e:  # noqa: BLE001 — zmq/system errors
+                logger.exception("gen client io error")
+                self._fail_all(f"generation client io error: {e!r}")
+                # Persistent socket errors must not become a hot loop.
+                time.sleep(0.05)
         sock.close(linger=200)
 
     def close(self) -> None:

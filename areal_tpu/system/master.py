@@ -408,22 +408,33 @@ class MasterWorker:
         if waits:
             await asyncio.gather(*waits)
 
-    def _acc_xfer(self, kind: str, send_r: Dict, recv_r: Optional[Dict] = None):
-        """Fold one transfer's reply metrics into this step's accounting."""
+    def _acc_xfer(
+        self,
+        kind: str,
+        send_r: Optional[Dict] = None,
+        recv_r: Optional[Dict] = None,
+        count: bool = True,
+    ):
+        """Fold one transfer's reply metrics into this step's accounting.
+        Either side may be absent (e.g. param recvs arrive separately from
+        their sends); `count` increments the per-kind transfer counter."""
         acc = self._xfer_acc
-        acc[f"{kind}_bytes"] = (
-            acc.get(f"{kind}_bytes", 0.0) + float(send_r.get("bytes", 0) or 0)
-        )
-        acc[f"{kind}_send_s"] = (
-            acc.get(f"{kind}_send_s", 0.0)
-            + float(send_r.get("seconds", 0.0) or 0.0)
-        )
+        if send_r is not None:
+            acc[f"{kind}_bytes"] = (
+                acc.get(f"{kind}_bytes", 0.0)
+                + float(send_r.get("bytes", 0) or 0)
+            )
+            acc[f"{kind}_send_s"] = (
+                acc.get(f"{kind}_send_s", 0.0)
+                + float(send_r.get("seconds", 0.0) or 0.0)
+            )
         if recv_r is not None:
             acc[f"{kind}_recv_s"] = (
                 acc.get(f"{kind}_recv_s", 0.0)
                 + float(recv_r.get("seconds", 0.0) or 0.0)
             )
-        acc[f"{kind}_count"] = acc.get(f"{kind}_count", 0.0) + 1.0
+        if count:
+            acc[f"{kind}_count"] = acc.get(f"{kind}_count", 0.0) + 1.0
 
     def _group(self, model_key: str) -> List[int]:
         return self.groups.get(model_key, [self.placement[model_key]])
@@ -643,6 +654,8 @@ class MasterWorker:
                 )
                 for send_r in resps[: len(group)]:
                     self._acc_xfer("param", send_r)
+                for recv_r in resps[len(group):]:
+                    self._acc_xfer("param", recv_r=recv_r, count=False)
 
     async def _apply_difficulty_filter(self):
         """Remove prompts whose group accuracy this step falls outside the

@@ -154,8 +154,15 @@ class ZMQTransfer(TransferPlane):
                 f"worker {self.worker_index}: no transfer within {timeout}s"
             )
         frames = self._pull.recv_multipart(copy=False)
+        # Reconstruct over WRITABLE bytearrays (one memcpy per buffer):
+        # arrays built over read-only zmq frame memory would diverge from
+        # the in-process plane (which delivers ordinary writable arrays)
+        # and crash any in-place consumer only on multi-process runs —
+        # exactly where CI coverage is thinnest.  The send side stays
+        # zero-copy; this is the single unavoidable receive copy.
         return pickle.loads(
-            frames[0].buffer, buffers=[f.buffer for f in frames[1:]]
+            frames[0].buffer,
+            buffers=[bytearray(f.buffer) for f in frames[1:]],
         )
 
     def close(self) -> None:

@@ -511,6 +511,7 @@ class ModelWorker:
 
         from areal_tpu.base.distributed import to_host
 
+        t0 = time.monotonic()
         kind, host = self._recv_xfer(req["xfer_id"])
         assert kind == "params", kind
         eng = self.models[req["model_name"]].engine
@@ -526,7 +527,7 @@ class ModelWorker:
                 cur,
             )
             eng.set_params(mixed)
-        return {}
+        return {"seconds": time.monotonic() - t0}
 
     def _handle_param_sync(self, req):
         """Copy/EMA params src -> dst (generator hot-swap, EMA ref).

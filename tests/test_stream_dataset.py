@@ -37,14 +37,16 @@ def ds():
 
 
 def _push(ds, rows):
+    before = len(ds)
     p = RowPusher(addr=ds.addr)
     p.push_many(rows)
     p.close()
-    # PUSH/PULL is async: poll until delivered.
+    # PUSH/PULL is async: poll until ALL new rows delivered (waiting for
+    # len(rows) alone races when the dataset already holds items).
     import time
 
-    for _ in range(100):
-        if len(ds) >= len(rows):
+    for _ in range(200):
+        if len(ds) >= before + len(rows):
             return
         time.sleep(0.02)
 
@@ -133,3 +135,39 @@ class TestStreamDataset:
 
     def test_registered_in_registry(self):
         assert "stream" in data_api.ALL_DATASET_CLASSES
+
+
+class TestStreamAuth:
+    def test_bad_token_rows_dropped(self):
+        d = StreamDataset(
+            seed=0, dp_rank=0, world_size=1,
+            tokenizer=fixtures.make_tokenizer(),
+            min_rows=0, token="sekret",
+        )
+        try:
+            good = RowPusher(addr=d.addr, token="sekret")
+            bad = RowPusher(addr=d.addr, token="wrong")
+            none = RowPusher(addr=d.addr)
+            bad.push_many(_rows(2))
+            none.push_many(_rows(2, start=10))
+            good.push_many(_rows(3, start=20))
+            import time
+
+            for _ in range(100):
+                if len(d) >= 3:
+                    break
+                time.sleep(0.02)
+            assert len(d) == 3
+            assert all(qid.startswith("s2") for qid in d.id2info)
+            for p in (good, bad, none):
+                p.close()
+        finally:
+            d.close()
+
+    def test_open_bind_needs_token(self):
+        with pytest.raises(ValueError, match="token"):
+            StreamDataset(
+                seed=0, dp_rank=0, world_size=1,
+                tokenizer=fixtures.make_tokenizer(),
+                min_rows=0, host="0.0.0.0",
+            )
