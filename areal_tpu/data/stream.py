@@ -187,11 +187,28 @@ class StreamDataset:
         deadline = time.monotonic() + block_ms / 1000.0
         while True:
             rows: List[Dict[str, Any]] = []
+            n_bad = 0
             while True:
                 try:
-                    rows.append(json.loads(self._sock.recv(zmq.NOBLOCK)))
+                    raw = self._sock.recv(zmq.NOBLOCK)
                 except zmq.Again:
                     break
+                # A malformed frame (hostile peer, buggy producer) must
+                # never kill the training loop — the token protects row
+                # INTEGRITY; this protects availability.
+                try:
+                    row = json.loads(raw)
+                except (ValueError, UnicodeDecodeError):
+                    n_bad += 1
+                    continue
+                if isinstance(row, dict):
+                    rows.append(row)
+                else:
+                    n_bad += 1
+            if n_bad:
+                logger.warning(
+                    f"stream dataset: dropped {n_bad} malformed frames"
+                )
             if rows:
                 self._ingest(rows)
             if not until or len(self._items) >= until:

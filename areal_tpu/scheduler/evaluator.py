@@ -35,6 +35,10 @@ class EvalConfig:
     max_prompts: Optional[int] = None
     parallel: str = "d1"
     batch_size: int = 64
+    # Applied to each row's prompt before tokenization (the reference's
+    # prompt_type templating, e.g. a chat wrapper):
+    #   --prompt-template $'<|user|>\n{prompt}\n<|assistant|>\n'
+    prompt_template: str = "{prompt}"
     # "greedy": one greedy sample per prompt (cheap smoke eval).
     # "avg@K" (e.g. "avg@32"): the reference's headline protocol — K
     # temperature-1.0 samples per prompt, score = pass@1 AVERAGED over all
@@ -213,7 +217,10 @@ def _eval_one_dataset(
         parts = []
         for i, r in enumerate(chunk):
             toks = np.asarray(
-                tokenizer.encode(r["prompt"]), dtype=np.int32
+                tokenizer.encode(
+                    config.prompt_template.format(prompt=r["prompt"])
+                ),
+                dtype=np.int32,
             )
             if len(toks) == 0:
                 toks = np.asarray([tokenizer.eos_token_id], np.int32)
@@ -376,6 +383,9 @@ def main():
     p.add_argument("--n-samples", type=int, default=1)
     p.add_argument("--max-prompts", type=int, default=None)
     p.add_argument("--parallel", default="d1")
+    p.add_argument("--prompt-template", default="{prompt}",
+                   help="format string applied to each prompt before "
+                        "tokenization (chat wrappers etc.)")
     p.add_argument("--protocol", default="greedy",
                    help="'greedy' or 'avg@K' (e.g. avg@32: the AIME "
                         "avg-of-32 pass@1 protocol at temperature 1.0)")
@@ -393,6 +403,7 @@ def main():
             max_prompts=args.max_prompts,
             parallel=args.parallel,
             protocol=args.protocol,
+            prompt_template=args.prompt_template,
         ),
     )
     if args.watch:

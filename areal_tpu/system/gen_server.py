@@ -524,6 +524,19 @@ class ZMQGenClient:
         sock.connect(addr)
         self._ready.set()
         outbox: "collections.deque[bytes]" = collections.deque()
+
+        def fail_all(err: str) -> None:
+            # Also purge queued frames: their futures are failed, so
+            # sending them later would make the server burn minutes of
+            # generation nobody will consume.
+            self._fail_all(err)
+            outbox.clear()
+            try:
+                while True:
+                    self._send_q.get_nowait()
+            except queue.Empty:
+                pass
+
         while not self._stop_evt.is_set():
             # The loop must SURVIVE (a dead IO thread strands every
             # pending and future request until its full timeout) and must
@@ -548,11 +561,11 @@ class ZMQGenClient:
                 except (ValueError, UnicodeDecodeError):
                     # One garbled frame cannot be correlated: fail all
                     # outstanding (never silently kill the thread).
-                    self._fail_all("generation server sent a garbled frame")
+                    fail_all("generation server sent a garbled frame")
                     continue
                 rid = msg.pop("rid", None)
                 if rid is None:
-                    self._fail_all(
+                    fail_all(
                         f"generation server error: {msg.get('error')}"
                     )
                     continue
@@ -567,13 +580,15 @@ class ZMQGenClient:
                         f.set_result(msg)
             except zmq.ContextTerminated:
                 # Process/context teardown: nothing left to serve.
-                self._fail_all("generation client context terminated")
+                fail_all("generation client context terminated")
                 return
             except Exception as e:  # noqa: BLE001 — zmq/system errors
                 logger.exception("gen client io error")
-                self._fail_all(f"generation client io error: {e!r}")
+                fail_all(f"generation client io error: {e!r}")
                 # Persistent socket errors must not become a hot loop.
                 time.sleep(0.05)
+        # Clean stop must not strand blocked callers until their timeout.
+        fail_all("generation client closed")
         sock.close(linger=200)
 
     def close(self) -> None:

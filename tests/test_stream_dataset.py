@@ -164,10 +164,41 @@ class TestStreamAuth:
         finally:
             d.close()
 
-    def test_open_bind_needs_token(self):
+    def test_open_bind_needs_token(self, monkeypatch):
+        # The guard must judge THIS call, not ambient developer env.
+        monkeypatch.delenv("AREAL_STREAM_TOKEN", raising=False)
+        monkeypatch.delenv("AREAL_GEN_INSECURE", raising=False)
         with pytest.raises(ValueError, match="token"):
             StreamDataset(
                 seed=0, dp_rank=0, world_size=1,
                 tokenizer=fixtures.make_tokenizer(),
                 min_rows=0, host="0.0.0.0",
             )
+
+    def test_malformed_frames_do_not_kill_the_dataset(self):
+        import zmq as _zmq
+
+        d = StreamDataset(
+            seed=0, dp_rank=0, world_size=1,
+            tokenizer=fixtures.make_tokenizer(), min_rows=0,
+        )
+        try:
+            s = _zmq.Context.instance().socket(_zmq.PUSH)
+            s.connect("tcp://" + d.addr)
+            s.send(b"not json at all")
+            s.send(b'"a json string, not a dict"')
+            import json as _json
+
+            s.send(_json.dumps(
+                {"query_id": "ok1", "prompt": "x", "task": "math",
+                 "solutions": ["\\boxed{1}"]}).encode())
+            import time as _time
+
+            for _ in range(100):
+                if len(d) >= 1:
+                    break
+                _time.sleep(0.02)
+            assert len(d) == 1 and "ok1" in d.id2info
+            s.close(linger=200)
+        finally:
+            d.close()
