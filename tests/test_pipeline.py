@@ -197,11 +197,13 @@ def test_1f1b_mem_bound_lower_peak_at_equal_microbatch_size(rng):
     )
 
 
-def test_train_engine_1f1b_mem_schedule_e2e():
-    """TrainEngine(pipe_schedule='1f1b-mem') trains on a p2 mesh and
-    matches the gpipe engine's first-step loss exactly."""
-    pc = ParallelConfig.from_str("p2")
-    mesh = make_mesh(pc, jax.devices()[:2])
+@pytest.mark.parametrize("layout", ["p2", "p2f2"])
+def test_train_engine_1f1b_mem_schedule_e2e(layout):
+    """TrainEngine(pipe_schedule='1f1b-mem') trains on pipelined meshes
+    (pure and FSDP-composed) and matches the gpipe engine's first-step
+    loss exactly."""
+    pc = ParallelConfig.from_str(layout)
+    mesh = make_mesh(pc, jax.devices()[: pc.world_size])
     cfg = tiny_config()
     params = tfm.init_params(cfg, jax.random.PRNGKey(5))
     tok = fixtures.make_tokenizer()
