@@ -814,3 +814,70 @@ class TestEarlyStop:
         )
         stats = actor_if.train_step(actor, rollout, mb)
         assert stats["n_minibatches_skipped"] == 0.0
+
+
+class TestAdaptiveKLRecover:
+    def test_kl_controller_survives_recover(self, tmp_path):
+        """The adaptive KL coefficient is algorithm state: a restored
+        trial must resume from the drifted value, not restart the
+        schedule at the initial kl_ctl."""
+        from areal_tpu.api.config import ModelAbstraction
+        from areal_tpu.api.data_api import DatasetAbstraction
+        from areal_tpu.experiments.common import (
+            PPOMathConfig,
+            build_ppo_math,
+            run_experiment,
+        )
+        from areal_tpu.system.master import ExperimentSaveEvalControl
+
+        tok = fixtures.make_tokenizer()
+        rows = fixtures.build_math_rows(16, seed=4)
+
+        def make(epochs, ctrl):
+            return PPOMathConfig(
+                actor=ModelAbstraction("random", {"config": tiny_config()}),
+                ref=ModelAbstraction("random", {"config": tiny_config()}),
+                dataset=DatasetAbstraction(
+                    "math_code_prompt",
+                    {"dataset_builder": lambda: rows, "max_length": 64},
+                ),
+                reward_interface_args={
+                    "id2info": {r["query_id"]: r for r in rows}
+                },
+                gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+                # A tiny target makes the measured ref-KL (two different
+                # random models) hit the +0.2 clip every step: the value
+                # drifts deterministically by x1.0016 per step (8 seqs /
+                # horizon 1000).
+                ppo_kwargs={
+                    "n_minibatches": 2, "kl_ctl": 0.1,
+                    "kl_adaptive": True, "adaptive_kl_target": 1e-6,
+                    "adaptive_kl_horizon": 1000.0,
+                },
+                optimizer=OptimizerConfig(
+                    lr=1e-4, warmup_steps_proportion=0.0
+                ),
+                batch_size=8,
+                total_train_epochs=epochs,
+                ctrl=ctrl,
+                fileroot=str(tmp_path),
+            )
+
+        m1, s1 = run_experiment(
+            build_ppo_math(
+                make(1, ExperimentSaveEvalControl(ckpt_freq_steps=1)), tok
+            ),
+            tokenizer=tok,
+        )
+        v1 = m1.pool.workers[0].interfaces["actor@0"]._kl().value
+        assert v1 > 0.1  # drifted above the initial coefficient
+
+        m2, s2 = run_experiment(
+            build_ppo_math(make(2, ExperimentSaveEvalControl()), tok),
+            tokenizer=tok,
+        )
+        # Restored trial REPORTS the recovered value on its first step and
+        # keeps drifting from there.
+        assert np.isclose(s2[0]["actor_train/kl_ctl_value"], v1, rtol=1e-6)
+        v2 = m2.pool.workers[0].interfaces["actor@0"]._kl().value
+        assert v2 > v1
