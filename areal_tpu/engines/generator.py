@@ -55,6 +55,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         pad_token_id: Optional[int] = None,
         compute_dtype=jnp.bfloat16,
         max_decode_batch: int = 64,
+        donation_safe_swap: bool = True,
     ):
         if cfg.is_critic:
             raise ValueError("cannot generate from a critic model")
@@ -66,6 +67,15 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             compute_dtype = jnp.float32
         self.compute_dtype = compute_dtype
         self.max_decode_batch = max_decode_batch
+        # When True (default), set_params COPIES any leaf whose buffers
+        # alias the source tree — required when generation can overlap a
+        # train step that donates those buffers (rollout_ahead).  In a
+        # strictly synchronous colocated trial the alias is safe (nothing
+        # decodes between the optimizer's donation and the rebind), and
+        # skipping the copy saves a full extra parameter footprint in HBM
+        # — the difference between a 1.5B model fitting or OOMing on one
+        # 16 GB chip.
+        self.donation_safe_swap = donation_safe_swap
         # Generation has no CP/PP path (decode is token-at-a-time and
         # latency-bound); only the flash half of the shared dispatch policy
         # applies to prefill.  A pipelined allocation is accepted by folding
@@ -112,16 +122,48 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         # later DONATES — async rollout would then decode from deleted
         # buffers.  Copy any leaf whose BUFFERS still alias the input
         # (object identity alone misses distinct Arrays sharing storage).
-        from areal_tpu.engines.offload import buffers_alias
+        # Synchronous trials opt out (donation_safe_swap=False): the alias
+        # is never read between donation and the post-step rebind, and the
+        # saved copy is a full parameter footprint of HBM.
+        if self.donation_safe_swap:
+            from areal_tpu.engines.offload import buffers_alias
 
-        self.params = jax.tree.map(
-            lambda p, orig: jnp.copy(p) if buffers_alias(p, orig) else p,
-            placed, params,
-        )
+            self.params = jax.tree.map(
+                lambda p, orig: (
+                    jnp.copy(p) if buffers_alias(p, orig) else p
+                ),
+                placed, params,
+            )
+        else:
+            self.params = placed
 
     def get_params(self):
         self._ensure_loaded()
+        self._require_params()
         return self.params
+
+    def release_params(self) -> None:
+        """Drop the weight reference (colocated synchronous loops).
+
+        With donation_safe_swap=False the generator aliases the train
+        master's buffers; a live alias blocks the optimizer step's buffer
+        donation (XLA refuses to donate a referenced buffer, costing a
+        transient extra parameter copy).  Between the last generate() and
+        the post-step set_params() the weights are dead — release them so
+        the optimizer updates in place.  Any offloaded host copy is stale
+        by the same argument and is dropped too.  Any engine call before
+        the next set_params() raises, which is the intended misuse
+        signal."""
+        self.params = None
+        self._host_offload = None
+        self._offload_shardings = None
+
+    def _require_params(self) -> None:
+        if self.params is None:
+            raise RuntimeError(
+                "GeneratorEngine weights were release_params()-ed; call "
+                "set_params() before using the engine again"
+            )
 
     # ---------------- generation ----------------
 
@@ -162,6 +204,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
           seq_no_eos_mask   — 1.0 per sequence iff truncated (no EOS)
         """
         self._ensure_loaded()
+        self._require_params()
         self.prefill_dispatches = 0
         prompt_lens = sample.seqlens_of(prompt_key)
         bounds = sample.cu_seqlens(prompt_key)
