@@ -145,8 +145,22 @@ class SequenceSample:
         metadata = {}
         for k in samples[0].metadata:
             metadata[k] = datapack.flat2d([s.metadata.get(k, []) for s in samples])
+        # Preserve dtype/trailing-shape info on metadata-only samples (the
+        # master-worker currency) — the sharded data plane zero-fills
+        # arrays from it, and a lost dtype would silently promote int32
+        # token ids to float.
+        dtypes: Dict[str, Optional[np.dtype]] = {}
+        trailing: Dict[str, Optional[Tuple[int, ...]]] = {}
+        for s in samples:
+            for k, v in s.dtypes.items():
+                if v is not None:
+                    dtypes.setdefault(k, v)
+            for k, v in s.trailing_shapes.items():
+                if v is not None:
+                    trailing.setdefault(k, v)
         return cls(
-            keys=keys, ids=ids, seqlens=seqlens, data=data, metadata=metadata
+            keys=keys, ids=ids, seqlens=seqlens, data=data,
+            metadata=metadata, dtypes=dtypes, trailing_shapes=trailing,
         )
 
     # ---------------- views / basic props ----------------
@@ -278,14 +292,57 @@ class SequenceSample:
     def split(self, mb_spec: MicroBatchSpec) -> List["SequenceSample"]:
         return [self.select_idx(g) for g in self.split_groups(mb_spec) if g]
 
+    def shard_blocks(self) -> Optional[List[List[int]]]:
+        """Data-plane shard layout, if any: per-shard lists of batch
+        indices derived from the per-id `shard_of = (rank, n)` metadata
+        tags the worker attaches when the master shipped this member only
+        its own rows.  Blocks may be empty (a shard with no sequences in
+        this view still needs its aligned — empty — row block).  None when
+        untagged or single-shard."""
+        tags = self.metadata.get("shard_of")
+        if not tags:
+            return None
+        n = int(tags[0][1])
+        if n <= 1:
+            return None
+        if any(int(t[1]) != n for t in tags):
+            raise ValueError(f"inconsistent shard_of tags: {tags}")
+        return [
+            [i for i, t in enumerate(tags) if int(t[0]) == s]
+            for s in range(n)
+        ]
+
     def split_balanced(self, k: int) -> List["SequenceSample"]:
         """Exactly-k token-balanced split for DP dispatch.  Every part must be
-        non-empty (bs >= k required)."""
+        non-empty (bs >= k required).
+
+        On a data-plane-sharded sample (see shard_blocks) each SHARD is
+        split into k parts independently and part j concatenates every
+        shard's j-th part — all SPMD group members must derive identical
+        per-shard minibatch membership from metadata alone."""
         if self.bs < k:
             raise ValueError(f"cannot split bs={self.bs} into {k} parts")
-        lens = [sum(self.seqlens[self.main_key()][i]) for i in range(self.bs)]
-        groups = datapack.partition_balanced(lens, k)
-        return [self.select_idx(g) for g in groups]
+        key = self.main_key()
+        lens = [sum(self.seqlens[key][i]) for i in range(self.bs)]
+        blocks = self.shard_blocks()
+        if blocks is None:
+            groups = datapack.partition_balanced(lens, k)
+            return [self.select_idx(g) for g in groups]
+        per = [
+            datapack.partition_balanced([lens[i] for i in b], k)
+            if len(b) >= k
+            else [[j] for j in range(len(b))] + [[] for _ in range(k - len(b))]
+            for b in blocks
+        ]
+        out = []
+        for j in range(k):
+            idx = [b[i] for b, parts in zip(blocks, per) for i in parts[j]]
+            if not idx:
+                raise ValueError(
+                    f"sharded split produced an empty minibatch {j}/{k}"
+                )
+            out.append(self.select_idx(idx))
+        return out
 
     def __repr__(self):
         kind = "meta" if self.data is None else "data"

@@ -54,6 +54,15 @@ class MFCDef:
     mb_spec: MicroBatchSpec = dataclasses.field(default_factory=MicroBatchSpec)
     pre_hooks: List = dataclasses.field(default_factory=list)
     post_hooks: List = dataclasses.field(default_factory=list)
+    # Heavy per-token input keys the data plane may ship SHARD-EXACTLY
+    # (each SPMD group member receives only the rows its process-local
+    # devices consume) when the model's mesh batch axis spans processes.
+    # Keys NOT listed are broadcast to every member — required for any
+    # key whose VALUES feed host-side batch-global logic in the
+    # interface (e.g. prompt_mask for the PPO layout scan, per-seq
+    # rewards for GRPO grouping).  Empty = broadcast everything (the
+    # safe default).  Reference: data_manager.py:144-416.
+    shard_keys: Tuple[str, ...] = ()
 
     # Filled by build_graph:
     children: List["MFCDef"] = dataclasses.field(default_factory=list, repr=False)

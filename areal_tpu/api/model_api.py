@@ -270,6 +270,20 @@ class Engine(abc.ABC):
     ) -> SequenceSample:
         raise NotImplementedError(f"{type(self).__name__} cannot generate")
 
+    def data_shard_info(self):
+        """(shard_rank, n_shards) of the batch rows this PROCESS consumes
+        — the sharded data plane ships an SPMD group member only its own
+        row block when n_shards > 1 (reference: the data_manager's
+        shard-exact redistribution, realhf/system/data_manager.py:144).
+        Engines without a process-spanning batch axis report (0, 1):
+        "ship me everything"."""
+        mesh = getattr(self, "mesh", None)
+        if mesh is None:
+            return (0, 1)
+        from areal_tpu.base.topology import local_batch_shard
+
+        return local_batch_shard(mesh)
+
     # Checkpointing
     def get_params(self):
         raise NotImplementedError

@@ -209,3 +209,52 @@ def ranks_on_axis(parallel: ParallelConfig, axis: str, **fixed: int) -> List[int
 
 def batch_sharding_degree(mesh: Mesh) -> int:
     return int(np.prod([mesh.shape[a] for a in BATCH_AXES]))
+
+
+def local_batch_shard(mesh: Mesh, process_index: Optional[int] = None):
+    """(shard_rank, n_shards) of the batch axis owned by THIS process.
+
+    The data plane ships each SPMD group member only the input rows its
+    process-local devices consume (reference redistributes shard-exactly
+    the same way, realhf/system/data_manager.py:144-416).  A packed
+    batch's rows map contiguously onto the flattened (data, fsdp)
+    coordinates, so a process owns the row block matching the batch
+    coordinates of its local devices.
+
+    Returns (0, 1) — "needs the full batch" — when this process owns
+    every batch coordinate: single-process meshes, and meshes whose
+    process boundaries cut only non-batch axes (pure TP/PP spanning runs
+    the full batch on every host by construction).  Falls back to (0, 1)
+    whenever ownership is not a clean equal-size contiguous block
+    partition (correct, just unoptimized).
+    """
+    import jax
+
+    if process_index is None:
+        process_index = jax.process_index()
+    dev = mesh.devices
+    # Flatten batch axes (in AXIS_ORDER) to one leading dim; collapse the
+    # rest.  AXIS_ORDER = (pipe, data, fsdp, seq, model): move pipe after
+    # the batch axes so (data, fsdp) lead.
+    arr = np.moveaxis(dev, 0, 2)  # (data, fsdp, pipe, seq, model)
+    n_batch = arr.shape[0] * arr.shape[1]
+    flat = arr.reshape(n_batch, -1)
+    owners: List[frozenset] = [
+        frozenset(d.process_index for d in row) for row in flat
+    ]
+    if all(process_index in o for o in owners):
+        return 0, 1
+    # Group contiguous runs of identical owner sets.
+    blocks: List[Tuple[int, int, frozenset]] = []  # (start, stop, owners)
+    start = 0
+    for i in range(1, n_batch + 1):
+        if i == n_batch or owners[i] != owners[start]:
+            blocks.append((start, i, owners[start]))
+            start = i
+    sizes = {stop - start for start, stop, _ in blocks}
+    mine = [
+        b for b, (_, _, o) in enumerate(blocks) if process_index in o
+    ]
+    if len(sizes) != 1 or len(mine) != 1:
+        return 0, 1  # ragged or scattered ownership: take the full batch
+    return mine[0], len(blocks)

@@ -140,8 +140,11 @@ class StreamDataset:
             )
         self._sock = zmq.Context.instance().socket(zmq.PULL)
         bind_host = {"localhost": "127.0.0.1"}.get(host, host)
-        port = network.find_free_port()
-        self._sock.bind(f"tcp://{bind_host}:{port}")
+        # bind_to_random_port: the kernel picks a free port atomically —
+        # probing a free port first and binding it second is a TOCTOU race
+        # that can crash dataset construction at trial startup when
+        # another process grabs the port in between.
+        port = self._sock.bind_to_random_port(f"tcp://{bind_host}")
         self.addr = (
             f"{network.gethostip()}:{port}"
             if bind_host not in ("127.0.0.1",)

@@ -86,20 +86,20 @@ class InferenceEngine(HostOffloadMixin, Engine):
         extra_keys: Sequence[str] = (),
     ) -> SequenceSample:
         self._ensure_loaded()
-        mbs = sample.split(mb_spec)
         fwd = self._get_fwd_fn(post_fn)
         outs = []
-        for mb in mbs:
+        for mb, blocks in packing.split_sharded(sample, mb_spec):
             pk = packing.pack_sample(
                 mb,
                 token_key,
                 extra_keys=extra_keys,
                 n_rows_multiple=self.batch_shard,
                 max_tokens_per_row=mb_spec.max_tokens_per_mb,
+                shard_blocks=blocks,
             )
             batch = {
-                k: jax.device_put(
-                    v, sharding.named(self.mesh, sharding.batch_pspec())
+                k: sharding.place_rows(
+                    self.mesh, v, sharding.batch_pspec()
                 )
                 for k, v in pk.arrays.items()
             }

@@ -49,6 +49,60 @@ def decode_bucket_len(n: int) -> int:
     return bucket_len(n, large_step=_BUCKET_QUANTUM * 2)
 
 
+def split_sharded(
+    sample: SequenceSample, mb_spec
+) -> List[Tuple[SequenceSample, Optional[List[List[int]]]]]:
+    """Micro-batch split that stays consistent across data-plane shards.
+
+    When the sample carries per-id `shard_of` tags (set by the worker when
+    the master shipped each SPMD member only its own rows), every member
+    must derive the SAME number of micro-batches with the SAME per-shard
+    membership from metadata alone — a plain global FFD would interleave
+    shards' rows and diverge the jitted programs across processes.  Each
+    shard is FFD-split independently into a common group count k;
+    micro-batch j is the concatenation of every shard's j-th group, and
+    the returned per-microbatch shard blocks give each shard's positions
+    within it (feeding pack_sample's row-block layout).
+
+    Without shard tags this is exactly `sample.split(mb_spec)`.
+    """
+    blocks = sample.shard_blocks()
+    if not blocks or len(blocks) <= 1:
+        return [(mb, None) for mb in sample.split(mb_spec)]
+    key = sample.main_key()
+    lens = [sum(sample.seqlens[key][i]) for i in range(sample.bs)]
+    cap = mb_spec.max_tokens_per_mb or (sum(lens) + 1)
+    from areal_tpu.base import datapack
+
+    k = max(mb_spec.n_mbs, 1)
+    while True:
+        per = [
+            datapack.ffd_allocate(
+                [lens[i] for i in b], capacity=cap, min_groups=min(k, len(b))
+            )
+            if b
+            else []
+            for b in blocks
+        ]
+        k2 = max((len(g) for g in per), default=1)
+        if k2 <= k:
+            break
+        k = k2  # a shard needed more groups; re-split everyone to match
+    out = []
+    for j in range(k):
+        idx: List[int] = []
+        mb_blocks: List[List[int]] = []
+        for b, gs in zip(blocks, per):
+            g = [b[i] for i in gs[j]] if j < len(gs) else []
+            mb_blocks.append(list(range(len(idx), len(idx) + len(g))))
+            idx.extend(g)
+        if not idx:
+            continue
+        mb = sample.select_idx(idx)
+        out.append((mb, mb_blocks))
+    return out
+
+
 @dataclasses.dataclass
 class RowPack:
     """Dense row layout + the mapping back to packed-1D order.
@@ -77,12 +131,21 @@ def pack_sample(
     n_rows_multiple: int = 1,
     max_tokens_per_row: Optional[int] = None,
     row_len: Optional[int] = None,
+    shard_blocks: Optional[List[List[int]]] = None,
 ) -> RowPack:
     """Pack every sequence of `sample[token_key]` into dense rows.
 
     extra_keys must be token-aligned with token_key (same seqlens).  The
     number of rows is padded to a multiple of `n_rows_multiple` (the mesh's
     batch-sharding degree) with empty rows if needed.
+
+    shard_blocks (per-shard lists of sequence indices, together covering
+    every sequence exactly once) pins each shard's sequences to its own
+    equal-size contiguous ROW block, aligned with the contiguous
+    batch-coordinate layout `_device_batch` shards rows by.  On a
+    process-spanning mesh each process then materializes real data only
+    for its own block (the sharded data plane zero-fills the rest), and
+    identical metadata yields an identical layout on every member.
     """
     lens = sample.seqlens_of(token_key)
     for k in extra_keys:
@@ -92,10 +155,35 @@ def pack_sample(
             )
     cap = max_tokens_per_row or max(lens, default=1)
     cap = max(cap, max(lens, default=1))
-    groups = datapack.ffd_allocate(lens, capacity=cap)
-    # Pad row count up to a multiple.
-    while len(groups) % max(n_rows_multiple, 1):
-        groups.append([])
+    if shard_blocks is not None and len(shard_blocks) > 1:
+        n_shards = len(shard_blocks)
+        if sorted(i for b in shard_blocks for i in b) != list(
+            range(len(lens))
+        ):
+            raise ValueError("shard_blocks must partition the sequences")
+        per_groups = [
+            datapack.ffd_allocate(
+                [lens[i] for i in block], capacity=cap
+            )
+            for block in shard_blocks
+        ]
+        # Equal row blocks: every shard gets the same row count, itself a
+        # multiple of its slice of the batch-sharding degree.
+        mult = max(n_rows_multiple, 1)
+        per_mult = max(mult // n_shards, 1) if mult % n_shards == 0 else mult
+        rows_per_shard = max(len(g) for g in per_groups)
+        while rows_per_shard % per_mult:
+            rows_per_shard += 1
+        groups = []
+        for block, gs in zip(shard_blocks, per_groups):
+            local = [[block[i] for i in g] for g in gs]
+            local += [[] for _ in range(rows_per_shard - len(local))]
+            groups.extend(local)
+    else:
+        groups = datapack.ffd_allocate(lens, capacity=cap)
+        # Pad row count up to a multiple.
+        while len(groups) % max(n_rows_multiple, 1):
+            groups.append([])
     n_rows = len(groups)
     s_pad = row_len or bucket_len(
         max((sum(lens[i] for i in g) for g in groups), default=1)

@@ -272,7 +272,7 @@ class TrainEngine(HostOffloadMixin, Engine):
         loss tokens) so the final gradient equals the full-batch mean.
         """
         self._ensure_loaded()
-        mbs = sample.split(mb_spec)
+        sharded_mbs = packing.split_sharded(sample, mb_spec)
         packs = [
             packing.pack_sample(
                 mb,
@@ -280,11 +280,20 @@ class TrainEngine(HostOffloadMixin, Engine):
                 extra_keys=extra_keys,
                 n_rows_multiple=self.batch_shard,
                 max_tokens_per_row=mb_spec.max_tokens_per_mb,
+                shard_blocks=blocks,
             )
-            for mb in mbs
+            for mb, blocks in sharded_mbs
         ]
+        # 1f1b-mem row chunking slices contiguous row ranges, which would
+        # cut across the per-shard row blocks of a sharded batch; the two
+        # compose only via the grad-accum loop, so skip chunking there.
+        sharded = any(blocks for _, blocks in sharded_mbs)
         chunks = [
-            c for pk in packs for c in self._pack_row_chunks(pk.arrays)
+            c
+            for pk in packs
+            for c in (
+                [pk.arrays] if sharded else self._pack_row_chunks(pk.arrays)
+            )
         ]
         total_weight = float(sum(loss_weight_fn(c) for c in chunks))
         total_weight = max(total_weight, 1.0)
@@ -340,16 +349,16 @@ class TrainEngine(HostOffloadMixin, Engine):
         inside jit (e.g. gather next-token logprobs).  Output is re-packed
         into a SequenceSample keyed `output_key`, token-aligned."""
         self._ensure_loaded()
-        mbs = sample.split(mb_spec)
         fwd = self._get_fwd_fn(post_fn)
         outs = []
-        for mb in mbs:
+        for mb, blocks in packing.split_sharded(sample, mb_spec):
             pk = packing.pack_sample(
                 mb,
                 token_key,
                 extra_keys=extra_keys,
                 n_rows_multiple=self.batch_shard,
                 max_tokens_per_row=mb_spec.max_tokens_per_mb,
+                shard_blocks=blocks,
             )
             batch = self._device_batch(pk.arrays)
             dense = to_host(fwd(self.params, batch))
@@ -395,10 +404,12 @@ class TrainEngine(HostOffloadMixin, Engine):
 
     def _device_batch(self, arrays: Dict[str, np.ndarray]):
         return {
-            k: jax.device_put(v, self._batch_sharding)
-            if v.ndim == 2
-            else jax.device_put(
-                v, sharding.named(self.mesh, P(sharding.BATCH, "seq", None))
+            k: sharding.place_rows(
+                self.mesh,
+                v,
+                sharding.batch_pspec()
+                if v.ndim == 2
+                else P(sharding.BATCH, "seq", None),
             )
             for k, v in arrays.items()
         }

@@ -94,6 +94,9 @@ def build_sft(cfg: SFTConfig, tokenizer=None) -> ExperimentPlan:
         interface_type=ModelInterfaceType.TRAIN_STEP,
         interface_impl=ModelInterfaceAbstraction("sft"),
         input_keys=("packed_input_ids", "prompt_mask"),
+        # Tokens feed the device only; prompt_mask stays broadcast (its
+        # host-side counts set the global loss weight).
+        shard_keys=("packed_input_ids",),
         n_seqs=cfg.batch_size,
         mb_spec=cfg.mb_spec,
     )
@@ -383,6 +386,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                     interface_type=ModelInterfaceType.INFERENCE,
                     interface_impl=ModelInterfaceAbstraction("ppo_actor"),
                     input_keys=("packed_input_ids",),
+                    shard_keys=("packed_input_ids",),
                     output_keys=("packed_ref_logprobs",),
                     output_key_remap={"logprobs": "packed_ref_logprobs"},
                     n_seqs=cfg.batch_size,
@@ -399,12 +403,32 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                 interface_type=ModelInterfaceType.INFERENCE,
                 interface_impl=critic_if,
                 input_keys=("packed_input_ids", "prompt_mask"),
+                shard_keys=("packed_input_ids",),
                 output_keys=("values",),
                 n_seqs=cfg.batch_size,
                 mb_spec=cfg.mb_spec,
             )
         )
         train_inputs.append("values")
+    # Sharded dispatch for the actor train step: legal only when the
+    # PPO host path's batch-global advantage statistics depend solely on
+    # broadcast data — the GRPO default (no KL-in-reward, or no adv
+    # norm); see PPOActorInterface.train_step's runtime guard.
+    a = dict(cfg.ppo_kwargs)
+    no_kl_reward = (
+        float(a.get("kl_ctl", 0.0)) == 0.0
+        and not a.get("kl_adaptive", False)
+    )
+    train_shard_keys: tuple = ()
+    if critic is None and (no_kl_reward or not a.get("adv_norm", True)):
+        train_shard_keys = tuple(
+            k
+            for k in train_inputs
+            if k in (
+                "packed_input_ids", "packed_logprobs",
+                "packed_ref_logprobs",
+            )
+        )
     train_post_hooks = [ParamReallocHook(target=actor_gen)]
     if cfg.ref_ema_eta is not None:
         if ref is None:
@@ -423,6 +447,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
             interface_type=ModelInterfaceType.TRAIN_STEP,
             interface_impl=actor_if,
             input_keys=tuple(train_inputs),
+            shard_keys=train_shard_keys,
             n_seqs=cfg.batch_size,
             mb_spec=cfg.mb_spec,
             # After training, push fresh weights into the generator

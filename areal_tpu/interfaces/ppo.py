@@ -303,9 +303,23 @@ class PPOActorInterface(ModelInterface):
         ):
             sample = self._filter_best_of_k(sample)
         klv = self._kl().value
+        # Sharded data plane: heavy per-token inputs hold real values only
+        # for this member's own rows (layout metadata and per-seq keys are
+        # global).  Everything below stays SPMD-consistent — loss_mask and
+        # total weight derive from layout, GRPO group stats from broadcast
+        # per-seq scores, per-token arrays are only consumed by the rows'
+        # own devices — EXCEPT batch-global advantage normalization over
+        # per-token terms that differ across members.
+        if sample.shard_blocks() is not None and self.adv_norm and (
+            klv != 0.0 or not self.disable_value
+        ):
+            raise NotImplementedError(
+                "adv_norm over per-token advantage terms (KL-in-reward or "
+                "GAE values) is not batch-global under sharded data "
+                "dispatch; drop the node's shard_keys or disable adv_norm"
+            )
         layout, group_of = _extract_layout(sample)
         total = sum(L for (_, L, _) in layout)
-        tokens_np = np.asarray(sample.data["packed_input_ids"])
 
         # --- behavior logprobs, ref logprobs, values: full-length aligned
         old_logp = _seq_align_minus1(sample, "packed_logprobs")

@@ -158,6 +158,37 @@ def named(mesh: Mesh, spec: P) -> NamedSharding:
     return NamedSharding(mesh, spec)
 
 
+def place_rows(mesh: Mesh, value, spec: P):
+    """Put a row-major [B, ...] host batch array onto the mesh.
+
+    Single-process meshes (and meshes whose process boundaries cut only
+    non-batch axes) take the plain device_put path.  When the batch axis
+    SPANS processes, each process contributes only its own contiguous row
+    block via jax.make_array_from_process_local_data — the sharded data
+    plane ships a member only those rows (zero placeholders elsewhere), and
+    device_put's cross-process value check would (rightly) reject the
+    now-divergent full host arrays.  With unsharded full data the local
+    slice is identical, so this path is always safe when n > 1.
+    """
+    import numpy as np
+
+    from areal_tpu.base.topology import local_batch_shard
+
+    sh = NamedSharding(mesh, spec)
+    rank, n = local_batch_shard(mesh)
+    if n <= 1:
+        return jax.device_put(value, sh)
+    b = value.shape[0]
+    if b % n:
+        raise ValueError(
+            f"batch rows ({b}) must divide the process shard count ({n}); "
+            "the packer pads rows to the mesh batch degree"
+        )
+    lo = rank * (b // n)
+    local = np.ascontiguousarray(value[lo : lo + b // n])
+    return jax.make_array_from_process_local_data(sh, local, value.shape)
+
+
 def tree_named(mesh: Mesh, specs) -> Any:
     return jax.tree.map(
         lambda s: NamedSharding(mesh, s),

@@ -177,12 +177,21 @@ def _parse_datasets(data_path: str):
             continue
         # 'name=path' only when the prefix is a plain label — a '=' after
         # any '/' is part of the path (hive-style '/data/date=2024/x.jsonl').
-        # A bare relative filename containing '=' ('temp=0.7.jsonl') is
-        # ambiguous and parses as a label; write './temp=0.7.jsonl' to
-        # force path interpretation.
-        prefix = part.split("=", 1)[0]
-        if "=" in part and "/" not in prefix and "." not in prefix:
-            name, path = part.split("=", 1)
+        # Dotted labels ('v1.5=/d/aime.jsonl') are labels when what follows
+        # '=' is an explicit path ('/', './'); a bare relative filename
+        # containing '=' and a dotted prefix ('temp=0.7.jsonl') is
+        # ambiguous and REJECTED rather than silently misparsed — write
+        # './temp=0.7.jsonl' (path) or 'label=./temp=0.7.jsonl'.
+        prefix, _, rest = part.partition("=")
+        if "=" in part and "/" not in prefix:
+            if "." not in prefix or rest.startswith(("/", "./", "~")):
+                name, path = prefix, rest
+            else:
+                raise ValueError(
+                    f"ambiguous dataset spec {part!r}: dotted prefix "
+                    f"{prefix!r} could be a label or part of a filename; "
+                    "use an explicit path ('./file') or 'label=./file'"
+                )
         else:
             name = os.path.splitext(os.path.basename(part))[0]
             path = part

@@ -262,13 +262,22 @@ class TPUPodSchedulerClient(SchedulerClient):
         if worker_type not in self._jobs:
             return
         host, log, pid = self._jobs.pop(worker_type)
+        # TERM first; then poll briefly and escalate to KILL.  A worker
+        # that ignores TERM would otherwise survive stop_all() holding the
+        # TPU chip lease, and the recover retry's resubmitted worker fails
+        # to initialize against the still-held devices.
+        p = shlex.quote(pid)
         self.transport(
             self.ssh_argv(
                 host,
-                f"[ -f {shlex.quote(pid)} ] && "
-                f"pkill -TERM -P $(cat {shlex.quote(pid)}) 2>/dev/null; "
-                f"[ -f {shlex.quote(pid)} ] && "
-                f"kill -TERM $(cat {shlex.quote(pid)}) 2>/dev/null; true",
+                f"if [ -f {p} ]; then w=$(cat {p}); "
+                f"pkill -TERM -P $w 2>/dev/null; "
+                f"kill -TERM $w 2>/dev/null; "
+                "for i in 1 2 3 4 5 6 7 8 9 10; do "
+                "kill -0 $w 2>/dev/null || break; sleep 0.5; done; "
+                "if kill -0 $w 2>/dev/null; then "
+                f"pkill -KILL -P $w 2>/dev/null; "
+                "kill -KILL $w 2>/dev/null; fi; fi; true",
             )
         )
 

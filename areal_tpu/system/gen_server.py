@@ -597,7 +597,19 @@ class ZMQGenClient:
     def _call_many(self, reqs: List[Dict]) -> List[Dict]:
         import concurrent.futures as _cf
 
-        self._ready.wait(30)
+        # Fail fast instead of enqueueing onto a dead IO loop: a call made
+        # after close(), or before the IO thread ever connected, would
+        # otherwise park frames in the send queue and block the caller for
+        # the full timeout_s (default hours).
+        if self._stop_evt.is_set():
+            raise RuntimeError(
+                f"generation client for {self.url} is closed"
+            )
+        if not self._ready.wait(30):
+            raise TimeoutError(
+                f"generation server {self.url}: IO thread not connected "
+                "after 30s"
+            )
         futs = []
         with self._plock:
             for req in reqs:

@@ -12,7 +12,7 @@ import contextvars
 import dataclasses
 import os
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from areal_tpu.api.config import ModelInterfaceType
 from areal_tpu.api.dfg import DFG, MFCDef, OffloadHook, ParamReallocHook
@@ -161,6 +161,9 @@ class MasterWorker:
         # (data id, key) — the master's equivalent of the reference's
         # GlobalStorageTracker (realhf/system/redistributor.py:12).
         self._owners: Dict[str, Dict[str, set]] = {}
+        # model key -> each group member's (shard_rank, n_shards) for
+        # sharded data dispatch (see _shard_infos).
+        self._shard_info_cache: Dict[str, List[Tuple[int, int]]] = {}
         self._xfer_id = 0
         # (sid, key, dst) -> Future resolved when the transfer lands; lets a
         # concurrent MFC needing the same copy await it instead of
@@ -324,11 +327,12 @@ class MasterWorker:
                 else:
                     km.setdefault(key, set()).add(worker)
 
-    async def _ensure_data(self, node: MFCDef, ids, dst: int):
+    async def _ensure_data(self, node: MFCDef, ids, dst: int, keys=None):
         """Move any input (id, key) not yet resident on `dst` from an owning
         worker, as one tagged transfer per source (the data-plane pre-hook;
         reference: model_function_call data_transfer pre-hooks +
-        redistributor.derive_plan)."""
+        redistributor.derive_plan).  `keys` restricts the shipped keys (the
+        sharded plane ships heavy keys for a member's own rows only)."""
         plans: Dict[int, Dict[str, list]] = {}  # src -> key -> [ids]
         waits = set()
         started: list = []
@@ -336,7 +340,7 @@ class MasterWorker:
         # in-flight registrations below are atomic wrt other coroutines.
         for sid in ids:
             km = self._owners.get(sid, {})
-            for key in node.input_keys:
+            for key in keys if keys is not None else node.input_keys:
                 holders = km.get(key)
                 if holders is None:
                     raise KeyError(
@@ -468,7 +472,9 @@ class MasterWorker:
                 k: float(sum(v) / len(v)) for k, v in merged.items()
             }
         else:
-            resp = await self._dispatch_mfc(node, list(batch.ids), group)
+            resp = await self._dispatch_mfc(
+                node, list(batch.ids), group, meta=batch
+            )
             results[node.name] = resp.get("stats") or {}
         if (
             node.interface_type == ModelInterfaceType.TRAIN_STEP
@@ -532,19 +538,77 @@ class MasterWorker:
         )
         return [r.get("stats") for r in resps]
 
+    async def _shard_infos(
+        self, node: MFCDef, group: List[int]
+    ) -> Optional[List[Tuple[int, int]]]:
+        """Each member's (shard_rank, n_shards) for this model's batch
+        rows, cached per model key.  None when sharded shipping cannot
+        apply (any member wants the full batch, or members disagree on
+        the shard count)."""
+        key = str(node.model_name)
+        infos = self._shard_info_cache.get(key)
+        if infos is None:
+            resps = await asyncio.gather(
+                *[
+                    self.pool.request(
+                        w, {"type": "shard_info", "model_name": key}
+                    )
+                    for w in group
+                ]
+            )
+            infos = [(int(r["rank"]), int(r["n"])) for r in resps]
+            self._shard_info_cache[key] = infos
+        ns = {n for _, n in infos}
+        if len(ns) != 1:
+            return None  # members disagree: fall back to full broadcast
+        n = ns.pop()
+        if n <= 1 or {r for r, _ in infos} != set(range(n)):
+            return None  # unsharded, or some shard block has no receiver
+        return infos
+
     async def _dispatch_mfc(
-        self, node: MFCDef, ids: List[str], group: List[int]
+        self, node: MFCDef, ids: List[str], group: List[int], meta=None
     ) -> Dict:
-        # Data-plane pre-hook: every group member executes the MFC
-        # SPMD-symmetrically, so each needs the full input batch resident.
-        # (Known optimization once host counts grow: ship each member only
-        # the rows its local devices consume and assemble the global array
-        # with jax.make_array_from_process_local_data — requires the
-        # packer to agree on global row order from metadata alone.  The
-        # transfer/* step stats exist to show when that's worth doing.)
-        await asyncio.gather(
-            *[self._ensure_data(node, ids, w) for w in group]
-        )
+        # Data-plane pre-hook.  Default: every group member executes the
+        # MFC SPMD-symmetrically and receives the full input batch.  When
+        # the node declares shard_keys AND the members' meshes split the
+        # batch axis across processes, those keys are shipped
+        # SHARD-EXACTLY: each member gets only the rows its own devices
+        # consume (the packer derives the global row layout from metadata
+        # alone; see packing.split_sharded / pack_sample shard_blocks).
+        # Reference: data_manager.py:144-416 shard-exact redistribution.
+        shard_keys = set(node.shard_keys) & set(node.input_keys)
+        bcast_keys = set(node.input_keys) - shard_keys
+        plan = None
+        if meta is not None and shard_keys and len(group) > 1:
+            infos = await self._shard_infos(node, group)
+            if infos is not None:
+                n = infos[0][1]
+                sizes = [
+                    int(sum(meta.seqlens[meta.main_key()][i]))
+                    for i in range(len(ids))
+                ]
+                from areal_tpu.base.datapack import partition_balanced
+
+                blocks = partition_balanced(sizes, n)
+                plan = {"blocks": blocks, "infos": infos, "n": n}
+        if plan is None:
+            await asyncio.gather(
+                *[self._ensure_data(node, ids, w) for w in group]
+            )
+        else:
+            coros = []
+            for w, (rank, _) in zip(group, plan["infos"]):
+                mine = [ids[i] for i in plan["blocks"][rank]]
+                if mine:
+                    coros.append(
+                        self._ensure_data(node, mine, w, keys=shard_keys)
+                    )
+                if bcast_keys:
+                    coros.append(
+                        self._ensure_data(node, ids, w, keys=bcast_keys)
+                    )
+            await asyncio.gather(*coros)
         payload = {
             "type": "mfc",
             "model_name": str(node.model_name),
@@ -555,6 +619,15 @@ class MasterWorker:
             "output_key_remap": dict(node.output_key_remap),
             "mb_spec": node.mb_spec,
         }
+        if plan is not None:
+            shard_of = {}
+            for s, block in enumerate(plan["blocks"]):
+                for i in block:
+                    shard_of[ids[i]] = [s, plan["n"]]
+            payload["shard_of"] = shard_of
+            payload["shard_meta"] = meta.select_keys(
+                set(node.input_keys) & meta.keys
+            )
         resps = await asyncio.gather(
             *[self.pool.request(w, payload) for w in group]
         )
@@ -652,8 +725,12 @@ class MasterWorker:
                         for w, xid in zip(target_group, xfer_ids)
                     ],
                 )
-                for send_r in resps[: len(group)]:
-                    self._acc_xfer("param", send_r)
+                for i, send_r in enumerate(resps[: len(group)]):
+                    # Only member 0 actually sends (sender=i==0); the
+                    # rest reply bytes=0 and must not bump the transfer
+                    # counter or param_count over-reports on multi-member
+                    # source groups.
+                    self._acc_xfer("param", send_r, count=(i == 0))
                 for recv_r in resps[len(group):]:
                     self._acc_xfer("param", recv_r=recv_r, count=False)
 

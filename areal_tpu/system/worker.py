@@ -53,6 +53,34 @@ import areal_tpu.interfaces.null  # noqa: F401
 _TRACE_LOCK = threading.Lock()
 
 
+def _zero_filled(meta_row: SequenceSample, keys) -> SequenceSample:
+    """Zero-data placeholder for keys this member did not receive under
+    sharded dispatch — correct layout (seqlens/dtype/trailing shape) with
+    zero values; the real values live on the process whose devices consume
+    those rows, and device_put only reads the rows local to each process."""
+    data = {}
+    seqlens = {}
+    for k in keys:
+        sls = meta_row.seqlens[k]
+        n = sum(sum(s) for s in sls)
+        trail = tuple(meta_row.trailing_shapes.get(k) or ())
+        dt = meta_row.dtypes.get(k)
+        if dt is None:
+            raise ValueError(
+                f"cannot zero-fill {k!r} for {meta_row.ids}: the shipped "
+                "metadata carries no dtype (a silent float default would "
+                "corrupt integer token ids)"
+            )
+        data[k] = np.zeros((n, *trail), dtype=dt)
+        seqlens[k] = [list(s) for s in sls]
+    return SequenceSample(
+        keys=set(keys),
+        ids=list(meta_row.ids),
+        seqlens=seqlens,
+        data=data,
+    )
+
+
 def _check_hbm_kill(perf: Dict[str, float]) -> None:
     """Fail the worker when device memory crosses a configured watermark
     (reference: model_worker.py:1434-1537 GPU-mem kill threshold) — a
@@ -282,6 +310,16 @@ class ModelWorker:
             self.data_cache[one.ids[0]] = one
         return {"meta": batch.meta()}
 
+    def _handle_shard_info(self, req):
+        """(shard_rank, n_shards) of the batch rows this process consumes
+        for the named model — the master's sharded data plane ships only
+        that row block when n > 1 (see master._dispatch_mfc)."""
+        engine = self.models[req["model_name"]].engine
+        if engine is None:
+            return {"rank": 0, "n": 1}
+        rank, n = engine.data_shard_info()
+        return {"rank": int(rank), "n": int(n)}
+
     def _handle_mfc(self, req):
         """Execute one model function call on cached data."""
         model_key: str = req["model_name"]
@@ -291,12 +329,39 @@ class ModelWorker:
         remap_in: Dict[str, str] = req.get("input_key_remap", {})
         remap_out: Dict[str, str] = req.get("output_key_remap", {})
         mb_spec: MicroBatchSpec = req.get("mb_spec") or MicroBatchSpec()
+        # Sharded dispatch: heavy keys arrived only for this member's own
+        # rows; other rows' arrays are zero-filled from metadata (their
+        # real values live on the processes whose devices consume them —
+        # identical PACK layout everywhere, local VALUES only where they
+        # land; see api/dfg.py MFCDef.shard_keys).
+        shard_of: Dict[str, list] = req.get("shard_of") or {}
+        shard_meta = req.get("shard_meta")
 
         parts = []
-        for sid in ids:
-            entry = self.data_cache[sid]
-            parts.append(entry.select_keys(input_keys & entry.keys))
+        for idx, sid in enumerate(ids):
+            entry = self.data_cache.get(sid)
+            have = input_keys & entry.keys if entry is not None else set()
+            part = entry.select_keys(have) if have else None
+            if shard_of:
+                missing = input_keys - have
+                if missing:
+                    mrow = shard_meta.select_idx([idx])
+                    zero = _zero_filled(mrow, missing & mrow.keys)
+                    if part is None:
+                        part = zero
+                    else:
+                        part.update_(zero)
+            if part is None:
+                raise KeyError(
+                    f"worker {self.config.worker_index}: no data for "
+                    f"{sid!r} (keys {sorted(input_keys)})"
+                )
+            parts.append(part)
         sample = SequenceSample.gather(parts)
+        if shard_of:
+            sample.metadata["shard_of"] = [
+                list(shard_of[sid]) for sid in ids
+            ]
         sample.remap_keys_(remap_in)
 
         model = self.models[model_key]

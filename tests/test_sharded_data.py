@@ -1,0 +1,492 @@
+"""Sharded data plane: per-member row shipping + shard-aligned packing.
+
+Reference behavior: realhf/system/data_manager.py:144-416 redistributes
+inputs shard-exactly so every worker receives only the rows its devices
+consume.  Here the master ships each SPMD group member its own row block
+for a node's `shard_keys` (api/dfg.py) and the packer derives an identical
+global row layout from metadata alone (engines/packing.py shard_blocks).
+
+A single test process cannot host a genuinely process-spanning mesh, so
+coverage splits into: (1) shard-ownership arithmetic on synthetic meshes,
+(2) metadata-determined pack/split parity against the unsharded path on
+real engines, and (3) the master-plane wire protocol + transfer accounting
+with per-member shard ranks injected.
+"""
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.base import topology
+from areal_tpu.engines import packing
+from tests import fixtures
+
+
+class _FakeDev:
+    def __init__(self, pi):
+        self.process_index = pi
+
+
+class _FakeMesh:
+    def __init__(self, shape, process_indices):
+        self.devices = np.array(
+            [_FakeDev(p) for p in process_indices], dtype=object
+        ).reshape(shape)
+
+
+class TestLocalBatchShard:
+    """Ownership arithmetic over (pipe, data, fsdp, seq, model) meshes."""
+
+    def test_single_process_owns_everything(self):
+        m = _FakeMesh((1, 2, 1, 1, 1), [0, 0])
+        assert topology.local_batch_shard(m, 0) == (0, 1)
+
+    def test_data_axis_split_across_processes(self):
+        m = _FakeMesh((1, 4, 1, 1, 1), [0, 0, 1, 1])
+        assert topology.local_batch_shard(m, 0) == (0, 2)
+        assert topology.local_batch_shard(m, 1) == (1, 2)
+
+    def test_model_axis_spanning_needs_full_batch(self):
+        # Pure TP across hosts: every process touches every batch coord.
+        m = _FakeMesh((1, 2, 1, 1, 2), [0, 1, 0, 1])
+        assert topology.local_batch_shard(m, 0) == (0, 1)
+        assert topology.local_batch_shard(m, 1) == (0, 1)
+
+    def test_data_and_model_split(self):
+        m = _FakeMesh((1, 2, 1, 1, 2), [0, 1, 2, 3])
+        assert topology.local_batch_shard(m, 0) == (0, 2)
+        assert topology.local_batch_shard(m, 1) == (0, 2)
+        assert topology.local_batch_shard(m, 2) == (1, 2)
+
+    def test_pipe_split_owns_everything(self):
+        m = _FakeMesh((2, 2, 1, 1, 1), [0, 0, 1, 1])
+        assert topology.local_batch_shard(m, 0) == (0, 1)
+
+    def test_fsdp_axis_counts_as_batch(self):
+        m = _FakeMesh((1, 1, 4, 1, 1), [0, 0, 1, 1])
+        assert topology.local_batch_shard(m, 1) == (1, 2)
+
+    def test_ragged_ownership_falls_back(self):
+        m = _FakeMesh((1, 4, 1, 1, 1), [0, 0, 0, 1])
+        assert topology.local_batch_shard(m, 1) == (0, 1)
+
+
+def _tagged_sample(n=8, n_shards=2, seed=0, with_data=True):
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(6, 20, size=n).tolist()
+    ids = [f"s{i}" for i in range(n)]
+    data = None
+    if with_data:
+        toks = rng.integers(1, 50, size=sum(lens)).astype(np.int32)
+        mask = rng.integers(0, 2, size=sum(lens)).astype(np.bool_)
+        data = {"packed_input_ids": toks, "prompt_mask": mask}
+    s = SequenceSample(
+        keys={"packed_input_ids", "prompt_mask"},
+        ids=ids,
+        seqlens={
+            "packed_input_ids": [[int(l)] for l in lens],
+            "prompt_mask": [[int(l)] for l in lens],
+        },
+        data=data,
+        metadata={
+            "shard_of": [[i % n_shards, n_shards] for i in range(n)]
+        },
+        dtypes={
+            "packed_input_ids": np.dtype(np.int32),
+            "prompt_mask": np.dtype(np.bool_),
+        },
+        trailing_shapes={"packed_input_ids": (), "prompt_mask": ()},
+    )
+    return s
+
+
+class TestShardBlocks:
+    def test_blocks_from_tags(self):
+        s = _tagged_sample(n=6, n_shards=2)
+        assert s.shard_blocks() == [[0, 2, 4], [1, 3, 5]]
+
+    def test_untagged_is_none(self):
+        s = _tagged_sample(n=4)
+        s.metadata.pop("shard_of")
+        assert s.shard_blocks() is None
+
+    def test_tags_survive_select_and_split(self):
+        s = _tagged_sample(n=8, n_shards=2)
+        sub = s.select_idx([1, 2, 5])
+        assert sub.metadata["shard_of"] == [[1, 2], [0, 2], [1, 2]]
+        for mb in s.split_balanced(2):
+            assert "shard_of" in mb.metadata
+            blocks = mb.shard_blocks()
+            assert blocks is not None and len(blocks) == 2
+
+    def test_split_balanced_keeps_shard_membership(self):
+        s = _tagged_sample(n=8, n_shards=2)
+        parts = s.split_balanced(2)
+        seen = []
+        for mb in parts:
+            for i, t in zip(mb.ids, mb.metadata["shard_of"]):
+                # The tag must match the original assignment.
+                orig = int(i[1:]) % 2
+                assert t[0] == orig
+                seen.append(i)
+        assert sorted(seen) == sorted(s.ids)
+
+
+class TestShardedPack:
+    def test_row_blocks_are_shard_aligned(self):
+        s = _tagged_sample(n=8, n_shards=2)
+        blocks = s.shard_blocks()
+        pk = packing.pack_sample(
+            s, "packed_input_ids", extra_keys=("prompt_mask",),
+            shard_blocks=blocks, max_tokens_per_row=32,
+        )
+        rows_per_shard = pk.n_rows // 2
+        for shard, block in enumerate(blocks):
+            for i in block:
+                r, _, _ = pk.seq_map[i]
+                assert shard * rows_per_shard <= r < (shard + 1) * rows_per_shard
+
+    def test_pack_content_parity_with_unsharded(self):
+        s = _tagged_sample(n=8, n_shards=2)
+        pk = packing.pack_sample(
+            s, "packed_input_ids", extra_keys=("prompt_mask",),
+            shard_blocks=s.shard_blocks(), max_tokens_per_row=32,
+        )
+        # Unpacking restores every sequence's tokens in original order.
+        got = pk.unpack(pk.arrays["tokens"])
+        np.testing.assert_array_equal(got, s.data["packed_input_ids"])
+        got_m = pk.unpack(pk.arrays["prompt_mask"])
+        np.testing.assert_array_equal(got_m, s.data["prompt_mask"])
+
+    def test_layout_derivable_from_metadata_alone(self):
+        """Every group member must compute the SAME split + pack layout
+        from seqlens + tags only (data values differ per member)."""
+        a = _tagged_sample(n=10, n_shards=2, seed=3)
+        b = _tagged_sample(n=10, n_shards=2, seed=3)
+        # Member b holds different (here: zeroed) data for shard-0 rows.
+        zero = np.zeros_like(b.data["packed_input_ids"])
+        b.data["packed_input_ids"] = zero
+        mb_spec = MicroBatchSpec(max_tokens_per_mb=48)
+        sa = packing.split_sharded(a, mb_spec)
+        sb = packing.split_sharded(b, mb_spec)
+        assert len(sa) == len(sb)
+        for (ma, ba), (mb_, bb) in zip(sa, sb):
+            assert list(ma.ids) == list(mb_.ids)
+            assert ba == bb
+            pa = packing.pack_sample(
+                ma, "packed_input_ids", shard_blocks=ba,
+                max_tokens_per_row=48,
+            )
+            pb = packing.pack_sample(
+                mb_, "packed_input_ids", shard_blocks=bb,
+                max_tokens_per_row=48,
+            )
+            assert pa.seq_map == pb.seq_map
+            assert pa.arrays["tokens"].shape == pb.arrays["tokens"].shape
+            np.testing.assert_array_equal(
+                pa.arrays["segment_ids"], pb.arrays["segment_ids"]
+            )
+
+    def test_shard_blocks_must_partition(self):
+        s = _tagged_sample(n=4, n_shards=2)
+        with pytest.raises(ValueError):
+            packing.pack_sample(
+                s, "packed_input_ids", shard_blocks=[[0, 1], [1, 2, 3]]
+            )
+
+
+class TestEngineShardParity:
+    """On one process all rows are addressable, so a tagged sample must
+    produce the same numbers as the untagged path — pinning that the
+    shard-aligned layout changes row placement, never semantics."""
+
+    def _engine_and_sample(self):
+        import jax
+
+        from areal_tpu.api.model_api import FinetuneSpec, OptimizerConfig
+        from areal_tpu.base.topology import ParallelConfig, make_mesh
+        from areal_tpu.engines.train import TrainEngine
+        from areal_tpu.models import transformer as tfm
+        from areal_tpu.models.config import tiny_config
+
+        cfg = tiny_config()
+        mesh = make_mesh(ParallelConfig(data=2), jax.devices()[:2])
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        eng = TrainEngine(
+            cfg, params, mesh,
+            optimizer_config=OptimizerConfig(
+                lr=1e-3, warmup_steps_proportion=0.0
+            ),
+            ftspec=FinetuneSpec(1, 8, 8),
+        )
+        rng = np.random.default_rng(7)
+        n = 8
+        lens = rng.integers(8, 24, size=n).tolist()
+        total = int(sum(lens))
+        s = SequenceSample(
+            keys={"packed_input_ids", "prompt_mask"},
+            ids=[f"q{i}" for i in range(n)],
+            seqlens={
+                "packed_input_ids": [[int(l)] for l in lens],
+                "prompt_mask": [[int(l)] for l in lens],
+            },
+            data={
+                "packed_input_ids": rng.integers(
+                    1, cfg.vocab_size, size=total
+                ).astype(np.int32),
+                "prompt_mask": np.concatenate(
+                    [
+                        np.arange(l) < max(2, l // 3)
+                        for l in lens
+                    ]
+                ),
+            },
+        )
+        return eng, s
+
+    def test_train_batch_parity(self):
+        from areal_tpu.ops import functional as F
+
+        eng, s = self._engine_and_sample()
+        mb_spec = MicroBatchSpec(max_tokens_per_mb=64)
+
+        base = eng.train_batch(
+            s, mb_spec, loss_fn=F.sft_loss,
+            loss_weight_fn=F.sft_label_count,
+            extra_keys=("prompt_mask",),
+        )
+        # Fresh engine (same init seed): the optimizer step above mutated
+        # the first one's params.
+        eng, _ = self._engine_and_sample()
+        tagged = SequenceSample(
+            keys=set(s.keys),
+            ids=list(s.ids),
+            seqlens={k: [list(x) for x in v] for k, v in s.seqlens.items()},
+            data=dict(s.data),
+            metadata={"shard_of": [[i % 2, 2] for i in range(s.bs)]},
+        )
+        got = eng.train_batch(
+            tagged, mb_spec, loss_fn=F.sft_loss,
+            loss_weight_fn=F.sft_label_count,
+            extra_keys=("prompt_mask",),
+        )
+        # One optimizer step each from the same start: the full-batch
+        # grad is a sum over sequences, invariant to row placement.
+        assert np.isclose(got["loss"], base["loss"], rtol=2e-3), (
+            got["loss"], base["loss"],
+        )
+
+    def test_forward_parity(self):
+        eng, s = self._engine_and_sample()
+        mb_spec = MicroBatchSpec(max_tokens_per_mb=64)
+        from areal_tpu.interfaces.ppo import _logprob_post
+
+        base = eng.forward(
+            s.select_keys({"packed_input_ids"}),
+            mb_spec,
+            post_fn=_logprob_post,
+            output_key="logprobs",
+        )
+        tagged = s.select_keys({"packed_input_ids"})
+        tagged.metadata["shard_of"] = [[i % 2, 2] for i in range(s.bs)]
+        got = eng.forward(
+            tagged, mb_spec, post_fn=_logprob_post, output_key="logprobs"
+        )
+        np.testing.assert_allclose(
+            np.asarray(got.data["logprobs"]),
+            np.asarray(base.data["logprobs"]),
+            rtol=2e-4, atol=2e-4,
+        )
+
+
+class TestMasterShardedDispatch:
+    """Wire protocol + transfer accounting with injected shard ranks."""
+
+    def _run(self, tmp_path, sharded: bool):
+        from areal_tpu.api.config import ModelAbstraction
+        from areal_tpu.api.data_api import DatasetAbstraction
+        from areal_tpu.api.model_api import OptimizerConfig
+        from areal_tpu.base.topology import ParallelConfig
+        from areal_tpu.experiments.common import (
+            MicroBatchSpec as _MBS,
+            SFTConfig,
+            build_sft,
+            run_experiment,
+        )
+        from areal_tpu.models.config import tiny_config
+        from areal_tpu.system.master import ExperimentSaveEvalControl
+
+        tok = fixtures.make_tokenizer()
+        cfg = SFTConfig(
+            model=ModelAbstraction("random", {"config": tiny_config()}),
+            dataset=DatasetAbstraction(
+                "prompt_answer",
+                {
+                    "dataset_builder": lambda: fixtures.build_sft_rows(
+                        16, seed=2
+                    ),
+                    "max_length": 128,
+                },
+            ),
+            parallel=ParallelConfig(data=2),
+            optimizer=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0),
+            batch_size=8,
+            total_train_epochs=1,
+            n_hosts=2,
+            ctrl=ExperimentSaveEvalControl(),
+            fileroot=str(tmp_path),
+        )
+        plan = build_sft(cfg, tok)
+        if not sharded:
+            for node in plan.dfg.nodes:
+                node.shard_keys = ()
+        # Inject per-member shard ranks: a single test process owns every
+        # device, so real engines report (0, 1); a genuinely spanning
+        # mesh is a multi-process world.
+        from areal_tpu.system.worker import ModelWorker
+
+        orig = ModelWorker._handle_shard_info
+
+        def fake(self, req):
+            return {"rank": self.config.worker_index, "n": 2}
+
+        ModelWorker._handle_shard_info = fake
+        try:
+            master, stats = run_experiment(plan, tokenizer=tok)
+        finally:
+            ModelWorker._handle_shard_info = orig
+        return master, stats
+
+    def test_sharded_ships_fewer_bytes_end_to_end(self, tmp_path):
+        m_full, st_full = self._run(tmp_path / "full", sharded=False)
+        m_sh, st_sh = self._run(tmp_path / "sh", sharded=True)
+        assert len(st_full) == len(st_sh)
+        full = np.mean([s["transfer/data_bytes"] for s in st_full])
+        sh = np.mean([s["transfer/data_bytes"] for s in st_sh])
+        # The dataset lives on member 0, so only member 1 receives bytes:
+        # full ships ids+mask (5 B/token); sharded ships half the int32
+        # ids + the whole 1-byte mask (3 B/token) plus per-transfer
+        # framing.  Exact per-(id,key) routing is pinned by
+        # test_dispatch_protocol; this is the wire-level smoke.
+        assert sh < 0.85 * full, (sh, full)
+        assert sh > 0.40 * full, (sh, full)
+
+    def test_dispatch_protocol(self):
+        """Exact (id, key) routing of a sharded dispatch: each member gets
+        its own block's heavy keys, everyone gets the broadcast keys, and
+        the payload carries shard tags + metadata for zero-fill."""
+        import asyncio
+
+        from areal_tpu.api.config import (
+            ModelInterfaceAbstraction,
+            ModelInterfaceType,
+            ModelName,
+        )
+        from areal_tpu.api.dfg import MFCDef, build_graph
+        from areal_tpu.system.master import (
+            ExperimentSaveEvalControl,
+            MasterWorker,
+        )
+
+        node = MFCDef(
+            name="train",
+            model_name=ModelName("m"),
+            interface_type=ModelInterfaceType.TRAIN_STEP,
+            interface_impl=ModelInterfaceAbstraction("sft"),
+            input_keys=("packed_input_ids", "prompt_mask"),
+            shard_keys=("packed_input_ids",),
+            n_seqs=4,
+        )
+        dfg = build_graph([node])
+
+        sent = []  # (dst, request dict)
+
+        class _Pool:
+            n = 4
+
+            async def request(self, w, payload):
+                sent.append((w, payload))
+                t = payload["type"]
+                if t == "shard_info":
+                    return {"rank": w // 2, "n": 2}  # members 0,1 | 2,3
+                if t == "data_send":
+                    return {"bytes": 1, "seconds": 0.0}
+                if t == "data_recv":
+                    return {"seconds": 0.0}
+                if t == "mfc":
+                    return {"meta": None, "stats": {}}
+                return {}
+
+            @property
+            def n_workers(self):
+                return self.n
+
+        master = MasterWorker(
+            dfg=dfg,
+            pool=_Pool(),
+            model_placement={"m@0": 0},
+            data_worker_ids=[],
+            ctrl=ExperimentSaveEvalControl(),
+            model_groups={"m@0": [0, 1, 2, 3]},
+        )
+        ids = [f"x{i}" for i in range(4)]
+        # All data owned by a worker outside the group (id 3 is in-group;
+        # use a pseudo owner 0 for simplicity: member 0 holds everything).
+        for sid in ids:
+            master._owners[sid] = {
+                "packed_input_ids": {0},
+                "prompt_mask": {0},
+            }
+        meta = SequenceSample(
+            keys={"packed_input_ids", "prompt_mask"},
+            ids=ids,
+            seqlens={
+                "packed_input_ids": [[10]] * 4,
+                "prompt_mask": [[10]] * 4,
+            },
+            data=None,
+            dtypes={
+                "packed_input_ids": np.dtype(np.int32),
+                "prompt_mask": np.dtype(np.bool_),
+            },
+            trailing_shapes={"packed_input_ids": (), "prompt_mask": ()},
+        )
+        asyncio.run(
+            master._dispatch_mfc(node, ids, [0, 1, 2, 3], meta=meta)
+        )
+
+        shipped = {}  # dst -> key -> set(ids)
+        for w, p in sent:
+            if p["type"] != "data_send":
+                continue
+            for k in p["keys"]:
+                shipped.setdefault(p["dst"], {}).setdefault(k, set()).update(
+                    p["ids"]
+                )
+        # Equal-size blocks of the 4 equal-length ids: one block per shard
+        # rank; members 0,1 are rank 0, members 2,3 rank 1.
+        mfc_payloads = [p for _, p in sent if p["type"] == "mfc"]
+        assert len(mfc_payloads) == 4
+        tags = mfc_payloads[0]["shard_of"]
+        assert set(tags) == set(ids) and all(
+            t[1] == 2 for t in tags.values()
+        )
+        blk0 = {sid for sid, t in tags.items() if t[0] == 0}
+        blk1 = {sid for sid, t in tags.items() if t[0] == 1}
+        assert len(blk0) == len(blk1) == 2
+        # Member 0 owns everything: nothing shipped to it.
+        assert 0 not in shipped
+        # Member 1 (rank 0): its block's ids + the full broadcast mask.
+        assert shipped[1]["packed_input_ids"] == blk0
+        assert shipped[1]["prompt_mask"] == set(ids)
+        # Members 2,3 (rank 1): the other block + the mask.
+        for w in (2, 3):
+            assert shipped[w]["packed_input_ids"] == blk1
+            assert shipped[w]["prompt_mask"] == set(ids)
+        # Payload metadata enables zero-fill on every member.
+        sm = mfc_payloads[0]["shard_meta"]
+        assert sm.dtypes["packed_input_ids"] == np.dtype(np.int32)
+
+    def test_sharded_trial_completes(self, tmp_path):
+        _, stats = self._run(tmp_path, sharded=True)
+        assert stats and all(np.isfinite(s["loss"]) for s in stats)
