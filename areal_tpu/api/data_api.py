@@ -328,6 +328,13 @@ class SequenceSample:
         if blocks is None:
             groups = datapack.partition_balanced(lens, k)
             return [self.select_idx(g) for g in groups]
+        # A shard smaller than k covers only parts 0..len-1; when EVERY
+        # shard is smaller than k, later parts would be empty even though
+        # bs >= k holds globally (e.g. 2 shards x 3 rows, k=4).  Shrink k
+        # to the max any shard can fill — derived from metadata alone, so
+        # every SPMD member shrinks identically.  Callers get fewer (but
+        # never empty) minibatches.
+        k = min(k, max(len(b) for b in blocks))
         per = [
             datapack.partition_balanced([lens[i] for i in b], k)
             if len(b) >= k

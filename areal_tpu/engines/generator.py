@@ -67,6 +67,11 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             compute_dtype = jnp.float32
         self.compute_dtype = compute_dtype
         self.max_decode_batch = max_decode_batch
+        # Decode budget above which generate() refuses the static
+        # single-program path even when every request fits one pool (see
+        # generate() routing): 2048 steps ≈ tens of seconds per program,
+        # comfortably under device-runtime watchdogs.
+        self.static_path_max_new = 2048
         # When True (default), set_params COPIES any leaf whose buffers
         # alias the source tree — required when generation can overlap a
         # train step that donates those buffers (rollout_ahead).  In a
@@ -228,8 +233,17 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             # Static chunks win when every request fits one pool (uniform
             # lengths, no refills, zero per-chunk host round-trips);
             # inflight wins when stragglers would otherwise stall retired
-            # slots.
-            inflight = len(reqs) > b_cap
+            # slots.  Long decodes ALWAYS go inflight: the static path is
+            # one device program whose while_loop runs the whole decode
+            # (minutes on-device at 16k+ steps — TPU runtime watchdogs
+            # kill it as a stuck kernel) and allocates the full final KV
+            # window from step 0, streaming depth it doesn't need yet on
+            # every step; the inflight chunk loop keeps each program
+            # ~chunk_t tokens and grows the window geometrically.
+            inflight = (
+                len(reqs) > b_cap
+                or gconfig.max_new_tokens > self.static_path_max_new
+            )
         if inflight:
             self._generate_inflight(
                 [reqs[j] for j in order], gconfig, key, results

@@ -74,6 +74,20 @@ def make_optimizer(cfg: OptimizerConfig, total_steps: int) -> optax.GradientTran
     return optax.chain(*chain)
 
 
+@functools.lru_cache(maxsize=None)
+def _moments_fn(value_keys: Tuple[str, ...], mask_key: str):
+    @jax.jit
+    def f(batch):
+        mask = batch[mask_key] > 0
+        out = {"count": mask.sum().astype(jnp.float32)}
+        for k in value_keys:
+            v = jnp.where(mask, batch[k].astype(jnp.float32), 0.0)
+            out[k] = jnp.stack([v.sum(), (v * v).sum(), jnp.abs(v).sum()])
+        return out
+
+    return f
+
+
 def _cast_tree(tree, dtype):
     return jax.tree.map(
         lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
@@ -298,6 +312,22 @@ class TrainEngine(HostOffloadMixin, Engine):
         total_weight = float(sum(loss_weight_fn(c) for c in chunks))
         total_weight = max(total_weight, 1.0)
 
+        # Pack efficiency diagnostics: the MFU counter charges REAL
+        # tokens, the MXU computes PADDED grids — the ratio is the
+        # first thing to check when train MFU disappoints.
+        real_tokens = sum(
+            int((c["segment_ids"] > 0).sum()) for c in chunks
+        )
+        grid_tokens = sum(
+            int(np.prod(c["segment_ids"].shape)) for c in chunks
+        )
+        self.last_pack_stats = {
+            "real_tokens": real_tokens,
+            "grid_tokens": grid_tokens,
+            "pack_efficiency": real_tokens / max(grid_tokens, 1),
+            "n_micro_batches": len(chunks),
+        }
+
         grad_fn, grad_acc_fn = self._get_grad_fn(loss_fn)
         acc = None
         losses = []
@@ -334,6 +364,55 @@ class TrainEngine(HostOffloadMixin, Engine):
             else:
                 out[k] = float(np.mean(vals))
         return out
+
+    def masked_moments(
+        self,
+        sample: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        value_keys: Sequence[str],
+        mask_key: str = "loss_mask",
+        token_key: str = "packed_input_ids",
+    ) -> Dict[str, Any]:
+        """Exact batch-global masked reductions, computed ON DEVICE.
+
+        Under sharded data dispatch each member's HOST arrays hold real
+        values only for its own rows (the rest are zero-filled
+        placeholders), but the PLACED arrays are globally real: every
+        process contributes its own row block via
+        `sharding.place_rows` / `jax.make_array_from_process_local_data`.
+        A jitted global reduction over them is therefore exact and
+        identical on every SPMD member — the in-mesh replacement for the
+        full-batch redistribution that makes the reference's host-side
+        batch statistics trivially global
+        (realhf/system/data_manager.py:144-416).  PPO's batch-global
+        advantage moments, ref-KL, and value-norm running moments ride
+        this; without it those statistics would silently diverge across
+        members (each seeing zeros for the others' rows).
+
+        Returns {"count": N} plus, per value key, a float64 numpy vector
+        `[masked_sum, masked_sum_of_squares, masked_abs_sum]`.  Values
+        and mask must be token-aligned with `token_key`.
+        """
+        self._ensure_loaded()
+        value_keys = tuple(value_keys)
+        fn = _moments_fn(value_keys, mask_key)
+        count = 0.0
+        acc = {k: np.zeros(3, np.float64) for k in value_keys}
+        for mb, blocks in packing.split_sharded(sample, mb_spec):
+            pk = packing.pack_sample(
+                mb,
+                token_key,
+                extra_keys=value_keys + (mask_key,),
+                n_rows_multiple=self.batch_shard,
+                max_tokens_per_row=mb_spec.max_tokens_per_mb,
+                shard_blocks=blocks,
+            )
+            out = fn(self._device_batch(pk.arrays))
+            count += float(out["count"])
+            for k in value_keys:
+                acc[k] += np.asarray(out[k], np.float64)
+        acc["count"] = count
+        return acc
 
     def forward(
         self,

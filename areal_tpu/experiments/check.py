@@ -113,6 +113,17 @@ def check_ppo_math(cfg) -> None:
         _fail("fuse_rew_ref needs a ref model")
     if cfg.rollout_ahead not in (0, 1):
         _fail(f"rollout_ahead must be 0 or 1, got {cfg.rollout_ahead}")
+    if cfg.rollout_ahead > 0 and getattr(
+        cfg, "gen_backend_args", {}
+    ).get("donation_safe_swap") is False:
+        # The copy-free hot-swap aliases the train master's buffers; with
+        # one-step-ahead rollout the generator DECODES while the optimizer
+        # donates those buffers — a use-after-free, not a memory tradeoff.
+        _fail(
+            "donation_safe_swap=False requires synchronous rollout "
+            "(rollout_ahead=0): async generation would decode from "
+            "buffers the optimizer step donates"
+        )
     if cfg.dataset_filter:
         lo = cfg.dataset_filter.get("min_accuracy", 0.0)
         hi = cfg.dataset_filter.get("max_accuracy", 1.0)

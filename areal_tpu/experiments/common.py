@@ -187,6 +187,13 @@ class PPOMathConfig:
     # Asynchronous rollout: overlap next-step generation with training
     # (one-step-stale behavior policy, PPO-ratio-corrected).
     rollout_ahead: int = 0
+    # Extra GeneratorEngine kwargs (e.g. max_decode_batch, or forcing
+    # donation_safe_swap — config check rejects the alias mode under
+    # rollout_ahead>0).  Defaults supplied by build_ppo_math win unless
+    # overridden here.
+    gen_backend_args: Dict[str, Any] = dataclasses.field(
+        default_factory=dict
+    )
     # Host-offload the reference model's params after each ref_inf call
     # (OffloadHook; frees its HBM between steps).
     offload_ref: bool = False
@@ -410,25 +417,20 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
             )
         )
         train_inputs.append("values")
-    # Sharded dispatch for the actor train step: legal only when the
-    # PPO host path's batch-global advantage statistics depend solely on
-    # broadcast data — the GRPO default (no KL-in-reward, or no adv
-    # norm); see PPOActorInterface.train_step's runtime guard.
-    a = dict(cfg.ppo_kwargs)
-    no_kl_reward = (
-        float(a.get("kl_ctl", 0.0)) == 0.0
-        and not a.get("kl_adaptive", False)
+    # Sharded dispatch for the train steps: per-row math consumes only
+    # the member's own (real) rows, and batch-GLOBAL statistics —
+    # advantage moments, ref-KL (incl. the adaptive controller), the
+    # critic's value-norm running moments — come from an exact in-mesh
+    # reduction over the placed arrays (TrainEngine.masked_moments), so
+    # every PPO configuration dispatches shard-exact.  prompt_mask stays
+    # broadcast: sequence layout (loss masks, prompt lengths) must be
+    # derivable by every member from global data.  (The reference
+    # redistributes full batches instead, data_manager.py:144-416.)
+    _heavy = (
+        "packed_input_ids", "packed_logprobs", "packed_ref_logprobs",
+        "values", "dense_rewards",
     )
-    train_shard_keys: tuple = ()
-    if critic is None and (no_kl_reward or not a.get("adv_norm", True)):
-        train_shard_keys = tuple(
-            k
-            for k in train_inputs
-            if k in (
-                "packed_input_ids", "packed_logprobs",
-                "packed_ref_logprobs",
-            )
-        )
+    train_shard_keys = tuple(k for k in train_inputs if k in _heavy)
     train_post_hooks = [ParamReallocHook(target=actor_gen)]
     if cfg.ref_ema_eta is not None:
         if ref is None:
@@ -467,6 +469,9 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                     "packed_input_ids", "prompt_mask", "packed_logprobs",
                     "seq_no_eos_mask", "rewards", "values",
                 ),
+                shard_keys=(
+                    "packed_input_ids", "packed_logprobs", "values",
+                ),
                 n_seqs=cfg.batch_size,
                 mb_spec=cfg.mb_spec,
             )
@@ -493,7 +498,23 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
             else ModelShardSpec(
                 name=actor_gen,
                 model=cfg.actor,
-                backend=ModelBackendAbstraction("generator"),
+                # Synchronous trials (rollout_ahead=0): generation never
+                # overlaps the donating optimizer step, so the generator
+                # may ALIAS the train master's buffers instead of copying
+                # them (set_params' defensive copy is what the copy-vs-OOM
+                # margin is for 1.5B on a 16 GB chip); the master releases
+                # the alias before each aliased train step (see
+                # MasterWorker._release_aliased_generators).  One-step-
+                # ahead rollout decodes DURING training and must keep the
+                # defensive copy.  Reference mechanism this replaces:
+                # the weight-refresh dance in model_worker.py:1040-1067.
+                backend=ModelBackendAbstraction(
+                    "generator",
+                    {
+                        "donation_safe_swap": cfg.rollout_ahead > 0,
+                        **cfg.gen_backend_args,
+                    },
+                ),
                 interface=actor_if,
                 parallel=cfg.gen_parallel or cfg.actor_parallel,
                 device_offset=cfg.gen_device_offset,

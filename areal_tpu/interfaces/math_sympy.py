@@ -217,6 +217,20 @@ def _parse(s: str):
 def _exprs_equal(a: str, b: str) -> bool:
     import sympy
 
+    ta, tb = latex_to_expr(a), latex_to_expr(b)
+    # General equations (lhs = rhs on both sides): compare the zero-forms
+    # up to overall sign — "-34x-45y+20z-100=0" must equal
+    # "34x+45y-20z+100=0" (reference: grader.py:312 compares
+    # |lhs-rhs| symbolically).
+    if ta.count("=") == 1 and tb.count("=") == 1:
+        da = _parse_equation_diff(ta)
+        db = _parse_equation_diff(tb)
+        if da is not None and db is not None:
+            return bool(
+                sympy.simplify(da - db) == 0
+                or sympy.simplify(da + db) == 0
+            )
+
     ea, eb = _parse(a), _parse(b)
     if ea == eb:
         return True
@@ -228,8 +242,41 @@ def _exprs_equal(a: str, b: str) -> bool:
             return True
     except (TypeError, ValueError):
         pass
+    # Pure numbers: the reference grades digit pairs with rel_tol=1e-4
+    # (grader.py:278) — "2.6667" equals 8/3.
+    if not ea.free_symbols and not eb.free_symbols:
+        try:
+            fa, fb = complex(sympy.N(ea, 15)), complex(sympy.N(eb, 15))
+            if abs(fa - fb) <= 1e-4 * max(abs(fb), 1e-12):
+                return True
+        except (TypeError, ValueError):
+            pass
     res = ea.equals(eb)
     return bool(res)
+
+
+def _parse_equation_diff(txt: str):
+    """lhs-rhs of a general equation, or None when either side does not
+    parse as an expression (single-variable 'x = 5' keeps its dedicated
+    grade-the-rhs path in `_parse`)."""
+    lhs, rhs = txt.split("=")
+    if re.fullmatch(r"\s*[a-zA-Z]\w*\s*", lhs):
+        return None
+    from sympy.parsing.sympy_parser import (
+        implicit_multiplication_application,
+        parse_expr,
+        standard_transformations,
+    )
+
+    try:
+        tr = standard_transformations + (
+            implicit_multiplication_application,
+        )
+        return parse_expr(lhs, transformations=tr, evaluate=True) - parse_expr(
+            rhs, transformations=tr, evaluate=True
+        )
+    except Exception:
+        return None
 
 
 def sympy_match_worker(pred: str, gold: str) -> bool:

@@ -84,13 +84,53 @@ def _as_number(s: str) -> Optional[Fraction]:
         return None
 
 
+def choice_answer_clean(pred: str) -> str:
+    """Multiple-choice extraction, reference-parity
+    (evaluation/grader.py:30 / evaluation/parser.py:373): the LAST
+    standalone A-E letter in the prediction wins ('The answer is (B).'
+    -> 'B'); otherwise the stripped prediction itself."""
+    pred = pred.strip("\n").rstrip(".").rstrip("/").strip(" ").lstrip(":")
+    found = re.findall(r"\b(A|B|C|D|E)\b", pred.upper())
+    out = found[-1] if found else pred.strip().strip(".")
+    return out.rstrip(".").rstrip("/")
+
+
+def is_multi_choice(gold: str) -> bool:
+    """True when the gold answer is one or more choice letters (GPQA /
+    MMLU-style), e.g. 'B' or 'ACD' (reference: math_eval.py:369)."""
+    g = gold.strip()
+    return bool(g) and all(c in "ABCDE" for c in g)
+
+
+def choice_match(pred: str, gold: str) -> bool:
+    gold = gold.strip()
+    if len(gold) == 1:
+        return choice_answer_clean(pred) == gold
+    # Multi-letter golds: collect STANDALONE letters (word-boundary, like
+    # the single-letter path) so prose ("the answers are A, C and D")
+    # doesn't shed stray capitals into the comparison; a bare compact
+    # answer ("ACD") has no \b-separated letters and falls back to the
+    # reference's char filter over the extracted answer
+    # (math_eval.py:596).
+    standalone = re.findall(r"\b([A-E])\b", pred.upper())
+    if standalone:
+        return "".join(standalone) == gold
+    return "".join(c for c in pred.upper() if c in "ABCDE") == gold
+
+
 def answers_match(pred: str, gold: str) -> bool:
     p, g = normalize(pred), normalize(gold)
     if p == g:
         return True
     pn, gn = _as_number(p), _as_number(g)
     if pn is not None and gn is not None:
-        return pn == gn
+        if pn == gn:
+            return True
+        # Reference numeric semantics (evaluation/grader.py:106,278):
+        # percent-flexible (x matches x/100 and 100x) with rel_tol=1e-4.
+        for cand in (gn, gn / 100, gn * 100):
+            if abs(pn - cand) <= 1e-4 * max(abs(cand), 1e-12):
+                return True
     return False
 
 
@@ -103,16 +143,30 @@ def verify_math(
     (0.5 vs \\frac{\\sqrt2}{2}-style mismatches, intervals, matrices) fall
     through to the sympy grader with a hard per-call timeout."""
     pred = extract_answer(generated_text)
-    if pred is None:
-        return False
     golds = []
     for sol in solutions:
         gold = extract_boxed(sol)
         if gold is None:
             gold = sol
-        if answers_match(pred, gold):
+        # Multiple-choice golds (GPQA-style) grade through choice
+        # extraction — a boxed answer is not required; without one, the
+        # last non-empty line stands in (prose earlier in the generation
+        # is full of stray capitals the \b(A|..)\b scan would hit).
+        if is_multi_choice(gold):
+            cand = pred
+            if cand is None:
+                lines = [
+                    l for l in generated_text.strip().splitlines() if l.strip()
+                ]
+                cand = lines[-1] if lines else ""
+            if choice_match(cand, gold):
+                return True
+            continue
+        if pred is not None and answers_match(pred, gold):
             return True
         golds.append(gold)
+    if pred is None:
+        return False
     if use_sympy:
         from areal_tpu.interfaces.math_sympy import answers_match_sympy
 

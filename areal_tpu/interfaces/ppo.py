@@ -57,6 +57,11 @@ def _ppo_actor_loss_factory(eps_clip: float):
             "importance_weight_sum": jnp.where(mask, ratio, 0.0).sum(),
             "clip_ratio_sum": n_clipped.astype(jnp.float32),
             "approx_kl_sum": approx_kl,
+            # |adv| rides the device stats (not host numpy) so the value
+            # is exact under sharded dispatch, where host arrays are
+            # zero-filled for other members' rows but the placed batch is
+            # globally real.
+            "advantage_abs_sum": jnp.where(mask, jnp.abs(adv), 0.0).sum(),
         }
 
     return loss_fn
@@ -305,19 +310,16 @@ class PPOActorInterface(ModelInterface):
         klv = self._kl().value
         # Sharded data plane: heavy per-token inputs hold real values only
         # for this member's own rows (layout metadata and per-seq keys are
-        # global).  Everything below stays SPMD-consistent — loss_mask and
-        # total weight derive from layout, GRPO group stats from broadcast
-        # per-seq scores, per-token arrays are only consumed by the rows'
-        # own devices — EXCEPT batch-global advantage normalization over
-        # per-token terms that differ across members.
-        if sample.shard_blocks() is not None and self.adv_norm and (
-            klv != 0.0 or not self.disable_value
-        ):
-            raise NotImplementedError(
-                "adv_norm over per-token advantage terms (KL-in-reward or "
-                "GAE values) is not batch-global under sharded data "
-                "dispatch; drop the node's shard_keys or disable adv_norm"
-            )
+        # global).  Per-row math below stays SPMD-consistent — loss_mask
+        # and total weight derive from layout, GRPO group stats from
+        # broadcast per-seq scores, per-token arrays are only consumed by
+        # the rows' own devices.  Batch-GLOBAL statistics (advantage
+        # moments for adv_norm, the policy↔ref KL for the stat and the
+        # adaptive controller) cannot come from these host arrays; they
+        # are computed by an exact in-mesh reduction over the placed
+        # arrays instead (TrainEngine.masked_moments) — identical on
+        # every member, so adaptive kl_ctl stays in lockstep.
+        sharded = sample.shard_blocks() is not None
         layout, group_of = _extract_layout(sample)
         total = sum(L for (_, L, _) in layout)
 
@@ -446,9 +448,46 @@ class PPOActorInterface(ModelInterface):
                     adv_full[lo:hi] = adv1[off : off + n]
                     off += n
 
+        # Batch-global moments: under sharded dispatch, reduce on device
+        # (one cheap extra placement of [adv, klterm, mask]); otherwise
+        # host numpy.  ref_kl uses the same pass — computed here, the
+        # controller update stays at its reference timing (post-update
+        # loop, ppo_interface.py:105).
+        ref_kl = None
+        batch_norm = self.adv_norm and not (
+            self.group_adv_norm and not self.disable_value
+        )
+        if sharded and (batch_norm or ref_logp is not None):
+            probe = sample.select_keys({"packed_input_ids"})
+            arrays = {"loss_mask": loss_mask}
+            vkeys = []
+            if batch_norm:
+                arrays["adv_probe"] = adv_full
+                vkeys.append("adv_probe")
+            if ref_logp is not None:
+                arrays["klterm"] = (old_logp - ref_logp) * loss_mask
+                vkeys.append("klterm")
+            _add_aligned_keys(probe, arrays)
+            mom = model.engine.masked_moments(
+                probe, mb_spec, vkeys, mask_key="loss_mask"
+            )
+            cnt = mom["count"]
+            if batch_norm and cnt > 0:
+                s, ssq, _ = mom["adv_probe"]
+                mean = s / cnt
+                std = float(np.sqrt(max(ssq / cnt - mean * mean, 0.0)))
+                m = loss_mask > 0
+                adv_full[m] = (adv_full[m] - mean) / (std + 1e-5)
+            if ref_logp is not None and cnt > 0:
+                ref_kl = float(mom["klterm"][0] / cnt)
         if self.adv_norm:
             m = loss_mask > 0
-            if self.group_adv_norm and not self.disable_value:
+            if not batch_norm:
+                # group_adv_norm is row-local (a group is one batch
+                # element, never split across shards): each member
+                # normalizes with its own rows' real data; garbage
+                # normalizations of other members' zero-filled rows are
+                # never consumed by their devices.
                 for gi in set(group_of):
                     gm = np.zeros_like(m)
                     for si, (lo, hi) in enumerate(seq_slices):
@@ -459,9 +498,10 @@ class PPOActorInterface(ModelInterface):
                         adv_full[gm] = (vals - vals.mean()) / (
                             vals.std() + 1e-5
                         )
-            elif m.any():
+            elif not sharded and m.any():
                 vals = adv_full[m]
                 adv_full[m] = (vals - vals.mean()) / (vals.std() + 1e-5)
+            # (sharded batch_norm already applied from device moments)
 
         train_sample = sample.select_keys(
             {"packed_input_ids", "prompt_mask"}
@@ -517,19 +557,25 @@ class PPOActorInterface(ModelInterface):
         # Adaptive KL control: steer next step's coefficient by this
         # batch's measured policy↔ref KL (reference updates inside the loss
         # fn with the same post-reward timing, ppo_interface.py:105).
-        ref_kl = 0.0
+        # Under sharded dispatch ref_kl was already device-reduced above
+        # (exact + identical on every member, so the controller cannot
+        # drift across the SPMD group); the host formula here would be
+        # understated ~1/n_shards by the zero-filled rows.
+        if ref_kl is None:
+            ref_kl = 0.0
+            if ref_logp is not None and loss_mask.sum() > 0:
+                ref_kl = float(
+                    ((old_logp - ref_logp) * loss_mask).sum()
+                    / loss_mask.sum()
+                )
         if ref_logp is not None and loss_mask.sum() > 0:
-            ref_kl = float(
-                ((old_logp - ref_logp) * loss_mask).sum() / loss_mask.sum()
-            )
             self._kl().update(ref_kl, n_steps=len(layout))
 
         out.update(
             task_reward=float(scores.mean()),
             no_eos_ratio=float(no_eos.mean()),
-            advantage_abs=float(np.abs(adv_full[loss_mask > 0]).mean())
-            if (loss_mask > 0).any()
-            else 0.0,
+            # advantage_abs arrives from the jitted loss stats (exact
+            # under sharding); out already carries it.
             n_response_tokens=float(loss_mask.sum()),
             kl_ctl_value=klv,
             ref_kl=ref_kl,
@@ -679,8 +725,27 @@ class PPOCriticInterface(ModelInterface):
             # Update running moments with this batch's real-scale returns,
             # then train the head against NORMALIZED targets (old values
             # re-normalized so the clip window lives in the same space).
+            # Sharded dispatch: host returns are garbage for other
+            # members' rows (their `values` are zero-filled), so the
+            # batch moments come from the exact in-mesh reduction —
+            # identical on every member, keeping the running stats in
+            # lockstep across the SPMD group.
             rms = self._rms()
-            rms.update(returns_full, mask=loss_mask)
+            if sample.shard_blocks() is not None:
+                probe = sample.select_keys({"packed_input_ids"})
+                _add_aligned_keys(
+                    probe,
+                    {"ret_probe": returns_full, "loss_mask": loss_mask},
+                )
+                mom = model.engine.masked_moments(
+                    probe, mb_spec, ("ret_probe",), mask_key="loss_mask"
+                )
+                cnt = mom["count"]
+                if cnt > 0:
+                    s, ssq, _ = mom["ret_probe"]
+                    rms.update_moments(s / cnt, ssq / cnt, cnt)
+            else:
+                rms.update(returns_full, mask=loss_mask)
             returns_full = rms.normalize(returns_full)
             values = rms.normalize(values)
 

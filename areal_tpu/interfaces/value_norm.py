@@ -42,8 +42,17 @@ class ExponentialRunningMeanStd:
         else:
             bm = float(x.mean())
             bmsq = float(np.square(x).mean())
-        self._mean = self.beta * self._mean + (1.0 - self.beta) * bm
-        self._mean_sq = self.beta * self._mean_sq + (1.0 - self.beta) * bmsq
+        self.update_moments(bm, bmsq, 1.0)
+
+    def update_moments(self, mean: float, mean_sq: float, count: float):
+        """Update from precomputed batch moments — the entry point for
+        sharded data dispatch, where the batch mean/mean² come from an
+        exact in-mesh reduction (TrainEngine.masked_moments) instead of
+        host arrays that are zero-filled for other members' rows."""
+        if count <= 0:
+            return
+        self._mean = self.beta * self._mean + (1.0 - self.beta) * mean
+        self._mean_sq = self.beta * self._mean_sq + (1.0 - self.beta) * mean_sq
         self._debias = self.beta * self._debias + (1.0 - self.beta)
 
     def mean_std(self):
@@ -97,6 +106,14 @@ class MovingAverageRunningMeanStd:
             self._sum += float(x.sum())
             self._sum_sq += float(np.square(x).sum())
             self._count += float(x.size)
+
+    def update_moments(self, mean: float, mean_sq: float, count: float):
+        """See ExponentialRunningMeanStd.update_moments."""
+        if count <= 0:
+            return
+        self._sum += mean * count
+        self._sum_sq += mean_sq * count
+        self._count += count
 
     def mean_std(self):
         if self._count == 0.0:

@@ -39,11 +39,15 @@ class ModelConfig:
     moe_intermediate_dim: int = 0
     # Router aux loss coefficient (reference: modules/moe/router.py)
     moe_aux_loss_coef: float = 0.001
-    # "topk": capacity-based dispatch — expert FLOPs scale with top-k, not
-    # E (tokens over capacity are dropped, GShard-style).  "dense": every
-    # expert computes every token then results are weight-masked — E/k
-    # times the FLOPs, kept as the numerics oracle.
-    moe_dispatch: str = "topk"
+    # "grouped" (default): dropless grouped-GEMM over expert-sorted
+    # tokens via jax.lax.ragged_dot (megablox-style) — expert FLOPs
+    # exactly proportional to tokens, numerics equal to the oracle.
+    # "topk": capacity-based dispatch — FLOPs scale with top-k times the
+    # capacity factor, tokens over capacity are dropped (GShard-style);
+    # the true-EP path (all-to-all over the expert axis).  "dense":
+    # every expert computes every token then results are weight-masked —
+    # E/k times the FLOPs, kept as the numerics oracle.
+    moe_dispatch: str = "grouped"
     # Expert capacity = ceil(T * k / E * this); 1.0 = perfectly balanced.
     moe_capacity_factor: float = 1.25
     # ---- architecture family switches (reference: api/from_hf/*) ----

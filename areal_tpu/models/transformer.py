@@ -263,9 +263,50 @@ def _mlp_moe_topk(h: jax.Array, blk: Params, cfg: ModelConfig) -> Tuple[jax.Arra
     return out.reshape(b, s, d), aux
 
 
+def _mlp_moe_grouped(h: jax.Array, blk: Params, cfg: ModelConfig) -> Tuple[jax.Array, jax.Array]:
+    """Dropless grouped-GEMM dispatch (the default): tokens sorted by
+    expert, expert matmuls ride `jax.lax.ragged_dot` — XLA:TPU's native
+    megablox-style ragged kernel, which tiles each expert's contiguous
+    row group onto the MXU without materializing per-expert buffers.
+
+    Expert FLOPs are exactly 3·T·k·D·F — proportional to TOKENS, where
+    the dense oracle pays E/k× that and capacity dispatch pays
+    capacity_factor× plus GShard's one-hot dispatch einsums (T·E·C·D
+    each, quadratic in T).  No token is ever dropped, so this matches
+    the dense oracle bit-for-bit up to matmul rounding.  The TPU
+    equivalent of the reference's grouped GEMM
+    (realhf/impl/model/utils/moe.py, tests/cpp_extensions/
+    test_grouped_gemm.py:149).
+
+    Under expert-parallel meshes the stacked expert weights are sharded
+    over fsdp (parallel/sharding.py moe rules); GSPMD resolves
+    ragged_dot by gathering the expert dim — ZeRO-style weight
+    gathering, the right trade below ~100B total expert bytes.  True
+    token all-to-all EP stays on `moe_dispatch="topk"`.
+    """
+    b, s, d = h.shape
+    x = h.reshape(-1, d)  # [T, D]
+    T = x.shape[0]
+    k = cfg.n_experts_per_tok
+    top_w, top_idx, one_hot, aux = _moe_route(x, blk, cfg)
+    flat_e = top_idx.reshape(-1)  # [T*k], token-major
+    order = jnp.argsort(flat_e, stable=True)
+    group_sizes = jnp.sum(one_hot, axis=(0, 1)).astype(jnp.int32)  # [E]
+    tok_of = order // k
+    xs = x[tok_of]  # [T*k, D] sorted by expert
+    gate = jax.nn.silu(jax.lax.ragged_dot(xs, blk["wg"], group_sizes))
+    up = jax.lax.ragged_dot(xs, blk["wu"], group_sizes)
+    ys = jax.lax.ragged_dot(gate * up, blk["wd"], group_sizes)  # [T*k, D]
+    w_sorted = top_w.reshape(-1)[order].astype(ys.dtype)
+    out = jnp.zeros_like(x).at[tok_of].add(ys * w_sorted[:, None])
+    return out.reshape(b, s, d), aux
+
+
 def _mlp_moe(h: jax.Array, blk: Params, cfg: ModelConfig) -> Tuple[jax.Array, jax.Array]:
     if cfg.moe_dispatch == "dense":
         return _mlp_moe_dense(h, blk, cfg)
+    if cfg.moe_dispatch == "grouped":
+        return _mlp_moe_grouped(h, blk, cfg)
     return _mlp_moe_topk(h, blk, cfg)
 
 
@@ -723,13 +764,23 @@ def decode_step_inflight(
     cache: KVCache,
     slots: jax.Array,  # [B] int32 — per-row cache write slot
     valid_to: jax.Array,  # [B] int32 — one past the last valid slot (incl. new)
+    unroll: bool = False,
 ) -> Tuple[jax.Array, KVCache]:
     """Decode step with PER-ROW write slots (left-aligned rows), for the
     continuous-batching generator where rows start/stop independently and
     therefore sit at different cache depths.  The per-row write is a vmapped
     `dynamic_update_slice` (a small scatter — [B, n_kv, d] per layer), not a
     full-cache rewrite.  Reference: InflightBatchingGenerator's per-slot
-    cache bookkeeping (realhf/impl/model/nn/real_llm_generate.py:670)."""
+    cache bookkeeping (realhf/impl/model/nn/real_llm_generate.py:670).
+
+    unroll=True trades compile time for HBM traffic: the scan's dynamic
+    per-layer cache read (`dynamic_index_in_dim` with a traced index)
+    cannot fuse into the attention dot on TPU, so every layer's K and V
+    windows are materialized as full HLO temps EVERY step — at 1.5B/b=32
+    that extra write+read is comparable to streaming the weights and is
+    the measured gap between decode and its roofline.  A python-level
+    layer loop with STATIC indices lets XLA read the cache windows in
+    place (leading-axis static slices alias) and update them in place."""
     b = tokens.shape[0]
     x = _embed(params, cfg, tokens, positions)[:, None, :]
     cos, sin = rope_cos_sin(positions[:, None], cfg.head_dim, cfg.rope_theta)
@@ -737,18 +788,23 @@ def decode_step_inflight(
 
     rows = jnp.arange(b)
 
-    def body(carry, blk):
-        y, kc, vc, li = carry
+    def body(carry, blk, li=None):
+        y, kc, vc, dyn_li = carry
+        li_ = dyn_li if li is None else li
         h = _norm(y, blk["ln1"], blk.get("ln1_b"), cfg)
         q, k, v = _block_kv(h, blk, cfg, cos, sin)
         # Direct scatter of the B new entries at (layer, row, slots[row]) —
         # in place on the scan carry.  The earlier formulation materialized
         # and wrote back a WHOLE [B, S, h, d] layer per token (~GBs/token
         # of pure HBM traffic at 1.5B scale).
-        kc = kc.at[li, rows, slots].set(k[:, 0].astype(kc.dtype))
-        vc = vc.at[li, rows, slots].set(v[:, 0].astype(vc.dtype))
-        k_layer = jax.lax.dynamic_index_in_dim(kc, li, axis=0, keepdims=False)
-        v_layer = jax.lax.dynamic_index_in_dim(vc, li, axis=0, keepdims=False)
+        kc = kc.at[li_, rows, slots].set(k[:, 0].astype(kc.dtype))
+        vc = vc.at[li_, rows, slots].set(v[:, 0].astype(vc.dtype))
+        k_layer = jax.lax.dynamic_index_in_dim(
+            kc, li_, axis=0, keepdims=False
+        )
+        v_layer = jax.lax.dynamic_index_in_dim(
+            vc, li_, axis=0, keepdims=False
+        )
         attn = decode_attention(q, k_layer, v_layer, zero_from, valid_to)
         ao = attn.reshape(b, 1, cfg.q_dim) @ blk["wo"]
         if cfg.proj_bias:
@@ -756,11 +812,18 @@ def decode_step_inflight(
         y = y + ao
         h2 = _norm(y, blk["ln2"], blk.get("ln2_b"), cfg)
         y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk, cfg))
-        return (y, kc, vc, li + 1), None
+        return (y, kc, vc, dyn_li + 1), None
 
-    (x, kc, vc, _), _ = jax.lax.scan(
-        body, (x, cache.k, cache.v, jnp.int32(0)), params["blocks"]
-    )
+    if unroll:
+        carry = (x, cache.k, cache.v, jnp.int32(0))
+        for li in range(cfg.n_layers):
+            blk = jax.tree.map(lambda a: a[li], params["blocks"])
+            carry, _ = body(carry, blk, li=li)
+        x, kc, vc, _ = carry
+    else:
+        (x, kc, vc, _), _ = jax.lax.scan(
+            body, (x, cache.k, cache.v, jnp.int32(0)), params["blocks"]
+        )
     x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
     logits = _head(params, cfg, x)[:, 0]
     return logits, KVCache(k=kc, v=vc)

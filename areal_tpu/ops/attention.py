@@ -196,7 +196,9 @@ def packed_attention(
             q, k, v, segment_ids, use_flash, causal=causal
         )
     if use_flash is None:
-        use_flash = jax.default_backend() == "tpu"
+        from areal_tpu.base.distributed import is_tpu_backend
+
+        use_flash = is_tpu_backend()
     if use_flash:
         try:
             from areal_tpu.ops.pallas.flash_attention import flash_attention

@@ -36,7 +36,9 @@ DEFAULT_BLOCK_K = 128
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from areal_tpu.base.distributed import is_tpu_backend
+
+    return not is_tpu_backend()
 
 
 # ---------------------------------------------------------------------------

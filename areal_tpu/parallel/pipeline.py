@@ -99,10 +99,19 @@ def pipelined_blocks(
             raise ValueError(
                 f"row length {x.shape[1]} not divisible by seq={n_seq}"
             )
-        if cfg.is_moe:
-            # Per-chunk expert capacity would silently differ from the
-            # global dispatch the non-pipelined CP path computes.
-            raise NotImplementedError("MoE under combined CP + PP")
+        if cfg.is_moe and cfg.moe_dispatch == "topk":
+            # Capacity dispatch computes expert capacity from the tokens
+            # it SEES: per-(CP-chunk, microbatch) capacity would silently
+            # differ from the global dispatch the non-pipelined CP path
+            # computes (different drops => different numerics).  The
+            # dropless dispatches ("grouped", "dense") are per-token
+            # chunk-invariant and pass through; only the load-balancing
+            # aux becomes a mean of per-chunk terms instead of the global
+            # batch term (gradient pressure per chunk, same fixed point).
+            raise NotImplementedError(
+                "capacity (topk) MoE under combined CP + PP; use "
+                "moe_dispatch='grouped' (dropless, chunk-invariant)"
+            )
         cp_manual = (SEQ_AXIS, n_seq)
         use_flash = False  # dense ring blocks inside the manual region
 

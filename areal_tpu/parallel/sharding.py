@@ -130,9 +130,11 @@ def attn_dispatch(mesh: Mesh, cfg=None):
     if mesh.devices.size == 1:
         use_flash = None
     else:
+        from areal_tpu.base.distributed import is_tpu_backend
+
         m = mesh.shape[MODEL_AXIS]
         eligible = (
-            jax.default_backend() == "tpu"
+            is_tpu_backend()
             and mesh.shape[SEQ_AXIS] == 1
             and mesh.shape[PIPE_AXIS] == 1
             and cfg is not None

@@ -5,6 +5,16 @@ Capability parity: realhf/scheduler/slurm/client.py:32 (`SlurmSchedulerClient`
 to the sbatch surface a TPU-pod slurm deployment exposes; GPU/gres types and
 the pyxis container plumbing are replaced by plain `--wrap` launches with an
 optional container prefix.
+
+SCOPE (deliberate): the PRODUCTION launcher for this framework is
+`tpu_pod.py` — TPU fleets are allocated as whole pod slices by the cloud
+control plane, so the reference's fragmentation-aware per-GPU resource
+arithmetic (realhf/scheduler/slurm/utils.py:64, 870 LoC of allocate+commit
+bookkeeping over gres strings) has no TPU counterpart: there is nothing to
+fragment — a trial gets a pod slice or it doesn't.  This client exists for
+shops that front their TPU VMs with slurm as a queue, and intentionally
+stays at the sbatch/squeue surface (validated against mocked slurm
+binaries in tests/test_slurm.py; no real cluster in CI).
 """
 
 import os

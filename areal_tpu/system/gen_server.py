@@ -13,12 +13,17 @@ inference runtime, so the server is a thin stdlib-HTTP shell around it:
 - POST /update_weights — hot-swap from an HF checkpoint dir.
 - GET  /health — liveness + current weight version.
 
-Two transports share that collector:
+Two transports share that collector, BOTH on by default:
 - HTTP (ThreadingHTTPServer): the ops/debug surface — curl-able, JSON.
-- ZMQ ROUTER (`zmq_port`): the high-throughput trainer plane — JSON
-  frames, one DEALER connection per client pipelining any number of
-  in-flight requests with rid correlation, no thread-per-request.  The
-  `zmq://host:port` URL scheme selects it in RemoteGeneratorEngine.
+  Thread-per-request, fine for humans and health checks; not the plane
+  a multi-rank trainer should pump thousands of requests through.
+- ZMQ ROUTER (`zmq_port`, default 0 = auto-bind): the high-throughput
+  trainer plane — JSON frames, one DEALER connection per client
+  pipelining any number of in-flight requests with rid correlation, no
+  thread-per-request.  The `zmq://host:port` URL scheme selects it in
+  RemoteGeneratorEngine; the CLI prints both URLs and experiment
+  configs should point `gen_server_url` at the zmq one for serving at
+  rank scale (`zmq_port=None` turns the plane off).
 
 `RemoteGeneratorEngine` (backend "remote_generator") makes a model worker
 talk to such a server instead of holding generation weights itself — the
@@ -88,7 +93,7 @@ class GenerationServer:
         max_batch: int = 256,
         token: str = "",
         ckpt_root: str = "",
-        zmq_port: Optional[int] = None,  # 0 = random; None = HTTP only
+        zmq_port: Optional[int] = 0,  # 0 = random; None = HTTP only
     ):
         self.engine = engine
         self.version = 0

@@ -454,6 +454,11 @@ class MasterWorker:
         # Pre hooks (param sync from another model, e.g. gen <- train).
         for hook in node.pre_hooks:
             await self._run_hook(hook, node, group)
+        if (
+            self.rollout_ahead == 0
+            and node.interface_type == ModelInterfaceType.TRAIN_STEP
+        ):
+            await self._release_aliased_generators(node)
         replicas = self.replicas.get(str(node.model_name))
         splittable = (
             replicas
@@ -639,6 +644,33 @@ class MasterWorker:
                 self._record_owner(resp["meta"], w, replace=(i == 0))
             await self.buffer.amend_batch(resp["meta"])
         return resp
+
+    async def _release_aliased_generators(self, node: MFCDef):
+        """Synchronous colocated trials: a generator configured with
+        donation_safe_swap=False ALIASES the train master's buffers (the
+        copy-free hot-swap that makes 1.5B PPO fit 16 GB); a live alias
+        blocks the optimizer step's buffer donation, transiently costing
+        a full extra parameter copy.  Between the last generate() and
+        this train node's post-hook resync the weights are dead — tell
+        the hook targets to drop them before the step.  Only full-copy
+        hooks (eta>=1) qualify: an EMA target still needs its current
+        params.  Workers whose engine keeps the defensive copy
+        (donation_safe_swap=True, remote generators) no-op.  Replaces the
+        reference's weight-refresh ordering, model_worker.py:1040-1067."""
+        targets = []
+        for hook in node.post_hooks:
+            if isinstance(hook, ParamReallocHook) and hook.eta >= 1.0:
+                t = str(hook.target)
+                targets += [(t, w) for w in self._hook_target_set(t)]
+        if targets:
+            await asyncio.gather(
+                *[
+                    self.pool.request(
+                        w, {"type": "release_params", "model_name": t}
+                    )
+                    for t, w in targets
+                ]
+            )
 
     async def _run_hook(self, hook, node: MFCDef, group: List[int]):
         if isinstance(hook, OffloadHook):
@@ -907,6 +939,12 @@ class MasterWorker:
         the generator) by replaying each train node's realloc post-hooks."""
         info = self._restore_pending
         self._restore_pending = None
+        # Model engines are about to be (re)loaded: any cached per-member
+        # shard ownership may describe the pre-crash build.  Meshes don't
+        # change across a same-config recover today, but a stale entry
+        # here would silently mis-ship rows — refresh is one round-trip
+        # per model per trial.
+        self._shard_info_cache.clear()
         for node in self._train_rpcs:
             d = self._ckpt_dir(node, "recover_checkpoint")
             if not os.path.isdir(d):

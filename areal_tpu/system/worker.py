@@ -346,7 +346,20 @@ class ModelWorker:
                 missing = input_keys - have
                 if missing:
                     mrow = shard_meta.select_idx([idx])
-                    zero = _zero_filled(mrow, missing & mrow.keys)
+                    unknown = missing - mrow.keys
+                    if unknown:
+                        # A key absent from BOTH the member's cache and
+                        # the shipped shard metadata cannot be
+                        # zero-filled; dropping it would surface later as
+                        # a bewildering KeyError deep in pack/interface
+                        # code — fail here, at the cause.
+                        raise KeyError(
+                            f"worker {self.config.worker_index}: input "
+                            f"key(s) {sorted(unknown)} for {sid!r} are in "
+                            "neither the data cache nor the shard "
+                            "metadata"
+                        )
+                    zero = _zero_filled(mrow, missing)
                     if part is None:
                         part = zero
                     else:
@@ -593,6 +606,21 @@ class ModelWorker:
             )
             eng.set_params(mixed)
         return {"seconds": time.monotonic() - t0}
+
+    def _handle_release_params(self, req):
+        """Drop an aliasing generator's weight reference ahead of the
+        colocated train step (master: _release_aliased_generators).  Only
+        engines that opted out of the defensive swap copy hold an alias
+        worth releasing; everything else (donation-safe generators,
+        remote/inference engines) answers released=False untouched."""
+        eng = self.models[req["model_name"]].engine
+        if (
+            getattr(eng, "donation_safe_swap", True) is False
+            and hasattr(eng, "release_params")
+        ):
+            eng.release_params()
+            return {"released": True}
+        return {"released": False}
 
     def _handle_param_sync(self, req):
         """Copy/EMA params src -> dst (generator hot-swap, EMA ref).

@@ -246,7 +246,14 @@ def main(size: str = "1.5b"):
                 "vs_baseline": round(
                     samples_per_sec / BASELINE_SAMPLES_PER_SEC_CHIP, 3
                 ),
-                "gen_tokens_per_sec": round(total_gen_tokens / dt, 1),
+                # Decode throughput = generated tokens over time spent
+                # GENERATING (dividing by whole-step time, as an earlier
+                # revision did, understates decode ~3x and made it look
+                # 6x off roofline when it is ~1.5x off).
+                "gen_tokens_per_sec": round(
+                    total_gen_tokens / max(timers["gen"], 1e-9), 1
+                ),
+                "gen_tokens_per_sec_e2e": round(total_gen_tokens / dt, 1),
                 "step_seconds": round(dt / n_iters, 2),
                 "gen_seconds": round(timers["gen"] / n_iters, 2),
                 "train_seconds": round(timers["train"] / n_iters, 2),
@@ -255,6 +262,14 @@ def main(size: str = "1.5b"):
                 "mfu_train": round(mfu_train, 4) if mfu_train else None,
                 "mfu_e2e": round(mfu_e2e, 4) if mfu_e2e else None,
                 "warmup_seconds": round(warmup_s, 1),
+                # Fraction of the padded [rows, row_len] train grid that
+                # is real tokens — the padding waste MFU silently pays.
+                "pack_efficiency": round(
+                    getattr(train_engine, "last_pack_stats", {}).get(
+                        "pack_efficiency", 0.0
+                    ),
+                    3,
+                ),
                 "config": (
                     f"qwen2-{size} bf16, {n_prompts} prompts x{group} group, "
                     f"{prompt_len} prompt + <={max_new} new tokens, GRPO, "

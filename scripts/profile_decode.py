@@ -33,6 +33,7 @@ def main():
     # v5e: ~819 GB/s HBM. Override per chip (v5p ~2765, v4 ~1228).
     p.add_argument("--hbm-gbps", type=float, default=819.0)
     p.add_argument("--platform", default="auto", choices=("auto", "cpu"))
+    p.add_argument("--unroll", action="store_true")
     args = p.parse_args()
 
     import jax
@@ -70,19 +71,34 @@ def main():
             slots = jnp.full((b,), w // 2, jnp.int32)
             valid = jnp.full((b,), w // 2 + 1, jnp.int32)
 
-            @functools.partial(jax.jit, donate_argnums=(1,))
-            def step(params, cache, toks, pos, slots, valid):
-                return tfm.decode_step_inflight(
-                    params, cfg, toks, pos, cache, slots, valid
-                )
+            n_steps = args.steps
 
-            logits, cache = step(params, cache, toks, pos, slots, valid)
-            jax.block_until_ready(logits)
+            # Time N steps inside ONE program (like the generator's
+            # static while_loop and the inflight chunk fn): per-call
+            # dispatch over a tunneled PJRT backend costs tens of ms,
+            # which at one step per call swamps the ~5 ms step itself.
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def chunk(params, cache, toks, pos, slots, valid):
+                def body(i, st):
+                    toks, cache = st
+                    logits, cache = tfm.decode_step_inflight(
+                        params, cfg, toks, pos + i, cache, slots + i,
+                        valid + i, unroll=args.unroll,
+                    )
+                    return jnp.argmax(logits, -1).astype(jnp.int32), cache
+
+                toks, cache = jax.lax.fori_loop(
+                    0, n_steps, body, (toks, cache)
+                )
+                return toks, cache
+
+            toks2, cache = chunk(params, cache, toks, pos, slots, valid)
+            np.asarray(toks2)  # force (block_until_ready is unreliable
+            # on tunneled PJRT backends — a host transfer provably waits)
             t0 = time.perf_counter()
-            for _ in range(args.steps):
-                logits, cache = step(params, cache, toks, pos, slots, valid)
-            jax.block_until_ready(logits)
-            dt = (time.perf_counter() - t0) / args.steps
+            toks2, cache = chunk(params, cache, toks2, pos, slots, valid)
+            np.asarray(toks2)
+            dt = (time.perf_counter() - t0) / n_steps
 
             kv_bytes = (
                 2 * cfg.n_layers * b * w * cfg.n_kv_heads * cfg.head_dim

@@ -1,0 +1,143 @@
+"""GPipe vs 1f1b-mem pipeline schedule comparison (VERDICT r4 #8).
+
+One real chip cannot host a pipe>1 mesh, so this runs on the fake
+8-device CPU cluster — wall-clock there is NOT TPU wall-clock, but the
+two quantities that decide the schedule question transfer:
+
+- peak live activation memory per jitted step (compiled bytes; the
+  reason 1f1b-mem exists), and
+- the in-flight-microbatch bubble structure (ticks of idle stage time,
+  visible as the step-time ratio at equal total microbatches).
+
+Usage: python scripts/profile_pipeline.py [--pipe 2] [--rows 16]
+Prints one JSON line per schedule.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--pipe", type=int, default=2)
+    p.add_argument("--rows", type=int, default=16)
+    p.add_argument("--row-len", type=int, default=128)
+    p.add_argument("--iters", type=int, default=3)
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model_api import FinetuneSpec, OptimizerConfig
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.engines.train import TrainEngine
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.models.config import tiny_config
+
+    import jax.numpy as jnp
+
+    n_dev = jax.device_count()
+    data = n_dev // args.pipe
+    pc = ParallelConfig(data=data, pipe=args.pipe)
+    cfg = tiny_config(n_layers=4 * args.pipe)
+    rng = np.random.default_rng(0)
+    L = args.row_len
+    sample = SequenceSample(
+        keys={"packed_input_ids", "loss_mask"},
+        ids=[f"r{i}" for i in range(args.rows)],
+        seqlens={
+            "packed_input_ids": [[L]] * args.rows,
+            "loss_mask": [[L]] * args.rows,
+        },
+        data={
+            "packed_input_ids": rng.integers(
+                0, cfg.vocab_size, size=args.rows * L
+            ).astype(np.int32),
+            "loss_mask": np.ones(args.rows * L, np.float32),
+        },
+    )
+
+    def loss_fn(out, batch):
+        m = batch["loss_mask"] > 0
+        s = jnp.where(m, out, 0.0).sum()
+        return s, {"s_sum": s}
+
+    for sched in ("gpipe", "1f1b-mem"):
+        mesh = make_mesh(pc, jax.devices())
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        eng = TrainEngine(
+            cfg, params, mesh,
+            optimizer_config=OptimizerConfig(lr=1e-4,
+                                             warmup_steps_proportion=0.0),
+            ftspec=FinetuneSpec(1, 8, 8),
+            pipe_schedule=sched,
+        )
+        mb_spec = MicroBatchSpec(max_tokens_per_mb=args.rows * L)
+        t0 = time.perf_counter()
+        eng.train_batch(
+            sample, mb_spec, loss_fn=loss_fn,
+            loss_weight_fn=lambda a: float((a["loss_mask"] > 0).sum()),
+            extra_keys=("loss_mask",),
+        )
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            eng.train_batch(
+                sample, mb_spec, loss_fn=loss_fn,
+                loss_weight_fn=lambda a: float((a["loss_mask"] > 0).sum()),
+                extra_keys=("loss_mask",),
+            )
+        dt = (time.perf_counter() - t0) / args.iters
+
+        # Compiled peak temp bytes of the grad fn (the memory the
+        # schedule exists to bound).
+        peak = None
+        try:
+            grad_fn, _ = eng._get_grad_fn(loss_fn)
+            # Re-lower on the final packed shape for an apples comparison.
+            import areal_tpu.engines.packing as packing
+
+            pk = packing.pack_sample(
+                sample, "packed_input_ids", extra_keys=("loss_mask",),
+                n_rows_multiple=eng.batch_shard,
+                max_tokens_per_row=mb_spec.max_tokens_per_mb,
+            )
+            chunks = eng._pack_row_chunks(pk.arrays)
+            batch = eng._device_batch(chunks[0])
+            mem = (
+                grad_fn.lower(eng.params, batch, jnp.float32(1.0))
+                .compile()
+                .memory_analysis()
+            )
+            if mem is not None:
+                peak = int(getattr(mem, "temp_size_in_bytes", 0))
+        except Exception as e:  # noqa: BLE001 — diagnostic only
+            peak = f"unavailable: {e}"
+        print(
+            json.dumps(
+                {
+                    "schedule": sched,
+                    "pipe": args.pipe,
+                    "step_seconds": round(dt, 3),
+                    "compile_seconds": round(compile_s, 1),
+                    "peak_temp_bytes": peak,
+                    "n_micro_batches": eng.last_pack_stats[
+                        "n_micro_batches"
+                    ],
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

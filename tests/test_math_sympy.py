@@ -111,3 +111,101 @@ def test_verify_math_uses_sympy_stage():
     assert not verify_math(
         r"... the answer is \boxed{\sqrt{2}}", [r"\boxed{2}"]
     )
+
+
+# ---------------------------------------------------------------------------
+# Reference-grader parity table (round 5).
+#
+# The vectors below are the tricky pairs the reference's verdict-grade
+# grader exercises in its self-test
+# (/root/reference/evaluation/grader.py:357 `_test_math_equal`) plus the
+# qwen pipeline's semantics (math_verify_utils_qwen.py).  Expected values
+# are the REFERENCE's verdicts.  Pairs our from-scratch grader does not yet
+# decide the same way are xfail-annotated — a documented pass-rate against
+# the reference corpus, not silent divergence.
+# ---------------------------------------------------------------------------
+
+REFERENCE_VECTORS = [
+    # (pred, gold, reference_verdict, xfail-reason-or-None)
+    ("0.0833333333333333", r"\frac{1}{12}", True, None),
+    ("(1,4.5)", r"(1,\frac{9}{2})", True, None),
+    (r"\frac{x}{7}+\frac{2}{7}", r"\frac{x+2}{7}", True, None),
+    (r"\sec^2(y)", r"\tan^2(y)+1", True, None),
+    (
+        r"\begin{pmatrix}-\frac{7}{4}&-2\\4&\frac{1}{4}\end{pmatrix}",
+        r"(\begin{pmatrix}-\frac{7}{4}&-2\\4&\frac{1}{4}\\\end{pmatrix})",
+        True,
+        None,
+    ),
+    (
+        r"\begin{pmatrix}0.290243531202435\\0.196008371385084\\-0.186381278538813\end{pmatrix}",
+        r"(\begin{pmatrix}0.29\\0.196\\-0.186\\\end{pmatrix})",
+        True,
+        "entry 0.290243 vs 0.29 is outside even the reference's 1e-4 "
+        "rel-tol (grader.py:278); its vendored latex2sympy path is not "
+        "runnable here (no antlr) to confirm its actual verdict — kept "
+        "as the one documented divergence",
+    ),
+    (
+        r"\frac{\sqrt{\sqrt{11}+\sqrt{194}}}{2\sqrt{33}+15}",
+        r"\frac{\sqrt{\sqrt{11}+\sqrt{194}}}{15+2\sqrt{33}}",
+        True,
+        None,
+    ),
+    ("-34x-45y+20z-100=0", "34x+45y-20z+100=0", True, None),
+    ("(+5)(b+2)", "(a+5)(b+2)", False, None),
+    (r"\frac{1+\sqrt{5}}{2}", "2", False, None),
+    ("1", r"1\\sqrt{19}", False, None),
+    ("(0.6,2.6667]", r"(\frac{3}{5},\frac{8}{3}]", True, None),
+    ("x+1", "x+2n+1", False, None),
+]
+
+
+@pytest.mark.parametrize(
+    "pred,gold,want,xfail", REFERENCE_VECTORS,
+    ids=[f"v{i}" for i in range(len(REFERENCE_VECTORS))],
+)
+def test_reference_grader_parity(pred, gold, want, xfail):
+    if xfail:
+        pytest.xfail(xfail)
+    got = answers_match_sympy(pred, gold, timeout=10.0)
+    assert got == want, (pred, gold, got, want)
+
+
+class TestMultipleChoice:
+    """GPQA/MMLU-style grading (reference: grader.py:30 choice_answer_clean,
+    math_eval.py:369,596)."""
+
+    def test_choice_clean_last_letter_wins(self):
+        from areal_tpu.interfaces.math_verify import choice_answer_clean
+
+        assert choice_answer_clean("The answer is (B).") == "B"
+        assert choice_answer_clean("A or C? I'll go with D") == "D"
+        assert choice_answer_clean("42") == "42"
+
+    def test_verify_math_choice_gold(self):
+        from areal_tpu.interfaces.math_verify import verify_math
+
+        assert verify_math(r"thus \boxed{B}", ["B"])
+        assert verify_math("The answer is (C).", ["C"])
+        assert not verify_math("The answer is (C).", ["B"])
+        # Multi-letter gold (select-all-that-apply).
+        assert verify_math(r"\boxed{ACD}", ["ACD"])
+        assert not verify_math(r"\boxed{AD}", ["ACD"])
+        # Prose statements shed stray capitals; standalone letters win.
+        assert verify_math("Therefore the answers are A, C and D", ["ACD"])
+        assert not verify_math("Therefore the answers are A and D", ["ACD"])
+
+    def test_choice_without_boxed_uses_last_line(self):
+        from areal_tpu.interfaces.math_verify import verify_math
+
+        text = "Because A implies B...\nFinal: (E)"
+        assert verify_math(text, ["E"])
+
+    def test_numeric_percent_and_reltol(self):
+        from areal_tpu.interfaces.math_verify import answers_match
+
+        assert answers_match("0.5", r"50\%")
+        assert answers_match("50", "0.5")  # percent-flexible both ways
+        assert answers_match("3.14159", "3.141592653589793")
+        assert not answers_match("33.3", r"\frac{100}{3}")  # rel 1e-3 > tol

@@ -304,3 +304,53 @@ def test_pipeline_ring_gradients_match(rng):
         np.testing.assert_allclose(
             np.asarray(b_), np.asarray(a), rtol=1e-3, atol=1e-4
         )
+
+
+def test_pipeline_cp_moe_grouped_forward_matches(rng):
+    """MoE under combined CP + PP, unfenced for the dropless dispatches
+    (round 5): per-token routing is chunk-invariant, so the pipelined
+    ring forward must equal the dense forward.  (Capacity dispatch stays
+    fenced: per-chunk capacity would change which tokens drop.)"""
+    import dataclasses
+
+    pc = ParallelConfig.from_str("p2s2")
+    mesh = make_mesh(pc, jax.devices()[:4])
+    cfg = dataclasses.replace(
+        tiny_config(n_experts=4), moe_dispatch="grouped"
+    )
+    params = tfm.init_params(cfg, jax.random.PRNGKey(3))
+    b, s, m = 2, 32, 2
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (b, s)), jnp.int32)
+    seg = jnp.ones((b, s), jnp.int32)
+    want = jax.jit(lambda p, t, sg: tfm.forward(p, cfg, t, sg))(
+        params, toks, seg
+    )
+    on_mesh = sharding.shard_params(params, mesh)
+    got = jax.jit(
+        lambda p, t, sg: tfm.forward(
+            p, cfg, t, sg, pp_mesh=mesh, pp_microbatches=m, cp_mesh=mesh
+        )
+    )(on_mesh, toks, seg)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_pipeline_cp_moe_topk_still_fenced(rng):
+    import dataclasses
+
+    import pytest
+
+    pc = ParallelConfig.from_str("p2s2")
+    mesh = make_mesh(pc, jax.devices()[:4])
+    cfg = dataclasses.replace(tiny_config(n_experts=4), moe_dispatch="topk")
+    params = sharding.shard_params(
+        tfm.init_params(cfg, jax.random.PRNGKey(3)), mesh
+    )
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)), jnp.int32)
+    seg = jnp.ones((2, 32), jnp.int32)
+    with pytest.raises(NotImplementedError, match="capacity"):
+        tfm.forward(
+            params, cfg, toks, seg, pp_mesh=mesh, pp_microbatches=2,
+            cp_mesh=mesh,
+        )
