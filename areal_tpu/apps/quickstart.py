@@ -313,6 +313,18 @@ def cmd_ppo_math(args):
         offload_ref=args.offload_ref,
         gen_server_url=args.gen_server_url,
         rollout_ahead=args.rollout_ahead,
+        gen_backend_args=(
+            {"kv_cache_dtype": args.kv_cache_dtype}
+            if args.kv_cache_dtype != "auto" else {}
+        ),
+        train_backend_args={
+            k: v
+            for k, v in (
+                ("master_dtype", args.master_dtype),
+                ("remat_policy", args.remat),
+            )
+            if v is not None
+        },
         dataset=DatasetAbstraction(
             "math_code_prompt", {"dataset_path": args.dataset_path}
         ),
@@ -397,6 +409,17 @@ def main(argv=None):
                          "exceeds this (e.g. 0.1)")
     pp.add_argument("--ref-ema-eta", type=float, default=None,
                     help="EMA-update the ref toward the actor each step")
+    pp.add_argument("--kv-cache-dtype", default="auto",
+                    choices=("auto", "int8"),
+                    help="int8 halves KV HBM per generated token (the "
+                         "capacity bound for 16k+ decodes)")
+    pp.add_argument("--master-dtype", default=None,
+                    choices=(None, "float32", "bfloat16"),
+                    help="optimizer master/Adam dtype; bfloat16 halves "
+                         "optimizer memory (the single-chip 1.5B fit)")
+    pp.add_argument("--remat", default=None,
+                    choices=(None, "full", "dots", "none"),
+                    help="activation rematerialization policy for training")
     pp.add_argument("--fuse-rew-ref", action="store_true",
                     help="one fused MFC for reward grading + ref inference")
     pp.add_argument("--offload-ref", action="store_true",

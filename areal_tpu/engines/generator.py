@@ -56,6 +56,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         compute_dtype=jnp.bfloat16,
         max_decode_batch: int = 64,
         donation_safe_swap: bool = True,
+        kv_cache_dtype: str = "auto",
     ):
         if cfg.is_critic:
             raise ValueError("cannot generate from a critic model")
@@ -72,6 +73,20 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         # generate() routing): 2048 steps ≈ tens of seconds per program,
         # comfortably under device-runtime watchdogs.
         self.static_path_max_new = 2048
+        # "auto" = compute dtype; "int8" halves KV HBM per token (the
+        # long-context capacity bound — see models.transformer.KVCache).
+        # Applies to the inflight (continuous batching) path; the
+        # speculative path keeps full precision (its exact-verification
+        # contract compares against the real model distribution).
+        # Validated here because YAML/gen_backend_args bypass the CLI's
+        # argparse choices — a silently ignored "INT8"/"int4" would OOM
+        # the exact 16k decode the flag exists to make fit.
+        if kv_cache_dtype not in ("auto", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'auto' or 'int8', "
+                f"got {kv_cache_dtype!r}"
+            )
+        self.kv_cache_dtype = kv_cache_dtype
         # When True (default), set_params COPIES any leaf whose buffers
         # alias the source tree — required when generation can overlap a
         # train step that donates those buffers (rollout_ahead).  In a
@@ -278,7 +293,12 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         # handful of shapes total).
         cur_w = bucket_len(max_prompt + chunk_t)
         cache = tfm.init_kv_cache(
-            self.cfg, n_slots, cur_w, dtype=self.compute_dtype
+            self.cfg, n_slots, cur_w,
+            dtype=(
+                "int8"
+                if self.kv_cache_dtype == "int8"
+                else self.compute_dtype
+            ),
         )
         logits_buf = jnp.zeros((n_slots, self.cfg.vocab_size), jnp.float32)
         cache_len = np.zeros((n_slots,), np.int32)
@@ -518,7 +538,20 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         new_w = bucket_len(max(need, 2 * cur_w))
         pad = [(0, 0), (0, 0), (0, new_w - cur_w), (0, 0), (0, 0)]
         return (
-            tfm.KVCache(k=jnp.pad(cache.k, pad), v=jnp.pad(cache.v, pad)),
+            tfm.KVCache(
+                k=jnp.pad(cache.k, pad),
+                v=jnp.pad(cache.v, pad),
+                k_scale=(
+                    jnp.pad(cache.k_scale, pad[:-1])
+                    if cache.quantized
+                    else None
+                ),
+                v_scale=(
+                    jnp.pad(cache.v_scale, pad[:-1])
+                    if cache.quantized
+                    else None
+                ),
+            ),
             new_w,
         )
 

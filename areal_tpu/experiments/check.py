@@ -113,6 +113,15 @@ def check_ppo_math(cfg) -> None:
         _fail("fuse_rew_ref needs a ref model")
     if cfg.rollout_ahead not in (0, 1):
         _fail(f"rollout_ahead must be 0 or 1, got {cfg.rollout_ahead}")
+    if cfg.gen_server_url and getattr(cfg, "gen_backend_args", None):
+        # Decoupled serving builds a weightless remote_generator backend;
+        # local GeneratorEngine kwargs would be silently ignored — the
+        # user's explicit flag (e.g. kv_cache_dtype) must not no-op.
+        _fail(
+            "gen_backend_args apply to the in-process GeneratorEngine "
+            "and are ignored under gen_server_url (configure the "
+            "standalone gen_server instead)"
+        )
     if cfg.rollout_ahead > 0 and getattr(
         cfg, "gen_backend_args", {}
     ).get("donation_safe_swap") is False:

@@ -194,6 +194,12 @@ class PPOMathConfig:
     gen_backend_args: Dict[str, Any] = dataclasses.field(
         default_factory=dict
     )
+    # Extra TrainEngine kwargs for actor/critic (remat_policy,
+    # master_dtype, pipe_schedule) — the single-chip 1.5B fit needs
+    # master_dtype="bfloat16" here, exactly like bench.py.
+    train_backend_args: Dict[str, Any] = dataclasses.field(
+        default_factory=dict
+    )
     # Host-offload the reference model's params after each ref_inf call
     # (OffloadHook; frees its HBM between steps).
     offload_ref: bool = False
@@ -486,7 +492,9 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
         ModelShardSpec(
             name=actor,
             model=cfg.actor,
-            backend=ModelBackendAbstraction("train"),
+            backend=ModelBackendAbstraction(
+                "train", dict(cfg.train_backend_args)
+            ),
             interface=actor_if,
             parallel=cfg.actor_parallel,
             optimizer=cfg.optimizer,
@@ -549,7 +557,9 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
             ModelShardSpec(
                 name=critic,
                 model=cfg.critic,
-                backend=ModelBackendAbstraction("train"),
+                backend=ModelBackendAbstraction(
+                    "train", dict(cfg.train_backend_args)
+                ),
                 interface=critic_if,
                 parallel=cfg.critic_parallel,
                 optimizer=cfg.optimizer,

@@ -178,6 +178,10 @@ def _select_group_seqs(sample: SequenceSample, keep) -> SequenceSample:
         ids=list(sample.ids),
         seqlens=new_seqlens,
         data=new_data,
+        # Same ids in the same order: per-id metadata — crucially the
+        # shard_of tags that keep the batch on the sharded-dispatch
+        # statistics path — carries over verbatim.
+        metadata={k: list(v) for k, v in sample.metadata.items()},
     )
 
 

@@ -611,17 +611,53 @@ def forward_with_aux(
 
 @dataclasses.dataclass
 class KVCache:
-    """Dense per-layer KV cache: k/v [L, B, S_max, n_kv, head_dim]."""
+    """Dense per-layer KV cache: k/v [L, B, S_max, n_kv, head_dim].
+
+    int8 mode (k/v int8 + per-(layer,row,slot,head) bf16 scales in
+    k_scale/v_scale): HALVES the HBM bytes per cached token.  At long
+    context the decode batch × window product is capacity-bound — a 1.5B
+    model's bf16 cache at batch 32 × 16k window is ~15 GB and does not
+    fit a 16 GB chip at all; int8 does.  Scales add 1/head_dim overhead.
+    (Bandwidth parity, not win: without a fused dequant-attention kernel
+    the read path materializes a bf16 layer view — the saving is
+    capacity and the cache WRITE stream.)  Reference role: KV-cache
+    quantization knobs in serving engines (sglang).
+    """
 
     k: jax.Array
     v: jax.Array
+    k_scale: "jax.Array | None" = None  # [L, B, S_max, n_kv] bf16
+    v_scale: "jax.Array | None" = None
 
     @property
     def s_max(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
-jax.tree_util.register_dataclass(KVCache, data_fields=["k", "v"], meta_fields=[])
+
+jax.tree_util.register_dataclass(
+    KVCache, data_fields=["k", "v", "k_scale", "v_scale"], meta_fields=[]
+)
+
+
+def kv_quant(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-head int8 quantization over the trailing head_dim:
+    [..., d] float -> (int8 [..., d], bf16 scale [...])."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / s[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, s.astype(jnp.bfloat16)
+
+
+def kv_dequant(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
+    return (q.astype(jnp.float32) * s.astype(jnp.float32)[..., None]).astype(
+        dtype
+    )
 
 
 def init_kv_cache(
@@ -629,6 +665,13 @@ def init_kv_cache(
 ) -> KVCache:
     shape = (cfg.n_layers, batch, s_max, cfg.n_kv_heads, cfg.head_dim)
     dtype = dtype or cfg.dtype
+    if dtype in (jnp.int8, "int8"):
+        return KVCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(shape[:-1], jnp.bfloat16),
+            v_scale=jnp.zeros(shape[:-1], jnp.bfloat16),
+        )
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
@@ -787,9 +830,10 @@ def decode_step_inflight(
     zero_from = jnp.zeros((b,), jnp.int32)
 
     rows = jnp.arange(b)
+    quant = cache.quantized  # trace-time static
 
     def body(carry, blk, li=None):
-        y, kc, vc, dyn_li = carry
+        y, kc, vc, ksc, vsc, dyn_li = carry
         li_ = dyn_li if li is None else li
         h = _norm(y, blk["ln1"], blk.get("ln1_b"), cfg)
         q, k, v = _block_kv(h, blk, cfg, cos, sin)
@@ -797,14 +841,32 @@ def decode_step_inflight(
         # in place on the scan carry.  The earlier formulation materialized
         # and wrote back a WHOLE [B, S, h, d] layer per token (~GBs/token
         # of pure HBM traffic at 1.5B scale).
-        kc = kc.at[li_, rows, slots].set(k[:, 0].astype(kc.dtype))
-        vc = vc.at[li_, rows, slots].set(v[:, 0].astype(vc.dtype))
-        k_layer = jax.lax.dynamic_index_in_dim(
-            kc, li_, axis=0, keepdims=False
-        )
-        v_layer = jax.lax.dynamic_index_in_dim(
-            vc, li_, axis=0, keepdims=False
-        )
+        if quant:
+            kq, ks = kv_quant(k[:, 0])
+            vq, vs = kv_quant(v[:, 0])
+            kc = kc.at[li_, rows, slots].set(kq)
+            vc = vc.at[li_, rows, slots].set(vq)
+            ksc = ksc.at[li_, rows, slots].set(ks)
+            vsc = vsc.at[li_, rows, slots].set(vs)
+            k_layer = kv_dequant(
+                jax.lax.dynamic_index_in_dim(kc, li_, axis=0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(ksc, li_, axis=0, keepdims=False),
+                q.dtype,
+            )
+            v_layer = kv_dequant(
+                jax.lax.dynamic_index_in_dim(vc, li_, axis=0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(vsc, li_, axis=0, keepdims=False),
+                q.dtype,
+            )
+        else:
+            kc = kc.at[li_, rows, slots].set(k[:, 0].astype(kc.dtype))
+            vc = vc.at[li_, rows, slots].set(v[:, 0].astype(vc.dtype))
+            k_layer = jax.lax.dynamic_index_in_dim(
+                kc, li_, axis=0, keepdims=False
+            )
+            v_layer = jax.lax.dynamic_index_in_dim(
+                vc, li_, axis=0, keepdims=False
+            )
         attn = decode_attention(q, k_layer, v_layer, zero_from, valid_to)
         ao = attn.reshape(b, 1, cfg.q_dim) @ blk["wo"]
         if cfg.proj_bias:
@@ -812,21 +874,31 @@ def decode_step_inflight(
         y = y + ao
         h2 = _norm(y, blk["ln2"], blk.get("ln2_b"), cfg)
         y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk, cfg))
-        return (y, kc, vc, dyn_li + 1), None
+        return (y, kc, vc, ksc, vsc, dyn_li + 1), None
 
+    # Scale carries: zero-size placeholders when unquantized keep ONE
+    # carry structure for both modes.
+    ksc0 = cache.k_scale if quant else jnp.zeros((0,), jnp.bfloat16)
+    vsc0 = cache.v_scale if quant else jnp.zeros((0,), jnp.bfloat16)
     if unroll:
-        carry = (x, cache.k, cache.v, jnp.int32(0))
+        carry = (x, cache.k, cache.v, ksc0, vsc0, jnp.int32(0))
         for li in range(cfg.n_layers):
             blk = jax.tree.map(lambda a: a[li], params["blocks"])
             carry, _ = body(carry, blk, li=li)
-        x, kc, vc, _ = carry
+        x, kc, vc, ksc, vsc, _ = carry
     else:
-        (x, kc, vc, _), _ = jax.lax.scan(
-            body, (x, cache.k, cache.v, jnp.int32(0)), params["blocks"]
+        (x, kc, vc, ksc, vsc, _), _ = jax.lax.scan(
+            body,
+            (x, cache.k, cache.v, ksc0, vsc0, jnp.int32(0)),
+            params["blocks"],
         )
     x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
     logits = _head(params, cfg, x)[:, 0]
-    return logits, KVCache(k=kc, v=vc)
+    return logits, KVCache(
+        k=kc, v=vc,
+        k_scale=ksc if quant else None,
+        v_scale=vsc if quant else None,
+    )
 
 
 def decode_step_spec(
@@ -904,19 +976,33 @@ def prefill_into_slots(
     seg = (
         jnp.arange(sp)[None, :] < prompt_lens[:, None]
     ).astype(jnp.int32)
+    # Prefill computes in the model dtype; quantization (if the target
+    # cache is int8) happens once at the scatter below.
+    row_dtype = cfg.dtype if cache.quantized else cache.k.dtype
     row_cache = KVCache(
         k=jnp.zeros(
-            (cfg.n_layers, m, sp, cfg.n_kv_heads, cfg.head_dim),
-            cache.k.dtype,
+            (cfg.n_layers, m, sp, cfg.n_kv_heads, cfg.head_dim), row_dtype
         ),
         v=jnp.zeros(
-            (cfg.n_layers, m, sp, cfg.n_kv_heads, cfg.head_dim),
-            cache.v.dtype,
+            (cfg.n_layers, m, sp, cfg.n_kv_heads, cfg.head_dim), row_dtype
         ),
     )
     logits, row_cache = prefill(
         params, cfg, tokens, seg, row_cache, use_flash=use_flash
     )
+    if cache.quantized:
+        kq, ks = kv_quant(row_cache.k)
+        vq, vs = kv_quant(row_cache.v)
+        return logits, KVCache(
+            k=cache.k.at[:, slot_rows, :sp].set(kq, mode="drop"),
+            v=cache.v.at[:, slot_rows, :sp].set(vq, mode="drop"),
+            k_scale=cache.k_scale.at[:, slot_rows, :sp].set(
+                ks, mode="drop"
+            ),
+            v_scale=cache.v_scale.at[:, slot_rows, :sp].set(
+                vs, mode="drop"
+            ),
+        )
     new_k = cache.k.at[:, slot_rows, :sp].set(row_cache.k, mode="drop")
     new_v = cache.v.at[:, slot_rows, :sp].set(row_cache.v, mode="drop")
     return logits, KVCache(k=new_k, v=new_v)

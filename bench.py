@@ -94,6 +94,24 @@ def main(size: str = "1.5b"):
     from areal_tpu.models import transformer as tfm
     from areal_tpu.models.config import qwen2_config
 
+    n_prompts, group, prompt_len, max_new = (
+        int(os.environ.get("AREAL_BENCH_PROMPTS", 8)), 4, 128, 1024
+    )
+    n_iters = 3
+    mode = os.environ.get("AREAL_BENCH_MODE", "")
+    if mode == "longctx":
+        # Reference-scale decode budget (ppo-7B-distill-gpus-128.yaml
+        # decodes up to 27,648 new tokens with max_tokens_per_mb=30720):
+        # fewer samples, >=16k new tokens each, KV window growing through
+        # the inflight generator's buckets.  int8 KV cache by default —
+        # at 16k+ the cache is the capacity bound (bf16 at batch 8 x 16k
+        # is ~3.7 GB next to 9.3 GB of engine state), and halving it is
+        # what lets the decode batch reach 8 on this chip.
+        n_prompts = int(os.environ.get("AREAL_BENCH_PROMPTS", 4))
+        group, max_new, n_iters = 2, 16384, 1
+        os.environ.setdefault("AREAL_BENCH_MB_TOKENS", "32768")
+        os.environ.setdefault("AREAL_BENCH_KV_DTYPE", "int8")
+
     mesh = make_mesh(ParallelConfig(), jax.devices()[:1])
     cfg = qwen2_config(size, param_dtype="bfloat16")
     params = tfm.init_params(cfg, jax.random.PRNGKey(0))
@@ -124,26 +142,21 @@ def main(size: str = "1.5b"):
     del params
     gen_engine = GeneratorEngine(
         cfg, train_engine.get_params(), mesh,
-        eos_token_id=tok.eos_token_id, max_decode_batch=32,
+        eos_token_id=tok.eos_token_id,
+        max_decode_batch=int(os.environ.get("AREAL_BENCH_DECODE_BATCH", 32)),
         # Synchronous colocated loop: generation never overlaps the
         # donating optimizer step, so the generator may alias the train
         # master's buffers instead of copying them — without this the
         # extra 3.1 GB param copy pushes 1.5B past this chip's 16 GB HBM.
         donation_safe_swap=False,
+        # "int8" halves KV HBM per token — the capacity lever for the
+        # >=16k longctx mode (a bf16 cache at batch 32 x 16k does not
+        # fit this chip at all).
+        kv_cache_dtype=os.environ.get("AREAL_BENCH_KV_DTYPE", "auto"),
     )
     actor = Model("actor", engine=train_engine, tokenizer=tok, config=cfg)
     gen = Model("actor_gen", engine=gen_engine, tokenizer=tok, config=cfg)
 
-    n_prompts, group, prompt_len, max_new = 8, 4, 128, 1024
-    n_iters = 3
-    mode = os.environ.get("AREAL_BENCH_MODE", "")
-    if mode == "longctx":
-        # Reference-scale decode budget (ppo-7B-distill-gpus-128.yaml
-        # decodes up to 27,648 new tokens with max_tokens_per_mb=30720):
-        # fewer samples, >=16k new tokens each, KV window growing through
-        # the inflight generator's buckets.
-        n_prompts, group, max_new, n_iters = 2, 2, 16384, 1
-        os.environ.setdefault("AREAL_BENCH_MB_TOKENS", "32768")
     rng = np.random.default_rng(0)
     prompts = SequenceSample(
         keys={"packed_prompts"},
@@ -165,8 +178,11 @@ def main(size: str = "1.5b"):
     # Token-budget micro-batches: the fused logprob head avoids the dense
     # [B,S,V] logits, leaving attention/MLP activations as the peak term.
     # Sweepable: AREAL_BENCH_MB_TOKENS.
+    # Default 8192: the best measured remat=full point of the r4/r5
+    # on-chip sweeps (1.28 samples/s/chip vs 1.22 at 4096; 16384 was
+    # slower).
     mb = MicroBatchSpec(
-        max_tokens_per_mb=int(os.environ.get("AREAL_BENCH_MB_TOKENS", 4096))
+        max_tokens_per_mb=int(os.environ.get("AREAL_BENCH_MB_TOKENS", 8192))
     )
 
     timers = {"gen": 0.0, "train": 0.0, "sync": 0.0}
@@ -277,8 +293,22 @@ def main(size: str = "1.5b"):
                 ),
                 "baseline_note": (
                     "0.30 samples/s/chip = boba 1.5B e2e on 8xH800 at up to "
-                    "27648 new tokens; this bench caps decode at 1024 tokens "
-                    "and one H800 has ~2x this chip's bf16 peak"
+                    "27648 new tokens (250 steps x 512 prompts x 16 resp / "
+                    "240h / 8 chips, reference README.md:38-43); this row "
+                    f"decodes up to {max_new} new tokens/sample — a "
+                    "like-for-like decode budget (within 1.7x of the "
+                    "reference's 27,648 cap; its median response is far "
+                    "below the cap) — on ONE v5e chip with ~0.5x an "
+                    "H800's bf16 peak; vs_baseline divides by the same "
+                    "0.30 constant"
+                    if mode == "longctx"
+                    else
+                    "0.30 samples/s/chip = boba 1.5B e2e on 8xH800 at up "
+                    "to 27648 new tokens; this bench caps decode at "
+                    f"{max_new} tokens (long tails dominate the "
+                    "reference's wall-clock) and one H800 has ~2x this "
+                    "chip's bf16 peak — see the longctx row for the "
+                    "like-for-like comparison"
                 ),
             }
         )

@@ -49,7 +49,9 @@ def main():
     n_dev = jax.device_count()
     data = n_dev // args.pipe
     pc = ParallelConfig(data=data, pipe=args.pipe)
-    cfg = tiny_config(n_layers=4 * args.pipe)
+    import dataclasses
+
+    cfg = dataclasses.replace(tiny_config(), n_layers=4 * args.pipe)
     rng = np.random.default_rng(0)
     L = args.row_len
     sample = SequenceSample(

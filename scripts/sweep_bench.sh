@@ -17,7 +17,19 @@ for remat in full dots none; do
       timeout 1800 python bench.py "$size" || echo "(failed: $remat/$mb)" >&2
   done
 done
-# Long-context row: >=16k new tokens/sample (reference decodes up to 27,648).
+# Decode-batch scaling: more prompts per step amortize the weight stream
+# over more rows (decode is bandwidth-bound).
+for b in 64 128; do
+  echo "=== decode batch $b ===" >&2
+  AREAL_BENCH_DECODE_BATCH="$b" AREAL_BENCH_PROMPTS=$((b / 4)) \
+    AREAL_BENCH_MB_TOKENS=8192 \
+    timeout 1800 python bench.py "$size" || echo "(failed: db$b)" >&2
+done
+# Long-context row: >=16k new tokens/sample (reference decodes up to 27,648);
+# int8 KV cache by default (capacity bound at 16k+).
 echo "=== longctx (16384 new tokens) ===" >&2
 AREAL_BENCH_MODE=longctx AREAL_BENCH_REMAT=full \
   timeout 3600 python bench.py "$size" || echo "(failed: longctx)" >&2
+echo "=== longctx bf16 kv (16384 new tokens) ===" >&2
+AREAL_BENCH_MODE=longctx AREAL_BENCH_REMAT=full AREAL_BENCH_KV_DTYPE=auto \
+  timeout 3600 python bench.py "$size" || echo "(failed: longctx-bf16)" >&2

@@ -212,3 +212,44 @@ class TestFrequencyControl:
         fc2.load_state_dict(state)
         assert not fc2.check()
         assert fc2.check()
+
+
+class TestBackendDetection:
+    def test_is_tpu_false_on_cpu(self):
+        from areal_tpu.base import distributed
+
+        # CPU test cluster: default_backend() == "cpu", device_kind "cpu".
+        distributed._is_tpu = None
+        assert distributed.is_tpu_backend() is False
+
+    def test_device_kind_fallback(self, monkeypatch):
+        """Tunneled PJRT platforms report a non-'tpu' platform name while
+        their devices ARE TPUs — the device kind decides."""
+        from areal_tpu.base import distributed
+
+        class _Dev:
+            device_kind = "TPU v5 lite"
+
+        import jax
+
+        distributed._is_tpu = None
+        monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+        monkeypatch.setattr(jax, "devices", lambda: [_Dev()])
+        assert distributed.is_tpu_backend() is True
+        distributed._is_tpu = None  # don't poison other tests
+
+    def test_probe_failure_not_memoized(self, monkeypatch):
+        from areal_tpu.base import distributed
+
+        import jax
+
+        distributed._is_tpu = None
+        monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+
+        def boom():
+            raise RuntimeError("tunnel down")
+
+        monkeypatch.setattr(jax, "devices", boom)
+        assert distributed.is_tpu_backend() is False
+        assert distributed._is_tpu is None  # transient failure not cached
+        distributed._is_tpu = None

@@ -119,3 +119,47 @@ class TestSFTChecks:
         )
         with pytest.raises(ValueError, match="cannot fill"):
             build_sft(cfg, fixtures.make_tokenizer())
+
+
+class TestAliasSwapChecks:
+    """Colocated copy-free hot-swap wiring (round 5, VERDICT #3)."""
+
+    def test_sync_default_aliases_generator(self):
+        plan = build_ppo_math(_ppo_cfg(), fixtures.make_tokenizer())
+        gen = [
+            s
+            for w in plan.worker_configs
+            for s in w.shards
+            if s.backend.type_ == "generator"
+        ]
+        assert gen and all(
+            s.backend.args.get("donation_safe_swap") is False for s in gen
+        )
+
+    def test_async_keeps_defensive_copy(self):
+        plan = build_ppo_math(
+            _ppo_cfg(rollout_ahead=1), fixtures.make_tokenizer()
+        )
+        gen = [
+            s
+            for w in plan.worker_configs
+            for s in w.shards
+            if s.backend.type_ == "generator"
+        ]
+        assert gen and all(
+            s.backend.args.get("donation_safe_swap") is True for s in gen
+        )
+
+    def test_async_refuses_forced_alias(self):
+        _expect(
+            "donation_safe_swap",
+            rollout_ahead=1,
+            gen_backend_args={"donation_safe_swap": False},
+        )
+
+    def test_gen_backend_args_refused_with_remote_server(self):
+        _expect(
+            "gen_backend_args",
+            gen_server_url="http://h:1",
+            gen_backend_args={"kv_cache_dtype": "int8"},
+        )

@@ -171,6 +171,10 @@ def test_grader_is_shared_with_training_rewards():
         "```python\nprint(int(input()) * 2)\n```",
         {"input_output": {"inputs": ["3\n"], "outputs": ["6"]}},
     )
+    # GPQA-style multiple choice rides the same math grader (round 5):
+    # a jsonl row with solutions=["B"] grades through choice extraction.
+    assert g.verify("math", "The correct option is (B).", {"solutions": ["B"]})
+    assert not g.verify("math", "The correct option is (B).", {"solutions": ["C"]})
 
 
 def test_multi_dataset_eval(tmp_path):

@@ -825,3 +825,100 @@ class TestShardedSplitShrink:
         s.metadata["shard_of"] = [[0, 2]] * 6 + [[1, 2]] * 2
         parts = s.split_balanced(4)
         assert len(parts) == 4
+
+
+class TestReleaseAliasedGenerators:
+    """Master-side release protocol for the colocated copy-free hot-swap
+    (round 5): before a synchronous train MFC whose post-hook fully
+    re-syncs a target, the master tells the target's workers to drop the
+    aliasing weights so the optimizer can donate in place.  EMA hooks
+    (eta<1) must NOT release — the target still needs its params."""
+
+    def _master(self, sent, rollout_ahead=0):
+        import asyncio  # noqa: F401
+
+        from areal_tpu.system.master import (
+            ExperimentSaveEvalControl,
+            MasterWorker,
+        )
+
+        class _Pool:
+            n_workers = 3
+
+            async def request(self, w, payload):
+                sent.append((w, payload))
+                return {}
+
+        from areal_tpu.api.config import (
+            ModelInterfaceAbstraction,
+            ModelInterfaceType,
+            ModelName,
+        )
+        from areal_tpu.api.dfg import MFCDef, ParamReallocHook, build_graph
+
+        gen_name = ModelName("actor_gen", 0)
+        ref_name = ModelName("ref", 0)
+        node = MFCDef(
+            name="actor_train",
+            model_name=ModelName("actor", 0),
+            interface_type=ModelInterfaceType.TRAIN_STEP,
+            interface_impl=ModelInterfaceAbstraction("sft"),
+            input_keys=("packed_input_ids",),
+            n_seqs=2,
+            post_hooks=[
+                ParamReallocHook(target=gen_name),           # full copy
+                ParamReallocHook(target=ref_name, eta=0.5),  # EMA
+            ],
+        )
+        dfg = build_graph([node])
+        master = MasterWorker(
+            dfg=dfg,
+            pool=_Pool(),
+            model_placement={
+                "actor@0": 0, "actor_gen@0": 1, "ref@0": 2,
+            },
+            data_worker_ids=[],
+            ctrl=ExperimentSaveEvalControl(),
+            rollout_ahead=rollout_ahead,
+        )
+        return master, node
+
+    def test_release_targets_full_copy_hooks_only(self):
+        import asyncio
+
+        sent = []
+        master, node = self._master(sent)
+        asyncio.run(master._release_aliased_generators(node))
+        reqs = [(w, p) for w, p in sent if p["type"] == "release_params"]
+        assert reqs == [(1, {
+            "type": "release_params", "model_name": "actor_gen@0",
+        })], sent
+
+    def test_worker_noops_on_safe_engines(self):
+        from areal_tpu.api.model_api import Model
+        from areal_tpu.system.worker import ModelWorker
+
+        class _SafeEng:
+            donation_safe_swap = True
+            released = False
+
+            def release_params(self):
+                self.released = True
+
+        class _AliasEng(_SafeEng):
+            donation_safe_swap = False
+
+        w = ModelWorker.__new__(ModelWorker)
+        safe, alias = _SafeEng(), _AliasEng()
+        w.models = {
+            "g_safe": Model("g_safe", safe, None, None),
+            "g_alias": Model("g_alias", alias, None, None),
+        }
+        assert w._handle_release_params(
+            {"model_name": "g_safe"}
+        ) == {"released": False}
+        assert not safe.released
+        assert w._handle_release_params(
+            {"model_name": "g_alias"}
+        ) == {"released": True}
+        assert alias.released
