@@ -75,9 +75,10 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         self.static_path_max_new = 2048
         # "auto" = compute dtype; "int8" halves KV HBM per token (the
         # long-context capacity bound — see models.transformer.KVCache).
-        # Applies to the inflight (continuous batching) path; the
-        # speculative path keeps full precision (its exact-verification
-        # contract compares against the real model distribution).
+        # Applies to the inflight paths (plain + speculative; spec stays
+        # distribution-exact because drafts and verification score
+        # against the same quantized-cache model).  The static short-
+        # decode path keeps full precision (its windows are small).
         # Validated here because YAML/gen_backend_args bypass the CLI's
         # argparse choices — a silently ignored "INT8"/"int4" would OOM
         # the exact 16k decode the flag exists to make fit.
@@ -574,8 +575,16 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         step_cap = n_steps * (K + 1)
 
         cur_w = bucket_len(max_prompt + step_cap + K + 1)
+        # int8 stays distribution-exact here: drafts AND their exact
+        # verification both score against the quantized-cache model, so
+        # the emitted distribution equals plain decoding with this cache.
         cache = tfm.init_kv_cache(
-            self.cfg, n_slots, cur_w, dtype=self.compute_dtype
+            self.cfg, n_slots, cur_w,
+            dtype=(
+                "int8"
+                if self.kv_cache_dtype == "int8"
+                else self.compute_dtype
+            ),
         )
         # History buffer: prompt + emitted tokens per row (device-resident;
         # the in-chunk n-gram proposal reads it).

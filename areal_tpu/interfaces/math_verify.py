@@ -84,13 +84,19 @@ def _as_number(s: str) -> Optional[Fraction]:
         return None
 
 
+# A-E is the reference's range (grader.py:30); F-J extends it for
+# 10-option sets (MMLU-Pro style), where the reference would crash.
+CHOICE_LETTERS = "ABCDEFGHIJ"
+_CHOICE_RE = re.compile(r"\b([A-J])\b")
+
+
 def choice_answer_clean(pred: str) -> str:
     """Multiple-choice extraction, reference-parity
     (evaluation/grader.py:30 / evaluation/parser.py:373): the LAST
-    standalone A-E letter in the prediction wins ('The answer is (B).'
-    -> 'B'); otherwise the stripped prediction itself."""
+    standalone choice letter in the prediction wins ('The answer is
+    (B).' -> 'B'); otherwise the stripped prediction itself."""
     pred = pred.strip("\n").rstrip(".").rstrip("/").strip(" ").lstrip(":")
-    found = re.findall(r"\b(A|B|C|D|E)\b", pred.upper())
+    found = _CHOICE_RE.findall(pred.upper())
     out = found[-1] if found else pred.strip().strip(".")
     return out.rstrip(".").rstrip("/")
 
@@ -99,7 +105,7 @@ def is_multi_choice(gold: str) -> bool:
     """True when the gold answer is one or more choice letters (GPQA /
     MMLU-style), e.g. 'B' or 'ACD' (reference: math_eval.py:369)."""
     g = gold.strip()
-    return bool(g) and all(c in "ABCDE" for c in g)
+    return bool(g) and all(c in CHOICE_LETTERS for c in g)
 
 
 def choice_match(pred: str, gold: str) -> bool:
@@ -112,10 +118,10 @@ def choice_match(pred: str, gold: str) -> bool:
     # answer ("ACD") has no \b-separated letters and falls back to the
     # reference's char filter over the extracted answer
     # (math_eval.py:596).
-    standalone = re.findall(r"\b([A-E])\b", pred.upper())
+    standalone = _CHOICE_RE.findall(pred.upper())
     if standalone:
         return "".join(standalone) == gold
-    return "".join(c for c in pred.upper() if c in "ABCDE") == gold
+    return "".join(c for c in pred.upper() if c in CHOICE_LETTERS) == gold
 
 
 def answers_match(pred: str, gold: str) -> bool:

@@ -660,6 +660,43 @@ def kv_dequant(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
     )
 
 
+def _cache_update_read(
+    kc, vc, ksc, vsc, k, v, li, idx, quant: bool, read_dtype
+):
+    """Shared cache write + layer read for the decode steps: scatter the
+    new K/V entries at `(li, *idx)` (quantizing when the cache is int8)
+    and return the layer's (possibly dequantized) K/V views.  One
+    implementation for the plain and speculative paths so a quantization
+    change can never silently diverge their distributions."""
+    if quant:
+        kq, ks = kv_quant(k)
+        vq, vs = kv_quant(v)
+        kc = kc.at[(li, *idx)].set(kq)
+        vc = vc.at[(li, *idx)].set(vq)
+        ksc = ksc.at[(li, *idx)].set(ks)
+        vsc = vsc.at[(li, *idx)].set(vs)
+        k_layer = kv_dequant(
+            jax.lax.dynamic_index_in_dim(kc, li, axis=0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(ksc, li, axis=0, keepdims=False),
+            read_dtype,
+        )
+        v_layer = kv_dequant(
+            jax.lax.dynamic_index_in_dim(vc, li, axis=0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(vsc, li, axis=0, keepdims=False),
+            read_dtype,
+        )
+    else:
+        kc = kc.at[(li, *idx)].set(k.astype(kc.dtype))
+        vc = vc.at[(li, *idx)].set(v.astype(vc.dtype))
+        k_layer = jax.lax.dynamic_index_in_dim(
+            kc, li, axis=0, keepdims=False
+        )
+        v_layer = jax.lax.dynamic_index_in_dim(
+            vc, li, axis=0, keepdims=False
+        )
+    return kc, vc, ksc, vsc, k_layer, v_layer
+
+
 def init_kv_cache(
     cfg: ModelConfig, batch: int, s_max: int, dtype=None
 ) -> KVCache:
@@ -841,32 +878,10 @@ def decode_step_inflight(
         # in place on the scan carry.  The earlier formulation materialized
         # and wrote back a WHOLE [B, S, h, d] layer per token (~GBs/token
         # of pure HBM traffic at 1.5B scale).
-        if quant:
-            kq, ks = kv_quant(k[:, 0])
-            vq, vs = kv_quant(v[:, 0])
-            kc = kc.at[li_, rows, slots].set(kq)
-            vc = vc.at[li_, rows, slots].set(vq)
-            ksc = ksc.at[li_, rows, slots].set(ks)
-            vsc = vsc.at[li_, rows, slots].set(vs)
-            k_layer = kv_dequant(
-                jax.lax.dynamic_index_in_dim(kc, li_, axis=0, keepdims=False),
-                jax.lax.dynamic_index_in_dim(ksc, li_, axis=0, keepdims=False),
-                q.dtype,
-            )
-            v_layer = kv_dequant(
-                jax.lax.dynamic_index_in_dim(vc, li_, axis=0, keepdims=False),
-                jax.lax.dynamic_index_in_dim(vsc, li_, axis=0, keepdims=False),
-                q.dtype,
-            )
-        else:
-            kc = kc.at[li_, rows, slots].set(k[:, 0].astype(kc.dtype))
-            vc = vc.at[li_, rows, slots].set(v[:, 0].astype(vc.dtype))
-            k_layer = jax.lax.dynamic_index_in_dim(
-                kc, li_, axis=0, keepdims=False
-            )
-            v_layer = jax.lax.dynamic_index_in_dim(
-                vc, li_, axis=0, keepdims=False
-            )
+        kc, vc, ksc, vsc, k_layer, v_layer = _cache_update_read(
+            kc, vc, ksc, vsc, k[:, 0], v[:, 0], li_, (rows, slots),
+            quant, q.dtype,
+        )
         attn = decode_attention(q, k_layer, v_layer, zero_from, valid_to)
         ao = attn.reshape(b, 1, cfg.q_dim) @ blk["wo"]
         if cfg.proj_bias:
@@ -924,15 +939,18 @@ def decode_step_spec(
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
     rows = jnp.arange(b)
     col_idx = slots0[:, None] + jnp.arange(q_len)[None, :]  # [B, Q]
+    quant = cache.quantized  # int8 is SOUND here: drafts and exact
+    # verification both score against the quantized-cache model, so the
+    # emitted distribution equals plain decoding with the same cache.
 
     def body(carry, blk):
-        y, kc, vc, li = carry
+        y, kc, vc, ksc, vsc, li = carry
         h = _norm(y, blk["ln1"], blk.get("ln1_b"), cfg)
         q, k, v = _block_kv(h, blk, cfg, cos, sin)  # [B, Q, h, d]
-        kc = kc.at[li, rows[:, None], col_idx].set(k.astype(kc.dtype))
-        vc = vc.at[li, rows[:, None], col_idx].set(v.astype(vc.dtype))
-        k_layer = jax.lax.dynamic_index_in_dim(kc, li, axis=0, keepdims=False)
-        v_layer = jax.lax.dynamic_index_in_dim(vc, li, axis=0, keepdims=False)
+        kc, vc, ksc, vsc, k_layer, v_layer = _cache_update_read(
+            kc, vc, ksc, vsc, k, v, li, (rows[:, None], col_idx),
+            quant, q.dtype,
+        )
         attn = decode_attention_chunk(
             q, k_layer, v_layer,
             jnp.zeros((b,), jnp.int32), slots0 + 1,
@@ -945,14 +963,22 @@ def decode_step_spec(
         y = y + (
             _mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk, cfg)
         )
-        return (y, kc, vc, li + 1), None
+        return (y, kc, vc, ksc, vsc, li + 1), None
 
-    (x, kc, vc, _), _ = jax.lax.scan(
-        body, (x, cache.k, cache.v, jnp.int32(0)), params["blocks"]
+    ksc0 = cache.k_scale if quant else jnp.zeros((0,), jnp.bfloat16)
+    vsc0 = cache.v_scale if quant else jnp.zeros((0,), jnp.bfloat16)
+    (x, kc, vc, ksc, vsc, _), _ = jax.lax.scan(
+        body,
+        (x, cache.k, cache.v, ksc0, vsc0, jnp.int32(0)),
+        params["blocks"],
     )
     x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
     logits = _head(params, cfg, x)  # [B, Q, V]
-    return logits, KVCache(k=kc, v=vc)
+    return logits, KVCache(
+        k=kc, v=vc,
+        k_scale=ksc if quant else None,
+        v_scale=vsc if quant else None,
+    )
 
 
 def prefill_into_slots(

@@ -225,9 +225,27 @@ def _eval_one_dataset(
         chunk = rows[start : start + config.batch_size]
         parts = []
         for i, r in enumerate(chunk):
+            prompt = r["prompt"]
+            # GPQA/MMLU-style rows: render lettered options under the
+            # question (reference: evaluation/data_loader.py choice rows
+            # + parser.py choice extraction); grading then goes through
+            # verify_math's multiple-choice path on the letter gold.
+            choices = r.get("choices")
+            if choices:
+                from areal_tpu.interfaces.math_verify import CHOICE_LETTERS
+
+                if len(choices) > len(CHOICE_LETTERS):
+                    raise ValueError(
+                        f"row {r.get('query_id')!r} has {len(choices)} "
+                        f"choices; at most {len(CHOICE_LETTERS)} supported"
+                    )
+                prompt = prompt + "\n" + "\n".join(
+                    f"({CHOICE_LETTERS[j]}) {c}"
+                    for j, c in enumerate(choices)
+                )
             toks = np.asarray(
                 tokenizer.encode(
-                    config.prompt_template.format(prompt=r["prompt"])
+                    config.prompt_template.format(prompt=prompt)
                 ),
                 dtype=np.int32,
             )
@@ -252,8 +270,25 @@ def _eval_one_dataset(
             # the evaluator covers both halves of the reference's
             # math+code evaluation surface.
             task = r.get("task", "math")
+            sols = r.get("solutions") or r.get("answers") or []
+            if not sols:
+                # Letter golds of choice rows ("answer": "B" /
+                # reference's "choice_answer").  HF-style INT golds are
+                # option indices ("answer": 0 means choice A) — note 0 is
+                # falsy, so no `or` chains here.
+                letter = r.get("answer")
+                if letter is None:
+                    letter = r.get("choice_answer")
+                if isinstance(letter, int) and r.get("choices"):
+                    from areal_tpu.interfaces.math_verify import (
+                        CHOICE_LETTERS,
+                    )
+
+                    letter = CHOICE_LETTERS[letter]
+                if letter is not None:
+                    sols = [str(letter)]
             info = {
-                "solutions": r.get("solutions") or r.get("answers") or [],
+                "solutions": sols,
                 "input_output": r.get("input_output"),
             }
             bounds = one.cu_seqlens("packed_input_ids")

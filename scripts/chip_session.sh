@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# One-shot on-chip measurement session (run when the TPU tunnel is alive;
+# the watcher covers bench.py + the remat/longctx sweep separately).
+# Captures, in order of diagnostic value:
+#   1. measured MXU peak (honest MFU denominator)
+#   2. train-step component timing, remat=full vs dots
+#   3. decode roofline at bench + longctx shapes
+#   4. PRODUCTION-path 1.5B colocated memory probe
+set -u
+cd "$(dirname "$0")/.."
+out=chip_session
+mkdir -p "$out"
+echo "=== probe_matmul ===" | tee "$out/session.log"
+timeout 1200 python scripts/probe_matmul.py 2>&1 | tee -a "$out/session.log"
+for remat in full dots; do
+  echo "=== profile_train remat=$remat ===" | tee -a "$out/session.log"
+  timeout 1800 python scripts/profile_train.py --remat "$remat" \
+    --tokens 8192 2>&1 | tail -6 | tee -a "$out/session.log" \
+    || echo "(failed: train/$remat)" | tee -a "$out/session.log"
+done
+echo "=== profile_decode ===" | tee -a "$out/session.log"
+timeout 1200 python scripts/profile_decode.py --batches 8,32 \
+  --windows 1280,16640 --steps 64 2>&1 | tail -6 \
+  | tee -a "$out/session.log" || true
+echo "=== probe_mem trial (production 16GB fit) ===" \
+  | tee -a "$out/session.log"
+PROBE_MAX_NEW=512 timeout 2400 python scripts/probe_mem.py trial 2>&1 \
+  | tail -12 | tee -a "$out/session.log" \
+  || echo "(failed: probe_mem trial)" | tee -a "$out/session.log"
+echo "=== done ===" | tee -a "$out/session.log"

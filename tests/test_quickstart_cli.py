@@ -82,6 +82,8 @@ def test_quickstart_ppo_cli_full_flags(tmp_path, ckpt_dir, capsys):
         "examples/configs/sft-1.5b-v5e-8.yaml",
         "examples/configs/ppo-1.5b-v5e-8.yaml",
         "examples/configs/ppo-7b-v5p-32.yaml",
+        "examples/configs/ppo-7b-zero-v5p-32.yaml",
+        "examples/configs/sft-32b-v5p-64.yaml",
     ],
 )
 def test_example_configs_keys_resolve(cfg):
