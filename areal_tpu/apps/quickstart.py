@@ -418,7 +418,7 @@ def main(argv=None):
                     help="optimizer master/Adam dtype; bfloat16 halves "
                          "optimizer memory (the single-chip 1.5B fit)")
     pp.add_argument("--remat", default=None,
-                    choices=(None, "full", "dots", "none"),
+                    choices=(None, "full", "dots_small", "dots", "none"),
                     help="activation rematerialization policy for training")
     pp.add_argument("--fuse-rew-ref", action="store_true",
                     help="one fused MFC for reward grading + ref inference")

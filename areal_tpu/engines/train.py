@@ -120,8 +120,10 @@ class TrainEngine(HostOffloadMixin, Engine):
         # large-model recipes make when HBM, not accuracy, binds.
         master_dtype=jnp.float32,
         # Activation rematerialization per layer: "full" (save nothing),
-        # "dots" (save matmul outputs; ~zero recompute when activations
-        # fit), "none".  See models/transformer.py _backbone.
+        # "dots" (save ALL matmul outputs; ~zero recompute when they
+        # fit), "dots_small" (save only the two per-layer residual-
+        # branch outputs — ~1/8 of "dots" memory, recomputes most of
+        # the layer), "none".  See models/transformer.py _backbone.
         remat_policy: str = "full",
         # Pipeline schedule (pipe>1 meshes only):
         #   "gpipe"    — up to 4P in-flight microbatches; bubble

@@ -28,6 +28,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from areal_tpu.models.config import ModelConfig
 from areal_tpu.ops.attention import (
@@ -369,12 +370,19 @@ def _block_forward(
     attn_out = attn.reshape(b, s, cfg.q_dim) @ blk["wo"]
     if cfg.proj_bias:
         attn_out = attn_out + blk["bo"]
+    # Named checkpoints for remat="dots_small" (see _backbone): the
+    # attention output and the MLP down-projection output are the SMALL
+    # per-token dots ([*, D]) whose saving lets backward skip only the
+    # fat gate/up recompute candidates' DOWNSTREAM — memory ~2x "full"
+    # remat instead of the ~7x of "dots".
+    attn_out = checkpoint_name(attn_out, "attn_out")
     x = x + attn_out
     h2 = _norm(x, blk["ln2"], blk.get("ln2_b"), cfg)
     if cfg.is_moe:
         mlp_out, aux = _mlp_moe(h2, blk, cfg)
     else:
         mlp_out, aux = _mlp_dense(h2, blk, cfg), jnp.zeros((), jnp.float32)
+    mlp_out = checkpoint_name(mlp_out, "mlp_out")
     return x + mlp_out, aux
 
 
@@ -475,6 +483,9 @@ def _backbone(
     #   "dots" — save matmul outputs, recompute elementwise/norms only
     #     (more memory, near-zero recompute — the right default when the
     #     activations fit);
+    #   "dots_small" — save only the per-layer residual-branch outputs
+    #     (attn_out, mlp_out): ~1/8 the memory of "dots", recomputes
+    #     most of the layer — for models where "dots" overflows HBM;
     #   "none"/False — plain autodiff residuals.
     if remat is True or remat == "full":
         body = jax.checkpoint(
@@ -484,6 +495,19 @@ def _backbone(
         body = jax.checkpoint(
             body,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    elif remat == "dots_small":
+        # Middle ground when "dots" (~46 KB/token/layer of saved matmul
+        # outputs at 1.5B) overflows HBM but "full" recompute caps MFU:
+        # save only the two [*, D] residual-branch outputs per layer
+        # (~6 KB/token/layer) — backward recomputes qkv/attention and
+        # the fat gate/up matmuls, but the residual stream itself is
+        # never recomputed.
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "mlp_out"
+            ),
         )
     elif remat not in (False, None, "none"):
         raise ValueError(f"unknown remat policy {remat!r}")

@@ -136,7 +136,7 @@ def main(size: str = "1.5b"):
         optimizer_config=OptimizerConfig(lr=2e-5, warmup_steps_proportion=0.0),
         ftspec=FinetuneSpec(1, 64, 64),
         master_dtype=jnp.bfloat16,
-        # Sweepable without edits: AREAL_BENCH_REMAT=dots|none|full.
+        # Sweepable without edits: AREAL_BENCH_REMAT=full|dots_small|dots|none.
         remat_policy=os.environ.get("AREAL_BENCH_REMAT", "full"),
     )
     del params

@@ -12,7 +12,7 @@ out=chip_session
 mkdir -p "$out"
 echo "=== probe_matmul ===" | tee "$out/session.log"
 timeout 1200 python scripts/probe_matmul.py 2>&1 | tee -a "$out/session.log"
-for remat in full dots; do
+for remat in full dots_small dots; do
   echo "=== profile_train remat=$remat ===" | tee -a "$out/session.log"
   timeout 1800 python scripts/profile_train.py --remat "$remat" \
     --tokens 8192 2>&1 | tail -6 | tee -a "$out/session.log" \

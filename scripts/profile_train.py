@@ -11,7 +11,7 @@ slow stage is identified instead of guessed (bench r4/r5 measured
 mfu_train ~0.13 with remat=full and no further breakdown).
 
 Usage: python scripts/profile_train.py [--size 1.5b] [--tokens 8192]
-       [--remat full|dots|none] [--iters 3]
+       [--remat full|dots_small|dots|none] [--iters 3]
 """
 
 import argparse
@@ -102,8 +102,14 @@ def main():
     )
     bench("backbone fwd", backbone, fwd_flops, params, tokens, seg, pos)
     bench("fwd + fused head", fwd_head, fwd_flops, params, tokens, seg, pos)
-    # bwd ~2x fwd (+1x recompute under remat=full)
-    mult = 3.0 + (1.0 if args.remat in ("full", True) else 0.0)
+    # bwd ~2x fwd; remat recompute adds ~1x for "full" and ~0.9x for
+    # "dots_small" (everything but the residual-branch outputs is
+    # recomputed: qkv, attention, gate/up — nearly the whole layer).
+    mult = 3.0
+    if args.remat in ("full", True):
+        mult += 1.0
+    elif args.remat == "dots_small":
+        mult += 0.9
     bench("fwd+bwd (grad)", grad, mult * fwd_flops, params)
 
 

@@ -10,7 +10,7 @@
 set -u
 size="${1:-1.5b}"
 cd "$(dirname "$0")/.."
-for remat in full dots none; do
+for remat in full dots_small dots none; do
   for mb in 4096 8192 16384; do
     echo "=== remat=$remat mb_tokens=$mb ===" >&2
     AREAL_BENCH_REMAT="$remat" AREAL_BENCH_MB_TOKENS="$mb" \
