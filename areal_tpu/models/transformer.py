@@ -667,31 +667,26 @@ jax.tree_util.register_dataclass(
 )
 
 
-def kv_quant(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Symmetric per-head int8 quantization over the trailing head_dim:
-    [..., d] float -> (int8 [..., d], bf16 scale [...])."""
-    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
-    s = jnp.maximum(s, 1e-8)
-    q = jnp.clip(
-        jnp.round(x.astype(jnp.float32) / s[..., None]), -127, 127
-    ).astype(jnp.int8)
-    return q, s.astype(jnp.bfloat16)
-
-
-def kv_dequant(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
-    return (q.astype(jnp.float32) * s.astype(jnp.float32)[..., None]).astype(
-        dtype
-    )
+# Canonical implementations live in ops/quant.py (shared with the
+# attention paths); re-exported here for the cache-facing API.
+from areal_tpu.ops.quant import kv_dequant, kv_quant  # noqa: E402,F401
 
 
 def _cache_update_read(
-    kc, vc, ksc, vsc, k, v, li, idx, quant: bool, read_dtype
+    kc, vc, ksc, vsc, k, v, li, idx, quant: bool, read_dtype,
+    dequant: bool = True,
 ):
     """Shared cache write + layer read for the decode steps: scatter the
     new K/V entries at `(li, *idx)` (quantizing when the cache is int8)
-    and return the layer's (possibly dequantized) K/V views.  One
-    implementation for the plain and speculative paths so a quantization
-    change can never silently diverge their distributions."""
+    and return the layer's K/V views.  One implementation for the plain
+    and speculative paths so a quantization change can never silently
+    diverge their distributions.
+
+    dequant=True materializes bf16/f32 layer views (consumers that only
+    take dense operands); dequant=False returns the RAW views plus the
+    layer's scales (or None) — for `decode_attention`, which dequantizes
+    itself (in-kernel under AREAL_DECODE_KERNEL=1, saving the extra
+    bf16 window materialization where bandwidth is the bottleneck)."""
     if quant:
         kq, ks = kv_quant(k)
         vq, vs = kv_quant(v)
@@ -699,26 +694,20 @@ def _cache_update_read(
         vc = vc.at[(li, *idx)].set(vq)
         ksc = ksc.at[(li, *idx)].set(ks)
         vsc = vsc.at[(li, *idx)].set(vs)
-        k_layer = kv_dequant(
-            jax.lax.dynamic_index_in_dim(kc, li, axis=0, keepdims=False),
-            jax.lax.dynamic_index_in_dim(ksc, li, axis=0, keepdims=False),
-            read_dtype,
-        )
-        v_layer = kv_dequant(
-            jax.lax.dynamic_index_in_dim(vc, li, axis=0, keepdims=False),
-            jax.lax.dynamic_index_in_dim(vsc, li, axis=0, keepdims=False),
-            read_dtype,
-        )
-    else:
-        kc = kc.at[(li, *idx)].set(k.astype(kc.dtype))
-        vc = vc.at[(li, *idx)].set(v.astype(vc.dtype))
-        k_layer = jax.lax.dynamic_index_in_dim(
-            kc, li, axis=0, keepdims=False
-        )
-        v_layer = jax.lax.dynamic_index_in_dim(
-            vc, li, axis=0, keepdims=False
-        )
-    return kc, vc, ksc, vsc, k_layer, v_layer
+        ks_l = jax.lax.dynamic_index_in_dim(ksc, li, axis=0, keepdims=False)
+        vs_l = jax.lax.dynamic_index_in_dim(vsc, li, axis=0, keepdims=False)
+        k_raw = jax.lax.dynamic_index_in_dim(kc, li, axis=0, keepdims=False)
+        v_raw = jax.lax.dynamic_index_in_dim(vc, li, axis=0, keepdims=False)
+        if dequant:
+            k_layer = kv_dequant(k_raw, ks_l, read_dtype)
+            v_layer = kv_dequant(v_raw, vs_l, read_dtype)
+            return kc, vc, ksc, vsc, k_layer, v_layer, None, None
+        return kc, vc, ksc, vsc, k_raw, v_raw, ks_l, vs_l
+    kc = kc.at[(li, *idx)].set(k.astype(kc.dtype))
+    vc = vc.at[(li, *idx)].set(v.astype(vc.dtype))
+    k_layer = jax.lax.dynamic_index_in_dim(kc, li, axis=0, keepdims=False)
+    v_layer = jax.lax.dynamic_index_in_dim(vc, li, axis=0, keepdims=False)
+    return kc, vc, ksc, vsc, k_layer, v_layer, None, None
 
 
 def init_kv_cache(
@@ -902,11 +891,16 @@ def decode_step_inflight(
         # in place on the scan carry.  The earlier formulation materialized
         # and wrote back a WHOLE [B, S, h, d] layer per token (~GBs/token
         # of pure HBM traffic at 1.5B scale).
-        kc, vc, ksc, vsc, k_layer, v_layer = _cache_update_read(
-            kc, vc, ksc, vsc, k[:, 0], v[:, 0], li_, (rows, slots),
-            quant, q.dtype,
+        kc, vc, ksc, vsc, k_layer, v_layer, ks_l, vs_l = (
+            _cache_update_read(
+                kc, vc, ksc, vsc, k[:, 0], v[:, 0], li_, (rows, slots),
+                quant, q.dtype, dequant=False,
+            )
         )
-        attn = decode_attention(q, k_layer, v_layer, zero_from, valid_to)
+        attn = decode_attention(
+            q, k_layer, v_layer, zero_from, valid_to,
+            k_scale=ks_l, v_scale=vs_l,
+        )
         ao = attn.reshape(b, 1, cfg.q_dim) @ blk["wo"]
         if cfg.proj_bias:
             ao = ao + blk["bo"]
@@ -971,7 +965,7 @@ def decode_step_spec(
         y, kc, vc, ksc, vsc, li = carry
         h = _norm(y, blk["ln1"], blk.get("ln1_b"), cfg)
         q, k, v = _block_kv(h, blk, cfg, cos, sin)  # [B, Q, h, d]
-        kc, vc, ksc, vsc, k_layer, v_layer = _cache_update_read(
+        kc, vc, ksc, vsc, k_layer, v_layer, _, _ = _cache_update_read(
             kc, vc, ksc, vsc, k, v, li, (rows[:, None], col_idx),
             quant, q.dtype,
         )

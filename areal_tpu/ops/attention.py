@@ -92,20 +92,56 @@ def decode_attention_reference(
     return out.astype(q.dtype)
 
 
+_DECODE_KERNEL_SNAPSHOT = None
+
+
+def _decode_kernel_enabled() -> bool:
+    """AREAL_DECODE_KERNEL=1 switches decode attention to the fused
+    Pallas kernel (ops/pallas/decode_attention.py).  Read once: jit
+    caches don't key on env vars."""
+    global _DECODE_KERNEL_SNAPSHOT
+    if _DECODE_KERNEL_SNAPSHOT is None:
+        import os
+
+        _DECODE_KERNEL_SNAPSHOT = (
+            os.environ.get("AREAL_DECODE_KERNEL") == "1"
+        )
+    return _DECODE_KERNEL_SNAPSHOT
+
+
 def decode_attention(
     q: jax.Array,  # [B, 1, n_q, d] — one new token per row
     k_cache: jax.Array,  # [B, S_max, n_kv, d]
     v_cache: jax.Array,  # [B, S_max, n_kv, d]
     valid_from: jax.Array,  # [B] int — first valid cache slot per row
     valid_to: jax.Array,  # scalar/[B] int — one past the last valid slot
+    k_scale: "Optional[jax.Array]" = None,  # [B, S_max, n_kv]: int8 cache
+    v_scale: "Optional[jax.Array]" = None,
 ) -> jax.Array:
     """Single-token GQA decode attention, HBM-lean: no repeat_kv expansion
     (query heads grouped per KV head) and no fp32 materialization of the
     cache — bf16 operands with fp32 MXU accumulation.  `[valid_from,
     valid_to)` is the live window (right-aligned prompt layout).
+    With `k_scale`/`v_scale` the caches are int8 and dequantized here
+    (in-kernel when AREAL_DECODE_KERNEL=1 — the bandwidth-saving path).
 
     Replaces the reference's flash_attn_with_kvcache decode path
     (realhf/impl/model/modules/attn.py:251)."""
+    if _decode_kernel_enabled():
+        from areal_tpu.ops.pallas.decode_attention import (
+            decode_attention_kernel,
+        )
+
+        return decode_attention_kernel(
+            q, k_cache, v_cache,
+            jnp.asarray(valid_from, jnp.int32),
+            valid_to, k_scale, v_scale,
+        )
+    if k_scale is not None:
+        from areal_tpu.ops.quant import kv_dequant
+
+        k_cache = kv_dequant(k_cache, k_scale, q.dtype)
+        v_cache = kv_dequant(v_cache, v_scale, q.dtype)
     b, _, n_q, d = q.shape
     n_kv = k_cache.shape[2]
     n_rep = n_q // n_kv

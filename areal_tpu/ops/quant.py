@@ -1,0 +1,28 @@
+"""Symmetric per-head int8 quantization for KV caches.
+
+One canonical implementation: the transformer cache paths, the dense
+decode-attention fallback, and the fused Pallas decode kernel all grade
+against these exact semantics — a quantization change in one place
+cannot silently diverge the others.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def kv_quant(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[..., d] float -> (int8 [..., d], bf16 scale [...])."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / s[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, s.astype(jnp.bfloat16)
+
+
+def kv_dequant(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
+    return (
+        q.astype(jnp.float32) * s.astype(jnp.float32)[..., None]
+    ).astype(dtype)

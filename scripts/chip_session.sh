@@ -22,6 +22,10 @@ echo "=== profile_decode ===" | tee -a "$out/session.log"
 timeout 1200 python scripts/profile_decode.py --batches 8,32 \
   --windows 1280,16640 --steps 64 2>&1 | tail -6 \
   | tee -a "$out/session.log" || true
+echo "=== profile_decode (fused pallas kernel) ===" | tee -a "$out/session.log"
+AREAL_DECODE_KERNEL=1 timeout 1200 python scripts/profile_decode.py \
+  --batches 8,32 --windows 1280,16640 --steps 64 2>&1 | tail -6 \
+  | tee -a "$out/session.log" || true
 echo "=== probe_mem trial (production 16GB fit) ===" \
   | tee -a "$out/session.log"
 PROBE_MAX_NEW=512 timeout 2400 python scripts/probe_mem.py trial 2>&1 \

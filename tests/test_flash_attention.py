@@ -170,3 +170,89 @@ class TestFlashSharded:
         q, k, v, seg = _inputs(rng, b=4, s=256, hq=4, hkv=2, d=32)
         with pytest.raises(ValueError):
             flash_attention_sharded(q, k, v, seg, mesh)
+
+
+class TestDecodeAttentionKernel:
+    """Fused decode-attention Pallas kernel (interpret mode on CPU) vs
+    the dense XLA path, bf16/f32 and int8-with-scales."""
+
+    def _mk(self, rng, b=4, s=256, nq=8, nkv=2, d=128):
+        q = jnp.asarray(rng.standard_normal((b, 1, nq, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, nkv, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, s, nkv, d)), jnp.float32)
+        lo = jnp.asarray(rng.integers(0, s // 4, b), jnp.int32)
+        hi = jnp.asarray(rng.integers(s // 2, s, b), jnp.int32)
+        return q, k, v, lo, hi
+
+    def test_matches_dense(self, rng):
+        from areal_tpu.ops.attention import decode_attention
+        from areal_tpu.ops.pallas.decode_attention import (
+            decode_attention_kernel,
+        )
+
+        q, k, v, lo, hi = self._mk(rng)
+        want = decode_attention(q, k, v, lo, hi)
+        got = decode_attention_kernel(q, k, v, lo, hi, block_k=64)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_matches_dense_int8(self, rng):
+        from areal_tpu.models.transformer import kv_quant
+        from areal_tpu.ops.attention import decode_attention
+        from areal_tpu.ops.pallas.decode_attention import (
+            decode_attention_kernel,
+        )
+
+        q, k, v, lo, hi = self._mk(rng)
+        kq, ks = kv_quant(k)
+        vq, vs = kv_quant(v)
+        want = decode_attention(q, kq, vq, lo, hi, k_scale=ks, v_scale=vs)
+        got = decode_attention_kernel(
+            q, kq, vq, lo, hi, k_scale=ks, v_scale=vs, block_k=64
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-4, atol=3e-4
+        )
+
+    def test_scalar_valid_to(self, rng):
+        from areal_tpu.ops.attention import decode_attention
+        from areal_tpu.ops.pallas.decode_attention import (
+            decode_attention_kernel,
+        )
+
+        q, k, v, lo, _ = self._mk(rng)
+        hi = jnp.int32(200)  # scalar broadcast form the generator uses
+        want = decode_attention(q, k, v, lo, hi)
+        got = decode_attention_kernel(q, k, v, lo, hi, block_k=64)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_env_gate_routes_to_kernel(self, rng, monkeypatch):
+        from areal_tpu.ops import attention
+
+        q, k, v, lo, hi = self._mk(rng, b=2, s=128)
+        monkeypatch.setattr(attention, "_DECODE_KERNEL_SNAPSHOT", True)
+        got = attention.decode_attention(q, k, v, lo, hi)
+        monkeypatch.setattr(attention, "_DECODE_KERNEL_SNAPSHOT", False)
+        want = attention.decode_attention(q, k, v, lo, hi)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_default_block_on_bucketed_window(self, rng):
+        """Real decode windows are 256-quantum buckets (1280, 1792, ...)
+        that do NOT divide the default block; the kernel must step its
+        block down, not crash."""
+        from areal_tpu.ops.attention import decode_attention
+        from areal_tpu.ops.pallas.decode_attention import (
+            decode_attention_kernel,
+        )
+
+        q, k, v, lo, hi = self._mk(rng, b=2, s=1280)
+        want = decode_attention(q, k, v, lo, hi)
+        got = decode_attention_kernel(q, k, v, lo, hi)  # default block_k
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )

@@ -339,3 +339,32 @@ class TestInt8KVCache:
             for a in (c8.k, c8.v, c8.k_scale, c8.v_scale)
         )
         assert b8 < 0.6 * (c16.k.nbytes + c16.v.nbytes)
+
+
+def test_inflight_with_decode_kernel(cfg, params, rng, monkeypatch):
+    """The fused decode-attention kernel (AREAL_DECODE_KERNEL=1) slots
+    into the inflight loop transparently: greedy outputs equal the dense
+    path's."""
+    from areal_tpu.ops import attention
+
+    mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+    sample = _prompt_sample(rng, cfg, lens=(4, 9, 6))
+    g = GenerationHyperparameters(n=1, max_new_tokens=6, greedy=True)
+
+    monkeypatch.setattr(attention, "_DECODE_KERNEL_SNAPSHOT", False)
+    eng_dense = GeneratorEngine(
+        cfg, params, mesh, eos_token_id=EOS, max_decode_batch=2
+    )
+    out_dense = eng_dense.generate(sample, MicroBatchSpec(), g, inflight=True)
+
+    monkeypatch.setattr(attention, "_DECODE_KERNEL_SNAPSHOT", True)
+    eng_kern = GeneratorEngine(
+        cfg, params, mesh, eos_token_id=EOS, max_decode_batch=2
+    )
+    out_kern = eng_kern.generate(sample, MicroBatchSpec(), g, inflight=True)
+    monkeypatch.setattr(attention, "_DECODE_KERNEL_SNAPSHOT", None)
+
+    np.testing.assert_array_equal(
+        np.asarray(out_kern.data["packed_input_ids"]),
+        np.asarray(out_dense.data["packed_input_ids"]),
+    )
