@@ -965,13 +965,16 @@ def decode_step_spec(
         y, kc, vc, ksc, vsc, li = carry
         h = _norm(y, blk["ln1"], blk.get("ln1_b"), cfg)
         q, k, v = _block_kv(h, blk, cfg, cos, sin)  # [B, Q, h, d]
-        kc, vc, ksc, vsc, k_layer, v_layer, _, _ = _cache_update_read(
-            kc, vc, ksc, vsc, k, v, li, (rows[:, None], col_idx),
-            quant, q.dtype,
+        kc, vc, ksc, vsc, k_layer, v_layer, ks_l, vs_l = (
+            _cache_update_read(
+                kc, vc, ksc, vsc, k, v, li, (rows[:, None], col_idx),
+                quant, q.dtype, dequant=False,
+            )
         )
         attn = decode_attention_chunk(
             q, k_layer, v_layer,
             jnp.zeros((b,), jnp.int32), slots0 + 1,
+            k_scale=ks_l, v_scale=vs_l,
         )
         ao = attn.reshape(b, q_len, cfg.q_dim) @ blk["wo"]
         if cfg.proj_bias:

@@ -173,12 +173,29 @@ def decode_attention_chunk(
     v_cache: jax.Array,  # [B, S_max, n_kv, d]
     valid_from: jax.Array,  # [B] int — first valid cache slot per row
     valid_to0: jax.Array,  # [B] int — one past query 0's last visible slot
+    k_scale: "Optional[jax.Array]" = None,  # [B, S_max, n_kv]: int8 cache
+    v_scale: "Optional[jax.Array]" = None,
 ) -> jax.Array:
     """Multi-query decode attention for speculative decoding: query i
     attends the window [valid_from, valid_to0 + i) — the causal extension
     of `decode_attention` to a chunk of Q drafted positions (each draft
     sees the cache up to and including its own just-written slot).
     Same GQA-grouped, bf16-operand/fp32-accumulate formulation."""
+    if _decode_kernel_enabled():
+        from areal_tpu.ops.pallas.decode_attention import (
+            decode_attention_chunk_kernel,
+        )
+
+        return decode_attention_chunk_kernel(
+            q, k_cache, v_cache,
+            jnp.asarray(valid_from, jnp.int32), valid_to0,
+            k_scale, v_scale,
+        )
+    if k_scale is not None:
+        from areal_tpu.ops.quant import kv_dequant
+
+        k_cache = kv_dequant(k_cache, k_scale, q.dtype)
+        v_cache = kv_dequant(v_cache, v_scale, q.dtype)
     b, nq_tok, n_q, d = q.shape
     n_kv = k_cache.shape[2]
     n_rep = n_q // n_kv
