@@ -102,8 +102,9 @@ def _add_common(p: argparse.ArgumentParser):
     p.add_argument("--eval-max-new-tokens", type=int, default=256)
     p.add_argument("--eval-protocol", default="greedy",
                    type=_eval_protocol_arg,
-                   help="'greedy' or 'avg@K' (avg@32 = the AIME avg-of-32 "
-                        "pass@1 protocol at temperature 1.0)")
+                   help="'greedy', 'avg@K' (avg@32 = the AIME avg-of-32 "
+                        "pass@1 protocol at temperature 1.0), or 'maj@K' "
+                        "(majority voting over K samples)")
 
 
 def _apply_yaml_config(parser: argparse.ArgumentParser, argv):

@@ -54,16 +54,16 @@ class EvalConfig:
 
 
 def parse_protocol(proto: str) -> Optional[int]:
-    """'greedy' -> None; 'avg@K' -> K.  Anything else raises."""
+    """'greedy' -> None; 'avg@K'/'maj@K' -> K.  Anything else raises."""
     if proto == "greedy":
         return None
-    m = re.fullmatch(r"avg@(\d+)", proto)
-    if not m or int(m.group(1)) < 1:
+    m = re.fullmatch(r"(avg|maj)@(\d+)", proto)
+    if not m or int(m.group(2)) < 1:
         raise ValueError(
-            f"unknown eval protocol {proto!r}: use 'greedy' or 'avg@K' "
-            "(e.g. avg@32)"
+            f"unknown eval protocol {proto!r}: use 'greedy', 'avg@K', or "
+            "'maj@K' (e.g. avg@32, maj@8)"
         )
-    return int(m.group(1))
+    return int(m.group(2))
 
 
 _GRADER = None
@@ -126,6 +126,7 @@ def evaluate_checkpoint(
         config.n_samples, config.greedy, config.temperature,
     )
     k = parse_protocol(config.protocol)
+    majority = config.protocol.startswith("maj@")
     if k is not None:
         # avg@K: K independent temp-1.0 samples per prompt; greedy would
         # collapse them into K copies of one answer.
@@ -146,14 +147,18 @@ def evaluate_checkpoint(
     total_s = 0.0
     for name, path in datasets:
         one = _eval_one_dataset(
-            engine, tokenizer, config, gconfig, n, path, seed
+            engine, tokenizer, config, gconfig, n, path, seed,
+            majority=majority,
         )
         total_s += one["eval_seconds"]
         if len(datasets) == 1:
             return one
         for k_, v in one.items():
             result[f"{name}/{k_}"] = v
-    for key in ("pass@1", f"pass@{n}", "pass@1_prompt_std"):
+    agg_keys = ["pass@1", f"pass@{n}", "pass@1_prompt_std"]
+    if majority:
+        agg_keys.append(f"maj@{n}")
+    for key in agg_keys:
         vals = [result[f"{nm}/{key}"] for nm, _ in datasets]
         result[key] = float(np.mean(vals))
     result["samples_per_prompt"] = float(n)
@@ -209,7 +214,7 @@ def _parse_datasets(data_path: str):
 
 def _eval_one_dataset(
     engine, tokenizer, config: EvalConfig, gconfig, n: int, data_path: str,
-    seed: int,
+    seed: int, majority: bool = False,
 ) -> Dict[str, float]:
     import numpy as np
 
@@ -219,6 +224,7 @@ def _eval_one_dataset(
     n_correct = 0
     n_total = 0
     n_any = 0
+    n_maj = 0
     prompt_acc: List[float] = []  # per-prompt mean correctness
     t0 = time.monotonic()
     for start in range(0, len(rows), config.batch_size):
@@ -297,10 +303,12 @@ def _eval_one_dataset(
             any_ok = False
             row_ok = 0
             row_n = 0
+            texts = []
             for s in range(len(bounds) - 1):
                 lo, hi = bounds[s], bounds[s + 1]
                 resp = toks_all[lo:hi][~pmask[lo:hi].astype(bool)]
                 text = tokenizer.decode(resp.tolist())
+                texts.append(text)
                 ok = bool(_grader().verify(task, text, info))
                 n_correct += ok
                 row_ok += ok
@@ -308,6 +316,8 @@ def _eval_one_dataset(
                 n_total += 1
                 any_ok = any_ok or ok
             n_any += any_ok
+            if majority:
+                n_maj += _majority_correct(task, texts, info)
             prompt_acc.append(row_ok / max(row_n, 1))
     # pass@1 is the SAMPLE mean — under avg@K this is exactly the
     # reference's "average pass@1 over K samples" headline number.
@@ -321,7 +331,41 @@ def _eval_one_dataset(
         "n_samples": float(n_total),
         "eval_seconds": time.monotonic() - t0,
     }
+    if majority:
+        result[f"maj@{n}"] = n_maj / max(len(rows), 1)
     return result
+
+
+def _majority_correct(task: str, texts, info) -> bool:
+    """maj@K (reference: evaluation/rm_maj_eval.py group_pred): cluster
+    the K sampled answers by pairwise equivalence, grade the LARGEST
+    cluster's representative.  Equivalence uses the same grading stack
+    (each candidate answer treated as the gold for its peers), so
+    '1/2' and '0.5' vote together."""
+    from areal_tpu.interfaces.math_verify import (
+        answers_match,
+        extract_answer,
+    )
+
+    preds = [extract_answer(t) or "" for t in texts]
+    clusters: List[List[int]] = []
+    reps: List[str] = []
+    for i, p in enumerate(preds):
+        placed = False
+        for ci, rep in enumerate(reps):
+            # Unextractable answers cluster TOGETHER ("" == ""): a
+            # no-answer majority must be able to win (and then grade
+            # wrong), as in the reference's equal-string grouping.
+            if answers_match(p, rep):
+                clusters[ci].append(i)
+                placed = True
+                break
+        if not placed:
+            clusters.append([i])
+            reps.append(p)
+    best = max(range(len(clusters)), key=lambda ci: len(clusters[ci]))
+    winner = texts[clusters[best][0]]
+    return bool(_grader().verify(task, winner, info))
 
 
 _STEP_RE = re.compile(r"^(?:step_|epoch\w*_)(\d+)$")
@@ -431,8 +475,9 @@ def main():
                    help="format string applied to each prompt before "
                         "tokenization (chat wrappers etc.)")
     p.add_argument("--protocol", default="greedy",
-                   help="'greedy' or 'avg@K' (e.g. avg@32: the AIME "
-                        "avg-of-32 pass@1 protocol at temperature 1.0)")
+                   help="'greedy', 'avg@K' (e.g. avg@32: the AIME "
+                        "avg-of-32 pass@1 protocol at temperature 1.0), "
+                        "or 'maj@K' (majority voting over K samples)")
     p.add_argument("--watch", action="store_true")
     p.add_argument("--interval", type=float, default=10.0)
     args = p.parse_args()

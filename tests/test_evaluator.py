@@ -317,3 +317,75 @@ def test_choice_int_answer_and_many_options(tmp_path):
 
     assert verify_math("the answer is (H)", ["H"])
     assert not verify_math("the answer is (H)", ["G"])
+
+
+def test_maj_at_k_protocol(tmp_path):
+    """maj@K (reference: evaluation/rm_maj_eval.py): cluster the K
+    sampled answers by grading-equivalence, grade the largest cluster's
+    representative."""
+    ckpt = _write_ckpt(tmp_path / "ckpts", 1)
+    data = tmp_path / "aime.jsonl"
+    _write_data(data)
+    res = evaluate_checkpoint(
+        ckpt,
+        EvalConfig(
+            data_path=str(data), tokenizer_path="char:512",
+            max_new_tokens=8, protocol="maj@4",
+        ),
+    )
+    assert "maj@4" in res
+    assert 0.0 <= res["maj@4"] <= 1.0
+    assert res["samples_per_prompt"] == 4.0
+
+
+def test_majority_clustering_equivalence():
+    """'1/2' and '0.5' vote together; the majority wins over a plurality
+    of distinct wrong answers."""
+    from areal_tpu.scheduler.evaluator import _majority_correct
+
+    texts = [
+        r"the answer is \boxed{1/2}",
+        r"the answer is \boxed{0.5}",
+        r"the answer is \boxed{7}",
+        r"the answer is \boxed{9}",
+    ]
+    info = {"solutions": [r"\boxed{\frac{1}{2}}"]}
+    assert _majority_correct("math", texts, info) is True
+    # Flip the majority to a wrong answer cluster.
+    texts_wrong = [
+        r"the answer is \boxed{7}",
+        r"the answer is \boxed{7.0}",
+        r"the answer is \boxed{1/2}",
+    ]
+    assert _majority_correct("math", texts_wrong, info) is False
+
+
+def test_majority_no_answer_cluster_wins():
+    """Unextractable answers cluster together — a no-answer majority must
+    outvote a single correct answer (and then grade wrong)."""
+    from areal_tpu.scheduler.evaluator import _majority_correct
+
+    texts = [
+        r"the answer is \boxed{1/2}",
+        "I am not sure.",
+        "Cannot determine.",
+        "No final answer.",
+    ]
+    info = {"solutions": [r"\boxed{\frac{1}{2}}"]}
+    assert _majority_correct("math", texts, info) is False
+
+
+def test_maj_at_k_multi_dataset_flat_key(tmp_path):
+    ckpt = _write_ckpt(tmp_path / "ckpts", 1)
+    d1 = tmp_path / "a.jsonl"
+    _write_data(d1, n=2)
+    d2 = tmp_path / "b.jsonl"
+    _write_data(d2, n=2)
+    res = evaluate_checkpoint(
+        ckpt,
+        EvalConfig(
+            data_path=f"a={d1},b={d2}", tokenizer_path="char:512",
+            max_new_tokens=4, protocol="maj@2",
+        ),
+    )
+    assert "maj@2" in res and "a/maj@2" in res and "b/maj@2" in res
