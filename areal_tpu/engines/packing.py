@@ -91,14 +91,26 @@ def split_sharded(
     out = []
     for j in range(k):
         idx: List[int] = []
-        mb_blocks: List[List[int]] = []
+        row_blocks: List[List[int]] = []
         for b, gs in zip(blocks, per):
             g = [b[i] for i in gs[j]] if j < len(gs) else []
-            mb_blocks.append(list(range(len(idx), len(idx) + len(g))))
+            row_blocks.append(list(range(len(idx), len(idx) + len(g))))
             idx.extend(g)
         if not idx:
             continue
         mb = sample.select_idx(idx)
+        # pack_sample's shard_blocks index SEQUENCES, not batch rows —
+        # a PPO row carries `group` sequences, so the two only coincide
+        # for 1-sequence rows.  Expand each shard's contiguous row range
+        # to its sequence range (rows are ordered shard-major, so the
+        # sequence blocks stay contiguous).
+        row_nseq = [len(sample.seqlens[key][i]) for i in idx]
+        mb_blocks: List[List[int]] = []
+        pos = 0
+        for rb in row_blocks:
+            n_seq = sum(row_nseq[r] for r in rb)
+            mb_blocks.append(list(range(pos, pos + n_seq)))
+            pos += n_seq
         out.append((mb, mb_blocks))
     return out
 

@@ -65,3 +65,21 @@ def random_sample(rng: np.random.Generator, ids, keys=("packed_input_ids",), max
         for k in keys
     }
     return SequenceSample(keys=set(keys), ids=list(ids), seqlens=seqlens, data=data)
+
+
+def zero_fill_unowned(sample, rank, n_shards, keys):
+    """Test-side mirror of the worker's sharded zero-fill: blank the
+    token ranges of every id NOT owned by `rank` (ownership = id index
+    mod n_shards) for the given per-token keys.  cu_seqlens is per
+    SEQUENCE; an id spans its whole group of sequences."""
+    for i in range(sample.bs):
+        if i % n_shards == rank:
+            continue
+        for k in keys:
+            if k not in sample.keys:
+                continue
+            b = sample.cu_seqlens(k)
+            s0 = sum(len(g) for g in sample.seqlens[k][:i])
+            s1 = s0 + len(sample.seqlens[k][i])
+            sample.data[k][b[s0]: b[s1]] = 0
+    return sample

@@ -594,14 +594,9 @@ def _shard_view(sample, rank, n_shards):
         "packed_input_ids", "packed_logprobs", "packed_ref_logprobs",
         "values",
     )
-    for i in range(view.bs):
-        if i % n_shards == rank:
-            continue
-        for k in heavy:
-            if k not in view.keys:
-                continue
-            b = view.cu_seqlens(k)
-            view.data[k][b[i]: b[i + 1]] = 0
+    from tests.fixtures import zero_fill_unowned
+
+    zero_fill_unowned(view, rank, n_shards, heavy)
     view.metadata["shard_of"] = [
         [i % n_shards, n_shards] for i in range(view.bs)
     ]
@@ -613,7 +608,9 @@ def _own_token_mask(sample, rank, n_shards, key="packed_input_ids"):
     b = sample.cu_seqlens(key)
     for i in range(sample.bs):
         if i % n_shards == rank:
-            m[b[i]: b[i + 1]] = True
+            s0 = sum(len(g) for g in sample.seqlens[key][:i])
+            s1 = s0 + len(sample.seqlens[key][i])
+            m[b[s0]: b[s1]] = True
     return m
 
 
