@@ -919,3 +919,56 @@ class TestReleaseAliasedGenerators:
             {"model_name": "g_alias"}
         ) == {"released": True}
         assert alias.released
+
+
+class TestShardedBestOfK:
+    def test_filter_keeps_tags_and_packs(self):
+        """Best-of-k filtering preserves shard_of (round-5 fix) so the
+        filtered batch still rides the sharded dispatch path and packs
+        with sequence-level shard blocks."""
+        from areal_tpu.api.model_api import GenerationHyperparameters
+        from areal_tpu.interfaces.ppo import PPOActorInterface
+
+        rng = np.random.default_rng(5)
+        n_ids, gsize = 4, 3
+        seqlens = [[8, 9, 10] for _ in range(n_ids)]
+        total = sum(sum(r) for r in seqlens)
+        n_seqs = n_ids * gsize
+        pmask = np.zeros(total, bool)
+        off = 0
+        for l in (x for r in seqlens for x in r):
+            pmask[off : off + 3] = True
+            off += l
+        s = SequenceSample(
+            keys={"packed_input_ids", "prompt_mask", "rewards"},
+            ids=[f"q{i}" for i in range(n_ids)],
+            seqlens={
+                "packed_input_ids": [list(r) for r in seqlens],
+                "prompt_mask": [list(r) for r in seqlens],
+                "rewards": [[1] * gsize] * n_ids,
+            },
+            data={
+                "packed_input_ids": rng.integers(1, 50, total).astype(
+                    np.int32
+                ),
+                "prompt_mask": pmask,
+                "rewards": rng.normal(size=n_seqs).astype(np.float32),
+            },
+            metadata={"shard_of": [[i % 2, 2] for i in range(n_ids)]},
+        )
+        iface = PPOActorInterface(
+            gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+            generation_size=gsize,
+        )
+        kept = iface._filter_best_of_k(s)
+        assert kept.metadata["shard_of"] == s.metadata["shard_of"]
+        assert all(
+            len(g) == 2 for g in kept.seqlens["packed_input_ids"]
+        )
+        # The filtered group-structured batch still packs shard-aligned.
+        for mb, blocks in packing.split_sharded(kept, MicroBatchSpec()):
+            pk = packing.pack_sample(
+                mb, "packed_input_ids", extra_keys=("prompt_mask",),
+                n_rows_multiple=2, shard_blocks=blocks,
+            )
+            assert pk.n_rows >= 2
