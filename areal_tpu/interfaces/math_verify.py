@@ -90,14 +90,28 @@ CHOICE_LETTERS = "ABCDEFGHIJ"
 _CHOICE_RE = re.compile(r"\b([A-J])\b")
 
 
+_PAREN_CHOICE_RE = re.compile(r"\(([A-J])\)")
+
+
 def choice_answer_clean(pred: str) -> str:
-    """Multiple-choice extraction, reference-parity
-    (evaluation/grader.py:30 / evaluation/parser.py:373): the LAST
-    standalone choice letter in the prediction wins ('The answer is
-    (B).' -> 'B'); otherwise the stripped prediction itself."""
+    """Multiple-choice extraction (reference: evaluation/grader.py:30 /
+    parser.py:373 last-standalone-letter-wins, extended to A-J).
+    Priority: the last PARENTHESIZED letter ('(B)'), then the last
+    standalone letter — but the English words 'A' and 'I' only count
+    when no other candidate exists ('The answer is (B). I am sure.'
+    must grade B, not I)."""
     pred = pred.strip("\n").rstrip(".").rstrip("/").strip(" ").lstrip(":")
-    found = _CHOICE_RE.findall(pred.upper())
-    out = found[-1] if found else pred.strip().strip(".")
+    up = pred.upper()
+    paren = _PAREN_CHOICE_RE.findall(up)
+    if paren:
+        return paren[-1]
+    found = _CHOICE_RE.findall(up)
+    unambiguous = [c for c in found if c not in ("A", "I")]
+    if unambiguous:
+        return unambiguous[-1]
+    if found:
+        return found[-1]
+    out = pred.strip().strip(".")
     return out.rstrip(".").rstrip("/")
 
 
@@ -118,10 +132,25 @@ def choice_match(pred: str, gold: str) -> bool:
     # answer ("ACD") has no \b-separated letters and falls back to the
     # reference's char filter over the extracted answer
     # (math_eval.py:596).
-    standalone = _CHOICE_RE.findall(pred.upper())
-    if standalone:
-        return "".join(standalone) == gold
-    return "".join(c for c in pred.upper() if c in CHOICE_LETTERS) == gold
+    # Order- and duplicate-insensitive: "the correct options are (C)
+    # and (A)" must match gold "AC"; restating a letter must not break
+    # the comparison.  Bare 'A'/'I' are ambiguous (English words), so
+    # the prediction matches if ANY consistent reading — parenthesized
+    # letters only, standalone letters without A/I, standalone letters
+    # with them, or the reference's raw char filter — equals the gold
+    # set.  (The reference's char filter alone has both failure modes;
+    # trying each reading strictly dominates it.)
+    up = pred.upper()
+    want = "".join(sorted(set(gold)))
+    readings = (
+        _PAREN_CHOICE_RE.findall(up),
+        [c for c in _CHOICE_RE.findall(up) if c not in ("A", "I")],
+        _CHOICE_RE.findall(up),
+        [c for c in up if c in CHOICE_LETTERS],
+    )
+    return any(
+        r and "".join(sorted(set(r))) == want for r in readings
+    )
 
 
 def answers_match(pred: str, gold: str) -> bool:

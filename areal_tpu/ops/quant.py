@@ -13,13 +13,19 @@ import jax.numpy as jnp
 
 
 def kv_quant(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """[..., d] float -> (int8 [..., d], bf16 scale [...])."""
+    """[..., d] float -> (int8 [..., d], bf16 scale [...]).
+
+    The scale is rounded to bf16 BEFORE quantizing so quantize and
+    dequantize use the identical value — otherwise the bf16 rounding of
+    the stored scale adds a uniform per-head error on top of the int8
+    step and saturated entries dequantize past the original max."""
     s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
-    s = jnp.maximum(s, 1e-8)
+    s = jnp.maximum(s, 1e-8).astype(jnp.bfloat16)
     q = jnp.clip(
-        jnp.round(x.astype(jnp.float32) / s[..., None]), -127, 127
+        jnp.round(x.astype(jnp.float32) / s.astype(jnp.float32)[..., None]),
+        -127, 127,
     ).astype(jnp.int8)
-    return q, s.astype(jnp.bfloat16)
+    return q, s
 
 
 def kv_dequant(q: jax.Array, s: jax.Array, dtype) -> jax.Array:

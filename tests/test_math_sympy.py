@@ -209,3 +209,27 @@ class TestMultipleChoice:
         assert answers_match("50", "0.5")  # percent-flexible both ways
         assert answers_match("3.14159", "3.141592653589793")
         assert not answers_match("33.3", r"\frac{100}{3}")  # rel 1e-3 > tol
+
+
+class TestChoiceExtractionRobustness:
+    """Round-5 hardening: prose pollution and order-insensitivity."""
+
+    def test_trailing_I_does_not_override(self):
+        from areal_tpu.interfaces.math_verify import verify_math
+
+        assert verify_math("The answer is (B). I am confident.", ["B"])
+        assert verify_math("Answer: B. I checked twice", ["B"])
+
+    def test_bare_A_and_I_still_gradeable(self):
+        from areal_tpu.interfaces.math_verify import verify_math
+
+        assert verify_math("the answer is A", ["A"])
+        assert verify_math(r"\boxed{I}", ["I"])
+        assert not verify_math("the answer is B", ["A"])
+
+    def test_multi_letter_order_and_duplicates(self):
+        from areal_tpu.interfaces.math_verify import verify_math
+
+        assert verify_math("The correct options are (C) and (A).", ["AC"])
+        assert verify_math("B and D. B is right because...", ["BD"])
+        assert not verify_math("(C) and (A) and (D)", ["AC"])
