@@ -318,6 +318,9 @@ def cmd_ppo_math(args):
             {"kv_cache_dtype": args.kv_cache_dtype}
             if args.kv_cache_dtype != "auto" else {}
         ),
+        kv_paged=False if args.no_paged_kv else None,
+        kv_page_size=args.kv_page_size,
+        kv_pool_pages=args.kv_pool_pages,
         train_backend_args={
             k: v
             for k, v in (
@@ -414,6 +417,15 @@ def main(argv=None):
                     choices=("auto", "int8"),
                     help="int8 halves KV HBM per generated token (the "
                          "capacity bound for 16k+ decodes)")
+    pp.add_argument("--no-paged-kv", action="store_true",
+                    help="use the dense grow-by-doubling KV window "
+                         "instead of the paged pool (parity/debug)")
+    pp.add_argument("--kv-page-size", type=int, default=128,
+                    help="tokens per KV page in the paged decode pool")
+    pp.add_argument("--kv-pool-pages", type=int, default=0,
+                    help="fixed KV pool size in pages (0 = auto-size "
+                         "for the worst case; positive caps KV HBM and "
+                         "bounds concurrent admissions)")
     pp.add_argument("--master-dtype", default=None,
                     choices=(None, "float32", "bfloat16"),
                     help="optimizer master/Adam dtype; bfloat16 halves "

@@ -1,0 +1,46 @@
+"""jax version-portability shims.
+
+The repo rides whatever jax the TPU image bakes in, and that surface
+has drifted across containers: ``shard_map`` moved from
+``jax.experimental.shard_map`` to the jax top level, its
+replication-check kwarg was renamed ``check_rep`` -> ``check_vma``,
+and the manual-axes declaration flipped from ``auto=<complement>`` to
+``axis_names=<manual set>``.  Call sites import ``shard_map`` from
+here using the NEW spelling; old jax gets a translation.
+"""
+
+try:  # jax >= 0.8: top-level export, check_vma / axis_names kwargs
+    from jax import shard_map as _shard_map
+
+    _NEW_API = True
+except ImportError:  # pragma: no cover - older images
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _NEW_API = False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+              axis_names=None):
+    """``jax.shard_map`` with the >=0.8 keyword surface on any jax.
+
+    ``axis_names`` (the axes to manualize) is translated to old jax's
+    ``auto`` (the complement) when needed; ``check_vma`` maps to
+    ``check_rep``.
+    """
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if _NEW_API:
+        kwargs["check_vma"] = check_vma
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+    else:
+        kwargs["check_rep"] = check_vma
+        # No `auto=<complement>` translation for axis_names: old XLA's
+        # partial-manual lowering CHECK-fails (hlo_sharding_util
+        # IsManualSubgroup) on collectives inside the region.  Fully
+        # manualizing instead is semantics-preserving for bodies that are
+        # deterministic and collective-free over the undeclared axes —
+        # jit reshards (replicates) the inputs at the region boundary and
+        # every member of an undeclared axis computes identical values.
+        # The cost is losing intra-region GSPMD sharding, paid only on
+        # old-jax images.
+    return _shard_map(f, **kwargs)

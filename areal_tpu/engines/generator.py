@@ -23,6 +23,7 @@ InflightBatchingGenerator, real_llm_generate.py:670).
 
 import dataclasses
 import functools
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -37,12 +38,87 @@ from areal_tpu.base.distributed import to_host
 from areal_tpu.base.topology import batch_sharding_degree
 from areal_tpu.engines.offload import HostOffloadMixin
 from areal_tpu.engines.packing import decode_bucket_len as bucket_len
+from areal_tpu.engines.paging import PageAllocator, PagePoolExhausted
 from areal_tpu.models import transformer as tfm
 from areal_tpu.models.config import ModelConfig
 from areal_tpu.ops.sampling import sample_token
 from areal_tpu.parallel import sharding
 
 logger = logging.getLogger("generator")
+
+
+def _cache_nbytes(cache) -> int:
+    """Total byte footprint of a KV cache/pool (host-side metadata only)."""
+    total = 0
+    for a in (cache.k, cache.v, cache.k_scale, cache.v_scale):
+        if a is not None:
+            total += a.size * a.dtype.itemsize
+    return total
+
+
+def _spec_emit(
+    cfg, g, eos, rows, logits, drafts, sub, pending, cache_len, gen_count,
+    done, out_toks, out_logps, out_fill, tokens_buf,
+):
+    """Shared post-forward bookkeeping for one speculative decode step
+    (dense AND paged cache layouts — one implementation so the two can
+    never diverge in emission semantics): min-length EOS masking, exact
+    accept/reject (`spec_accept`), first-EOS truncation, appends into
+    the chunk output buffers and the device-resident history buffer.
+
+    Returns (tokens_buf, pending, cache_len, gen_count, done, out_toks,
+    out_logps, out_fill) — the post-step carry pieces."""
+    from areal_tpu.ops.sampling import spec_accept
+
+    K = g.spec_decode_k
+    if g.min_new_tokens > 0:
+        not_enough = (
+            gen_count[:, None] + jnp.arange(K + 1)[None, :]
+        ) < g.min_new_tokens
+        logits = jnp.where(
+            not_enough[:, :, None]
+            & (jnp.arange(cfg.vocab_size) == eos)[None, None, :],
+            -1e10,
+            logits,
+        )
+    emitted, logps, n_emit = spec_accept(
+        logits, drafts, sub,
+        temperature=g.temperature, top_k=g.top_k, top_p=g.top_p,
+        greedy=g.greedy,
+    )
+    n_emit = jnp.where(done, 0, n_emit)
+    # Truncate at the first EOS (inclusive).
+    j_idx = jnp.arange(K + 1)[None, :]
+    is_eos = (emitted == eos) & (j_idx < n_emit[:, None])
+    eos_pos = jnp.min(jnp.where(is_eos, j_idx, K + 1), axis=1)
+    n_emit = jnp.minimum(n_emit, eos_pos + 1)
+    new_done = done | jnp.any(is_eos, axis=1)
+    valid = j_idx < n_emit[:, None]
+    # Append to the output buffers at per-row fill offsets.
+    cols = out_fill[:, None] + j_idx
+    out_toks = out_toks.at[rows[:, None], cols].set(
+        jnp.where(valid, emitted, -1)
+    )
+    out_logps = out_logps.at[rows[:, None], cols].set(
+        jnp.where(valid, logps, 0.0)
+    )
+    out_fill = out_fill + n_emit
+    # History: emitted tokens live at positions L+1..L+n_emit.
+    bcols = jnp.minimum(
+        cache_len[:, None] + 1 + j_idx, tokens_buf.shape[1] - 1
+    )
+    cur = tokens_buf[rows[:, None], bcols]
+    tokens_buf = tokens_buf.at[rows[:, None], bcols].set(
+        jnp.where(valid, emitted, cur)
+    )
+    new_pending = jnp.take_along_axis(
+        emitted, jnp.clip(n_emit - 1, 0, K)[:, None], axis=1
+    )[:, 0]
+    pending2 = jnp.where(done | (n_emit == 0), pending, new_pending)
+    return (
+        tokens_buf, pending2, cache_len + n_emit, gen_count + n_emit,
+        new_done, out_toks, out_logps, out_fill,
+    )
 
 
 class GeneratorEngine(HostOffloadMixin, Engine):
@@ -57,6 +133,9 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         max_decode_batch: int = 64,
         donation_safe_swap: bool = True,
         kv_cache_dtype: str = "auto",
+        kv_paged: Optional[bool] = None,
+        kv_page_size: int = 128,
+        kv_pool_pages: int = 0,
     ):
         if cfg.is_critic:
             raise ValueError("cannot generate from a critic model")
@@ -88,6 +167,28 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 f"got {kv_cache_dtype!r}"
             )
         self.kv_cache_dtype = kv_cache_dtype
+        # Paged KV pool for the inflight family (plain + speculative):
+        # fixed-size page pool + host free-list allocator instead of the
+        # dense grow-by-doubling window — zero cache copies, exactly one
+        # decode compilation per generate call, retired slots' pages
+        # recycled into new admits.  Default ON; AREAL_PAGED_KV=0 (or
+        # kv_paged=False) falls back to the dense window (kept for
+        # parity tests and as the known-good path).
+        if kv_paged is None:
+            kv_paged = os.environ.get("AREAL_PAGED_KV", "1") != "0"
+        self.kv_paged = bool(kv_paged)
+        if kv_page_size < 1:
+            raise ValueError(f"kv_page_size must be >= 1, got {kv_page_size}")
+        if kv_pool_pages < 0:
+            raise ValueError(
+                f"kv_pool_pages must be >= 0 (0 = auto), got {kv_pool_pages}"
+            )
+        self.kv_page_size = int(kv_page_size)
+        # 0 = auto: size the pool for the worst case (every slot at
+        # prompt + max_new_tokens).  A positive value caps pool HBM and
+        # makes admission wait for freed pages (PagePoolExhausted if a
+        # LIVE slot cannot grow).
+        self.kv_pool_pages = int(kv_pool_pages)
         # When True (default), set_params COPIES any leaf whose buffers
         # alias the source tree — required when generation can overlap a
         # train step that donates those buffers (rollout_ahead).  In a
@@ -120,7 +221,23 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         # during the LAST generate() call — tests assert batching (one
         # dispatch per refill cycle, not one per admission).
         self.prefill_dispatches = 0
+        # Per-generate() perf counters (reset in generate(); the bench
+        # and the recompile-regression tests read them): decode-program
+        # compilations, bytes moved by whole-cache grow copies, and the
+        # last call's KV-memory utilization stats.
+        self.decode_compiles = 0
+        self.cache_copy_bytes = 0
+        self.last_pool_stats: Dict[str, Any] = {}
         self.set_params(params)
+
+    @property
+    def page_budget_tokens(self) -> Optional[int]:
+        """Token capacity of an explicitly sized page pool (None when
+        the pool is auto-sized) — the admission budget gen_server splits
+        request groups against."""
+        if not self.kv_paged or self.kv_pool_pages == 0:
+            return None
+        return self.kv_pool_pages * self.kv_page_size
 
     # ---------------- weights ----------------
 
@@ -227,6 +344,9 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         self._ensure_loaded()
         self._require_params()
         self.prefill_dispatches = 0
+        self.decode_compiles = 0
+        self.cache_copy_bytes = 0
+        self.last_pool_stats = {}
         prompt_lens = sample.seqlens_of(prompt_key)
         bounds = sample.cu_seqlens(prompt_key)
         prompts = np.asarray(sample.data[prompt_key])
@@ -276,9 +396,19 @@ class GeneratorEngine(HostOffloadMixin, Engine):
 
     def _generate_inflight(self, reqs, gconfig, key, results) -> None:
         """Fixed slot pool; retire finished rows and admit pending requests
-        between jitted T-token decode chunks."""
+        between jitted T-token decode chunks.  kv_paged (the default)
+        routes to the paged-pool variants: fixed shapes, one decode
+        compilation, zero grow copies."""
         if gconfig.spec_decode_k > 0:
+            if self.kv_paged:
+                return self._generate_inflight_spec_paged(
+                    reqs, gconfig, key, results
+                )
             return self._generate_inflight_spec(reqs, gconfig, key, results)
+        if self.kv_paged:
+            return self._generate_inflight_plain_paged(
+                reqs, gconfig, key, results
+            )
         return self._generate_inflight_plain(reqs, gconfig, key, results)
 
     def _generate_inflight_plain(self, reqs, gconfig, key, results) -> None:
@@ -334,8 +464,15 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             # Geometric (doubling) growth bounds recompiles + cache copies
             # to O(log length); dead slots are excluded (cache_len resets
             # on retirement).
-            cache, cur_w = self._grow_kv_cache(
+            old_bytes = _cache_nbytes(cache)
+            cache, new_w = self._grow_kv_cache(
                 cache, cur_w, int(cache_len.max()) + chunk_t
+            )
+            if new_w != cur_w:
+                self.cache_copy_bytes += old_bytes
+                cur_w = new_w
+            self._accum_pool_stats(
+                "dense", int(cache_len.sum()), n_slots * cur_w
             )
 
             # One jitted chunk: up to chunk_t tokens for every live slot.
@@ -365,12 +502,14 @@ class GeneratorEngine(HostOffloadMixin, Engine):
 
     def _drain_chunk_outputs(
         self, out_toks, out_logps, new_done, active, toks_acc, logps_acc,
-        results, done_host, cache_len, max_new: int,
+        results, done_host, cache_len, max_new: int, on_retire=None,
     ) -> None:
         """Shared inflight bookkeeping (plain + speculative loops): append
         each live slot's chunk output (rows are contiguous, -1-terminated),
         finish on EOS or the token budget, retire finished slots (a dead
-        slot must not drive cache growth)."""
+        slot must not drive cache growth).  `on_retire(slot)` fires when a
+        slot finishes — the paged loops hook it to recycle the slot's
+        pages into the free list."""
         for s in range(len(active)):
             if active[s] is None:
                 continue
@@ -397,6 +536,8 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 active[s] = None
                 done_host[s] = True
                 cache_len[s] = 0
+                if on_retire is not None:
+                    on_retire(s)
             else:
                 done_host[s] = bool(new_done[s])
 
@@ -522,6 +663,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             return out_toks, out_logps, logits, cache, cache_len, gen_count, done
 
         self._gen_fns[sig] = fn
+        self.decode_compiles += 1
         logger.info(
             f"compiled inflight decoder n_slots={n_slots} s_max={s_max} "
             f"chunk={chunk_t}"
@@ -555,6 +697,271 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             ),
             new_w,
         )
+
+    def _accum_pool_stats(
+        self, kind: str, live_tokens: int, allocated_tokens: int
+    ) -> None:
+        """Accumulate per-chunk KV-memory utilization (live tokens /
+        allocated cache tokens) into last_pool_stats — the bench reports
+        this next to tokens/s for the dense-vs-paged comparison."""
+        st = self.last_pool_stats
+        if st.get("kind") != kind:
+            st.clear()
+            st.update(
+                kind=kind, samples=0, live_tokens=0, allocated_tokens=0
+            )
+        st["samples"] += 1
+        st["live_tokens"] += int(live_tokens)
+        st["allocated_tokens"] += int(allocated_tokens)
+        st["utilization"] = st["live_tokens"] / max(st["allocated_tokens"], 1)
+
+    # -- paged inflight (fixed page pool + host free-list allocator) --
+
+    def _paged_kv_dtype(self):
+        return "int8" if self.kv_cache_dtype == "int8" else self.compute_dtype
+
+    def _generate_inflight_plain_paged(
+        self, reqs, gconfig, key, results
+    ) -> None:
+        """The plain inflight loop over a paged KV pool: the pool and the
+        decode program have ONE fixed shape for the whole generate call
+        (compiled exactly once), window growth is a host-side page-index
+        append, and retired slots' pages are recycled into new admits.
+        Replaces grow-by-doubling (`_generate_inflight_plain`), which
+        pays a full-cache copy + recompile at every bucket boundary."""
+        n_slots = min(max(self.batch_shard, self.max_decode_batch), len(reqs))
+        while n_slots % self.batch_shard:
+            n_slots += 1
+        ps = self.kv_page_size
+        chunk_t = min(32, gconfig.max_new_tokens)
+        max_prompt = max(len(t) for (_, _, t) in reqs)
+        # Page-table width: worst-case per-slot footprint (full prompt +
+        # the whole new-token budget + chunk slack — within a chunk,
+        # writes land up to chunk_t past the pre-chunk live length).
+        max_pages = -(-(max_prompt + gconfig.max_new_tokens + chunk_t) // ps)
+        n_pages = self.kv_pool_pages or n_slots * max_pages
+        alloc = PageAllocator(n_pages, ps, n_slots, max_pages)
+        pool = tfm.init_paged_kv_cache(
+            self.cfg, n_pages, ps, dtype=self._paged_kv_dtype()
+        )
+        decode_fn = self._get_paged_decode_fn(
+            n_slots, n_pages, max_pages, chunk_t, gconfig
+        )
+        logits_buf = jnp.zeros((n_slots, self.cfg.vocab_size), jnp.float32)
+        cache_len = np.zeros((n_slots,), np.int32)
+        gen_count = np.zeros((n_slots,), np.int32)
+        done_host = np.ones((n_slots,), bool)
+        active: List[Optional[Tuple[int, int]]] = [None] * n_slots
+        toks_acc: Dict[int, List[int]] = {}
+        logps_acc: Dict[int, List[float]] = {}
+        pending = list(reversed(reqs))
+
+        while pending or any(a is not None for a in active):
+            admits = self._take_admits_paged(
+                active, pending, n_slots, alloc, chunk_t
+            )
+            if admits:
+                rows, plens, slots, page_rows = self._pack_admits_paged(
+                    admits, n_slots, alloc
+                )
+                logits_buf, pool = self._get_prefill_pages_fn()(
+                    self.params, jnp.asarray(rows), jnp.asarray(plens),
+                    pool, logits_buf, jnp.asarray(slots),
+                    jnp.asarray(page_rows),
+                )
+                self.prefill_dispatches += 1
+                for s, i, rep, toks in admits:
+                    cache_len[s] = len(toks)
+                    gen_count[s] = 0
+                    done_host[s] = False
+                    active[s] = (i, rep)
+                    toks_acc[s] = []
+                    logps_acc[s] = []
+
+            # Map pages covering the next chunk for every live slot —
+            # the jitted chunk must never need a page the table lacks.
+            # This is the paged replacement for _grow_kv_cache: an int
+            # append on the host, no device copy, no recompile.
+            for s in range(n_slots):
+                if active[s] is not None:
+                    alloc.reserve(s, int(cache_len[s]) + chunk_t)
+            self._accum_pool_stats(
+                "paged", int(cache_len.sum()), alloc.allocated_pages() * ps
+            )
+
+            key, sub = jax.random.split(key)
+            (
+                out_toks, out_logps, logits_buf, pool,
+                new_cache_len, new_gen_count, new_done,
+            ) = decode_fn(
+                self.params, pool, logits_buf, jnp.asarray(alloc.table),
+                jnp.asarray(cache_len), jnp.asarray(gen_count),
+                jnp.asarray(done_host), sub,
+            )
+            out_toks = to_host(out_toks)
+            out_logps = to_host(out_logps)
+            cache_len = to_host(new_cache_len).copy()
+            gen_count = to_host(new_gen_count).copy()
+
+            self._drain_chunk_outputs(
+                out_toks, out_logps, to_host(new_done), active, toks_acc,
+                logps_acc, results, done_host, cache_len,
+                gconfig.max_new_tokens, on_retire=alloc.release,
+            )
+        self.last_pool_stats.update(
+            pool_pages=n_pages, page_size=ps,
+            pages_recycled=alloc.pages_recycled,
+            peak_pages_used=alloc.peak_pages_used,
+        )
+
+    def _take_admits_paged(self, active, pending, n_slots, alloc, slack):
+        """`_take_admits` against the page budget: a request is admitted
+        only when the allocator can map its prompt plus `slack` decode
+        tokens; otherwise it stays pending until retirements free pages.
+        Raises PagePoolExhausted when the pool cannot hold even ONE
+        request with nothing live to retire (undersized kv_pool_pages —
+        waiting would spin forever)."""
+        admits = []
+        for s in range(n_slots):
+            if active[s] is None and pending:
+                plen = len(pending[-1][2])
+                if not alloc.can_reserve(s, plen + slack):
+                    break
+                i, rep, toks = pending.pop()
+                alloc.reserve(s, plen + slack)
+                admits.append((s, i, rep, toks))
+        if (
+            not admits
+            and pending
+            and not any(a is not None for a in active)
+        ):
+            free_slot = next(
+                s for s in range(n_slots) if active[s] is None
+            )
+            alloc.reserve(free_slot, len(pending[-1][2]) + slack)  # raises
+        return admits
+
+    def _pack_admits_paged(self, admits, n_slots, alloc):
+        """`_pack_admits` + page alignment: the prefill width SP must be
+        a whole number of pages (the row caches scatter as page-size
+        chunks), and each admitted row carries its assigned pool pages
+        (sentinel past its prompt — those chunks drop)."""
+        rows, plens, slots = self._pack_admits(admits, n_slots)
+        ps = alloc.page_size
+        sp = rows.shape[1]
+        if sp % ps:
+            rows = np.pad(
+                rows, [(0, 0), (0, ps - sp % ps)],
+                constant_values=self.pad_token_id,
+            )
+            sp = rows.shape[1]
+        page_rows = np.full(
+            (rows.shape[0], sp // ps), alloc.sentinel, np.int32
+        )
+        for j, (s, _, _, toks) in enumerate(admits):
+            np_ = alloc.pages_for(len(toks))
+            page_rows[j, :np_] = alloc.table[s, :np_]
+        return rows, plens, slots, page_rows
+
+    def _get_prefill_pages_fn(self):
+        sig = ("prefill_pages",)
+        if sig in self._gen_fns:
+            return self._gen_fns[sig]
+        cfg = self.cfg
+        use_flash = (
+            False if isinstance(self._use_flash, Mesh) else self._use_flash
+        )
+
+        @functools.partial(jax.jit, donate_argnums=(3, 4))
+        def fn(params, rows, plens, pool, logits_buf, slot_rows, page_rows):
+            logits, pool = tfm.prefill_into_pages(
+                params, cfg, rows, plens, pool, page_rows,
+                use_flash=use_flash,
+            )
+            logits_buf = logits_buf.at[slot_rows].set(logits, mode="drop")
+            return logits_buf, pool
+
+        self._gen_fns[sig] = fn
+        return fn
+
+    def _get_paged_decode_fn(
+        self, n_slots: int, n_pages: int, max_pages: int, chunk_t: int,
+        g: GenerationHyperparameters,
+    ):
+        """The paged decode chunk.  Its signature depends only on the
+        pool geometry — fixed for the whole generate call — so it
+        compiles EXACTLY ONCE (the dense variant recompiles per window
+        bucket); tests assert this via the decode_compiles counter."""
+        sig = (
+            "paged_inflight", n_slots, n_pages, max_pages, chunk_t,
+            g.min_new_tokens, g.greedy, g.top_p, g.top_k, g.temperature,
+        )
+        if sig in self._gen_fns:
+            return self._gen_fns[sig]
+        cfg = self.cfg
+        eos = self.eos_token_id
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def fn(params, pool, logits, page_table, cache_len, gen_count,
+               done, key):
+            out_toks = jnp.full((n_slots, chunk_t), -1, jnp.int32)
+            out_logps = jnp.zeros((n_slots, chunk_t), jnp.float32)
+
+            def body(t, st):
+                (logits, pool, cache_len, gen_count, done, out_toks,
+                 out_logps) = st
+                sub = jax.random.fold_in(key, t)
+                lg = logits
+                if g.min_new_tokens > 0:
+                    lg = jnp.where(
+                        (gen_count < g.min_new_tokens)[:, None]
+                        & (jnp.arange(cfg.vocab_size) == eos)[None, :],
+                        -1e10,
+                        lg,
+                    )
+                tok, logp = sample_token(
+                    lg, sub,
+                    temperature=g.temperature, top_k=g.top_k, top_p=g.top_p,
+                    greedy=g.greedy,
+                )
+                out_toks = jax.lax.dynamic_update_slice(
+                    out_toks, jnp.where(done, -1, tok)[:, None], (0, t)
+                )
+                out_logps = jax.lax.dynamic_update_slice(
+                    out_logps, jnp.where(done, 0.0, logp)[:, None], (0, t)
+                )
+                # Done rows keep rewriting their current position (the
+                # allocator keeps it mapped until the slot retires); no
+                # clamps — the reserve() before each chunk guarantees
+                # capacity, which is what makes the shape static.
+                next_logits, pool2 = tfm.decode_step_paged(
+                    params, cfg, jnp.where(done, eos, tok), cache_len,
+                    pool, page_table, cache_len, cache_len + 1,
+                )
+                new_done = done | (tok == eos)
+                cache_len = cache_len + (~done).astype(jnp.int32)
+                gen_count = gen_count + (~done).astype(jnp.int32)
+                return (
+                    next_logits, pool2, cache_len, gen_count, new_done,
+                    out_toks, out_logps,
+                )
+
+            st = (logits, pool, cache_len, gen_count, done, out_toks,
+                  out_logps)
+            st = jax.lax.fori_loop(0, chunk_t, body, st)
+            logits, pool, cache_len, gen_count, done, out_toks, out_logps = st
+            return (
+                out_toks, out_logps, logits, pool, cache_len, gen_count,
+                done,
+            )
+
+        self._gen_fns[sig] = fn
+        self.decode_compiles += 1
+        logger.info(
+            f"compiled paged inflight decoder n_slots={n_slots} "
+            f"pool={n_pages}x{self.kv_page_size} chunk={chunk_t}"
+        )
+        return fn
 
     # -- speculative inflight (n-gram drafts + exact verification) --
 
@@ -625,13 +1032,18 @@ class GeneratorEngine(HostOffloadMixin, Engine):
 
             # Growth: a chunk can add up to step_cap entries (+K scratch).
             need = int(cache_len.max()) + step_cap + K + 1
+            old_bytes = _cache_nbytes(cache)
             cache, new_w = self._grow_kv_cache(cache, cur_w, need)
             if new_w != cur_w:
+                self.cache_copy_bytes += old_bytes
                 tokens_buf = jnp.pad(
                     tokens_buf,
                     [(0, 0), (0, new_w + K + 2 - tokens_buf.shape[1])],
                 )
                 cur_w = new_w
+            self._accum_pool_stats(
+                "dense", int(cache_len.sum()), n_slots * cur_w
+            )
 
             fn = self._get_spec_decode_fn(n_slots, cur_w, n_steps, g)
             key, sub = jax.random.split(key)
@@ -708,7 +1120,6 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         cfg = self.cfg
         eos = self.eos_token_id
         from areal_tpu.ops.ngram import propose_ngram
-        from areal_tpu.ops.sampling import spec_accept
 
         out_w = n_steps * (K + 1)
         rows = jnp.arange(n_slots)
@@ -736,57 +1147,15 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                     jnp.where(done[:, None], eos, inputs),
                     positions, cache, slots0,
                 )  # [B, K+1, V]
-                if g.min_new_tokens > 0:
-                    not_enough = (
-                        gen_count[:, None] + jnp.arange(K + 1)[None, :]
-                    ) < g.min_new_tokens
-                    logits = jnp.where(
-                        not_enough[:, :, None]
-                        & (jnp.arange(cfg.vocab_size) == eos)[None, None, :],
-                        -1e10,
-                        logits,
-                    )
                 sub = jax.random.fold_in(key, t)
-                emitted, logps, n_emit = spec_accept(
-                    logits, drafts, sub,
-                    temperature=g.temperature, top_k=g.top_k, top_p=g.top_p,
-                    greedy=g.greedy,
+                (
+                    tokens_buf, pending2, cache_len2, gen_count2, new_done,
+                    out_toks, out_logps, out_fill,
+                ) = _spec_emit(
+                    cfg, g, eos, rows, logits, drafts, sub, pending,
+                    cache_len, gen_count, done, out_toks, out_logps,
+                    out_fill, tokens_buf,
                 )
-                n_emit = jnp.where(done, 0, n_emit)
-                # Truncate at the first EOS (inclusive).
-                j_idx = jnp.arange(K + 1)[None, :]
-                is_eos = (emitted == eos) & (j_idx < n_emit[:, None])
-                eos_pos = jnp.min(
-                    jnp.where(is_eos, j_idx, K + 1), axis=1
-                )
-                n_emit = jnp.minimum(n_emit, eos_pos + 1)
-                new_done = done | jnp.any(is_eos, axis=1)
-                valid = j_idx < n_emit[:, None]
-                # Append to the output buffers at per-row fill offsets.
-                cols = out_fill[:, None] + j_idx
-                out_toks = out_toks.at[rows[:, None], cols].set(
-                    jnp.where(valid, emitted, -1)
-                )
-                out_logps = out_logps.at[rows[:, None], cols].set(
-                    jnp.where(valid, logps, 0.0)
-                )
-                out_fill = out_fill + n_emit
-                # History: emitted tokens live at positions L+1..L+n_emit.
-                bcols = jnp.minimum(
-                    cache_len[:, None] + 1 + j_idx, tokens_buf.shape[1] - 1
-                )
-                cur = tokens_buf[rows[:, None], bcols]
-                tokens_buf = tokens_buf.at[rows[:, None], bcols].set(
-                    jnp.where(valid, emitted, cur)
-                )
-                new_pending = jnp.take_along_axis(
-                    emitted, jnp.clip(n_emit - 1, 0, K)[:, None], axis=1
-                )[:, 0]
-                pending2 = jnp.where(
-                    done | (n_emit == 0), pending, new_pending
-                )
-                cache_len2 = cache_len + n_emit
-                gen_count2 = gen_count + n_emit
                 return (
                     cache2, tokens_buf, pending2, cache_len2, gen_count2,
                     new_done, out_toks, out_logps, out_fill,
@@ -803,9 +1172,226 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             )
 
         self._gen_fns[sig] = fn
+        self.decode_compiles += 1
         logger.info(
             f"compiled spec decoder n_slots={n_slots} s_max={s_max} "
             f"steps={n_steps} K={K}"
+        )
+        return fn
+
+    # -- speculative inflight over the paged pool --
+
+    def _generate_inflight_spec_paged(self, reqs, g, key, results) -> None:
+        """`_generate_inflight_spec` over the paged KV pool: same n-gram
+        drafts + exact verification, but the pool, history buffer and
+        decode program keep ONE shape for the whole call — no grow
+        copies, one decode compilation, pages recycled on retirement."""
+        K = g.spec_decode_k
+        n_slots = min(max(self.batch_shard, self.max_decode_batch), len(reqs))
+        while n_slots % self.batch_shard:
+            n_slots += 1
+        ps = self.kv_page_size
+        max_prompt = max(len(t) for (_, _, t) in reqs)
+        n_steps = max(1, min(32, g.max_new_tokens) // (K + 1))
+        step_cap = n_steps * (K + 1)
+        # Chunk slack: a chunk advances up to step_cap positions and
+        # writes K+1 consecutive entries past the last advance.
+        slack = step_cap + K + 1
+        max_pages = -(-(max_prompt + g.max_new_tokens + slack) // ps)
+        n_pages = self.kv_pool_pages or n_slots * max_pages
+        alloc = PageAllocator(n_pages, ps, n_slots, max_pages)
+        pool = tfm.init_paged_kv_cache(
+            self.cfg, n_pages, ps, dtype=self._paged_kv_dtype()
+        )
+        # Fixed-width history buffer: must hold the widest admission
+        # prefill (bucketed + page-aligned) and the worst-case sequence.
+        sp_max = bucket_len(max_prompt)
+        sp_max += (-sp_max) % ps
+        buf_w = max(max_prompt + g.max_new_tokens + slack, sp_max) + K + 2
+        tokens_buf = jnp.zeros((n_slots, buf_w), jnp.int32)
+        pending = jnp.zeros((n_slots,), jnp.int32)
+        decode_fn = self._get_paged_spec_decode_fn(
+            n_slots, n_pages, max_pages, buf_w, n_steps, g
+        )
+        cache_len = np.zeros((n_slots,), np.int32)
+        gen_count = np.zeros((n_slots,), np.int32)
+        done_host = np.ones((n_slots,), bool)
+        active: List[Optional[Tuple[int, int]]] = [None] * n_slots
+        toks_acc: Dict[int, List[int]] = {}
+        logps_acc: Dict[int, List[float]] = {}
+        pending_list = list(reversed(reqs))
+
+        while pending_list or any(a is not None for a in active):
+            admits = self._take_admits_paged(
+                active, pending_list, n_slots, alloc, slack
+            )
+            if admits:
+                rows, plens, slots, page_rows = self._pack_admits_paged(
+                    admits, n_slots, alloc
+                )
+                key, sub = jax.random.split(key)
+                toks0, logps0, pool, tokens_buf, pending = (
+                    self._get_spec_admit_pages_fn(g)(
+                        self.params, jnp.asarray(rows), jnp.asarray(plens),
+                        pool, tokens_buf, pending, jnp.asarray(slots),
+                        jnp.asarray(page_rows), sub,
+                    )
+                )
+                self.prefill_dispatches += 1
+                toks0 = to_host(toks0)
+                logps0 = to_host(logps0)
+                for j, (s, i, rep, toks) in enumerate(admits):
+                    t0 = int(toks0[j])
+                    cache_len[s] = len(toks)
+                    gen_count[s] = 1  # the sampled pending token
+                    done_host[s] = t0 == self.eos_token_id
+                    active[s] = (i, rep)
+                    toks_acc[s] = [t0]
+                    logps_acc[s] = [float(logps0[j])]
+
+            for s in range(n_slots):
+                if active[s] is not None:
+                    alloc.reserve(s, int(cache_len[s]) + slack)
+            self._accum_pool_stats(
+                "paged", int(cache_len.sum()), alloc.allocated_pages() * ps
+            )
+
+            key, sub = jax.random.split(key)
+            (
+                out_toks, out_logps, tokens_buf, pool, pending,
+                new_cache_len, new_gen_count, new_done,
+            ) = decode_fn(
+                self.params, pool, tokens_buf, pending,
+                jnp.asarray(alloc.table), jnp.asarray(cache_len),
+                jnp.asarray(gen_count), jnp.asarray(done_host), sub,
+            )
+            out_toks = to_host(out_toks)
+            out_logps = to_host(out_logps)
+            cache_len = to_host(new_cache_len).copy()
+            gen_count = to_host(new_gen_count).copy()
+
+            self._drain_chunk_outputs(
+                out_toks, out_logps, to_host(new_done), active, toks_acc,
+                logps_acc, results, done_host, cache_len, g.max_new_tokens,
+                on_retire=alloc.release,
+            )
+        self.last_pool_stats.update(
+            pool_pages=n_pages, page_size=ps,
+            pages_recycled=alloc.pages_recycled,
+            peak_pages_used=alloc.peak_pages_used,
+        )
+
+    def _get_spec_admit_pages_fn(self, g):
+        sig = ("spec_admit_pages", g.greedy, g.top_p, g.top_k,
+               g.temperature, g.min_new_tokens)
+        if sig in self._gen_fns:
+            return self._gen_fns[sig]
+        cfg = self.cfg
+        eos = self.eos_token_id
+        use_flash = (
+            False if isinstance(self._use_flash, Mesh) else self._use_flash
+        )
+
+        # Batched paged admission: prefill into the assigned pool pages,
+        # sample each prompt's first pending token, record prompt+token
+        # into the history buffer — one dispatch per refill cycle.
+        @functools.partial(jax.jit, donate_argnums=(3, 4, 5))
+        def fn(params, rows, plens, pool, tokens_buf, pending, slot_rows,
+               page_rows, key):
+            sp = rows.shape[1]
+            logits, pool = tfm.prefill_into_pages(
+                params, cfg, rows, plens, pool, page_rows,
+                use_flash=use_flash,
+            )
+            lg = logits
+            if g.min_new_tokens > 0:
+                lg = jnp.where(
+                    (jnp.arange(cfg.vocab_size) == eos)[None, :], -1e10, lg
+                )
+            tok, logp = sample_token(
+                lg, key, temperature=g.temperature, top_k=g.top_k,
+                top_p=g.top_p, greedy=g.greedy,
+            )
+            tokens_buf = tokens_buf.at[slot_rows, :sp].set(rows, mode="drop")
+            tokens_buf = tokens_buf.at[slot_rows, plens].set(tok, mode="drop")
+            pending = pending.at[slot_rows].set(tok, mode="drop")
+            return tok, logp, pool, tokens_buf, pending
+
+        self._gen_fns[sig] = fn
+        return fn
+
+    def _get_paged_spec_decode_fn(
+        self, n_slots: int, n_pages: int, max_pages: int, buf_w: int,
+        n_steps: int, g: GenerationHyperparameters,
+    ):
+        K = g.spec_decode_k
+        sig = (
+            "paged_spec_decode", n_slots, n_pages, max_pages, buf_w,
+            n_steps, K, g.spec_ngram, g.min_new_tokens, g.greedy, g.top_p,
+            g.top_k, g.temperature,
+        )
+        if sig in self._gen_fns:
+            return self._gen_fns[sig]
+        cfg = self.cfg
+        eos = self.eos_token_id
+        from areal_tpu.ops.ngram import propose_ngram
+
+        out_w = n_steps * (K + 1)
+        rows = jnp.arange(n_slots)
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def fn(params, pool, tokens_buf, pending, page_table, cache_len,
+               gen_count, done, key):
+            out_toks = jnp.full((n_slots, out_w), -1, jnp.int32)
+            out_logps = jnp.zeros((n_slots, out_w), jnp.float32)
+            out_fill = jnp.zeros((n_slots,), jnp.int32)
+
+            def body(t, st):
+                (pool, tokens_buf, pending, cache_len, gen_count, done,
+                 out_toks, out_logps, out_fill) = st
+                drafts = propose_ngram(
+                    tokens_buf, cache_len + 1, K, g.spec_ngram
+                )  # [B, K]
+                inputs = jnp.concatenate(
+                    [pending[:, None], drafts], axis=1
+                )  # [B, K+1]
+                # No clamp: reserve() before the chunk guarantees every
+                # written position has a mapped page.
+                positions = cache_len[:, None] + jnp.arange(K + 1)[None, :]
+                logits, pool2 = tfm.decode_step_spec_paged(
+                    params, cfg,
+                    jnp.where(done[:, None], eos, inputs),
+                    positions, pool, page_table, cache_len,
+                )  # [B, K+1, V]
+                sub = jax.random.fold_in(key, t)
+                (
+                    tokens_buf, pending2, cache_len2, gen_count2, new_done,
+                    out_toks, out_logps, out_fill,
+                ) = _spec_emit(
+                    cfg, g, eos, rows, logits, drafts, sub, pending,
+                    cache_len, gen_count, done, out_toks, out_logps,
+                    out_fill, tokens_buf,
+                )
+                return (
+                    pool2, tokens_buf, pending2, cache_len2, gen_count2,
+                    new_done, out_toks, out_logps, out_fill,
+                )
+
+            st = (pool, tokens_buf, pending, cache_len, gen_count, done,
+                  out_toks, out_logps, out_fill)
+            st = jax.lax.fori_loop(0, n_steps, body, st)
+            (pool, tokens_buf, pending, cache_len, gen_count, done,
+             out_toks, out_logps, _) = st
+            return (
+                out_toks, out_logps, tokens_buf, pool, pending,
+                cache_len, gen_count, done,
+            )
+
+        self._gen_fns[sig] = fn
+        self.decode_compiles += 1
+        logger.info(
+            f"compiled paged spec decoder n_slots={n_slots} "
+            f"pool={n_pages}x{self.kv_page_size} steps={n_steps} K={K}"
         )
         return fn
 

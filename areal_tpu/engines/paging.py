@@ -1,0 +1,111 @@
+"""Host-side free-list page allocator for the paged KV pool.
+
+The device side (`models/transformer.py PagedKVCache`) is a dumb pool of
+`n_pages` fixed-size pages; ALL placement policy lives here, on the
+host, between jitted decode chunks: which pool pages belong to which
+slot, in what order, and which are free.  The allocator's `table` array
+is shipped to the device as the page table each chunk (a few KB), so
+"growing" a sequence is appending one int to a row — no cache copy, no
+recompile — and a retired slot's pages go back on the free list for the
+next admission.
+
+Reference role: vLLM's BlockAllocator / the block tables behind TPU
+ragged paged attention.
+"""
+
+from typing import List
+
+import numpy as np
+
+
+class PagePoolExhausted(RuntimeError):
+    """The KV page pool has no free page for a required allocation.
+
+    Raised BEFORE any device state is touched: the cache, page table and
+    free list are unchanged, so the condition is a clean capacity error
+    (raise `kv_pool_pages`, shrink the batch, or let the server admit
+    fewer requests), never corruption."""
+
+
+class PageAllocator:
+    """Free-list allocator over `n_pages` pages of `page_size` tokens.
+
+    Each of `n_slots` decode slots owns an ordered, contiguous-from-zero
+    list of pages: `table[slot, j]` is the pool page holding the slot's
+    flat positions [j*page_size, (j+1)*page_size).  Unmapped entries
+    hold the sentinel `n_pages` (device scatters drop it, gathers clamp
+    + mask)."""
+
+    def __init__(
+        self, n_pages: int, page_size: int, n_slots: int, max_pages: int
+    ):
+        if n_pages < 1 or page_size < 1:
+            raise ValueError("n_pages and page_size must be positive")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self.max_pages = int(max_pages)
+        self.sentinel = int(n_pages)
+        self.free: List[int] = list(range(n_pages - 1, -1, -1))
+        self.table = np.full((n_slots, max_pages), self.sentinel, np.int32)
+        self.used = np.zeros((n_slots,), np.int32)
+        # Stats for the bench/tests: recycled counts pages handed out
+        # again after having been freed by a retired slot.
+        self._freed_ever: set = set()
+        self.pages_recycled = 0
+        self.peak_pages_used = 0
+
+    def pages_for(self, tokens: int) -> int:
+        return -(-int(tokens) // self.page_size)
+
+    def allocated_pages(self) -> int:
+        return self.n_pages - len(self.free)
+
+    def can_reserve(self, slot: int, tokens: int) -> bool:
+        need = self.pages_for(tokens)
+        if need > self.max_pages:
+            return False
+        return need - int(self.used[slot]) <= len(self.free)
+
+    def reserve(self, slot: int, tokens: int) -> None:
+        """Ensure `slot` has mapped pages covering flat positions
+        [0, tokens).  Appends pages from the free list; raises
+        `PagePoolExhausted` (leaving all state unchanged for the pages
+        already mapped) when the pool or the table width cannot."""
+        need = self.pages_for(tokens)
+        if need > self.max_pages:
+            raise PagePoolExhausted(
+                f"slot {slot} needs {need} pages for {tokens} tokens but "
+                f"the page table holds max_pages={self.max_pages} "
+                f"(page_size={self.page_size})"
+            )
+        grow = need - int(self.used[slot])
+        if grow > len(self.free):
+            raise PagePoolExhausted(
+                f"KV page pool exhausted: slot {slot} needs {grow} more "
+                f"page(s) for {tokens} tokens but only {len(self.free)} of "
+                f"{self.n_pages} are free (page_size={self.page_size}); "
+                f"raise kv_pool_pages or admit fewer concurrent requests"
+            )
+        while self.used[slot] < need:
+            p = self.free.pop()
+            if p in self._freed_ever:
+                self.pages_recycled += 1
+            self.table[slot, self.used[slot]] = p
+            self.used[slot] += 1
+        self.peak_pages_used = max(
+            self.peak_pages_used, self.allocated_pages()
+        )
+
+    def release(self, slot: int) -> None:
+        """Return all of `slot`'s pages to the free list."""
+        for j in range(int(self.used[slot])):
+            p = int(self.table[slot, j])
+            self.free.append(p)
+            self._freed_ever.add(p)
+        self.table[slot, :] = self.sentinel
+        self.used[slot] = 0
+
+    def page_rows(self, slot: int, tokens: int) -> np.ndarray:
+        """The slot's first `pages_for(tokens)` mapped pages (for the
+        admission prefill scatter); caller must have reserve()d them."""
+        return self.table[slot, : self.pages_for(tokens)].copy()

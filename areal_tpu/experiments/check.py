@@ -122,6 +122,27 @@ def check_ppo_math(cfg) -> None:
             "and are ignored under gen_server_url (configure the "
             "standalone gen_server instead)"
         )
+    if getattr(cfg, "kv_page_size", 128) < 1:
+        _fail(f"kv_page_size must be >= 1, got {cfg.kv_page_size}")
+    if getattr(cfg, "kv_pool_pages", 0) < 0:
+        _fail(
+            f"kv_pool_pages must be >= 0 (0 = auto-size), got "
+            f"{cfg.kv_pool_pages}"
+        )
+    if cfg.gen_server_url and (
+        getattr(cfg, "kv_paged", None) is not None
+        or getattr(cfg, "kv_page_size", 128) != 128
+        or getattr(cfg, "kv_pool_pages", 0)
+    ):
+        # Same reasoning as gen_backend_args below: these configure the
+        # in-process GeneratorEngine, which decoupled serving never
+        # builds — a silently ignored capacity knob is a footgun.
+        _fail(
+            "kv_paged/kv_page_size/kv_pool_pages apply to the "
+            "in-process GeneratorEngine and are ignored under "
+            "gen_server_url (configure the standalone gen_server "
+            "instead)"
+        )
     if cfg.rollout_ahead > 0 and getattr(
         cfg, "gen_backend_args", {}
     ).get("donation_safe_swap") is False:

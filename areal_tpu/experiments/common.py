@@ -194,6 +194,15 @@ class PPOMathConfig:
     gen_backend_args: Dict[str, Any] = dataclasses.field(
         default_factory=dict
     )
+    # Paged-KV decode knobs (engines/generator.py): None = env default
+    # (AREAL_PAGED_KV, on unless "0"); False = dense grow-by-doubling
+    # window.  kv_pool_pages=0 auto-sizes the pool for the worst case;
+    # a positive value caps KV HBM and makes admission wait for freed
+    # pages (gen_server splits request groups against the resulting
+    # token budget).  gen_backend_args may still override all three.
+    kv_paged: Optional[bool] = None
+    kv_page_size: int = 128
+    kv_pool_pages: int = 0
     # Extra TrainEngine kwargs for actor/critic (remat_policy,
     # master_dtype, pipe_schedule) — the single-chip 1.5B fit needs
     # master_dtype="bfloat16" here, exactly like bench.py.
@@ -520,6 +529,9 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                     "generator",
                     {
                         "donation_safe_swap": cfg.rollout_ahead > 0,
+                        "kv_paged": cfg.kv_paged,
+                        "kv_page_size": cfg.kv_page_size,
+                        "kv_pool_pages": cfg.kv_pool_pages,
                         **cfg.gen_backend_args,
                     },
                 ),

@@ -96,30 +96,51 @@ _PAREN_CHOICE_RE = re.compile(r"\(([A-J])\)")
 def choice_answer_clean(pred: str) -> str:
     """Multiple-choice extraction (reference: evaluation/grader.py:30 /
     parser.py:373 last-standalone-letter-wins, extended to A-J).
-    Priority: the last PARENTHESIZED letter ('(B)'), then the last
-    standalone letter — but the English words 'A' and 'I' only count
-    when no other candidate exists ('The answer is (B). I am sure.'
-    must grade B, not I)."""
+    POSITIONAL: the last letter in the text wins whether it is
+    parenthesized or standalone — '(A) is wrong, the answer is B' must
+    grade B (a paren-beats-standalone priority would grade A).  The
+    English words 'A' and 'I' are ambiguous when bare (not
+    parenthesized) and only count when no other candidate exists
+    ('The answer is (B). I am sure.' must grade B, not I)."""
     pred = pred.strip("\n").rstrip(".").rstrip("/").strip(" ").lstrip(":")
     up = pred.upper()
-    paren = _PAREN_CHOICE_RE.findall(up)
-    if paren:
-        return paren[-1]
-    found = _CHOICE_RE.findall(up)
-    unambiguous = [c for c in found if c not in ("A", "I")]
-    if unambiguous:
-        return unambiguous[-1]
-    if found:
-        return found[-1]
+    cands = [
+        (m.start(1), m.group(1), True)
+        for m in _PAREN_CHOICE_RE.finditer(up)
+    ]
+    taken = {p for p, _, _ in cands}
+    cands += [
+        (m.start(1), m.group(1), False)
+        for m in _CHOICE_RE.finditer(up)
+        if m.start(1) not in taken
+    ]
+    strong = [(p, c) for p, c, paren in cands if paren or c not in ("A", "I")]
+    if strong:
+        return max(strong)[1]
+    if cands:
+        return max(cands)[1]
     out = pred.strip().strip(".")
     return out.rstrip(".").rstrip("/")
 
 
-def is_multi_choice(gold: str) -> bool:
-    """True when the gold answer is one or more choice letters (GPQA /
-    MMLU-style), e.g. 'B' or 'ACD' (reference: math_eval.py:369)."""
+def is_multi_choice(gold: str, is_choice: Optional[bool] = None) -> bool:
+    """True when the gold should grade through choice extraction.
+
+    `is_choice` is ROW-LEVEL evidence (the row carried a `choices`
+    field, or its task tag marks it multiple-choice): True/False decide
+    outright; None falls back to gold-string inference — one or more
+    choice letters (GPQA/MMLU-style), e.g. 'B' or 'ACD' (reference:
+    math_eval.py:369).  The inference alone misgrades math rows whose
+    honest answer happens to be a letter string (a variable named 'C',
+    interval endpoints 'AB'), so callers that know the row pass the
+    evidence down (interfaces/reward.py, scheduler/evaluator.py)."""
     g = gold.strip()
-    return bool(g) and all(c in CHOICE_LETTERS for c in g)
+    looks_like_letters = bool(g) and all(c in CHOICE_LETTERS for c in g)
+    if is_choice is None:
+        return looks_like_letters
+    # Even with row evidence the gold must be letters — a choice row
+    # whose gold is the option TEXT still grades as a plain answer.
+    return bool(is_choice) and looks_like_letters
 
 
 def choice_match(pred: str, gold: str) -> bool:
@@ -170,7 +191,10 @@ def answers_match(pred: str, gold: str) -> bool:
 
 
 def verify_math(
-    generated_text: str, solutions: List[str], use_sympy: bool = True
+    generated_text: str,
+    solutions: List[str],
+    use_sympy: bool = True,
+    is_choice: Optional[bool] = None,
 ) -> bool:
     """True iff the generated answer matches any gold solution (each gold
     may itself be a \\boxed{...} wrapper or a raw answer).  The cheap
@@ -187,7 +211,7 @@ def verify_math(
         # extraction — a boxed answer is not required; without one, the
         # last non-empty line stands in (prose earlier in the generation
         # is full of stray capitals the \b(A|..)\b scan would hit).
-        if is_multi_choice(gold):
+        if is_multi_choice(gold, is_choice):
             cand = pred
             if cand is None:
                 lines = [

@@ -24,6 +24,18 @@ from areal_tpu.interfaces import math_verify
 logger = logging.getLogger("reward")
 
 
+def _row_is_choice(info: Dict[str, Any]) -> Optional[bool]:
+    """Row-level multiple-choice evidence for is_multi_choice gating:
+    an explicit flag or a rendered `choices` list decides; absent both,
+    None lets the gold-string inference stand (rows without metadata
+    must keep grading letter golds)."""
+    if info.get("is_choice") is not None:
+        return bool(info["is_choice"])
+    if "choices" in info and info["choices"] is not None:
+        return bool(info["choices"])
+    return None
+
+
 @dataclasses.dataclass
 class MultiTaskRewardInterface(ModelInterface):
     """id2info maps query_id -> row dict with task/solutions/input_output
@@ -79,6 +91,7 @@ class MultiTaskRewardInterface(ModelInterface):
                         "text": text,
                         "solutions": info.get("solutions") or [],
                         "input_output": info.get("input_output"),
+                        "choices": info.get("choices"),
                         "timeout_s": self.code_timeout_s,
                     }
                 )
@@ -112,7 +125,11 @@ class MultiTaskRewardInterface(ModelInterface):
         """Grade one response for `task` ("math" | "code") — public so the
         offline evaluator shares the exact training-reward graders."""
         if task == "math":
-            return math_verify.verify_math(text, info.get("solutions", []))
+            return math_verify.verify_math(
+                text,
+                info.get("solutions", []),
+                is_choice=_row_is_choice(info),
+            )
         elif task == "code":
             return self._verify_code(text, info)
         logger.warning(f"unknown task {task!r}; reward 0")

@@ -33,9 +33,13 @@ def _grade_one(item: Dict[str, Any]) -> bool:
 
     task = item.get("task", "math")
     if task == "math":
+        from areal_tpu.interfaces.reward import _row_is_choice
+
         return bool(
             math_verify.verify_math(
-                item.get("text", ""), item.get("solutions") or []
+                item.get("text", ""),
+                item.get("solutions") or [],
+                is_choice=_row_is_choice(item),
             )
         )
     if task == "code":

@@ -35,6 +35,8 @@ from areal_tpu.ops.attention import (
     decode_attention,
     decode_attention_chunk,
     packed_attention,
+    paged_decode_attention,
+    paged_decode_attention_chunk,
     repeat_kv,
 )
 from areal_tpu.ops.norms import apply_rotary, rms_norm, rope_cos_sin
@@ -342,9 +344,10 @@ def _block_forward(
         # expressible once operands vary over the outer manual axis.
         from areal_tpu.ops.ring_attention import _ring_shard
 
-        axis_name, axis_size = cp_manual
+        axis_name, axis_size, *my_idx = cp_manual
         attn = _ring_shard(
-            q, k, v, segment_ids, axis_name, axis_size, causal=True
+            q, k, v, segment_ids, axis_name, axis_size, causal=True,
+            my_index=my_idx[0] if my_idx else None,
         )
     elif cp_mesh is not None:
         if cp_zigzag:
@@ -686,14 +689,19 @@ def _cache_update_read(
     take dense operands); dequant=False returns the RAW views plus the
     layer's scales (or None) — for `decode_attention`, which dequantizes
     itself (in-kernel under AREAL_DECODE_KERNEL=1, saving the extra
-    bf16 window materialization where bandwidth is the bottleneck)."""
+    bf16 window materialization where bandwidth is the bottleneck).
+
+    Out-of-range indices are DROPPED (the paged path writes through a
+    page table whose unmapped entries are the sentinel `n_pages`; the
+    dense paths always index in bounds, where `mode="drop"` is a
+    no-op)."""
     if quant:
         kq, ks = kv_quant(k)
         vq, vs = kv_quant(v)
-        kc = kc.at[(li, *idx)].set(kq)
-        vc = vc.at[(li, *idx)].set(vq)
-        ksc = ksc.at[(li, *idx)].set(ks)
-        vsc = vsc.at[(li, *idx)].set(vs)
+        kc = kc.at[(li, *idx)].set(kq, mode="drop")
+        vc = vc.at[(li, *idx)].set(vq, mode="drop")
+        ksc = ksc.at[(li, *idx)].set(ks, mode="drop")
+        vsc = vsc.at[(li, *idx)].set(vs, mode="drop")
         ks_l = jax.lax.dynamic_index_in_dim(ksc, li, axis=0, keepdims=False)
         vs_l = jax.lax.dynamic_index_in_dim(vsc, li, axis=0, keepdims=False)
         k_raw = jax.lax.dynamic_index_in_dim(kc, li, axis=0, keepdims=False)
@@ -703,8 +711,8 @@ def _cache_update_read(
             v_layer = kv_dequant(v_raw, vs_l, read_dtype)
             return kc, vc, ksc, vsc, k_layer, v_layer, None, None
         return kc, vc, ksc, vsc, k_raw, v_raw, ks_l, vs_l
-    kc = kc.at[(li, *idx)].set(k.astype(kc.dtype))
-    vc = vc.at[(li, *idx)].set(v.astype(vc.dtype))
+    kc = kc.at[(li, *idx)].set(k.astype(kc.dtype), mode="drop")
+    vc = vc.at[(li, *idx)].set(v.astype(vc.dtype), mode="drop")
     k_layer = jax.lax.dynamic_index_in_dim(kc, li, axis=0, keepdims=False)
     v_layer = jax.lax.dynamic_index_in_dim(vc, li, axis=0, keepdims=False)
     return kc, vc, ksc, vsc, k_layer, v_layer, None, None
@@ -1053,3 +1061,272 @@ def prefill_into_slots(
     new_k = cache.k.at[:, slot_rows, :sp].set(row_cache.k, mode="drop")
     new_v = cache.v.at[:, slot_rows, :sp].set(row_cache.v, mode="drop")
     return logits, KVCache(k=new_k, v=new_v)
+
+
+# --------------------------------------------------------------------------
+# Paged KV-cache generation path
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PagedKVCache:
+    """Block-paged KV pool: k/v [L, n_pages, page_size, n_kv, head_dim].
+
+    The dense inflight cache (`KVCache` at [L, n_slots, s_max, ...])
+    couples every slot to the batch-max window: growth is a full-cache
+    `jnp.pad` copy plus a decode recompile per bucket, and a finished
+    short row keeps holding s_max worth of HBM until the batch drains.
+    Paging breaks the coupling: the pool is allocated ONCE per generate
+    call, each slot owns an ordered list of pages (the host-side page
+    table), growth appends a page index, and a retired slot's pages are
+    recycled into new admits — fixed memory, fixed shapes, one decode
+    compilation.  Reference: TPU ragged paged attention / vLLM
+    PagedAttention block tables.
+
+    Page index `n_pages` is the UNMAPPED sentinel: writes through it are
+    dropped (`mode="drop"`), reads clamp and are masked by `valid_to`
+    (pages are mapped contiguously from position 0, so any position
+    beyond the mapped prefix is also beyond the live window).
+
+    int8 mode mirrors `KVCache`: int8 k/v + bf16 per-(layer,page,pos,
+    head) scales — same capacity halving, same quantizer
+    (`ops/quant.py`), so paged and dense int8 cannot diverge.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    k_scale: "jax.Array | None" = None  # [L, n_pages, page_size, n_kv] bf16
+    v_scale: "jax.Array | None" = None
+    page_size: int = 128  # static metadata (pytree aux)
+
+    @property
+    def n_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+jax.tree_util.register_dataclass(
+    PagedKVCache,
+    data_fields=["k", "v", "k_scale", "v_scale"],
+    meta_fields=["page_size"],
+)
+
+
+def init_paged_kv_cache(
+    cfg: ModelConfig, n_pages: int, page_size: int, dtype=None
+) -> PagedKVCache:
+    shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    dtype = dtype or cfg.dtype
+    if dtype in (jnp.int8, "int8"):
+        return PagedKVCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(shape[:-1], jnp.bfloat16),
+            v_scale=jnp.zeros(shape[:-1], jnp.bfloat16),
+            page_size=page_size,
+        )
+    return PagedKVCache(
+        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+        page_size=page_size,
+    )
+
+
+def _page_of(page_table: jax.Array, pos: jax.Array, page_size: int):
+    """Per-row (page, offset) write coordinates for flat positions `pos`
+    ([B] or [B, Q]) through `page_table` [B, max_pages]."""
+    pos2 = pos if pos.ndim == 2 else pos[:, None]
+    pages = jnp.take_along_axis(
+        page_table, pos2 // page_size, axis=1, mode="clip"
+    )
+    # Positions addressing beyond the table width must DROP, not alias
+    # the clipped last entry (2**30 is out of range of any pool axis).
+    oob = pos2 // page_size >= page_table.shape[1]
+    pages = jnp.where(oob, jnp.int32(2**30), pages)
+    pages = pages if pos.ndim == 2 else pages[:, 0]
+    return pages.astype(jnp.int32), (pos % page_size).astype(jnp.int32)
+
+
+def decode_step_paged(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [B] int32
+    positions: jax.Array,  # [B] int32 RoPE positions
+    cache: PagedKVCache,
+    page_table: jax.Array,  # [B, max_pages] int32, sentinel = n_pages
+    write_pos: jax.Array,  # [B] int32 — flat cache position to write
+    valid_to: jax.Array,  # [B] int32 — one past the last valid position
+) -> Tuple[jax.Array, PagedKVCache]:
+    """`decode_step_inflight` over a paged pool: identical math, but the
+    per-row write lands at (page_table[row, pos // ps], pos % ps) in the
+    shared pool and the read side attends through the page table
+    (`paged_decode_attention`: Pallas ragged kernel or XLA gather
+    fallback).  The pool shape never changes during a generate call, so
+    the enclosing program compiles exactly once."""
+    b = tokens.shape[0]
+    x = _embed(params, cfg, tokens, positions)[:, None, :]
+    cos, sin = rope_cos_sin(positions[:, None], cfg.head_dim, cfg.rope_theta)
+    wp_page, wp_off = _page_of(page_table, write_pos, cache.page_size)
+    quant = cache.quantized
+
+    def body(carry, blk):
+        y, kc, vc, ksc, vsc, li = carry
+        h = _norm(y, blk["ln1"], blk.get("ln1_b"), cfg)
+        q, k, v = _block_kv(h, blk, cfg, cos, sin)
+        kc, vc, ksc, vsc, k_pool_l, v_pool_l, ks_l, vs_l = (
+            _cache_update_read(
+                kc, vc, ksc, vsc, k[:, 0], v[:, 0], li, (wp_page, wp_off),
+                quant, q.dtype, dequant=False,
+            )
+        )
+        attn = paged_decode_attention(
+            q, k_pool_l, v_pool_l, page_table, valid_to,
+            k_scale=ks_l, v_scale=vs_l,
+        )
+        ao = attn.reshape(b, 1, cfg.q_dim) @ blk["wo"]
+        if cfg.proj_bias:
+            ao = ao + blk["bo"]
+        y = y + ao
+        h2 = _norm(y, blk["ln2"], blk.get("ln2_b"), cfg)
+        y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk, cfg))
+        return (y, kc, vc, ksc, vsc, li + 1), None
+
+    ksc0 = cache.k_scale if quant else jnp.zeros((0,), jnp.bfloat16)
+    vsc0 = cache.v_scale if quant else jnp.zeros((0,), jnp.bfloat16)
+    (x, kc, vc, ksc, vsc, _), _ = jax.lax.scan(
+        body,
+        (x, cache.k, cache.v, ksc0, vsc0, jnp.int32(0)),
+        params["blocks"],
+    )
+    x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
+    logits = _head(params, cfg, x)[:, 0]
+    return logits, PagedKVCache(
+        k=kc, v=vc,
+        k_scale=ksc if quant else None,
+        v_scale=vsc if quant else None,
+        page_size=cache.page_size,
+    )
+
+
+def decode_step_spec_paged(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [B, Q] int32 — pending token + Q-1 drafts per row
+    positions: jax.Array,  # [B, Q] int32 — RoPE positions
+    cache: PagedKVCache,
+    page_table: jax.Array,  # [B, max_pages] int32, sentinel = n_pages
+    write_pos0: jax.Array,  # [B] int32 — flat position of tokens[:, 0]
+) -> Tuple[jax.Array, PagedKVCache]:
+    """`decode_step_spec` over a paged pool: Q consecutive tokens per row
+    in one forward, k/v written at flat positions write_pos0..+Q-1
+    through the page table, fp32 logits [B, Q, V].  Same exact-
+    verification semantics (quantized cache included) as the dense
+    speculative step."""
+    b, q_len = tokens.shape
+    x = _embed(params, cfg, tokens.reshape(-1), positions.reshape(-1))
+    x = x.reshape(b, q_len, cfg.hidden_dim)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    col = write_pos0[:, None] + jnp.arange(q_len)[None, :]  # [B, Q]
+    wp_page, wp_off = _page_of(page_table, col, cache.page_size)
+    quant = cache.quantized
+
+    def body(carry, blk):
+        y, kc, vc, ksc, vsc, li = carry
+        h = _norm(y, blk["ln1"], blk.get("ln1_b"), cfg)
+        q, k, v = _block_kv(h, blk, cfg, cos, sin)  # [B, Q, h, d]
+        kc, vc, ksc, vsc, k_pool_l, v_pool_l, ks_l, vs_l = (
+            _cache_update_read(
+                kc, vc, ksc, vsc, k, v, li, (wp_page, wp_off),
+                quant, q.dtype, dequant=False,
+            )
+        )
+        attn = paged_decode_attention_chunk(
+            q, k_pool_l, v_pool_l, page_table, write_pos0 + 1,
+            k_scale=ks_l, v_scale=vs_l,
+        )
+        ao = attn.reshape(b, q_len, cfg.q_dim) @ blk["wo"]
+        if cfg.proj_bias:
+            ao = ao + blk["bo"]
+        y = y + ao
+        h2 = _norm(y, blk["ln2"], blk.get("ln2_b"), cfg)
+        y = y + (
+            _mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk, cfg)
+        )
+        return (y, kc, vc, ksc, vsc, li + 1), None
+
+    ksc0 = cache.k_scale if quant else jnp.zeros((0,), jnp.bfloat16)
+    vsc0 = cache.v_scale if quant else jnp.zeros((0,), jnp.bfloat16)
+    (x, kc, vc, ksc, vsc, _), _ = jax.lax.scan(
+        body,
+        (x, cache.k, cache.v, ksc0, vsc0, jnp.int32(0)),
+        params["blocks"],
+    )
+    x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
+    logits = _head(params, cfg, x)  # [B, Q, V]
+    return logits, PagedKVCache(
+        k=kc, v=vc,
+        k_scale=ksc if quant else None,
+        v_scale=vsc if quant else None,
+        page_size=cache.page_size,
+    )
+
+
+def prefill_into_pages(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [M, SP] left-aligned prompts (SP % page_size == 0)
+    prompt_lens: jax.Array,  # [M] int32
+    cache: PagedKVCache,
+    page_rows: jax.Array,  # [M, SP // page_size] int32 pool page ids
+    use_flash: "bool | None" = None,
+) -> Tuple[jax.Array, PagedKVCache]:
+    """`prefill_into_slots` for the paged pool: one batched forward for M
+    admitted prompts, then the dense per-row caches are reshaped into
+    page_size chunks and scattered at their assigned pool pages in one
+    op.  `page_rows` entries >= n_pages (the sentinel) are compile-shape
+    padding — those chunks drop, exactly like out-of-range `slot_rows`
+    in the dense path.  The tail of a prompt's last page holds garbage
+    past `prompt_lens`; it is overwritten by decode writes and masked by
+    `valid_to` until then."""
+    m, sp = tokens.shape
+    ps = cache.page_size
+    if sp % ps:
+        raise ValueError(f"prefill width {sp} not a multiple of page_size {ps}")
+    n_chunks = sp // ps
+    seg = (
+        jnp.arange(sp)[None, :] < prompt_lens[:, None]
+    ).astype(jnp.int32)
+    row_dtype = cfg.dtype if cache.quantized else cache.k.dtype
+    row_cache = KVCache(
+        k=jnp.zeros(
+            (cfg.n_layers, m, sp, cfg.n_kv_heads, cfg.head_dim), row_dtype
+        ),
+        v=jnp.zeros(
+            (cfg.n_layers, m, sp, cfg.n_kv_heads, cfg.head_dim), row_dtype
+        ),
+    )
+    logits, row_cache = prefill(
+        params, cfg, tokens, seg, row_cache, use_flash=use_flash
+    )
+
+    def chunked(a):  # [L, M, SP, ...] -> [L, M * n_chunks, ps, ...]
+        return a.reshape(a.shape[0], m * n_chunks, ps, *a.shape[3:])
+
+    flat = page_rows.reshape(-1)
+    if cache.quantized:
+        kq, ks = kv_quant(row_cache.k)
+        vq, vs = kv_quant(row_cache.v)
+        return logits, PagedKVCache(
+            k=cache.k.at[:, flat].set(chunked(kq), mode="drop"),
+            v=cache.v.at[:, flat].set(chunked(vq), mode="drop"),
+            k_scale=cache.k_scale.at[:, flat].set(chunked(ks), mode="drop"),
+            v_scale=cache.v_scale.at[:, flat].set(chunked(vs), mode="drop"),
+            page_size=ps,
+        )
+    return logits, PagedKVCache(
+        k=cache.k.at[:, flat].set(chunked(row_cache.k), mode="drop"),
+        v=cache.v.at[:, flat].set(chunked(row_cache.v), mode="drop"),
+        page_size=ps,
+    )

@@ -160,6 +160,10 @@ def decode_attention(
     )  # [B, S]
     logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
+    # Fully-masked rows (empty live window) softmax all-NEG_INF into a
+    # uniform distribution over garbage; zero them instead — matching
+    # the Pallas kernel, which emits exact zeros there.
+    probs = jnp.where(valid.any(axis=-1)[:, None, None, None], probs, 0.0)
     out = jnp.einsum(
         "bgrs,bsgd->bgrd", probs.astype(v_cache.dtype), v_cache,
         preferred_element_type=jnp.float32,
@@ -215,11 +219,100 @@ def decode_attention_chunk(
     )  # [B, Q, S]
     logits = jnp.where(valid[:, None, :, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
+    # Zero fully-masked (empty-window) rows: see decode_attention.
+    probs = jnp.where(
+        valid.any(axis=-1)[:, None, :, None, None], probs, 0.0
+    )
     out = jnp.einsum(
         "bgqrs,bsgd->bqgrd", probs.astype(v_cache.dtype), v_cache,
         preferred_element_type=jnp.float32,
     )
     return out.reshape(b, nq_tok, n_q, d).astype(q.dtype)
+
+
+# --------------------------------------------------------------------------
+# Paged decode attention (block-paged KV pool, models/transformer.py
+# PagedKVCache): Pallas ragged kernel on TPU (AREAL_DECODE_KERNEL=1),
+# gather-based XLA fallback elsewhere.
+# --------------------------------------------------------------------------
+
+
+def paged_gather_layer(
+    pool_layer: jax.Array,  # [P, ps, ...] one layer's pool view
+    page_table: jax.Array,  # [B, max_pages] int32 (sentinel >= P)
+) -> jax.Array:
+    """Gather a row-major dense window [B, max_pages*ps, ...] from the
+    pool through the page table.  Sentinel (unmapped) entries clamp to
+    the last page — their positions lie past every row's live window,
+    so the attention mask removes them.  This reads each slot's MAPPED
+    pages only (plus the clamped repeats for unmapped slots), not the
+    whole pool."""
+    p = pool_layer.shape[0]
+    pt = jnp.minimum(page_table.astype(jnp.int32), p - 1)
+    g = jnp.take(pool_layer, pt, axis=0)  # [B, mp, ps, ...]
+    b, mp, ps = g.shape[:3]
+    return g.reshape(b, mp * ps, *pool_layer.shape[2:])
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [B, 1, n_q, d]
+    k_pool: jax.Array,  # [P, ps, n_kv, d] — one layer's pool view
+    v_pool: jax.Array,
+    page_table: jax.Array,  # [B, max_pages] int32
+    valid_to: jax.Array,  # [B] int — one past the last valid position
+    k_scale: "Optional[jax.Array]" = None,  # [P, ps, n_kv]: int8 pool
+    v_scale: "Optional[jax.Array]" = None,
+) -> jax.Array:
+    """Single-token decode attention through a page table.  Paged rows
+    are left-aligned from flat position 0, so the live window is
+    [0, valid_to)."""
+    if _decode_kernel_enabled():
+        from areal_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_kernel,
+        )
+
+        return paged_decode_attention_kernel(
+            q, k_pool, v_pool, page_table, valid_to, k_scale, v_scale
+        )
+    b = q.shape[0]
+    k_cache = paged_gather_layer(k_pool, page_table)
+    v_cache = paged_gather_layer(v_pool, page_table)
+    ks = None if k_scale is None else paged_gather_layer(k_scale, page_table)
+    vs = None if v_scale is None else paged_gather_layer(v_scale, page_table)
+    return decode_attention(
+        q, k_cache, v_cache, jnp.zeros((b,), jnp.int32), valid_to,
+        k_scale=ks, v_scale=vs,
+    )
+
+
+def paged_decode_attention_chunk(
+    q: jax.Array,  # [B, Q, n_q, d]
+    k_pool: jax.Array,  # [P, ps, n_kv, d]
+    v_pool: jax.Array,
+    page_table: jax.Array,  # [B, max_pages] int32
+    valid_to0: jax.Array,  # [B] int — one past query 0's window
+    k_scale: "Optional[jax.Array]" = None,
+    v_scale: "Optional[jax.Array]" = None,
+) -> jax.Array:
+    """Speculative-chunk decode attention through a page table: query i
+    attends [0, valid_to0 + i)."""
+    if _decode_kernel_enabled():
+        from areal_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_chunk_kernel,
+        )
+
+        return paged_decode_attention_chunk_kernel(
+            q, k_pool, v_pool, page_table, valid_to0, k_scale, v_scale
+        )
+    b = q.shape[0]
+    k_cache = paged_gather_layer(k_pool, page_table)
+    v_cache = paged_gather_layer(v_pool, page_table)
+    ks = None if k_scale is None else paged_gather_layer(k_scale, page_table)
+    vs = None if v_scale is None else paged_gather_layer(v_scale, page_table)
+    return decode_attention_chunk(
+        q, k_cache, v_cache, jnp.zeros((b,), jnp.int32), valid_to0,
+        k_scale=ks, v_scale=vs,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("causal",))

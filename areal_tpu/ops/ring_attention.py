@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from areal_tpu.base.compat import shard_map
 from areal_tpu.base.topology import MODEL_AXIS, SEQ_AXIS
 from areal_tpu.ops.attention import NEG_INF, repeat_kv
 from areal_tpu.parallel.sharding import BATCH
@@ -64,10 +65,16 @@ def _block_update(o, m, l, q, k, v, q_seg, k_seg, q_pos, k_pos, causal):
     return o_new, m_new, l_new
 
 
-def _ring_shard(q, k, v, segment_ids, axis_name: str, axis_size: int, causal: bool):
-    """shard_map body: each seq-axis member holds one contiguous chunk."""
+def _ring_shard(q, k, v, segment_ids, axis_name: str, axis_size: int,
+                causal: bool, my_index=None):
+    """shard_map body: each seq-axis member holds one contiguous chunk.
+
+    `my_index` overrides `lax.axis_index` for callers already inside a
+    partial-manual region (the CP+PP pipeline), where old jax cannot
+    lower axis_index.
+    """
     b, sq, h, d = q.shape
-    my = jax.lax.axis_index(axis_name)
+    my = jax.lax.axis_index(axis_name) if my_index is None else my_index
     q_pos = my * sq + jnp.arange(sq, dtype=jnp.int32)
 
     o = jnp.zeros((b, h, sq, d), jnp.float32)
@@ -256,7 +263,7 @@ def zigzag_ring_packed_attention_prepermuted(
     n = mesh.shape[seq_axis]
     qkv_spec = P(BATCH, seq_axis, MODEL_AXIS, None)
     seg_spec = P(BATCH, seq_axis)
-    return jax.shard_map(
+    return shard_map(
         functools.partial(
             _zigzag_shard, axis_name=seq_axis, axis_size=n, causal=causal
         ),
@@ -303,7 +310,7 @@ def ring_packed_attention(
             seq_axis=seq_axis,
         )
         return jnp.take(outz, inv, axis=1)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _ring_shard, axis_name=seq_axis, axis_size=n, causal=causal
         ),

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from areal_tpu.base.compat import shard_map
 from areal_tpu.base.topology import PIPE_AXIS, SEQ_AXIS
 
 
@@ -121,10 +122,14 @@ def pipelined_blocks(
     x_mbs, seg_mbs = to_mbs(x), to_mbs(segment_ids)
     cos_mbs, sin_mbs = to_mbs(cos), to_mbs(sin)
 
-    def pipe_body(blocks_local, x_mbs, seg_mbs, cos_mbs, sin_mbs):
-        stage = jax.lax.axis_index(PIPE_AXIS)
+    def pipe_body(sids, qids, blocks_local, x_mbs, seg_mbs, cos_mbs, sin_mbs):
+        # Explicit per-shard index inputs instead of lax.axis_index: old
+        # jax lowers axis_index inside a partial-manual region through a
+        # partition_id HLO that the SPMD partitioner rejects.
+        stage = sids[0]
+        cp_info = cp_manual and (*cp_manual, qids[0])
         fwd = functools.partial(
-            _stage_scan, blocks_local, cfg, use_flash, cp_manual
+            _stage_scan, blocks_local, cfg, use_flash, cp_info
         )
         fwd = jax.checkpoint(
             fwd, policy=jax.checkpoint_policies.nothing_saveable
@@ -177,13 +182,22 @@ def pipelined_blocks(
     # tables enter as per-chunk shards ([m, rows, S/n_seq, ...]).
     seq = SEQ_AXIS if cp_manual else None
     act = P(None, None, seq)
-    fn = jax.shard_map(
+    fn = shard_map(
         pipe_body,
         mesh=mesh,
-        in_specs=(P(PIPE_AXIS), act, act, act, act),
+        in_specs=(
+            P(PIPE_AXIS),
+            P(SEQ_AXIS) if cp_manual else P(),
+            P(PIPE_AXIS),
+            act, act, act, act,
+        ),
         out_specs=(act, P()),
         axis_names={PIPE_AXIS, SEQ_AXIS} if cp_manual else {PIPE_AXIS},
         check_vma=False,
     )
-    y_mbs, aux = fn(blocks, x_mbs, seg_mbs, cos_mbs, sin_mbs)
+    sids = jnp.arange(n_stages, dtype=jnp.int32)
+    qids = jnp.arange(
+        mesh.shape[SEQ_AXIS] if cp_manual else 1, dtype=jnp.int32
+    )
+    y_mbs, aux = fn(sids, qids, blocks, x_mbs, seg_mbs, cos_mbs, sin_mbs)
     return y_mbs.reshape(b, *x.shape[1:]), aux

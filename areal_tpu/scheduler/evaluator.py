@@ -296,6 +296,11 @@ def _eval_one_dataset(
             info = {
                 "solutions": sols,
                 "input_output": r.get("input_output"),
+                # Row-level evidence for is_multi_choice gating: rows
+                # that rendered a choices block grade through choice
+                # extraction; rows without one keep the gold-string
+                # inference (None).
+                "choices": r.get("choices"),
             }
             bounds = one.cu_seqlens("packed_input_ids")
             toks_all = np.asarray(one.data["packed_input_ids"])
@@ -341,11 +346,24 @@ def _majority_correct(task: str, texts, info) -> bool:
     the K sampled answers by pairwise equivalence, grade the LARGEST
     cluster's representative.  Equivalence uses the same grading stack
     (each candidate answer treated as the gold for its peers), so
-    '1/2' and '0.5' vote together."""
+    '1/2' and '0.5' vote together.  The fast string/Fraction match
+    decides most pairs; when it fails on two extractable math answers,
+    the sympy grader breaks the tie so symbolically equivalent forms
+    ('\\sqrt{2}/2' vs '0.7071') also share a cluster — the same
+    two-tier stack verify_math grades with."""
     from areal_tpu.interfaces.math_verify import (
         answers_match,
         extract_answer,
     )
+
+    def _equiv(p: str, rep: str) -> bool:
+        if answers_match(p, rep):
+            return True
+        if task == "math" and p and rep:
+            from areal_tpu.interfaces.math_sympy import answers_match_sympy
+
+            return bool(answers_match_sympy(p, rep))
+        return False
 
     preds = [extract_answer(t) or "" for t in texts]
     clusters: List[List[int]] = []
@@ -356,7 +374,7 @@ def _majority_correct(task: str, texts, info) -> bool:
             # Unextractable answers cluster TOGETHER ("" == ""): a
             # no-answer majority must be able to win (and then grade
             # wrong), as in the reference's equal-string grouping.
-            if answers_match(p, rep):
+            if _equiv(p, rep):
                 clusters[ci].append(i)
                 placed = True
                 break

@@ -406,6 +406,31 @@ class GenerationServer:
             p.done.set()
 
     def _run_group(self, group: List[_Pending]):
+        """Split the batched group against the engine's KV page budget,
+        then run each sub-group as one generate call.  A paged engine
+        with a bounded pool (kv_pool_pages set) exposes the budget in
+        tokens; admitting a group whose worst-case footprint exceeds it
+        would either exhaust the pool mid-flight or serialize behind
+        the allocator — splitting up front keeps every call feasible.
+        A single oversized request still runs alone (the engine raises
+        a clean PagePoolExhausted that fails only that sub-group)."""
+        budget = getattr(self.engine, "page_budget_tokens", None)
+        if budget is None or len(group) <= 1:
+            return self._run_subgroup(group)
+        sub: List[_Pending] = []
+        used = 0
+        for p in group:
+            g = p.gconfig
+            need = g.n * (len(p.prompt_ids) + g.max_new_tokens)
+            if sub and used + need > budget:
+                self._run_subgroup(sub)
+                sub, used = [], 0
+            sub.append(p)
+            used += need
+        if sub:
+            self._run_subgroup(sub)
+
+    def _run_subgroup(self, group: List[_Pending]):
         try:
             g = group[0].gconfig
             # Internal ids are positional: client qids may collide across
@@ -821,6 +846,15 @@ def main():
     p.add_argument("--port", type=int, default=8091)
     p.add_argument("--eos-token-id", type=int, default=None)
     p.add_argument("--max-decode-batch", type=int, default=64)
+    p.add_argument("--kv-page-size", type=int, default=128,
+                   help="tokens per KV page in the paged decode pool")
+    p.add_argument("--kv-pool-pages", type=int, default=0,
+                   help="fixed KV pool size in pages (0 = auto-size); "
+                        "positive values bound concurrent admissions "
+                        "via the page budget")
+    p.add_argument("--no-paged-kv", action="store_true",
+                   help="dense grow-by-doubling KV window instead of "
+                        "the paged pool")
     p.add_argument("--token", default="",
                    help="shared secret (or AREAL_GEN_TOKEN)")
     p.add_argument("--zmq-port", type=int, default=None,
@@ -838,6 +872,9 @@ def main():
     engine = GeneratorEngine(
         cfg, params, mesh, eos_token_id=eos,
         max_decode_batch=args.max_decode_batch,
+        kv_paged=False if args.no_paged_kv else None,
+        kv_page_size=args.kv_page_size,
+        kv_pool_pages=args.kv_pool_pages,
     )
     server = GenerationServer(
         engine, host=args.host, port=args.port, token=args.token,

@@ -153,6 +153,16 @@ def main(size: str = "1.5b"):
         # >=16k longctx mode (a bf16 cache at batch 32 x 16k does not
         # fit this chip at all).
         kv_cache_dtype=os.environ.get("AREAL_BENCH_KV_DTYPE", "auto"),
+        # Paged-vs-dense decode leg: AREAL_BENCH_PAGED=0 forces the dense
+        # grow-by-doubling window, 1 forces the page pool; unset defers
+        # to the engine default (paged unless AREAL_PAGED_KV=0).
+        kv_paged=(
+            None
+            if os.environ.get("AREAL_BENCH_PAGED") is None
+            else os.environ["AREAL_BENCH_PAGED"] != "0"
+        ),
+        kv_page_size=int(os.environ.get("AREAL_BENCH_KV_PAGE_SIZE", 128)),
+        kv_pool_pages=int(os.environ.get("AREAL_BENCH_KV_POOL_PAGES", 0)),
     )
     actor = Model("actor", engine=train_engine, tokenizer=tok, config=cfg)
     gen = Model("actor_gen", engine=gen_engine, tokenizer=tok, config=cfg)
@@ -187,6 +197,9 @@ def main(size: str = "1.5b"):
 
     timers = {"gen": 0.0, "train": 0.0, "sync": 0.0}
     flops = {"gen": 0.0, "train": 0.0}
+    # KV-memory accounting for the dense-vs-paged comparison (counters
+    # reset per generate call; sum them over the recorded iters).
+    kv = {"copy_bytes": 0, "compiles": 0, "live": 0, "alloc": 0}
 
     def one_step(seed, record=False):
         t0 = time.time()
@@ -223,6 +236,11 @@ def main(size: str = "1.5b"):
             p_exp = [prompt_len] * len(out_lens)
             g_lens = [t - prompt_len for t in out_lens]
             flops["gen"] += monitor.flops_generate(cfg, p_exp, g_lens)
+            kv["copy_bytes"] += gen_engine.cache_copy_bytes
+            kv["compiles"] += gen_engine.decode_compiles
+            st = gen_engine.last_pool_stats
+            kv["live"] += st.get("live_tokens", 0)
+            kv["alloc"] += st.get("allocated_tokens", 0)
             tokens = sum(out_lens)
             flops["train"] += monitor.flops_train(
                 cfg, tokens, float(sum(t * t for t in out_lens))
@@ -278,6 +296,17 @@ def main(size: str = "1.5b"):
                 "mfu_train": round(mfu_train, 4) if mfu_train else None,
                 "mfu_e2e": round(mfu_e2e, 4) if mfu_e2e else None,
                 "warmup_seconds": round(warmup_s, 1),
+                # Paged-KV contract metrics: a paged run must show
+                # decode_compiles == n_iters (one per generate call) and
+                # cache_copy_bytes == 0; the dense leg pays both at every
+                # window-bucket crossing.  kv_pool_utilization = live
+                # tokens / allocated cache tokens, chunk-averaged.
+                "kv_paged": bool(gen_engine.kv_paged),
+                "decode_compiles": kv["compiles"],
+                "cache_copy_bytes": kv["copy_bytes"],
+                "kv_pool_utilization": round(
+                    kv["live"] / max(kv["alloc"], 1), 4
+                ),
                 # Fraction of the padded [rows, row_len] train grid that
                 # is real tokens — the padding waste MFU silently pays.
                 "pack_efficiency": round(

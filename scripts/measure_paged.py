@@ -1,0 +1,157 @@
+"""Dense-vs-paged KV decode measurement on the CPU test cluster.
+
+Runs the SAME long-decode workload (mixed-length prompts, >=4k new
+tokens per row, greedy, oversubscribed slots) through the inflight
+generator twice — dense grow-by-doubling window, then the paged pool —
+on 8 virtual CPU devices (the tests' fake-cluster configuration,
+tests/conftest.py), and emits one JSON line per leg plus a comparison
+line with the contract metrics:
+
+  - decode_compiles:    paged must pay exactly 1; dense pays one per
+                        window bucket the decode crosses
+  - cache_copy_bytes:   paged must be 0; dense copies the whole cache
+                        at every doubling
+  - kv_pool_utilization: live tokens / allocated cache tokens (chunk-
+                        averaged) — paged must be >= dense
+
+Usage (from the repo root; takes a few minutes):
+    python scripts/measure_paged.py [--max-new 4096] [--out FILE]
+
+The committed artifact is the stdout of one run, saved under a
+timestamped name (bench_paged_cpu8_<UTC>.log) and cited from PERF.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+EOS = 7
+PROMPT_LENS = (37, 120, 64, 230, 91, 333, 180, 45, 260, 150, 77, 410)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-new", type=int, default=4096)
+    ap.add_argument("--page-size", type=int, default=128)
+    ap.add_argument("--out", default=None,
+                    help="also append JSON lines to this file")
+    args = ap.parse_args()
+
+    import jax
+
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.engines.generator import GeneratorEngine
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.models.config import tiny_config
+
+    assert len(jax.devices()) == 8, (
+        f"expected the 8-virtual-device CPU cluster, got "
+        f"{len(jax.devices())} devices"
+    )
+    cfg = tiny_config()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(11))
+    mesh = make_mesh(ParallelConfig.from_str("d8"), jax.devices())
+
+    rng = np.random.default_rng(42)
+    data = np.concatenate(
+        [rng.integers(8, cfg.vocab_size, size=l) for l in PROMPT_LENS]
+    ).astype(np.int32)
+    sample = SequenceSample(
+        keys={"packed_prompts"},
+        ids=[f"p{i}" for i in range(len(PROMPT_LENS))],
+        seqlens={"packed_prompts": [[l] for l in PROMPT_LENS]},
+        data={"packed_prompts": data},
+    )
+    # min_new == max_new masks EOS: every row decodes the full budget,
+    # so the dense window is guaranteed to cross bucket boundaries.
+    g = GenerationHyperparameters(
+        n=1, max_new_tokens=args.max_new, min_new_tokens=args.max_new,
+        greedy=True,
+    )
+
+    lines = []
+
+    def emit(obj):
+        line = json.dumps(obj)
+        print(line, flush=True)
+        lines.append(line)
+
+    def leg(paged: bool):
+        eng = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, max_decode_batch=8,
+            kv_paged=paged, kv_page_size=args.page_size,
+        )
+        t0 = time.time()
+        out = eng.generate(sample, MicroBatchSpec(), g, inflight=True)
+        dt = time.time() - t0
+        gen_tokens = int(
+            sum(t for row in out.seqlens["packed_input_ids"] for t in row)
+        ) - sum(PROMPT_LENS)
+        st = eng.last_pool_stats
+        emit({
+            "leg": "paged" if paged else "dense",
+            "devices": len(jax.devices()),
+            "prompts": len(PROMPT_LENS),
+            "max_new_tokens": args.max_new,
+            "gen_tokens": gen_tokens,
+            "wall_seconds": round(dt, 2),
+            "gen_tokens_per_sec": round(gen_tokens / dt, 1),
+            "decode_compiles": eng.decode_compiles,
+            "cache_copy_bytes": eng.cache_copy_bytes,
+            "kv_pool_utilization": round(st.get("utilization", 0.0), 4),
+            "pool_pages": st.get("pool_pages"),
+            "page_size": st.get("page_size"),
+            "pages_recycled": st.get("pages_recycled"),
+            "peak_pages_used": st.get("peak_pages_used"),
+        })
+        return out, eng, dt
+
+    out_d, eng_d, _ = leg(paged=False)
+    out_p, eng_p, _ = leg(paged=True)
+
+    toks_equal = bool(
+        np.array_equal(
+            np.asarray(out_d.data["packed_input_ids"]),
+            np.asarray(out_p.data["packed_input_ids"]),
+        )
+    )
+    emit({
+        "leg": "compare",
+        "greedy_tokens_identical": toks_equal,
+        "paged_compiles_once": eng_p.decode_compiles == 1,
+        "paged_zero_copy": eng_p.cache_copy_bytes == 0,
+        "dense_copy_bytes": eng_d.cache_copy_bytes,
+        "dense_decode_compiles": eng_d.decode_compiles,
+        "utilization_paged_ge_dense": (
+            eng_p.last_pool_stats.get("utilization", 0.0)
+            >= eng_d.last_pool_stats.get("utilization", 0.0)
+        ),
+    })
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write("\n".join(lines) + "\n")
+    ok = (
+        toks_equal
+        and eng_p.decode_compiles == 1
+        and eng_p.cache_copy_bytes == 0
+    )
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

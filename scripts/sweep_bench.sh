@@ -10,6 +10,11 @@
 set -u
 size="${1:-1.5b}"
 cd "$(dirname "$0")/.."
+# Append-only, timestamp-named log: every sweep leaves its own artifact
+# (stale overwritten logs are how the r5 sweep results got lost).
+log="bench_sweep_$(date -u +%Y%m%dT%H%M%SZ).log"
+exec > >(tee -a "$log") 2>&1
+echo "=== sweep start $(date -u +%FT%TZ) size=$size log=$log ===" >&2
 for remat in full dots_small dots none; do
   for mb in 4096 8192 16384; do
     echo "=== remat=$remat mb_tokens=$mb ===" >&2
@@ -33,3 +38,14 @@ AREAL_BENCH_MODE=longctx AREAL_BENCH_REMAT=full \
 echo "=== longctx bf16 kv (16384 new tokens) ===" >&2
 AREAL_BENCH_MODE=longctx AREAL_BENCH_REMAT=full AREAL_BENCH_KV_DTYPE=auto \
   timeout 3600 python bench.py "$size" || echo "(failed: longctx-bf16)" >&2
+# Paged-vs-dense decode legs: same workload, the JSON rows carry the
+# contract metrics (decode_compiles, cache_copy_bytes,
+# kv_pool_utilization) next to tokens/s.  The paged row must show
+# compiles == iters and zero copied bytes; the dense row pays both at
+# every KV window doubling.
+echo "=== longctx paged kv ===" >&2
+AREAL_BENCH_MODE=longctx AREAL_BENCH_REMAT=full AREAL_BENCH_PAGED=1 \
+  timeout 3600 python bench.py "$size" || echo "(failed: longctx-paged)" >&2
+echo "=== longctx dense kv (grow-by-doubling) ===" >&2
+AREAL_BENCH_MODE=longctx AREAL_BENCH_REMAT=full AREAL_BENCH_PAGED=0 \
+  timeout 3600 python bench.py "$size" || echo "(failed: longctx-dense)" >&2

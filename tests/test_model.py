@@ -209,7 +209,8 @@ class TestForward:
 
         ragged, big_dots = [], []
         for eqn in jaxpr.jaxpr.eqns:
-            if eqn.primitive.name == "ragged_dot_general":
+            # jax renamed the primitive ragged_dot -> ragged_dot_general.
+            if eqn.primitive.name in ("ragged_dot", "ragged_dot_general"):
                 ragged.append(eqn)
             if eqn.primitive.name == "dot_general":
                 lhs_shape = eqn.invars[0].aval.shape

@@ -1,0 +1,291 @@
+"""Paged KV cache tests: the compile-once / zero-copy decode contract.
+
+The paged inflight path (engines/generator.py + engines/paging.py +
+models/transformer.py PagedKVCache) must be BIT-IDENTICAL to the dense
+grow-by-doubling window under greedy decoding (bf16/f32 and int8), while
+compiling its decode program exactly once per generate call and copying
+zero cache bytes — the two regressions the dense window pays at every
+bucket boundary.  Page recycling and pool exhaustion round out the
+allocator contract.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model_api import GenerationHyperparameters
+from areal_tpu.base.topology import ParallelConfig, make_mesh
+from areal_tpu.engines.generator import GeneratorEngine
+from areal_tpu.engines.paging import PageAllocator, PagePoolExhausted
+from areal_tpu.models import transformer as tfm
+from areal_tpu.models.config import tiny_config
+
+EOS = 7
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_config()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return tfm.init_params(cfg, jax.random.PRNGKey(11))
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+
+
+def _prompt_sample(rng, cfg, lens):
+    data = np.concatenate(
+        [rng.integers(8, cfg.vocab_size, size=l) for l in lens]
+    ).astype(np.int32)
+    return SequenceSample(
+        keys={"packed_prompts"},
+        ids=[f"p{i}" for i in range(len(lens))],
+        seqlens={"packed_prompts": [[l] for l in lens]},
+        data={"packed_prompts": data},
+    )
+
+
+def _engines(cfg, params, mesh, **kw):
+    dense = GeneratorEngine(
+        cfg, params, mesh, eos_token_id=EOS, kv_paged=False, **kw
+    )
+    paged = GeneratorEngine(
+        cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
+        kv_page_size=8, **kw
+    )
+    return dense, paged
+
+
+def _assert_same_output(a, b):
+    assert a.seqlens["packed_input_ids"] == b.seqlens["packed_input_ids"]
+    np.testing.assert_array_equal(
+        np.asarray(a.data["packed_input_ids"]),
+        np.asarray(b.data["packed_input_ids"]),
+    )
+    np.testing.assert_allclose(
+        np.asarray(a.data["packed_logprobs"]),
+        np.asarray(b.data["packed_logprobs"]),
+        rtol=2e-4, atol=2e-4,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.data["seq_no_eos_mask"]),
+        np.asarray(b.data["seq_no_eos_mask"]),
+    )
+
+
+class TestPageAllocator:
+    def test_reserve_appends_without_moving(self):
+        a = PageAllocator(n_pages=8, page_size=4, n_slots=2, max_pages=4)
+        a.reserve(0, 5)  # 2 pages
+        first = a.table[0, :2].copy()
+        a.reserve(0, 9)  # grow to 3 — existing mappings must not move
+        np.testing.assert_array_equal(a.table[0, :2], first)
+        assert a.used[0] == 3
+        assert a.allocated_pages() == 3
+
+    def test_release_recycles(self):
+        a = PageAllocator(n_pages=4, page_size=4, n_slots=2, max_pages=4)
+        a.reserve(0, 16)  # whole pool
+        assert not a.can_reserve(1, 1)
+        a.release(0)
+        assert a.used[0] == 0 and (a.table[0] == a.sentinel).all()
+        a.reserve(1, 16)
+        assert a.pages_recycled == 4
+
+    def test_pool_exhaustion_message(self):
+        a = PageAllocator(n_pages=2, page_size=4, n_slots=2, max_pages=8)
+        a.reserve(0, 8)
+        with pytest.raises(PagePoolExhausted, match="page pool exhausted"):
+            a.reserve(1, 4)
+        # Failed reserve left state untouched.
+        assert a.used[1] == 0 and a.allocated_pages() == 2
+
+    def test_table_width_overflow(self):
+        a = PageAllocator(n_pages=16, page_size=4, n_slots=1, max_pages=2)
+        with pytest.raises(PagePoolExhausted, match="max_pages"):
+            a.reserve(0, 12)
+
+
+class TestPagedParity:
+    """Token-for-token greedy parity against the dense window, over slot
+    retirement + re-admission (5 requests, 2 slots)."""
+
+    LENS = (4, 11, 6, 9, 5)
+
+    def _run(self, cfg, params, mesh, rng, g, **kw):
+        dense, paged = _engines(
+            cfg, params, mesh, max_decode_batch=2, **kw
+        )
+        sample = _prompt_sample(rng, cfg, self.LENS)
+        od = dense.generate(sample, MicroBatchSpec(), g, inflight=True)
+        op = paged.generate(sample, MicroBatchSpec(), g, inflight=True)
+        _assert_same_output(od, op)
+        assert paged.decode_compiles == 1
+        assert paged.cache_copy_bytes == 0
+        return dense, paged
+
+    def test_plain_greedy(self, cfg, params, mesh, rng):
+        g = GenerationHyperparameters(n=1, max_new_tokens=8, greedy=True)
+        self._run(cfg, params, mesh, rng, g)
+
+    def test_plain_greedy_int8(self, cfg, params, mesh, rng):
+        g = GenerationHyperparameters(n=1, max_new_tokens=8, greedy=True)
+        self._run(cfg, params, mesh, rng, g, kv_cache_dtype="int8")
+
+    def test_spec_greedy(self, cfg, params, mesh, rng):
+        g = GenerationHyperparameters(
+            n=1, max_new_tokens=10, greedy=True, spec_decode_k=2
+        )
+        self._run(cfg, params, mesh, rng, g)
+
+    def test_spec_greedy_int8(self, cfg, params, mesh, rng):
+        g = GenerationHyperparameters(
+            n=1, max_new_tokens=10, greedy=True, spec_decode_k=2
+        )
+        self._run(cfg, params, mesh, rng, g, kv_cache_dtype="int8")
+
+    def test_paged_pallas_kernel_parity(
+        self, cfg, params, mesh, rng, monkeypatch
+    ):
+        """AREAL_DECODE_KERNEL=1 routes paged decode through the Pallas
+        ragged paged-attention kernel (interpret mode on CPU) — same
+        greedy tokens as the gather-based XLA fallback AND the dense
+        window."""
+        from areal_tpu.ops import attention
+
+        g = GenerationHyperparameters(n=1, max_new_tokens=8, greedy=True)
+        monkeypatch.setattr(attention, "_DECODE_KERNEL_SNAPSHOT", True)
+        try:
+            self._run(cfg, params, mesh, rng, g)
+        finally:
+            monkeypatch.setattr(attention, "_DECODE_KERNEL_SNAPSHOT", None)
+
+
+class TestCompileOnceContract:
+    def test_dense_recompiles_paged_does_not(self, cfg, params, mesh, rng):
+        """A decode long enough to cross window buckets: the dense path
+        pays >1 decode compilation and >0 copied cache bytes (the
+        grow-by-doubling tax); the paged path pays exactly one
+        compilation and zero copies for the SAME tokens."""
+        dense, paged = _engines(cfg, params, mesh, max_decode_batch=2)
+        sample = _prompt_sample(rng, cfg, (6, 9))
+        # min_new == max_new masks EOS: rows must decode far enough to
+        # cross the first dense bucket boundary (128 -> 256).
+        g = GenerationHyperparameters(
+            n=1, max_new_tokens=160, min_new_tokens=160, greedy=True
+        )
+        od = dense.generate(sample, MicroBatchSpec(), g, inflight=True)
+        op = paged.generate(sample, MicroBatchSpec(), g, inflight=True)
+        _assert_same_output(od, op)
+        assert dense.decode_compiles > 1
+        assert dense.cache_copy_bytes > 0
+        assert paged.decode_compiles == 1
+        assert paged.cache_copy_bytes == 0
+
+    def test_pool_stats_reported(self, cfg, params, mesh, rng):
+        _, paged = _engines(cfg, params, mesh, max_decode_batch=2)
+        sample = _prompt_sample(rng, cfg, (5, 8, 6))
+        g = GenerationHyperparameters(n=1, max_new_tokens=6, greedy=True)
+        paged.generate(sample, MicroBatchSpec(), g, inflight=True)
+        st = paged.last_pool_stats
+        assert st["kind"] == "paged"
+        assert st["page_size"] == 8
+        assert 0.0 < st["utilization"] <= 1.0
+        assert st["peak_pages_used"] <= st["pool_pages"]
+
+
+class TestPageRecycling:
+    def test_bounded_pool_recycles_and_matches(self, cfg, params, mesh, rng):
+        """A pool too small for all slots at once: retirement must
+        recycle pages into later admissions (throttling them, never
+        corrupting them) — outputs still match the dense window."""
+        dense = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=False,
+            max_decode_batch=2,
+        )
+        paged = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
+            kv_page_size=8, kv_pool_pages=4, max_decode_batch=2,
+        )
+        # Worst case per slot: ceil((11 + 8 + 8) / 8) = 4 pages — the
+        # pool holds exactly ONE slot's worst case, so the second slot
+        # waits for the first to retire (admission against the budget).
+        lens = (4, 11, 6, 9, 5, 7)
+        sample = _prompt_sample(rng, cfg, lens)
+        g = GenerationHyperparameters(n=1, max_new_tokens=8, greedy=True)
+        od = dense.generate(sample, MicroBatchSpec(), g, inflight=True)
+        op = paged.generate(sample, MicroBatchSpec(), g, inflight=True)
+        _assert_same_output(od, op)
+        assert paged.last_pool_stats["pages_recycled"] > 0
+        assert paged.last_pool_stats["pool_pages"] == 4
+
+    def test_undersized_pool_raises_clear_error(
+        self, cfg, params, mesh, rng
+    ):
+        """A pool that cannot hold even one request must fail fast with
+        the capacity message, not deadlock the admission loop."""
+        paged = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
+            kv_page_size=8, kv_pool_pages=1, max_decode_batch=2,
+        )
+        sample = _prompt_sample(rng, cfg, (20,))
+        g = GenerationHyperparameters(n=1, max_new_tokens=16, greedy=True)
+        with pytest.raises(PagePoolExhausted, match="kv_pool_pages"):
+            paged.generate(sample, MicroBatchSpec(), g, inflight=True)
+
+
+class TestGenServerPageBudget:
+    def test_group_splitting_against_budget(self):
+        """gen_server splits a batched group so each generate call's
+        worst-case token footprint fits the engine's page budget."""
+        import threading
+
+        from areal_tpu.system.gen_server import GenerationServer, _Pending
+
+        g = GenerationHyperparameters(n=2, max_new_tokens=10, greedy=True)
+
+        def pend(plen):
+            return _Pending(
+                qid="q", prompt_ids=list(range(plen)), gconfig=g,
+                done=threading.Event(),
+            )
+
+        srv = GenerationServer.__new__(GenerationServer)
+        calls = []
+
+        class _Eng:
+            page_budget_tokens = 100
+
+        srv.engine = _Eng()
+        srv._run_subgroup = lambda grp: calls.append(len(grp))
+        # footprints: 2*(15+10)=50 each -> two per sub-group.
+        srv._run_group([pend(15), pend(15), pend(15), pend(15), pend(15)])
+        assert calls == [2, 2, 1]
+
+        # No budget -> one call.
+        calls.clear()
+        srv.engine = type("E", (), {"page_budget_tokens": None})()
+        srv._run_group([pend(15), pend(15), pend(15)])
+        assert calls == [3]
+
+    def test_engine_budget_property(self, cfg, params, mesh):
+        dense = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=False
+        )
+        assert dense.page_budget_tokens is None
+        auto = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=True
+        )
+        assert auto.page_budget_tokens is None  # auto-sized pool
+        capped = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
+            kv_page_size=16, kv_pool_pages=8,
+        )
+        assert capped.page_budget_tokens == 128

@@ -288,9 +288,14 @@ def _run_trial(mode: str, tmp_path) -> dict:
         out, _ = p.communicate(timeout=240)
         logs.append(out.decode(errors="replace"))
         if p.returncode != 0:
+            joined = "\n---\n".join(logs)
+            if "Multiprocess computations aren't implemented" in joined:
+                pytest.skip(
+                    "this jaxlib's CPU backend has no cross-process "
+                    "collectives (needs a gloo-enabled build)"
+                )
             raise AssertionError(
-                f"{mode} child failed (rc={p.returncode}):\n"
-                + "\n---\n".join(logs)
+                f"{mode} child failed (rc={p.returncode}):\n" + joined
             )
     with open(outfile) as f:
         return json.load(f)
