@@ -22,7 +22,7 @@ import pickle
 import sys
 from typing import Dict, List, Optional
 
-from areal_tpu.base import logging, name_resolve
+from areal_tpu.base import logging, name_resolve, tracer
 from areal_tpu.experiments.common import ExperimentPlan
 from areal_tpu.scheduler import JobException, make_scheduler
 from areal_tpu.system.master import MasterWorker
@@ -149,6 +149,15 @@ def run_experiment(
             "AREAL_NAME_RESOLVE": "file",
             "AREAL_NAME_RESOLVE_ROOT": root,
         }
+        # Trace shards from every process must land in ONE dir; the
+        # explicit env dict ships it to schedulers that don't inherit
+        # our environ (the master configures itself in MasterWorker).
+        trace_dir = tracer.default_dir(
+            plan.fileroot, plan.experiment_name, plan.trial_name
+        )
+        if trace_dir:
+            env["AREAL_TRACE"] = os.environ.get("AREAL_TRACE", "1")
+            env["AREAL_TRACE_DIR"] = trace_dir
         if scheduler_mode != "tpu-pod":
             # Colocated workers default to CPU: one process owns the TPU
             # runtime (apps/worker.py applies this via jax.config, since

@@ -1,0 +1,266 @@
+"""Stall attribution over a merged trace: where did each step's wall-clock
+go, per process?
+
+    python -m areal_tpu.apps.trace_report <trace_dir | trace.json> [--top N]
+
+Given a directory, first merges the ``trace_*.jsonl`` shards into
+``trace.json`` (tracer.merge_shards), then walks each process track and
+buckets every step's wall-clock into compute / comms / host / idle:
+
+- step windows come from the master's ``step`` spans (the whole trace is
+  one step when absent — e.g. a bare gen_server capture);
+- category time is the union of that process's categorized spans clipped
+  to the window, with precedence comms > compute > host (a compute span
+  nested inside a transfer wait counts once, as comms);
+- idle is the uncovered remainder — the bubbles future overlap PRs exist
+  to shrink.  The top-N bubble intervals are printed with the spans that
+  bound them, which is the artifact a perf PR cites before/after.
+
+Uncategorized spans (request lifetimes, dispatch waits) shape the
+timeline but never count toward a bucket.
+"""
+
+import argparse
+import json
+import os
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+from areal_tpu.base import tracer
+
+Interval = Tuple[int, int]  # [start_us, end_us)
+
+# Attribution precedence: a span overlapped by a higher category yields
+# to it so nested spans never double-count.
+CATEGORIES = ("comms", "compute", "host")
+
+
+def _union(intervals: List[Interval]) -> List[Interval]:
+    if not intervals:
+        return []
+    intervals = sorted(intervals)
+    out = [list(intervals[0])]
+    for s, e in intervals[1:]:
+        if s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return [(s, e) for s, e in out]
+
+
+def _subtract(base: List[Interval], cut: List[Interval]) -> List[Interval]:
+    """base minus cut; both must be sorted unions."""
+    out: List[Interval] = []
+    ci = 0
+    for s, e in base:
+        cur = s
+        while ci < len(cut) and cut[ci][1] <= cur:
+            ci += 1
+        j = ci
+        while j < len(cut) and cut[j][0] < e:
+            cs, ce = cut[j]
+            if cs > cur:
+                out.append((cur, cs))
+            cur = max(cur, ce)
+            if cur >= e:
+                break
+            j += 1
+        if cur < e:
+            out.append((cur, e))
+    return out
+
+
+def _clip(intervals: List[Interval], lo: int, hi: int) -> List[Interval]:
+    return [
+        (max(s, lo), min(e, hi))
+        for s, e in intervals
+        if min(e, hi) > max(s, lo)
+    ]
+
+
+def _total(intervals: List[Interval]) -> int:
+    return sum(e - s for s, e in intervals)
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _spans_by_pid(trace) -> Dict[int, List[Dict]]:
+    by_pid: Dict[int, List[Dict]] = defaultdict(list)
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") == "X":
+            by_pid[int(e.get("pid", 0))].append(e)
+    return by_pid
+
+
+def _proc_names(trace) -> Dict[int, str]:
+    names = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            names[int(e["pid"])] = e.get("args", {}).get("name", "?")
+    return names
+
+
+def _step_windows(trace) -> List[Tuple[Optional[int], int, int]]:
+    """[(step_number, start_us, end_us)] from ``step`` spans; the whole
+    trace as one anonymous window when no step spans exist."""
+    steps = []
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") == "X" and e.get("name") == "step":
+            num = (e.get("args") or {}).get("step")
+            steps.append(
+                (
+                    int(num) if num is not None else None,
+                    int(e["ts"]),
+                    int(e["ts"]) + int(e["dur"]),
+                )
+            )
+    if steps:
+        return sorted(steps, key=lambda t: t[1])
+    spans = [e for e in trace.get("traceEvents", []) if e.get("ph") == "X"]
+    if not spans:
+        return []
+    lo = min(int(e["ts"]) for e in spans)
+    hi = max(int(e["ts"]) + int(e["dur"]) for e in spans)
+    return [(None, lo, hi)]
+
+
+def attribute(trace) -> List[Dict[str, Any]]:
+    """-> one row per (step, process): {step, process, window_us,
+    compute_us, comms_us, host_us, idle_us}."""
+    by_pid = _spans_by_pid(trace)
+    names = _proc_names(trace)
+    rows = []
+    for step, lo, hi in _step_windows(trace):
+        for pid, spans in sorted(by_pid.items()):
+            cat_iv: Dict[str, List[Interval]] = {c: [] for c in CATEGORIES}
+            for e in spans:
+                c = e.get("cat")
+                if c in cat_iv:
+                    cat_iv[c].append(
+                        (int(e["ts"]), int(e["ts"]) + int(e["dur"]))
+                    )
+            covered: List[Interval] = []
+            row = {
+                "step": step,
+                "pid": pid,
+                "process": names.get(pid, str(pid)),
+                "window_us": hi - lo,
+            }
+            for c in CATEGORIES:
+                u = _subtract(_union(_clip(cat_iv[c], lo, hi)), covered)
+                row[f"{c}_us"] = _total(u)
+                covered = _union(covered + u)
+            row["idle_us"] = (hi - lo) - _total(covered)
+            row["_covered"] = covered
+            rows.append(row)
+    return rows
+
+
+def bubbles(trace, top: int = 5) -> List[Dict[str, Any]]:
+    """Largest uncovered (idle) intervals per process across all step
+    windows, with the categorized spans bounding each gap."""
+    by_pid = _spans_by_pid(trace)
+    names = _proc_names(trace)
+    windows = _step_windows(trace)
+    out = []
+    for pid, spans in by_pid.items():
+        cat_spans = [e for e in spans if e.get("cat") in CATEGORIES]
+        covered = _union(
+            [
+                (int(e["ts"]), int(e["ts"]) + int(e["dur"]))
+                for e in cat_spans
+            ]
+        )
+        for step, lo, hi in windows:
+            for gs, ge in _subtract([(lo, hi)], _clip(covered, lo, hi)):
+                before = after = None
+                for e in cat_spans:
+                    s, ee = int(e["ts"]), int(e["ts"]) + int(e["dur"])
+                    if ee <= gs and (
+                        before is None
+                        or ee > int(before["ts"]) + int(before["dur"])
+                    ):
+                        before = e
+                    if s >= ge and (
+                        after is None or s < int(after["ts"])
+                    ):
+                        after = e
+                out.append(
+                    {
+                        "process": names.get(pid, str(pid)),
+                        "step": step,
+                        "start_us": gs,
+                        "dur_us": ge - gs,
+                        "after_span": before["name"] if before else None,
+                        "before_span": after["name"] if after else None,
+                    }
+                )
+    out.sort(key=lambda b: -b["dur_us"])
+    return out[:top]
+
+
+def format_report(trace, top: int = 5) -> str:
+    rows = attribute(trace)
+    lines = []
+    ms = lambda us: f"{us / 1000.0:9.1f}"  # noqa: E731
+    lines.append(
+        f"{'step':>5} {'process':<16} {'window_ms':>9} {'compute':>9} "
+        f"{'comms':>9} {'host':>9} {'idle':>9} {'idle%':>6}"
+    )
+    for r in rows:
+        step = "-" if r["step"] is None else str(r["step"])
+        idle_pct = 100.0 * r["idle_us"] / max(r["window_us"], 1)
+        lines.append(
+            f"{step:>5} {r['process']:<16} {ms(r['window_us'])} "
+            f"{ms(r['compute_us'])} {ms(r['comms_us'])} {ms(r['host_us'])} "
+            f"{ms(r['idle_us'])} {idle_pct:5.1f}%"
+        )
+    bubs = bubbles(trace, top=top)
+    if bubs:
+        lines.append("")
+        lines.append(f"top {len(bubs)} bubbles (uncovered intervals):")
+        for b in bubs:
+            step = "-" if b["step"] is None else str(b["step"])
+            lines.append(
+                f"  {b['dur_us'] / 1000.0:8.1f} ms  step {step:>3}  "
+                f"{b['process']:<16} between "
+                f"{b['after_span'] or '<window start>'} and "
+                f"{b['before_span'] or '<window end>'}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="areal_tpu.apps.trace_report")
+    p.add_argument(
+        "path",
+        help="trace dir (shards are merged into trace.json) or a merged "
+        "trace.json",
+    )
+    p.add_argument("--top", type=int, default=5, help="bubbles to print")
+    p.add_argument(
+        "--out", default=None,
+        help="where to write the merged trace.json (dir input only)",
+    )
+    args = p.parse_args(argv)
+    if os.path.isdir(args.path):
+        out = args.out or os.path.join(args.path, "trace.json")
+        trace = tracer.merge_shards(args.path, out_path=out)
+        print(f"merged {args.path} -> {out}")
+    else:
+        trace = load_trace(args.path)
+    errors = tracer.validate_trace(trace)
+    if errors:
+        print("trace schema problems:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print(format_report(trace, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -32,9 +32,12 @@ def main():
             "jax_platforms", os.environ["AREAL_WORKER_PLATFORM"]
         )
 
-    from areal_tpu.base import compilation_cache, logging, seeding
+    from areal_tpu.base import compilation_cache, logging, seeding, tracer
 
     compilation_cache.enable()
+    # Shard name: trace_worker_<index>.jsonl (dir comes from
+    # AREAL_TRACE_DIR, exported by the launcher when tracing is on).
+    tracer.configure(role="worker", rank=args.index)
     from areal_tpu.system.stream import run_worker_stream
     from areal_tpu.system.transfer import ZMQTransfer
     from areal_tpu.system.worker import ModelWorker
@@ -73,6 +76,7 @@ def main():
             worker, args.experiment, args.trial, control=control
         )
     finally:
+        tracer.flush()
         transfer.close()
         control.stop()
     logger.info(f"worker {args.index} exiting")

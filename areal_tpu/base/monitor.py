@@ -134,7 +134,16 @@ class Timers:
             self._count[name] = self._count.get(name, 0) + 1
 
     def drain(self, prefix: str = "time/") -> Dict[str, float]:
-        out = {f"{prefix}{k}": v for k, v in self._acc.items()}
+        """Export accumulated marks and reset.  Per key: the total
+        seconds, the call count (``<key>_cnt``) and the mean per call
+        (``<key>_avg``) — counts used to be accumulated then silently
+        discarded, hiding e.g. how many micro-batches a total covered."""
+        out: Dict[str, float] = {}
+        for k, total in self._acc.items():
+            n = self._count.get(k, 0)
+            out[f"{prefix}{k}"] = total
+            out[f"{prefix}{k}_cnt"] = float(n)
+            out[f"{prefix}{k}_avg"] = total / n if n else 0.0
         self._acc.clear()
         self._count.clear()
         return out
@@ -162,6 +171,11 @@ class StatsLogger:
         self.dir = os.path.join(fileroot, "logs", experiment_name, trial_name)
         os.makedirs(self.dir, exist_ok=True)
         self.path = os.path.join(self.dir, "stats.jsonl")
+        # Persistent append handle: reopening per step costs an
+        # open/close syscall pair every step and loses append atomicity
+        # on some filesystems; explicit flush keeps the file greppable
+        # mid-trial.
+        self._jsonl = open(self.path, "a")
         if use_tensorboard is None:
             use_tensorboard = bool(os.environ.get("AREAL_TENSORBOARD"))
         if use_wandb is None:
@@ -191,8 +205,8 @@ class StatsLogger:
 
     def log(self, step: int, stats: Dict[str, float]) -> None:
         row = {"global_step": step, "ts": time.time(), **stats}
-        with open(self.path, "a") as f:
-            f.write(json.dumps(row) + "\n")
+        self._jsonl.write(json.dumps(row) + "\n")
+        self._jsonl.flush()
         if self._tb is not None:
             for k, v in stats.items():
                 self._tb.add_scalar(k, v, global_step=step)
@@ -201,6 +215,8 @@ class StatsLogger:
             self._wandb.log(stats, step=step)
 
     def close(self):
+        if self._jsonl is not None and not self._jsonl.closed:
+            self._jsonl.close()
         if self._tb is not None:
             self._tb.close()
         if self._wandb is not None:

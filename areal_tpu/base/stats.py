@@ -60,9 +60,29 @@ class StatsTracker:
 
 
 def merge_stats(stats: List[Dict[str, float]]) -> Dict[str, float]:
-    """Unweighted mean-merge of per-shard stat dicts (DP-head gather)."""
+    """Merge per-shard stat dicts (DP-head gather).
+
+    A key with a matching ``<key>_denominator`` in the same shards is a
+    denominator-weighted mean (token-weighted loss/KL): unequal DP shards
+    mean-merged unweighted would skew toward small shards.  Denominator
+    keys themselves SUM (the merged denominator of the merged mean);
+    everything else keeps the unweighted mean."""
     merged: Dict[str, List[float]] = defaultdict(list)
     for s in stats:
         for k, v in s.items():
             merged[k].append(float(v))
-    return {k: float(np.mean(v)) for k, v in merged.items()}
+    out: Dict[str, float] = {}
+    for k, vals in merged.items():
+        if k.endswith("_denominator"):
+            out[k] = float(np.sum(vals))
+            continue
+        weights = merged.get(f"{k}_denominator")
+        # Pairing is positional: only weight when every shard reported
+        # both the value and its denominator.
+        if weights is not None and len(weights) == len(vals):
+            total = float(np.sum(weights))
+            if total > 0:
+                out[k] = float(np.dot(vals, weights) / total)
+                continue
+        out[k] = float(np.mean(vals))
+    return out

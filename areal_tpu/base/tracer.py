@@ -1,0 +1,480 @@
+"""Distributed span tracer: Chrome/Perfetto timelines for every process.
+
+Capability intent (no direct reference counterpart — realhf exposes only
+the master's flat per-step perf log, master_worker.py:434-473): make the
+*shape* of a step visible.  Each process (master, model workers,
+gen_server, reward service) records spans into lock-free per-thread ring
+buffers and flushes them to a per-process ``trace_<role>_<rank>.jsonl``
+shard; :func:`merge_shards` aligns the shards' monotonic clocks via a
+(monotonic, epoch) pair stamped in each shard's meta line and emits a
+single Perfetto-loadable ``trace.json`` — one track per process, one
+thread lane per tid, counter tracks for sampled gauges.
+
+Design constraints:
+- Zero overhead when disabled: ``span()`` returns a shared no-op context
+  manager after one dict build + one bool check; no clock reads, no
+  buffer traffic (acceptance: <1% on the bench generate path).
+- No locks on the hot path: each thread appends to its own
+  ``collections.deque(maxlen=...)`` (GIL-atomic); the global registry
+  lock is taken once per thread lifetime and at flush.
+- Spans yield a MUTABLE args dict so callers can attach values computed
+  only after the work ran (the worker fills tokens/TFLOPs/MFU once the
+  analytic FLOP count exists).
+
+Gating: ``AREAL_TRACE=1`` enables, ``AREAL_TRACE_DIR`` picks the shard
+directory (the master defaults it to ``<fileroot>/logs/<exp>/<trial>/
+trace`` and exports it so scheduler-spawned workers inherit the dir).
+
+Usage::
+
+    from areal_tpu.base import tracer
+    tracer.configure(role="worker", rank=3)
+    with tracer.span("mfc:actor:train_step", cat="compute") as args:
+        ...
+        args["tflops"] = 1.23
+    tracer.counter("kv_pool", live_tokens=512, allocated_tokens=4096)
+    tracer.flush()
+
+Categories drive the stall-attribution report (apps/trace_report.py):
+``compute`` (device math), ``comms`` (data/param movement and the waits
+on it), ``host`` (CPU-side work: data loading, grading).  Uncategorized
+spans are timeline-only; uncovered step time is reported as idle.
+"""
+
+import atexit
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# Per-thread ring capacity.  A step emits O(100) events per process;
+# 65536 absorbs many steps between flushes before dropping the oldest.
+_RING_CAP = 65536
+
+_lock = threading.Lock()
+_buffers: List[collections.deque] = []  # every thread's ring, for flush
+_tls = threading.local()
+
+_state: Dict[str, Any] = {
+    "enabled": False,
+    "configured": False,
+    "role": None,
+    "rank": 0,
+    "dir": None,
+    "path": None,
+    "file": None,
+    "meta_written": False,
+}
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("AREAL_TRACE", "0") not in ("", "0")
+
+
+def enabled() -> bool:
+    return _state["enabled"]
+
+
+def configure(
+    role: str,
+    rank: int = 0,
+    dir: Optional[str] = None,
+    enabled: Optional[bool] = None,
+    force: bool = False,
+) -> bool:
+    """Set this process's trace identity and shard location.
+
+    First configure wins (a library re-configuring must not steal the
+    process's shard) unless ``force=True`` — tests use force to switch
+    shards mid-process.  ``enabled=None`` reads AREAL_TRACE; an explicit
+    bool overrides the env (tests, check_trace).  Returns the resulting
+    enabled state."""
+    with _lock:
+        if _state["configured"] and not force:
+            return _state["enabled"]
+        if enabled is None:
+            enabled = _env_enabled()
+        if force:
+            _close_file_locked()
+            _state["meta_written"] = False
+        _state["enabled"] = bool(enabled)
+        _state["configured"] = True
+        _state["role"] = str(role)
+        _state["rank"] = int(rank)
+        d = dir or os.environ.get("AREAL_TRACE_DIR")
+        if d is None and enabled:
+            import tempfile
+
+            d = os.path.join(tempfile.gettempdir(), "areal_tpu_trace")
+        _state["dir"] = d
+        _state["path"] = (
+            os.path.join(d, f"trace_{role}_{rank}.jsonl") if d else None
+        )
+        return _state["enabled"]
+
+
+def default_dir(fileroot: str, experiment: str, trial: str) -> Optional[str]:
+    """Resolve (and export) the trial's trace dir: AREAL_TRACE_DIR if the
+    operator set one, else ``<fileroot>/logs/<exp>/<trial>/trace``.  The
+    master calls this BEFORE workers start so scheduler-spawned processes
+    inherit one shared dir via the environment.  No-op when disabled."""
+    if not _env_enabled() and not _state["enabled"]:
+        return None
+    d = os.environ.get("AREAL_TRACE_DIR")
+    if not d:
+        d = os.path.join(fileroot, "logs", experiment, trial, "trace")
+        os.environ["AREAL_TRACE_DIR"] = d
+    return d
+
+
+def shard_path() -> Optional[str]:
+    return _state["path"]
+
+
+# ---------------- hot path ----------------
+
+
+def _buf() -> collections.deque:
+    b = getattr(_tls, "buf", None)
+    if b is None:
+        b = collections.deque(maxlen=_RING_CAP)
+        _tls.buf = b
+        with _lock:
+            _buffers.append(b)
+    return b
+
+
+class _Span:
+    __slots__ = ("name", "cat", "args", "t0")
+
+    def __init__(self, name: str, cat: Optional[str], args: Dict):
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self) -> Dict:
+        self.t0 = time.monotonic_ns()
+        return self.args
+
+    def __exit__(self, *exc) -> bool:
+        t1 = time.monotonic_ns()
+        ev = {
+            "ph": "X",
+            "name": self.name,
+            "ts": self.t0 // 1000,
+            "dur": max((t1 - self.t0) // 1000, 1),
+            "tid": threading.get_ident(),
+        }
+        if self.cat:
+            ev["cat"] = self.cat
+        if self.args:
+            ev["args"] = self.args
+        _buf().append(ev)
+        return False
+
+
+class _NoopSpan:
+    """Shared disabled-path span: __enter__ hands back the caller's own
+    args dict so post-hoc ``args[...] = v`` writes stay valid and cheap."""
+
+    __slots__ = ("args",)
+
+    def __init__(self, args: Dict):
+        self.args = args
+
+    def __enter__(self) -> Dict:
+        return self.args
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+def span(name: str, cat: Optional[str] = None, **args) -> Any:
+    if not _state["enabled"]:
+        return _NoopSpan(args)
+    return _Span(name, cat, args)
+
+
+def trace(name: Optional[str] = None, cat: Optional[str] = None):
+    """Decorator form: @tracer.trace("load_data", cat="host")."""
+
+    def deco(fn):
+        import functools
+
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            if not _state["enabled"]:
+                return fn(*a, **kw)
+            with span(label, cat=cat):
+                return fn(*a, **kw)
+
+        return wrapped
+
+    return deco
+
+
+def instant(name: str, **args) -> None:
+    if not _state["enabled"]:
+        return
+    ev = {
+        "ph": "i",
+        "name": name,
+        "ts": time.monotonic_ns() // 1000,
+        "tid": threading.get_ident(),
+        "s": "t",
+    }
+    if args:
+        ev["args"] = args
+    _buf().append(ev)
+
+
+def counter(name: str, **values) -> None:
+    """Sampled gauge: each kwarg becomes one series on the counter track
+    (Perfetto ph="C")."""
+    if not _state["enabled"]:
+        return
+    _buf().append(
+        {
+            "ph": "C",
+            "name": name,
+            "ts": time.monotonic_ns() // 1000,
+            "args": values,
+        }
+    )
+
+
+def complete(
+    name: str,
+    start_ns: int,
+    end_ns: Optional[int] = None,
+    cat: Optional[str] = None,
+    **args,
+) -> None:
+    """Emit a span with an explicit start (for request lifetimes measured
+    across threads, e.g. gen_server enqueue -> retire)."""
+    if not _state["enabled"]:
+        return
+    if end_ns is None:
+        end_ns = time.monotonic_ns()
+    ev = {
+        "ph": "X",
+        "name": name,
+        "ts": start_ns // 1000,
+        "dur": max((end_ns - start_ns) // 1000, 1),
+        "tid": threading.get_ident(),
+    }
+    if cat:
+        ev["cat"] = cat
+    if args:
+        ev["args"] = args
+    _buf().append(ev)
+
+
+# ---------------- flush / shard IO ----------------
+
+
+def _json_default(o):
+    try:
+        return float(o)
+    except Exception:
+        return str(o)
+
+
+def _close_file_locked() -> None:
+    f = _state["file"]
+    if f is not None:
+        try:
+            f.close()
+        except Exception:
+            pass
+        _state["file"] = None
+
+
+def flush() -> Optional[str]:
+    """Drain every thread's ring into this process's shard file.  Safe to
+    call from any thread; returns the shard path (None when disabled or
+    unconfigured)."""
+    if not _state["enabled"]:
+        return None
+    with _lock:
+        path = _state["path"]
+        if path is None:
+            return None
+        if _state["file"] is None:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            _state["file"] = open(path, "a")
+        f = _state["file"]
+        if not _state["meta_written"]:
+            # Paired clocks let the exporter shift this shard's monotonic
+            # timestamps onto the shared epoch timeline.
+            f.write(
+                json.dumps(
+                    {
+                        "kind": "meta",
+                        "role": _state["role"],
+                        "rank": _state["rank"],
+                        "pid": os.getpid(),
+                        "mono_us": time.monotonic_ns() // 1000,
+                        "epoch_us": int(time.time() * 1e6),
+                    }
+                )
+                + "\n"
+            )
+            _state["meta_written"] = True
+        for b in _buffers:
+            while True:
+                try:
+                    ev = b.popleft()
+                except IndexError:
+                    break
+                f.write(json.dumps(ev, default=_json_default) + "\n")
+        f.flush()
+        return path
+
+
+def _reset_for_tests() -> None:
+    """Disable tracing and drop all buffered events/identity (test
+    isolation; not part of the public surface)."""
+    with _lock:
+        _close_file_locked()
+        _state.update(
+            enabled=False,
+            configured=False,
+            role=None,
+            rank=0,
+            dir=None,
+            path=None,
+            meta_written=False,
+        )
+        for b in _buffers:
+            b.clear()
+
+
+atexit.register(flush)
+
+
+# ---------------- exporter ----------------
+
+
+def read_shard(path: str):
+    """-> (meta dict or None, [event dicts])."""
+    meta = None
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # torn tail line from a killed process
+            if row.get("kind") == "meta":
+                if meta is None:
+                    meta = row
+                continue
+            events.append(row)
+    return meta, events
+
+
+def merge_shards(
+    trace_dir: str, out_path: Optional[str] = None
+) -> Dict[str, Any]:
+    """Merge every ``trace_*.jsonl`` shard in ``trace_dir`` into one
+    Chrome/Perfetto trace object (and write it to ``out_path`` when
+    given).  Per shard: timestamps shift from its monotonic clock onto
+    the epoch timeline (meta's paired clocks), events get the shard's
+    pid, and a process_name metadata event labels the track
+    ``<role>_<rank>``."""
+    import glob
+
+    shards = sorted(glob.glob(os.path.join(trace_dir, "trace_*.jsonl")))
+    events: List[Dict[str, Any]] = []
+    synthetic_pid = 1 << 20  # shards missing a meta line (crashed early)
+    used_pids: set = set()
+    for path in shards:
+        meta, evs = read_shard(path)
+        if not evs:
+            continue
+        if meta is not None:
+            pid = int(meta["pid"])
+            shift = int(meta["epoch_us"]) - int(meta["mono_us"])
+            label = f"{meta['role']}_{meta['rank']}"
+        else:
+            pid = synthetic_pid
+            synthetic_pid += 1
+            shift = 0
+            label = os.path.basename(path)[len("trace_"):-len(".jsonl")]
+        # One track per shard: two shards can share an OS pid (a process
+        # re-configured into a new role, or pid recycling across hosts).
+        if pid in used_pids:
+            pid = synthetic_pid
+            synthetic_pid += 1
+        used_pids.add(pid)
+        events.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "ts": 0,
+                "args": {"name": label},
+            }
+        )
+        for ev in evs:
+            ev = dict(ev)
+            ev["pid"] = pid
+            ev["ts"] = int(ev.get("ts", 0)) + shift
+            ev.setdefault("tid", 0)
+            events.append(ev)
+    # Normalize onto a zero-based timeline (Perfetto renders epoch-µs
+    # offsets fine, but small numbers keep the JSON and UI readable).
+    real = [e for e in events if e["ph"] != "M"]
+    if real:
+        t0 = min(e["ts"] for e in real)
+        for e in real:
+            e["ts"] -= t0
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(trace, f, default=_json_default)
+    return trace
+
+
+def validate_trace(trace: Dict[str, Any]) -> List[str]:
+    """Schema check for the merged trace (shared by tests and
+    scripts/check_trace.py).  Returns a list of problems; empty = valid."""
+    errors: List[str] = []
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["traceEvents is not a list"]
+    if not any(e.get("ph") == "X" for e in evs):
+        errors.append("no complete ('X') span events")
+    try:
+        json.dumps(trace)
+    except (TypeError, ValueError) as e:
+        errors.append(f"not JSON-serializable: {e!r}")
+    for i, e in enumerate(evs):
+        ph = e.get("ph")
+        if ph not in ("X", "C", "M", "i"):
+            errors.append(f"event {i}: unknown ph {ph!r}")
+            continue
+        if not isinstance(e.get("name"), str):
+            errors.append(f"event {i}: missing name")
+        for field in ("ts", "pid", "tid"):
+            if not isinstance(e.get(field), int):
+                errors.append(f"event {i} ({e.get('name')}): bad {field}")
+        if ph == "X" and not (
+            isinstance(e.get("dur"), int) and e["dur"] >= 0
+        ):
+            errors.append(f"event {i} ({e.get('name')}): bad dur")
+        if ph == "C" and not isinstance(e.get("args"), dict):
+            errors.append(f"event {i} ({e.get('name')}): counter sans args")
+        if len(errors) > 20:
+            errors.append("... (truncated)")
+            break
+    return errors

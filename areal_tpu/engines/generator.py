@@ -33,7 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model_api import Engine, GenerationHyperparameters
-from areal_tpu.base import logging
+from areal_tpu.base import logging, tracer
 from areal_tpu.base.distributed import to_host
 from areal_tpu.base.topology import batch_sharding_degree
 from areal_tpu.engines.offload import HostOffloadMixin
@@ -380,17 +380,25 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 len(reqs) > b_cap
                 or gconfig.max_new_tokens > self.static_path_max_new
             )
-        if inflight:
-            self._generate_inflight(
-                [reqs[j] for j in order], gconfig, key, results
-            )
-        else:
-            for start in range(0, len(order), b_cap):
-                chunk = [reqs[j] for j in order[start : start + b_cap]]
-                key, sub = jax.random.split(key)
-                self._generate_chunk(chunk, gconfig, sub, results)
+        # Uncategorized envelope span (the inner prefill/decode spans carry
+        # cat="compute"; host assembly gaps inside show as idle).
+        with tracer.span(
+            "generate",
+            n_prompts=sample.bs,
+            n_reqs=len(reqs),
+            inflight=bool(inflight),
+        ):
+            if inflight:
+                self._generate_inflight(
+                    [reqs[j] for j in order], gconfig, key, results
+                )
+            else:
+                for start in range(0, len(order), b_cap):
+                    chunk = [reqs[j] for j in order[start : start + b_cap]]
+                    key, sub = jax.random.split(key)
+                    self._generate_chunk(chunk, gconfig, sub, results)
 
-        return self._assemble(sample, prompt_key, prompt_lens, results, n)
+            return self._assemble(sample, prompt_key, prompt_lens, results, n)
 
     # -- continuous batching (inflight refill) --
 
@@ -447,10 +455,11 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             admits = self._take_admits(active, pending, n_slots)
             if admits:
                 rows, plens, slots = self._pack_admits(admits, n_slots)
-                logits_buf, cache = self._get_prefill_slots_fn()(
-                    self.params, jnp.asarray(rows), jnp.asarray(plens),
-                    cache, logits_buf, jnp.asarray(slots),
-                )
+                with tracer.span("prefill", cat="compute", n=len(admits)):
+                    logits_buf, cache = self._get_prefill_slots_fn()(
+                        self.params, jnp.asarray(rows), jnp.asarray(plens),
+                        cache, logits_buf, jnp.asarray(slots),
+                    )
                 self.prefill_dispatches += 1
                 for s, i, rep, toks in admits:
                     cache_len[s] = len(toks)
@@ -480,16 +489,19 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 n_slots, cur_w, chunk_t, gconfig
             )
             key, sub = jax.random.split(key)
-            (
-                out_toks, out_logps, logits_buf, cache,
-                new_cache_len, new_gen_count, new_done,
-            ) = decode_fn(
-                self.params, cache, logits_buf,
-                jnp.asarray(cache_len), jnp.asarray(gen_count),
-                jnp.asarray(done_host), sub,
-            )
-            out_toks = to_host(out_toks)
-            out_logps = to_host(out_logps)
+            # The to_host() calls inside the span force device sync, so
+            # the span covers actual chunk execution, not just dispatch.
+            with tracer.span("decode_chunk", cat="compute", t=chunk_t):
+                (
+                    out_toks, out_logps, logits_buf, cache,
+                    new_cache_len, new_gen_count, new_done,
+                ) = decode_fn(
+                    self.params, cache, logits_buf,
+                    jnp.asarray(cache_len), jnp.asarray(gen_count),
+                    jnp.asarray(done_host), sub,
+                )
+                out_toks = to_host(out_toks)
+                out_logps = to_host(out_logps)
             cache_len = to_host(new_cache_len).copy()
             gen_count = to_host(new_gen_count).copy()
             new_done = to_host(new_done)
@@ -549,6 +561,11 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             if active[s] is None and pending:
                 i, rep, toks = pending.pop()
                 admits.append((s, i, rep, toks))
+        tracer.counter(
+            "gen_slots",
+            live=sum(a is not None for a in active) + len(admits),
+            pending=len(pending),
+        )
         return admits
 
     def _pack_admits(self, admits, n_slots):
@@ -714,6 +731,13 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         st["live_tokens"] += int(live_tokens)
         st["allocated_tokens"] += int(allocated_tokens)
         st["utilization"] = st["live_tokens"] / max(st["allocated_tokens"], 1)
+        # Per-chunk sampled gauge: KV pool pressure over time in the trace.
+        tracer.counter(
+            "kv_pool",
+            live_tokens=int(live_tokens),
+            allocated_tokens=int(allocated_tokens),
+            utilization=int(live_tokens) / max(int(allocated_tokens), 1),
+        )
 
     # -- paged inflight (fixed page pool + host free-list allocator) --
 
@@ -764,11 +788,12 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 rows, plens, slots, page_rows = self._pack_admits_paged(
                     admits, n_slots, alloc
                 )
-                logits_buf, pool = self._get_prefill_pages_fn()(
-                    self.params, jnp.asarray(rows), jnp.asarray(plens),
-                    pool, logits_buf, jnp.asarray(slots),
-                    jnp.asarray(page_rows),
-                )
+                with tracer.span("prefill", cat="compute", n=len(admits)):
+                    logits_buf, pool = self._get_prefill_pages_fn()(
+                        self.params, jnp.asarray(rows), jnp.asarray(plens),
+                        pool, logits_buf, jnp.asarray(slots),
+                        jnp.asarray(page_rows),
+                    )
                 self.prefill_dispatches += 1
                 for s, i, rep, toks in admits:
                     cache_len[s] = len(toks)
@@ -790,16 +815,17 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             )
 
             key, sub = jax.random.split(key)
-            (
-                out_toks, out_logps, logits_buf, pool,
-                new_cache_len, new_gen_count, new_done,
-            ) = decode_fn(
-                self.params, pool, logits_buf, jnp.asarray(alloc.table),
-                jnp.asarray(cache_len), jnp.asarray(gen_count),
-                jnp.asarray(done_host), sub,
-            )
-            out_toks = to_host(out_toks)
-            out_logps = to_host(out_logps)
+            with tracer.span("decode_chunk", cat="compute", t=chunk_t):
+                (
+                    out_toks, out_logps, logits_buf, pool,
+                    new_cache_len, new_gen_count, new_done,
+                ) = decode_fn(
+                    self.params, pool, logits_buf, jnp.asarray(alloc.table),
+                    jnp.asarray(cache_len), jnp.asarray(gen_count),
+                    jnp.asarray(done_host), sub,
+                )
+                out_toks = to_host(out_toks)
+                out_logps = to_host(out_logps)
             cache_len = to_host(new_cache_len).copy()
             gen_count = to_host(new_gen_count).copy()
 
@@ -839,6 +865,11 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 s for s in range(n_slots) if active[s] is None
             )
             alloc.reserve(free_slot, len(pending[-1][2]) + slack)  # raises
+        tracer.counter(
+            "gen_slots",
+            live=sum(a is not None for a in active) + len(admits),
+            pending=len(pending),
+        )
         return admits
 
     def _pack_admits_paged(self, admits, n_slots, alloc):
@@ -1010,17 +1041,20 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             if admits:
                 rows, plens, slots = self._pack_admits(admits, n_slots)
                 key, sub = jax.random.split(key)
-                toks0, logps0, cache, tokens_buf, pending = (
-                    self._get_spec_admit_fn(g)(
-                        self.params, jnp.asarray(rows), jnp.asarray(plens),
-                        cache, tokens_buf, pending, jnp.asarray(slots), sub,
+                with tracer.span("prefill", cat="compute", n=len(admits)):
+                    toks0, logps0, cache, tokens_buf, pending = (
+                        self._get_spec_admit_fn(g)(
+                            self.params, jnp.asarray(rows),
+                            jnp.asarray(plens), cache, tokens_buf, pending,
+                            jnp.asarray(slots), sub,
+                        )
                     )
-                )
-                self.prefill_dispatches += 1
-                # ONE host sync per refill cycle (the eos/done flag must be
-                # exact before the next chunk) — not one per admission.
-                toks0 = to_host(toks0)
-                logps0 = to_host(logps0)
+                    self.prefill_dispatches += 1
+                    # ONE host sync per refill cycle (the eos/done flag must
+                    # be exact before the next chunk) — not one per
+                    # admission.
+                    toks0 = to_host(toks0)
+                    logps0 = to_host(logps0)
                 for j, (s, i, rep, toks) in enumerate(admits):
                     t0 = int(toks0[j])
                     cache_len[s] = len(toks)
@@ -1047,16 +1081,17 @@ class GeneratorEngine(HostOffloadMixin, Engine):
 
             fn = self._get_spec_decode_fn(n_slots, cur_w, n_steps, g)
             key, sub = jax.random.split(key)
-            (
-                out_toks, out_logps, tokens_buf, cache, pending,
-                new_cache_len, new_gen_count, new_done,
-            ) = fn(
-                self.params, cache, tokens_buf, pending,
-                jnp.asarray(cache_len), jnp.asarray(gen_count),
-                jnp.asarray(done_host), sub,
-            )
-            out_toks = to_host(out_toks)
-            out_logps = to_host(out_logps)
+            with tracer.span("decode_chunk", cat="compute", t=step_cap):
+                (
+                    out_toks, out_logps, tokens_buf, cache, pending,
+                    new_cache_len, new_gen_count, new_done,
+                ) = fn(
+                    self.params, cache, tokens_buf, pending,
+                    jnp.asarray(cache_len), jnp.asarray(gen_count),
+                    jnp.asarray(done_host), sub,
+                )
+                out_toks = to_host(out_toks)
+                out_logps = to_host(out_logps)
             cache_len = to_host(new_cache_len).copy()
             gen_count = to_host(new_gen_count).copy()
 
@@ -1230,16 +1265,17 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                     admits, n_slots, alloc
                 )
                 key, sub = jax.random.split(key)
-                toks0, logps0, pool, tokens_buf, pending = (
-                    self._get_spec_admit_pages_fn(g)(
-                        self.params, jnp.asarray(rows), jnp.asarray(plens),
-                        pool, tokens_buf, pending, jnp.asarray(slots),
-                        jnp.asarray(page_rows), sub,
+                with tracer.span("prefill", cat="compute", n=len(admits)):
+                    toks0, logps0, pool, tokens_buf, pending = (
+                        self._get_spec_admit_pages_fn(g)(
+                            self.params, jnp.asarray(rows),
+                            jnp.asarray(plens), pool, tokens_buf, pending,
+                            jnp.asarray(slots), jnp.asarray(page_rows), sub,
+                        )
                     )
-                )
-                self.prefill_dispatches += 1
-                toks0 = to_host(toks0)
-                logps0 = to_host(logps0)
+                    self.prefill_dispatches += 1
+                    toks0 = to_host(toks0)
+                    logps0 = to_host(logps0)
                 for j, (s, i, rep, toks) in enumerate(admits):
                     t0 = int(toks0[j])
                     cache_len[s] = len(toks)
@@ -1257,16 +1293,17 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             )
 
             key, sub = jax.random.split(key)
-            (
-                out_toks, out_logps, tokens_buf, pool, pending,
-                new_cache_len, new_gen_count, new_done,
-            ) = decode_fn(
-                self.params, pool, tokens_buf, pending,
-                jnp.asarray(alloc.table), jnp.asarray(cache_len),
-                jnp.asarray(gen_count), jnp.asarray(done_host), sub,
-            )
-            out_toks = to_host(out_toks)
-            out_logps = to_host(out_logps)
+            with tracer.span("decode_chunk", cat="compute", t=step_cap):
+                (
+                    out_toks, out_logps, tokens_buf, pool, pending,
+                    new_cache_len, new_gen_count, new_done,
+                ) = decode_fn(
+                    self.params, pool, tokens_buf, pending,
+                    jnp.asarray(alloc.table), jnp.asarray(cache_len),
+                    jnp.asarray(gen_count), jnp.asarray(done_host), sub,
+                )
+                out_toks = to_host(out_toks)
+                out_logps = to_host(out_logps)
             cache_len = to_host(new_cache_len).copy()
             gen_count = to_host(new_gen_count).copy()
 
@@ -1415,12 +1452,15 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             prompt_len[r] = len(toks)
 
         fn = self._get_gen_fn(b, sp, s_total, gconfig)
-        toks, logps, gen_len = fn(self.params, prompt_tok, prompt_len, key)
-        toks, logps, gen_len = (
-            to_host(toks),
-            to_host(logps),
-            to_host(gen_len),
-        )
+        with tracer.span("gen_chunk", cat="compute", b=b_real, sp=sp):
+            toks, logps, gen_len = fn(
+                self.params, prompt_tok, prompt_len, key
+            )
+            toks, logps, gen_len = (
+                to_host(toks),
+                to_host(logps),
+                to_host(gen_len),
+            )
         for r, (i, rep, _) in enumerate(chunk):
             gl = int(gen_len[r])
             no_eos = gl == gconfig.max_new_tokens and (

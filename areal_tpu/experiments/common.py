@@ -626,10 +626,17 @@ def run_experiment(plan: ExperimentPlan, tokenizer=None):
     """
     import asyncio
 
+    from areal_tpu.base import tracer
     from areal_tpu.system.master import InProcessPool, MasterWorker
     from areal_tpu.system.transfer import InProcTransfer
     from areal_tpu.system.worker import ModelWorker
 
+    # One process hosts everything here, so all spans land in the master's
+    # shard (threads are separate trace rows); set the shared dir before
+    # any component configures the tracer.
+    tracer.default_dir(
+        plan.fileroot, plan.experiment_name, plan.trial_name
+    )
     planes = InProcTransfer.make_group(len(plan.worker_configs))
     workers = [
         ModelWorker(wc, tokenizer=tokenizer, transfer=planes[i])

@@ -22,7 +22,7 @@ from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
-from areal_tpu.base import logging
+from areal_tpu.base import logging, tracer
 
 logger = logging.getLogger("reward_service")
 
@@ -87,8 +87,10 @@ class _Handler(BaseHTTPRequestHandler):
             items = req["items"]
             # Code grading runs sandboxed subprocesses with multi-second
             # timeouts; grade the batch in parallel.
-            with ThreadPoolExecutor(max_workers=8) as ex:
-                results = list(ex.map(_grade_one, items))
+            with tracer.span("verify", cat="host", n=len(items)):
+                with ThreadPoolExecutor(max_workers=8) as ex:
+                    results = list(ex.map(_grade_one, items))
+            tracer.flush()
             self._send(200, {"results": results})
         except Exception as e:  # noqa: BLE001 — report to the client
             self._send(500, {"error": repr(e)})
@@ -106,6 +108,7 @@ def serve(
     Code grading EXECUTES submitted programs: the default bind is loopback,
     and any non-loopback deployment should set a shared token
     (--token / AREAL_REWARD_TOKEN; clients send X-Areal-Token)."""
+    tracer.configure(role="reward", rank=port)
     srv = ThreadingHTTPServer((host, port), _Handler)
     srv.auth_token = token or os.environ.get("AREAL_REWARD_TOKEN", "")
     logger.info(f"reward service listening on {host}:{srv.server_port}")

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from areal_tpu.base import tracer
 from areal_tpu.parallel import sharding
 
 
@@ -39,14 +40,23 @@ def reshard(
     floating leaves in the same XLA program (casting before the transfer
     halves the bytes moved when going fp32 -> bf16).
     """
-    if dtype is not None:
-        tree = jax.tree.map(
-            lambda x: x.astype(dtype)
-            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
-            else x,
-            tree,
-        )
-    return jax.device_put(tree, dst_shardings, donate=donate)
+    with tracer.span("reshard", cat="comms") as targs:
+        if dtype is not None:
+            tree = jax.tree.map(
+                lambda x: x.astype(dtype)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                else x,
+                tree,
+            )
+        out = jax.device_put(tree, dst_shardings, donate=donate)
+        if tracer.enabled():
+            # device_put is async; block so the span measures the actual
+            # transfer rather than dispatch.  Only paid when tracing.
+            out = jax.block_until_ready(out)
+            targs["bytes"] = int(
+                sum(x.nbytes for x in jax.tree.leaves(out))
+            )
+    return out
 
 
 def reshard_params(

@@ -53,7 +53,7 @@ from areal_tpu.api.model_api import (
     LLMAPIClient,
     register_backend,
 )
-from areal_tpu.base import logging
+from areal_tpu.base import logging, tracer
 
 logger = logging.getLogger("gen_server")
 
@@ -67,6 +67,9 @@ class _Pending:
     seed: Optional[int] = None
     result: Optional[Dict[str, Any]] = None
     error: Optional[str] = None
+    # Enqueue timestamp (monotonic ns) — the request lifetime span in the
+    # trace runs from here to completion, covering queue + batch-merge wait.
+    t_enq: Optional[int] = None
 
 
 def _gkey(p: _Pending):
@@ -254,6 +257,7 @@ class GenerationServer:
                         ),
                         done=threading.Event(),
                         seed=req.get("seed"),
+                        t_enq=time.monotonic_ns(),
                     )
                     self._queue.put(p)
                     jobs.append((ident, rid, p))
@@ -330,6 +334,7 @@ class GenerationServer:
             gconfig=g,
             done=threading.Event(),
             seed=(int(req["seed"]) if req.get("seed") is not None else None),
+            t_enq=time.monotonic_ns(),
         )
         self._queue.put(p)
         while not p.done.wait(timeout=1.0):
@@ -385,11 +390,19 @@ class GenerationServer:
                         batch.append(self._queue.get_nowait())
                     except queue.Empty:
                         break
+                # Sampled gauge: how deep the request queue sits when a
+                # batch is picked — the server-side pressure signal.
+                tracer.counter(
+                    "gen_queue",
+                    depth=self._queue.qsize(),
+                    batch=len(batch),
+                )
                 by_g: Dict[Any, List[_Pending]] = {}
                 for p in batch:
                     by_g.setdefault(_gkey(p), []).append(p)
                 for group in by_g.values():
                     self._run_group(group)
+                tracer.flush()
             except Exception as e:  # noqa: BLE001
                 logger.exception("collector batching error")
                 for p in batch:
@@ -450,11 +463,14 @@ class GenerationServer:
             )
             self._seed += 1
             seed = group[0].seed if group[0].seed is not None else self._seed
-            with self._engine_lock:
-                version = self.version
-                out = self.engine.generate(
-                    sample, MicroBatchSpec(), g, seed=seed
-                )
+            # Uncategorized on purpose: the engine's own compute spans
+            # attribute the time; this shows engine-lock wait + call shape.
+            with tracer.span("gen_batch", n_reqs=len(group)):
+                with self._engine_lock:
+                    version = self.version
+                    out = self.engine.generate(
+                        sample, MicroBatchSpec(), g, seed=seed
+                    )
             per_id = {s.ids[0]: s for s in out.unpack()}
             for uid, p in zip(uids, group):
                 p.result = _extract_output(
@@ -466,12 +482,22 @@ class GenerationServer:
                 p.error = repr(e)
         finally:
             for p in group:
+                if p.t_enq is not None:
+                    tracer.complete(
+                        f"request:{p.qid}",
+                        start_ns=p.t_enq,
+                        qid=p.qid,
+                        n=p.gconfig.n,
+                        prompt_len=len(p.prompt_ids),
+                        error=bool(p.error),
+                    )
                 p.done.set()
 
     def close(self):
         self._stop.set()
         self._http.shutdown()
         self._http.server_close()
+        tracer.flush()
 
 
 def _extract_output(
@@ -862,6 +888,7 @@ def main():
                         "port (0 = random); clients use zmq://host:port")
     args = p.parse_args()
 
+    tracer.configure(role="gen_server", rank=args.port)
     cfg, params = hf.load_hf_checkpoint(args.path)
     pc = ParallelConfig.from_str(args.parallel)
     mesh = make_mesh(pc, jax.devices()[: pc.world_size])

@@ -16,7 +16,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from areal_tpu.api.config import ModelInterfaceType
 from areal_tpu.api.dfg import DFG, MFCDef, OffloadHook, ParamReallocHook
-from areal_tpu.base import logging, recover, timeutil
+from areal_tpu.base import logging, recover, timeutil, tracer
 from areal_tpu.base.monitor import StatsLogger
 from areal_tpu.base.stats import merge_stats
 from areal_tpu.system.buffer import SequenceBuffer
@@ -132,6 +132,11 @@ class MasterWorker:
         )
         self.stats_history: List[Dict[str, float]] = []
         self.stats_logger = StatsLogger(fileroot, experiment_name, trial_name)
+        # Span tracing (AREAL_TRACE): resolve the trial's shared shard dir
+        # before claiming this process's identity so in-process workers
+        # and the master write one coherent shard set.
+        tracer.default_dir(fileroot, experiment_name, trial_name)
+        tracer.configure(role="master")
         self._steps_per_epoch: Optional[int] = None
         self._restore_pending: Optional[recover.RecoverInfo] = None
         self._train_rpcs = [
@@ -203,19 +208,30 @@ class MasterWorker:
         )
         if self._restore_pending:
             await self._restore_worker_state()
-        while self.step_info.global_step < total_steps:
-            t0 = time.monotonic()
-            stats = await self.execute_step()
-            dt = time.monotonic() - t0
-            stats["time/step_s"] = dt
-            self.stats_history.append(stats)
-            logger.info(
-                f"step {self.step_info.global_step + 1}/{total_steps} "
-                f"({dt:.2f}s): { {k: round(v, 4) for k, v in stats.items()} }"
-            )
-            self.stats_logger.log(self.step_info.global_step + 1, stats)
-            self.step_info = self.step_info.next(self._steps_per_epoch)
-            await self._post_step()
+        try:
+            while self.step_info.global_step < total_steps:
+                t0 = time.monotonic()
+                # The "step" span marks the attribution window every other
+                # track is bucketed against (apps/trace_report.py).
+                with tracer.span(
+                    "step", step=self.step_info.global_step + 1
+                ):
+                    stats = await self.execute_step()
+                dt = time.monotonic() - t0
+                stats["time/step_s"] = dt
+                self.stats_history.append(stats)
+                logger.info(
+                    f"step {self.step_info.global_step + 1}/{total_steps} "
+                    f"({dt:.2f}s): "
+                    f"{ {k: round(v, 4) for k, v in stats.items()} }"
+                )
+                self.stats_logger.log(self.step_info.global_step + 1, stats)
+                self.step_info = self.step_info.next(self._steps_per_epoch)
+                await self._post_step()
+                tracer.flush()
+        finally:
+            self.stats_logger.close()
+            tracer.flush()
         return self.stats_history
 
     async def _post_step(self):
@@ -307,16 +323,19 @@ class MasterWorker:
             _IN_PREFETCH.reset(token)
 
     async def _load_data(self):
-        resps = await asyncio.gather(
-            *[
-                self.pool.request(w, {"type": "fetch"})
-                for w in self.data_worker_ids
-            ]
-        )
-        for w, r in zip(self.data_worker_ids, resps):
-            meta = r["meta"]
-            self._record_owner(meta, w)
-            await self.buffer.put_batch(meta, step=self.step_info.global_step)
+        with tracer.span("load_data", cat="host"):
+            resps = await asyncio.gather(
+                *[
+                    self.pool.request(w, {"type": "fetch"})
+                    for w in self.data_worker_ids
+                ]
+            )
+            for w, r in zip(self.data_worker_ids, resps):
+                meta = r["meta"]
+                self._record_owner(meta, w)
+                await self.buffer.put_batch(
+                    meta, step=self.step_info.global_step
+                )
 
     def _record_owner(self, meta, worker: int, replace: bool = False):
         for sid in meta.ids:
@@ -379,21 +398,27 @@ class MasterWorker:
                 for keys, sids in groups.items():
                     xfer_id = self._xfer_id
                     self._xfer_id += 1
-                    send_r, recv_r = await asyncio.gather(
-                        self.pool.request(
-                            src,
-                            {
-                                "type": "data_send",
-                                "ids": sids,
-                                "keys": sorted(keys),
-                                "dst": dst,
-                                "xfer_id": xfer_id,
-                            },
-                        ),
-                        self.pool.request(
-                            dst, {"type": "data_recv", "xfer_id": xfer_id}
-                        ),
-                    )
+                    with tracer.span(
+                        "xfer:data", cat="comms",
+                        src=src, dst=dst, n=len(sids),
+                    ) as targs:
+                        send_r, recv_r = await asyncio.gather(
+                            self.pool.request(
+                                src,
+                                {
+                                    "type": "data_send",
+                                    "ids": sids,
+                                    "keys": sorted(keys),
+                                    "dst": dst,
+                                    "xfer_id": xfer_id,
+                                },
+                            ),
+                            self.pool.request(
+                                dst,
+                                {"type": "data_recv", "xfer_id": xfer_id},
+                            ),
+                        )
+                        targs["bytes"] = send_r.get("bytes", 0)
                     self._acc_xfer("data", send_r, recv_r)
         except BaseException as e:  # propagate to waiters, then re-raise
             err = e
@@ -469,13 +494,11 @@ class MasterWorker:
         )
         if splittable:
             stats_list = await self._run_mfc_split(node, batch, replicas)
-            merged: Dict[str, float] = {}
-            for st in stats_list:
-                for k, v in (st or {}).items():
-                    merged.setdefault(k, []).append(v)
-            results[node.name] = {
-                k: float(sum(v) / len(v)) for k, v in merged.items()
-            }
+            # Denominator-aware DP-head gather: token-weighted where the
+            # shards report `<key>_denominator`, mean otherwise.
+            results[node.name] = merge_stats(
+                [st or {} for st in stats_list]
+            )
         else:
             resp = await self._dispatch_mfc(
                 node, list(batch.ids), group, meta=batch
@@ -633,9 +656,14 @@ class MasterWorker:
             payload["shard_meta"] = meta.select_keys(
                 set(node.input_keys) & meta.keys
             )
-        resps = await asyncio.gather(
-            *[self.pool.request(w, payload) for w in group]
-        )
+        # Dispatch wait: uncategorized on purpose — the master is parked
+        # on worker compute here, which the worker tracks attribute.
+        with tracer.span(
+            f"mfc:{node.name}", model=str(node.model_name), n=len(ids)
+        ):
+            resps = await asyncio.gather(
+                *[self.pool.request(w, payload) for w in group]
+            )
         resp = resps[0]  # group[0] is the primary
         if resp.get("meta") is not None:
             # Every member computed (and cached) the full outputs; the
@@ -679,15 +707,16 @@ class MasterWorker:
                 self.replicas.get(target)
                 or (self._hook_target_set(target) if hook.target else group)
             )
-            await asyncio.gather(
-                *[
-                    self.pool.request(
-                        w,
-                        {"type": "offload", "model_name": target},
-                    )
-                    for w in targets
-                ]
-            )
+            with tracer.span(f"offload:{target}", cat="host"):
+                await asyncio.gather(
+                    *[
+                        self.pool.request(
+                            w,
+                            {"type": "offload", "model_name": target},
+                        )
+                        for w in targets
+                    ]
+                )
         elif isinstance(hook, ParamReallocHook):
             if (
                 self._ahead_task is not None
@@ -704,20 +733,23 @@ class MasterWorker:
                 # Colocated (same member set): every process holds both
                 # models; the copy/EMA is a local (or SPMD-collective-free)
                 # reshard on each.
-                await asyncio.gather(
-                    *[
-                        self.pool.request(
-                            w,
-                            {
-                                "type": "param_sync",
-                                "src": str(node.model_name),
-                                "dst": str(hook.target),
-                                "eta": hook.eta,
-                            },
-                        )
-                        for w in group
-                    ]
-                )
+                with tracer.span(
+                    f"param_sync:{hook.target}", cat="comms"
+                ):
+                    await asyncio.gather(
+                        *[
+                            self.pool.request(
+                                w,
+                                {
+                                    "type": "param_sync",
+                                    "src": str(node.model_name),
+                                    "dst": str(hook.target),
+                                    "eta": hook.eta,
+                                },
+                            )
+                            for w in group
+                        ]
+                    )
             else:
                 # Cross-set realloc over the transfer plane (reference:
                 # param_realloc NCCL groups, model_worker.py:1009).  EVERY
@@ -730,33 +762,41 @@ class MasterWorker:
                     range(self._xfer_id, self._xfer_id + len(target_group))
                 )
                 self._xfer_id += len(target_group)
-                resps = await asyncio.gather(
-                    *[
-                        self.pool.request(
-                            w,
-                            {
-                                "type": "param_send",
-                                "model_name": str(node.model_name),
-                                "dsts": target_group,
-                                "xfer_ids": xfer_ids,
-                                "sender": i == 0,
-                            },
-                        )
-                        for i, w in enumerate(group)
-                    ],
-                    *[
-                        self.pool.request(
-                            w,
-                            {
-                                "type": "param_recv",
-                                "model_name": str(hook.target),
-                                "xfer_id": xid,
-                                "eta": hook.eta,
-                            },
-                        )
-                        for w, xid in zip(target_group, xfer_ids)
-                    ],
-                )
+                with tracer.span(
+                    f"param_realloc:{hook.target}", cat="comms",
+                    n_dst=len(target_group),
+                ) as realloc_args:
+                    resps = await asyncio.gather(
+                        *[
+                            self.pool.request(
+                                w,
+                                {
+                                    "type": "param_send",
+                                    "model_name": str(node.model_name),
+                                    "dsts": target_group,
+                                    "xfer_ids": xfer_ids,
+                                    "sender": i == 0,
+                                },
+                            )
+                            for i, w in enumerate(group)
+                        ],
+                        *[
+                            self.pool.request(
+                                w,
+                                {
+                                    "type": "param_recv",
+                                    "model_name": str(hook.target),
+                                    "xfer_id": xid,
+                                    "eta": hook.eta,
+                                },
+                            )
+                            for w, xid in zip(target_group, xfer_ids)
+                        ],
+                    )
+                    realloc_args["bytes"] = sum(
+                        int(r.get("bytes", 0) or 0)
+                        for r in resps[: len(group)]
+                    )
                 for i, send_r in enumerate(resps[: len(group)]):
                     # Only member 0 actually sends (sender=i==0); the
                     # rest reply bytes=0 and must not bump the transfer

@@ -25,7 +25,7 @@ import queue
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
-from areal_tpu.base import logging, name_resolve, names, network
+from areal_tpu.base import logging, name_resolve, names, network, tracer
 
 logger = logging.getLogger("transfer")
 
@@ -82,17 +82,21 @@ class InProcTransfer(TransferPlane):
     def send(self, dst: int, xfer_id: int, payload: Any) -> int:
         # The object moves by reference; bytes are still COUNTED with the
         # wire encoding so in-process tests measure what a pod would ship.
-        meta, buffers = encode_oob(payload)
-        self.inboxes[dst].put((xfer_id, payload))
-        return payload_nbytes(meta, buffers)
+        with tracer.span("xfer_send", cat="comms", dst=dst) as targs:
+            meta, buffers = encode_oob(payload)
+            self.inboxes[dst].put((xfer_id, payload))
+            nbytes = payload_nbytes(meta, buffers)
+            targs["bytes"] = nbytes
+        return nbytes
 
     def recv(self, timeout: float = 300.0) -> Tuple[int, Any]:
-        try:
-            return self.inboxes[self.my_index].get(timeout=timeout)
-        except queue.Empty:
-            raise TimeoutError(
-                f"worker {self.my_index}: no transfer within {timeout}s"
-            ) from None
+        with tracer.span("xfer_recv", cat="comms"):
+            try:
+                return self.inboxes[self.my_index].get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"worker {self.my_index}: no transfer within {timeout}s"
+                ) from None
 
 
 class ZMQTransfer(TransferPlane):
@@ -132,38 +136,44 @@ class ZMQTransfer(TransferPlane):
         # Multipart zero-copy framing: frame 0 = pickle metadata, frames
         # 1.. = raw array buffers (protocol-5 out-of-band) — numpy data is
         # handed to zmq without an intermediate pickle copy.
-        meta, buffers = encode_oob((xfer_id, payload))
-        frames = [meta] + [b.raw() for b in buffers]
-        with self._lock:
-            if dst not in self._push:
-                addr = name_resolve.wait(
-                    pushpull_name(self.experiment, self.trial, dst),
-                    timeout=300,
-                )
-                s = self._ctx.socket(zmq.PUSH)
-                s.connect(addr)
-                self._push[dst] = s
-            self._push[dst].send_multipart(frames, copy=False)
-        return payload_nbytes(meta, buffers)
+        with tracer.span("xfer_send", cat="comms", dst=dst) as targs:
+            meta, buffers = encode_oob((xfer_id, payload))
+            frames = [meta] + [b.raw() for b in buffers]
+            with self._lock:
+                if dst not in self._push:
+                    addr = name_resolve.wait(
+                        pushpull_name(self.experiment, self.trial, dst),
+                        timeout=300,
+                    )
+                    s = self._ctx.socket(zmq.PUSH)
+                    s.connect(addr)
+                    self._push[dst] = s
+                self._push[dst].send_multipart(frames, copy=False)
+            nbytes = payload_nbytes(meta, buffers)
+            targs["bytes"] = nbytes
+        return nbytes
 
     def recv(self, timeout: float = 300.0) -> Tuple[int, Any]:
         import zmq
 
-        if not self._pull.poll(timeout * 1000):
-            raise TimeoutError(
-                f"worker {self.worker_index}: no transfer within {timeout}s"
+        with tracer.span("xfer_recv", cat="comms"):
+            if not self._pull.poll(timeout * 1000):
+                raise TimeoutError(
+                    f"worker {self.worker_index}: no transfer within "
+                    f"{timeout}s"
+                )
+            frames = self._pull.recv_multipart(copy=False)
+            # Reconstruct over WRITABLE bytearrays (one memcpy per buffer):
+            # arrays built over read-only zmq frame memory would diverge
+            # from the in-process plane (which delivers ordinary writable
+            # arrays) and crash any in-place consumer only on
+            # multi-process runs — exactly where CI coverage is thinnest.
+            # The send side stays zero-copy; this is the single
+            # unavoidable receive copy.
+            return pickle.loads(
+                frames[0].buffer,
+                buffers=[bytearray(f.buffer) for f in frames[1:]],
             )
-        frames = self._pull.recv_multipart(copy=False)
-        # Reconstruct over WRITABLE bytearrays (one memcpy per buffer):
-        # arrays built over read-only zmq frame memory would diverge from
-        # the in-process plane (which delivers ordinary writable arrays)
-        # and crash any in-place consumer only on multi-process runs —
-        # exactly where CI coverage is thinnest.  The send side stays
-        # zero-copy; this is the single unavoidable receive copy.
-        return pickle.loads(
-            frames[0].buffer,
-            buffers=[bytearray(f.buffer) for f in frames[1:]],
-        )
 
     def close(self) -> None:
         with self._lock:

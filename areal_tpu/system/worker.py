@@ -37,7 +37,8 @@ from areal_tpu.api.model_api import (
     OptimizerConfig,
     make_interface,
 )
-from areal_tpu.base import logging
+from areal_tpu.base import logging, tracer
+from areal_tpu.base.monitor import Timers
 from areal_tpu.base.topology import ParallelConfig, make_mesh
 from areal_tpu.models.config import ModelConfig
 
@@ -176,6 +177,10 @@ class ModelWorker:
         self.data_cache: Dict[str, SequenceSample] = {}
         self.datasets = []
         self.dataloaders = []
+        # Per-phase wall-clock marks, drained into each MFC's stats reply
+        # (time/mfc_<itype>, _cnt, _avg) so the master's per-step log shows
+        # where worker time went without a tracer attached.
+        self.timers = Timers()
         self._setup()
 
     # ---------------- setup ----------------
@@ -380,43 +385,59 @@ class ModelWorker:
         model = self.models[model_key]
         interface = self.interfaces[model_key]
         fn = getattr(interface, itype.value)
-        t0 = time.monotonic()
-        # Env-gated xprof capture per MFC (reference: REAL_DUMP_TRACE torch
-        # profiler export, model_worker.py:84-99,788-869).  Each MFC call
-        # writes a TensorBoard-viewable trace under
-        # $AREAL_DUMP_TRACE/<model>_<itype>/.
-        trace_root = os.environ.get("AREAL_DUMP_TRACE")
-        # JAX allows ONE active trace per process; concurrent MFCs (the
-        # in-process runner overlaps independent graph nodes) contend, so
-        # whoever holds the lock traces and the rest run untraced.
-        if trace_root and _TRACE_LOCK.acquire(blocking=False):
-            import jax
+        with tracer.span(f"mfc:{model_key}:{itype.value}", cat="compute") as targs:
+            with self.timers.record(f"mfc_{itype.value}"):
+                t0 = time.monotonic()
+                # Env-gated xprof capture per MFC (reference: REAL_DUMP_TRACE
+                # torch profiler export, model_worker.py:84-99,788-869).  Each
+                # MFC call writes a TensorBoard-viewable trace under
+                # $AREAL_DUMP_TRACE/<model>_<itype>/.
+                trace_root = os.environ.get("AREAL_DUMP_TRACE")
+                # JAX allows ONE active trace per process; concurrent MFCs (the
+                # in-process runner overlaps independent graph nodes) contend,
+                # so whoever holds the lock traces and the rest run untraced.
+                if trace_root and _TRACE_LOCK.acquire(blocking=False):
+                    import jax
 
-            tdir = os.path.join(
-                trace_root, f"{model_key.replace('/', '-')}_{itype.value}"
-            )
-            try:
-                with jax.profiler.trace(tdir):
+                    tdir = os.path.join(
+                        trace_root,
+                        f"{model_key.replace('/', '-')}_{itype.value}",
+                    )
+                    try:
+                        with jax.profiler.trace(tdir):
+                            result = fn(model, sample, mb_spec)
+                    finally:
+                        _TRACE_LOCK.release()
+                else:
                     result = fn(model, sample, mb_spec)
-            finally:
-                _TRACE_LOCK.release()
-        else:
-            result = fn(model, sample, mb_spec)
-        mfc_seconds = time.monotonic() - t0
-        if itype == ModelInterfaceType.GENERATE:
-            model.inc_version()  # advances the sampling seed per step
+                mfc_seconds = time.monotonic() - t0
+            if itype == ModelInterfaceType.GENERATE:
+                model.inc_version()  # advances the sampling seed per step
 
-        if isinstance(result, SequenceSample):
-            result.remap_keys_(remap_out)
-            perf = self._mfc_perf(model, itype, sample, result, mfc_seconds)
-            for one in result.unpack():
+            out_sample = result if isinstance(result, SequenceSample) else None
+            if out_sample is not None:
+                out_sample.remap_keys_(remap_out)
+            perf = self._mfc_perf(model, itype, sample, out_sample, mfc_seconds)
+            perf.update(self.timers.drain())
+            if tracer.enabled():
+                targs["mfc"] = f"{model_key}:{itype.value}"
+                key0 = next(iter(sample.keys))
+                targs["tokens"] = int(
+                    sum(sum(s) for s in sample.seqlens[key0])
+                )
+                if "perf/tflops" in perf:
+                    targs["tflops"] = perf["perf/tflops"]
+                if "perf/mfu" in perf:
+                    targs["mfu"] = perf["perf/mfu"]
+
+        if out_sample is not None:
+            for one in out_sample.unpack():
                 sid = one.ids[0]
                 if sid in self.data_cache:
                     self.data_cache[sid].update_(one)
                 else:
                     self.data_cache[sid] = one
-            return {"meta": result.meta(), "stats": perf}
-        perf = self._mfc_perf(model, itype, sample, None, mfc_seconds)
+            return {"meta": out_sample.meta(), "stats": perf}
         return {"meta": None, "stats": {**dict(result or {}), **perf}}
 
     def _mfc_perf(
@@ -721,6 +742,9 @@ class ModelWorker:
         for sid in list(self.data_cache):
             if sid not in keep:
                 del self.data_cache[sid]
+        # Once-per-step broadcast from the master: a natural trace flush
+        # point so shards stay current even if the worker later crashes.
+        tracer.flush()
         return {}
 
     def _handle_filter_dataset(self, req):

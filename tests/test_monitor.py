@@ -67,7 +67,9 @@ def test_timers_accumulate():
     with t.record("a"):
         pass
     out = t.drain()
-    assert set(out) == {"time/a"}
+    assert set(out) == {"time/a", "time/a_cnt", "time/a_avg"}
+    assert out["time/a_cnt"] == 2
+    assert out["time/a_avg"] == pytest.approx(out["time/a"] / 2)
     assert t.drain() == {}
 
 
