@@ -100,6 +100,10 @@ class APIGenerateOutput:
     output_logprobs: list  # List[List[float]]
     no_eos: list  # List[bool] — hit max_new_tokens without EOS
     version: int = 0  # server weight version that produced this
+    # Weight version sampling STARTED under (the head version): differs
+    # from `version` when an in-memory weight push interrupted and
+    # resumed this request.  Bounded-staleness admission keys on this.
+    version_start: int = 0
 
     @classmethod
     def from_input(cls, inp: "APIGenerateInput") -> "APIGenerateOutput":
@@ -117,7 +121,40 @@ class APIGenerateOutput:
         return [len(x) for x in self.output_ids]
 
 
-class LLMAPIClient:
+class BoundedAgenerateMixin:
+    """Bounds the async fan-out of `agenerate`: each call runs the
+    blocking `generate` in `asyncio.to_thread`, and an unbounded caller
+    (a rollout controller dispatching hundreds of prompts) would exhaust
+    the default thread pool and starve every other to_thread user in the
+    process.  A per-event-loop semaphore sized to the server's serving
+    capacity (`max_inflight`) caps concurrent threads per client."""
+
+    max_inflight: int = 64
+
+    def _agen_sem(self):
+        import asyncio
+
+        sems = getattr(self, "_agen_sems", None)
+        if sems is None:
+            sems = {}
+            self._agen_sems = sems
+        # asyncio primitives bind to a loop — key the cache by loop so a
+        # client shared across loops (tests, re-entrant runs) still works.
+        loop = asyncio.get_running_loop()
+        sem = sems.get(id(loop))
+        if sem is None:
+            sem = asyncio.Semaphore(max(1, int(self.max_inflight)))
+            sems[id(loop)] = sem
+        return sem
+
+    async def agenerate(self, inp: APIGenerateInput) -> APIGenerateOutput:
+        import asyncio
+
+        async with self._agen_sem():
+            return await asyncio.to_thread(self.generate, inp)
+
+
+class LLMAPIClient(BoundedAgenerateMixin):
     """Client for a GenerationServer (reference: model_api.py:83
     `LLMAPIClient` — async HTTP to SGLang; here stdlib urllib with a thread
     pool for concurrency and asyncio wrappers on top).
@@ -129,12 +166,19 @@ class LLMAPIClient:
         await client.agenerate(inp)
     """
 
-    def __init__(self, url: str, timeout_s: float = 7200.0, token: str = ""):
+    def __init__(
+        self,
+        url: str,
+        timeout_s: float = 7200.0,
+        token: str = "",
+        max_inflight: int = 64,
+    ):
         import os as _os
 
         self.url = url.rstrip("/")
         self.timeout_s = timeout_s
         self.token = token or _os.environ.get("AREAL_GEN_TOKEN", "")
+        self.max_inflight = max_inflight
 
     def _post(self, path: str, payload: Dict) -> Dict:
         import json as _json
@@ -200,6 +244,9 @@ class LLMAPIClient:
             output_logprobs=out["output_logprobs"],
             no_eos=out["no_eos"],
             version=int(out.get("version", 0)),
+            version_start=int(
+                out.get("version_start", out.get("version", 0))
+            ),
         )
 
     def generate_batch(
@@ -216,16 +263,18 @@ class LLMAPIClient:
         ) as ex:
             return list(ex.map(self.generate, inps))
 
-    async def agenerate(self, inp: APIGenerateInput) -> APIGenerateOutput:
-        import asyncio
-
-        return await asyncio.to_thread(self.generate, inp)
-
     def update_weights_from_disk(self, path: str) -> int:
         """Hot-swap server weights from an HF checkpoint dir; returns the
         new weight version (reference: sglang.py:383
         update_weights_from_disk)."""
         return int(self._post("/update_weights", {"path": path})["version"])
+
+    def pause(self) -> Dict:
+        """Interrupt in-flight decode at the next chunk boundary."""
+        return self._post("/pause", {})
+
+    def resume(self) -> Dict:
+        return self._post("/resume", {})
 
 
 class Engine(abc.ABC):

@@ -314,6 +314,9 @@ def cmd_ppo_math(args):
         offload_ref=args.offload_ref,
         gen_server_url=args.gen_server_url,
         rollout_ahead=args.rollout_ahead,
+        max_head_offpolicyness=args.max_head_offpolicyness,
+        replay_capacity=args.replay_capacity,
+        inmem_weight_sync=args.inmem_weight_sync,
         gen_backend_args=(
             {"kv_cache_dtype": args.kv_cache_dtype}
             if args.kv_cache_dtype != "auto" else {}
@@ -442,6 +445,21 @@ def main(argv=None):
     pp.add_argument("--rollout-ahead", type=int, default=0, choices=(0, 1),
                     help="1 = generate step t+1's rollouts while step t "
                          "trains (one-step-stale async rollout)")
+    pp.add_argument("--max-head-offpolicyness", type=int, default=None,
+                    help="enable the async-RL replay pipeline: keep up to "
+                         "N+1 rollout batches in flight and train only on "
+                         "batches whose head weight version lags the "
+                         "trainer by <= N (0 = bounded pipeline that "
+                         "degrades to synchronous numerics; mutually "
+                         "exclusive with --rollout-ahead)")
+    pp.add_argument("--replay-capacity", type=int, default=4,
+                    help="async RL: max resident rollout batches in the "
+                         "replay buffer (puts at capacity evict oldest)")
+    pp.add_argument("--inmem-weight-sync", action="store_true",
+                    help="decoupled serving: pause/resume generation "
+                         "around weight pushes (in-flight decodes halt at "
+                         "a chunk boundary and resume on their KV pages) "
+                         "instead of draining the server")
     pp.set_defaults(fn=cmd_ppo_math)
 
     # Install YAML defaults on whichever subcommand was chosen.

@@ -45,6 +45,12 @@ class RecoverInfo:
     interface_states: Dict[int, Dict[str, Any]] = dataclasses.field(
         default_factory=dict
     )
+    # Async RL: replay-buffer version watermarks (ReplayBuffer.watermarks())
+    # and rollout-controller state (RolloutController.state_dict(), incl.
+    # the prompt-stream cursor) — a recovered trial resumes admission and
+    # the data stream where the crashed one stopped.
+    replay_watermarks: Dict[str, int] = dataclasses.field(default_factory=dict)
+    rollout_state: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 def recover_root(fileroot: str, experiment_name: str, trial_name: str) -> str:
@@ -66,7 +72,20 @@ def load(root: str) -> Optional[RecoverInfo]:
     if not os.path.exists(path):
         return None
     with open(path, "rb") as f:
-        return pickle.load(f)
+        info = pickle.load(f)
+    # Pickles from before a field was added restore without it (pickle
+    # replays __dict__, not __init__) — backfill defaults so old recover
+    # files keep loading.
+    for fld in dataclasses.fields(RecoverInfo):
+        if not hasattr(info, fld.name):
+            setattr(
+                info,
+                fld.name,
+                fld.default_factory()
+                if fld.default_factory is not dataclasses.MISSING
+                else fld.default,
+            )
+    return info
 
 
 def discover_ckpt(ckpt_root: str) -> Optional[str]:

@@ -24,6 +24,7 @@ InflightBatchingGenerator, real_llm_generate.py:670).
 import dataclasses
 import functools
 import os
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -54,6 +55,46 @@ def _cache_nbytes(cache) -> int:
         if a is not None:
             total += a.size * a.dtype.itemsize
     return total
+
+
+@dataclasses.dataclass
+class _PagedGenSession:
+    """Parked state of an interrupted plain-paged inflight generate call.
+
+    Everything the chunk loop carries between iterations, host AND device
+    side, so `resume_generate()` can replay each live slot's last chunk
+    under fresh weights and continue exactly where the loop stopped.  The
+    PRNG key rides along and the replay consumes no keys, so an
+    interrupted-then-resumed run under unchanged weights is token-
+    identical to an uninterrupted one."""
+
+    gconfig: GenerationHyperparameters
+    key: Any  # jax PRNG key (chunk-split chain continues on resume)
+    results: Dict
+    n_slots: int
+    n_pages: int
+    max_pages: int
+    chunk_t: int
+    alloc: PageAllocator
+    pool: Any  # device PagedKVCache
+    logits_buf: Any  # device [n_slots, vocab] f32
+    cache_len: np.ndarray
+    gen_count: np.ndarray
+    done_host: np.ndarray
+    active: List[Optional[Tuple[int, int]]]
+    toks_acc: Dict[int, List[int]]
+    logps_acc: Dict[int, List[float]]
+    pending: List
+    # Per-slot prompt tokens + last chunk's emission count — together they
+    # define the tail to replay on resume (history = prompt + toks_acc).
+    slot_prompt: Dict[int, np.ndarray]
+    last_emit: np.ndarray
+    # Assembly context, filled by generate() at park time so
+    # resume_generate() can return a finished SequenceSample.
+    sample: Any = None
+    prompt_key: str = "packed_prompts"
+    prompt_lens: Any = None
+    n: int = 1
 
 
 def _spec_emit(
@@ -228,7 +269,38 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         self.decode_compiles = 0
         self.cache_copy_bytes = 0
         self.last_pool_stats: Dict[str, Any] = {}
+        # Interruptible generation (async RL): interrupt() makes the
+        # plain-paged inflight loop park at its next chunk boundary
+        # (generate() then returns None); resume_generate() replays each
+        # live slot's last chunk under the CURRENT weights — rewriting
+        # the tail KV on its already-mapped pages and refreshing the
+        # next-token logits — then continues the loop.  The other decode
+        # paths (dense, spec, static) ignore the event and run to
+        # completion, so a weight push there degrades to a full drain.
+        self._interrupt_evt = threading.Event()
+        self._session: Optional[_PagedGenSession] = None
+        self.resume_replays = 0
+        # Load gauges read racily by gen_server /health for queue-depth-
+        # aware balancing: slots live in the current chunk loop and the
+        # last sampled KV-pool utilization.
+        self.live_slots = 0
+        self.kv_utilization = 0.0
         self.set_params(params)
+
+    # ---------------- interruption (async weight sync) ----------------
+
+    def interrupt(self) -> None:
+        """Request the running generate() to park at the next chunk
+        boundary.  Safe from any thread; a no-op for non-paged paths."""
+        self._interrupt_evt.set()
+
+    def clear_interrupt(self) -> None:
+        self._interrupt_evt.clear()
+
+    @property
+    def interrupted(self) -> bool:
+        """True iff a parked session is waiting for resume_generate()."""
+        return self._session is not None
 
     @property
     def page_budget_tokens(self) -> Optional[int]:
@@ -343,6 +415,11 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         """
         self._ensure_loaded()
         self._require_params()
+        if self._session is not None:
+            raise RuntimeError(
+                "an interrupted generation is parked; call "
+                "resume_generate() before starting a new one"
+            )
         self.prefill_dispatches = 0
         self.decode_compiles = 0
         self.cache_copy_bytes = 0
@@ -392,6 +469,16 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 self._generate_inflight(
                     [reqs[j] for j in order], gconfig, key, results
                 )
+                if self._session is not None:
+                    # Parked on interrupt: stash the assembly context so
+                    # resume_generate() can finish the call.  None tells
+                    # the caller no sample was produced yet.
+                    st = self._session
+                    st.sample = sample
+                    st.prompt_key = prompt_key
+                    st.prompt_lens = prompt_lens
+                    st.n = n
+                    return None
             else:
                 for start in range(0, len(order), b_cap):
                     chunk = [reqs[j] for j in order[start : start + b_cap]]
@@ -399,6 +486,91 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                     self._generate_chunk(chunk, gconfig, sub, results)
 
             return self._assemble(sample, prompt_key, prompt_lens, results, n)
+
+    def resume_generate(self) -> Optional[SequenceSample]:
+        """Continue a parked generate() under the engine's CURRENT
+        weights.  Re-prefills only each live slot's last chunk of tokens
+        (teacher-forced through its existing page table, overwriting the
+        tail KV in place and refreshing the next-token logits), then
+        re-enters the chunk loop — so a weight push costs one chunk of
+        forward, not a drain + full re-prefill.  Returns the finished
+        SequenceSample, or None if interrupted again."""
+        st = self._session
+        if st is None:
+            raise RuntimeError("no interrupted generation to resume")
+        self._ensure_loaded()
+        self._require_params()
+        self._session = None
+        live = [s for s in range(st.n_slots) if st.active[s] is not None]
+        if live:
+            Q = st.chunk_t
+            tokens = np.full((st.n_slots, Q), self.pad_token_id, np.int32)
+            positions = np.zeros((st.n_slots, Q), np.int32)
+            write_pos0 = np.zeros((st.n_slots,), np.int32)
+            take_idx = np.zeros((st.n_slots,), np.int32)
+            live_mask = np.zeros((st.n_slots,), bool)
+            for s in live:
+                hist = np.concatenate(
+                    [st.slot_prompt[s], np.asarray(st.toks_acc[s], np.int32)]
+                )
+                L = int(st.cache_len[s])  # == len(hist): one KV per token
+                # Replay window: the last chunk's emissions (>= 1 so the
+                # fresh logits always come from a real forward).  Padding
+                # columns write at positions < the slot's pre-interrupt
+                # reservation and are overwritten by the next decode
+                # chunk — harmless by the same argument as done-row
+                # rewrites in the decode step.
+                r = int(min(max(int(st.last_emit[s]), 1), Q, L))
+                tokens[s, :r] = hist[L - r :]
+                write_pos0[s] = L - r
+                positions[s] = (L - r) + np.arange(Q)
+                take_idx[s] = r - 1
+                live_mask[s] = True
+            with tracer.span("resume_replay", cat="compute", n=len(live)):
+                st.logits_buf, st.pool = self._get_paged_replay_fn(
+                    st.n_slots, st.n_pages, st.max_pages, st.chunk_t
+                )(
+                    self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                    st.pool, jnp.asarray(st.alloc.table),
+                    jnp.asarray(write_pos0), st.logits_buf,
+                    jnp.asarray(take_idx), jnp.asarray(live_mask),
+                )
+        self.resume_replays += 1
+        if not self._run_paged_loop(st):
+            return None
+        return self._assemble(
+            st.sample, st.prompt_key, st.prompt_lens, st.results, st.n
+        )
+
+    def _get_paged_replay_fn(
+        self, n_slots: int, n_pages: int, max_pages: int, chunk_t: int
+    ):
+        """Teacher-forced tail replay for resume: Q history tokens per
+        row forwarded through the existing page table (KV overwritten in
+        place), next-token logits taken at each row's last valid query.
+        Inactive rows carry sentinel tables, so their writes drop and
+        their (garbage) logits are masked out by live_mask."""
+        sig = ("paged_replay", n_slots, n_pages, max_pages, chunk_t)
+        if sig in self._gen_fns:
+            return self._gen_fns[sig]
+        cfg = self.cfg
+
+        @functools.partial(jax.jit, donate_argnums=(3, 6))
+        def fn(params, tokens, positions, pool, page_table, write_pos0,
+               logits_buf, take_idx, live_mask):
+            logits_all, pool = tfm.decode_step_spec_paged(
+                params, cfg, tokens, positions, pool, page_table, write_pos0
+            )
+            fresh = jnp.take_along_axis(
+                logits_all, take_idx[:, None, None], axis=1
+            )[:, 0]
+            logits_buf = jnp.where(
+                live_mask[:, None], fresh.astype(logits_buf.dtype), logits_buf
+            )
+            return logits_buf, pool
+
+        self._gen_fns[sig] = fn
+        return fn
 
     # -- continuous batching (inflight refill) --
 
@@ -561,10 +733,9 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             if active[s] is None and pending:
                 i, rep, toks = pending.pop()
                 admits.append((s, i, rep, toks))
+        self.live_slots = sum(a is not None for a in active) + len(admits)
         tracer.counter(
-            "gen_slots",
-            live=sum(a is not None for a in active) + len(admits),
-            pending=len(pending),
+            "gen_slots", live=self.live_slots, pending=len(pending)
         )
         return admits
 
@@ -731,6 +902,8 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         st["live_tokens"] += int(live_tokens)
         st["allocated_tokens"] += int(allocated_tokens)
         st["utilization"] = st["live_tokens"] / max(st["allocated_tokens"], 1)
+        # Instantaneous utilization, exposed through gen_server /health.
+        self.kv_utilization = int(live_tokens) / max(int(allocated_tokens), 1)
         # Per-chunk sampled gauge: KV pool pressure over time in the trace.
         tracer.counter(
             "kv_pool",
@@ -764,81 +937,123 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         # writes land up to chunk_t past the pre-chunk live length).
         max_pages = -(-(max_prompt + gconfig.max_new_tokens + chunk_t) // ps)
         n_pages = self.kv_pool_pages or n_slots * max_pages
-        alloc = PageAllocator(n_pages, ps, n_slots, max_pages)
-        pool = tfm.init_paged_kv_cache(
-            self.cfg, n_pages, ps, dtype=self._paged_kv_dtype()
+        st = _PagedGenSession(
+            gconfig=gconfig,
+            key=key,
+            results=results,
+            n_slots=n_slots,
+            n_pages=n_pages,
+            max_pages=max_pages,
+            chunk_t=chunk_t,
+            alloc=PageAllocator(n_pages, ps, n_slots, max_pages),
+            pool=tfm.init_paged_kv_cache(
+                self.cfg, n_pages, ps, dtype=self._paged_kv_dtype()
+            ),
+            logits_buf=jnp.zeros((n_slots, self.cfg.vocab_size), jnp.float32),
+            cache_len=np.zeros((n_slots,), np.int32),
+            gen_count=np.zeros((n_slots,), np.int32),
+            done_host=np.ones((n_slots,), bool),
+            active=[None] * n_slots,
+            toks_acc={},
+            logps_acc={},
+            pending=list(reversed(reqs)),
+            slot_prompt={},
+            last_emit=np.zeros((n_slots,), np.int32),
         )
-        decode_fn = self._get_paged_decode_fn(
-            n_slots, n_pages, max_pages, chunk_t, gconfig
-        )
-        logits_buf = jnp.zeros((n_slots, self.cfg.vocab_size), jnp.float32)
-        cache_len = np.zeros((n_slots,), np.int32)
-        gen_count = np.zeros((n_slots,), np.int32)
-        done_host = np.ones((n_slots,), bool)
-        active: List[Optional[Tuple[int, int]]] = [None] * n_slots
-        toks_acc: Dict[int, List[int]] = {}
-        logps_acc: Dict[int, List[float]] = {}
-        pending = list(reversed(reqs))
+        self._run_paged_loop(st)
 
-        while pending or any(a is not None for a in active):
+    def _run_paged_loop(self, st: "_PagedGenSession") -> bool:
+        """The plain-paged chunk loop, interruptible at chunk boundaries:
+        checks the interrupt event at the top of every iteration and
+        parks the whole session (device pool + host bookkeeping) when
+        set.  Returns True when all requests finished, False when
+        parked (self._session then holds the state for
+        resume_generate())."""
+        gconfig = st.gconfig
+        alloc = st.alloc
+        n_slots, ps, chunk_t = st.n_slots, alloc.page_size, st.chunk_t
+        decode_fn = self._get_paged_decode_fn(
+            n_slots, st.n_pages, st.max_pages, chunk_t, gconfig
+        )
+        while st.pending or any(a is not None for a in st.active):
+            if self._interrupt_evt.is_set():
+                self._session = st
+                tracer.counter(
+                    "gen_interrupt",
+                    parked_live=sum(a is not None for a in st.active),
+                    parked_pending=len(st.pending),
+                )
+                return False
             admits = self._take_admits_paged(
-                active, pending, n_slots, alloc, chunk_t
+                st.active, st.pending, n_slots, alloc, chunk_t
             )
             if admits:
                 rows, plens, slots, page_rows = self._pack_admits_paged(
                     admits, n_slots, alloc
                 )
                 with tracer.span("prefill", cat="compute", n=len(admits)):
-                    logits_buf, pool = self._get_prefill_pages_fn()(
+                    st.logits_buf, st.pool = self._get_prefill_pages_fn()(
                         self.params, jnp.asarray(rows), jnp.asarray(plens),
-                        pool, logits_buf, jnp.asarray(slots),
+                        st.pool, st.logits_buf, jnp.asarray(slots),
                         jnp.asarray(page_rows),
                     )
                 self.prefill_dispatches += 1
                 for s, i, rep, toks in admits:
-                    cache_len[s] = len(toks)
-                    gen_count[s] = 0
-                    done_host[s] = False
-                    active[s] = (i, rep)
-                    toks_acc[s] = []
-                    logps_acc[s] = []
+                    st.cache_len[s] = len(toks)
+                    st.gen_count[s] = 0
+                    st.done_host[s] = False
+                    st.active[s] = (i, rep)
+                    st.toks_acc[s] = []
+                    st.logps_acc[s] = []
+                    st.slot_prompt[s] = np.asarray(toks, np.int32)
 
             # Map pages covering the next chunk for every live slot —
             # the jitted chunk must never need a page the table lacks.
             # This is the paged replacement for _grow_kv_cache: an int
             # append on the host, no device copy, no recompile.
             for s in range(n_slots):
-                if active[s] is not None:
-                    alloc.reserve(s, int(cache_len[s]) + chunk_t)
+                if st.active[s] is not None:
+                    alloc.reserve(s, int(st.cache_len[s]) + chunk_t)
             self._accum_pool_stats(
-                "paged", int(cache_len.sum()), alloc.allocated_pages() * ps
+                "paged", int(st.cache_len.sum()), alloc.allocated_pages() * ps
             )
 
-            key, sub = jax.random.split(key)
+            st.key, sub = jax.random.split(st.key)
+            prev_gen = st.gen_count.copy()
             with tracer.span("decode_chunk", cat="compute", t=chunk_t):
                 (
-                    out_toks, out_logps, logits_buf, pool,
+                    out_toks, out_logps, st.logits_buf, st.pool,
                     new_cache_len, new_gen_count, new_done,
                 ) = decode_fn(
-                    self.params, pool, logits_buf, jnp.asarray(alloc.table),
-                    jnp.asarray(cache_len), jnp.asarray(gen_count),
-                    jnp.asarray(done_host), sub,
+                    self.params, st.pool, st.logits_buf,
+                    jnp.asarray(alloc.table), jnp.asarray(st.cache_len),
+                    jnp.asarray(st.gen_count), jnp.asarray(st.done_host),
+                    sub,
                 )
                 out_toks = to_host(out_toks)
                 out_logps = to_host(out_logps)
-            cache_len = to_host(new_cache_len).copy()
-            gen_count = to_host(new_gen_count).copy()
+            st.cache_len = to_host(new_cache_len).copy()
+            st.gen_count = to_host(new_gen_count).copy()
+            # Tokens each slot emitted THIS chunk = the tail a resume
+            # must replay under fresh weights.
+            st.last_emit = st.gen_count - prev_gen
+
+            def _retire(s):
+                alloc.release(s)
+                st.slot_prompt.pop(s, None)
 
             self._drain_chunk_outputs(
-                out_toks, out_logps, to_host(new_done), active, toks_acc,
-                logps_acc, results, done_host, cache_len,
-                gconfig.max_new_tokens, on_retire=alloc.release,
+                out_toks, out_logps, to_host(new_done), st.active,
+                st.toks_acc, st.logps_acc, st.results, st.done_host,
+                st.cache_len, gconfig.max_new_tokens, on_retire=_retire,
             )
         self.last_pool_stats.update(
-            pool_pages=n_pages, page_size=ps,
+            pool_pages=st.n_pages, page_size=ps,
             pages_recycled=alloc.pages_recycled,
             peak_pages_used=alloc.peak_pages_used,
         )
+        self.live_slots = 0
+        return True
 
     def _take_admits_paged(self, active, pending, n_slots, alloc, slack):
         """`_take_admits` against the page budget: a request is admitted
@@ -865,10 +1080,9 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 s for s in range(n_slots) if active[s] is None
             )
             alloc.reserve(free_slot, len(pending[-1][2]) + slack)  # raises
+        self.live_slots = sum(a is not None for a in active) + len(admits)
         tracer.counter(
-            "gen_slots",
-            live=sum(a is not None for a in active) + len(admits),
-            pending=len(pending),
+            "gen_slots", live=self.live_slots, pending=len(pending)
         )
         return admits
 

@@ -113,6 +113,25 @@ def check_ppo_math(cfg) -> None:
         _fail("fuse_rew_ref needs a ref model")
     if cfg.rollout_ahead not in (0, 1):
         _fail(f"rollout_ahead must be 0 or 1, got {cfg.rollout_ahead}")
+    mho = getattr(cfg, "max_head_offpolicyness", None)
+    if mho is not None:
+        if mho < 0:
+            _fail(
+                f"max_head_offpolicyness must be >= 0, got {mho}"
+            )
+        if cfg.rollout_ahead > 0:
+            # Both knobs claim ownership of the prefetch pipeline; the
+            # async-RL replay path subsumes rollout_ahead=1 (it is
+            # max_head_offpolicyness=0 plus admission control).
+            _fail(
+                "max_head_offpolicyness and rollout_ahead are mutually "
+                "exclusive (async RL replaces the one-step-ahead path)"
+            )
+    if getattr(cfg, "replay_capacity", 4) < 1:
+        _fail(
+            f"replay_capacity must be >= 1, got "
+            f"{getattr(cfg, 'replay_capacity', 4)}"
+        )
     if cfg.gen_server_url and getattr(cfg, "gen_backend_args", None):
         # Decoupled serving builds a weightless remote_generator backend;
         # local GeneratorEngine kwargs would be silently ignored — the
@@ -143,16 +162,18 @@ def check_ppo_math(cfg) -> None:
             "gen_server_url (configure the standalone gen_server "
             "instead)"
         )
-    if cfg.rollout_ahead > 0 and getattr(
+    if (cfg.rollout_ahead > 0 or mho is not None) and getattr(
         cfg, "gen_backend_args", {}
     ).get("donation_safe_swap") is False:
         # The copy-free hot-swap aliases the train master's buffers; with
-        # one-step-ahead rollout the generator DECODES while the optimizer
-        # donates those buffers — a use-after-free, not a memory tradeoff.
+        # one-step-ahead rollout OR async-RL prefetch the generator
+        # DECODES while the optimizer donates those buffers — a
+        # use-after-free, not a memory tradeoff.
         _fail(
             "donation_safe_swap=False requires synchronous rollout "
-            "(rollout_ahead=0): async generation would decode from "
-            "buffers the optimizer step donates"
+            "(rollout_ahead=0 and no max_head_offpolicyness): async "
+            "generation would decode from buffers the optimizer step "
+            "donates"
         )
     if cfg.dataset_filter:
         lo = cfg.dataset_filter.get("min_accuracy", 0.0)

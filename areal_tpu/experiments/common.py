@@ -59,6 +59,11 @@ class ExperimentPlan:
     # Asynchronous rollout: generate step t+1's rollouts while step t
     # trains (one-step-stale behavior policy; see master._execute_step_async).
     rollout_ahead: int = 0
+    # Asynchronous RL (staleness-bounded pipeline, replay-buffer-driven;
+    # see master._execute_step_async_rl).  None = off.
+    max_head_offpolicyness: Optional[int] = None
+    replay_capacity: int = 4
+    buffer_max_age_steps: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -187,6 +192,27 @@ class PPOMathConfig:
     # Asynchronous rollout: overlap next-step generation with training
     # (one-step-stale behavior policy, PPO-ratio-corrected).
     rollout_ahead: int = 0
+    # Asynchronous RL (AReaL-style, arxiv 2505.24298): keep
+    # max_head_offpolicyness + 1 rollout batches in flight, admit them to
+    # training through a staleness-bounded replay buffer, and correct the
+    # off-policy gap with decoupled PPO (behav_imp_weight_cap is wired
+    # into the actor interface automatically when the cap is > 0).
+    # 0 = bounded pipeline that degrades to synchronous ordering.
+    # None = async RL off.  Mutually exclusive with rollout_ahead.
+    max_head_offpolicyness: Optional[int] = None
+    # Replay capacity in batches for the async-RL pipeline.
+    replay_capacity: int = 4
+    # Importance-weight cap for decoupled PPO; tokens whose behavior
+    # weight exceeds it are masked out.  Only applied when
+    # max_head_offpolicyness > 0 (at 0 the plain PPO loss keeps exact
+    # synchronous numerics).  ppo_kwargs["behav_imp_weight_cap"] wins.
+    behav_imp_weight_cap: float = 5.0
+    # Interruptible weight sync for gen_server_url trials: pause the
+    # servers at a chunk boundary around each weight push instead of
+    # draining in-flight requests (GenerationServer pause/resume;
+    # interrupted requests resume on their existing KV pages).  The
+    # in-process path always hot-swaps in memory.
+    inmem_weight_sync: bool = False
     # Extra GeneratorEngine kwargs (e.g. max_decode_batch, or forcing
     # donation_safe_swap — config check rejects the alias mode under
     # rollout_ahead>0).  Defaults supplied by build_ppo_math win unless
@@ -283,6 +309,7 @@ def _remote_gen_shard(cfg: "PPOMathConfig", actor_gen, actor_if):
                     if u.strip()
                 ],
                 "model_type": model_type,
+                "inmem_sync": cfg.inmem_weight_sync,
             },
         ),
         interface=actor_if,
@@ -306,6 +333,12 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
 
     ppo_kwargs = dict(cfg.ppo_kwargs)
     ppo_kwargs.setdefault("disable_value", disable_value)
+    if (cfg.max_head_offpolicyness or 0) > 0:
+        # Off-policy samples are admissible -> decoupled PPO corrects for
+        # them.  At cap 0 the plain loss keeps exact synchronous numerics.
+        ppo_kwargs.setdefault(
+            "behav_imp_weight_cap", cfg.behav_imp_weight_cap
+        )
     use_dense = bool(ppo_kwargs.get("use_dense_reward"))
     if use_dense and cfg.reward_interface is None:
         raise ValueError(
@@ -528,7 +561,11 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                 backend=ModelBackendAbstraction(
                     "generator",
                     {
-                        "donation_safe_swap": cfg.rollout_ahead > 0,
+                        # Both async modes decode while the optimizer step
+                        # donates the train buffers -> the generator MUST
+                        # keep its defensive copy.
+                        "donation_safe_swap": cfg.rollout_ahead > 0
+                        or cfg.max_head_offpolicyness is not None,
                         "kv_paged": cfg.kv_paged,
                         "kv_page_size": cfg.kv_page_size,
                         "kv_pool_pages": cfg.kv_pool_pages,
@@ -617,6 +654,8 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
         model_replicas=replicas or None,
         difficulty_filter=cfg.dataset_filter,
         rollout_ahead=cfg.rollout_ahead,
+        max_head_offpolicyness=cfg.max_head_offpolicyness,
+        replay_capacity=cfg.replay_capacity,
     )
 
 
@@ -656,6 +695,9 @@ def run_experiment(plan: ExperimentPlan, tokenizer=None):
         model_replicas=plan.model_replicas,
         difficulty_filter=plan.difficulty_filter,
         rollout_ahead=plan.rollout_ahead,
+        max_head_offpolicyness=plan.max_head_offpolicyness,
+        replay_capacity=plan.replay_capacity,
+        buffer_max_age_steps=plan.buffer_max_age_steps,
     )
     master.load_recover_info()
     stats = asyncio.run(master.run())

@@ -38,31 +38,53 @@ logger = logging.getLogger("ppo")
 # ---------------- jit loss fns (module-level: stable cache keys) ----------------
 
 
-def _ppo_actor_loss_factory(eps_clip: float):
+def _ppo_actor_loss_factory(
+    eps_clip: float, behav_imp_weight_cap: Optional[float] = None
+):
+    """With `behav_imp_weight_cap` set, this is the DECOUPLED PPO objective
+    (reference: ppo_functional.actor_loss_fn `proximal_logprobs` branch +
+    arxiv 2505.24298 §4.2): the proximal policy (recomputed under the
+    weights at train-step start) anchors the clipped ratio, while the
+    behavior policy (the generator that sampled the tokens, possibly
+    several versions old) enters as an importance weight
+    exp(prox_logp - old_logp) on the per-token loss.  Tokens whose
+    behavior weight exceeds the cap are masked out entirely — the
+    variance-control rule AReaL uses instead of truncating the weight."""
+    decoupled = behav_imp_weight_cap is not None
+
     def loss_fn(new_logp, batch):
         # `new_logp`: the engine's fused per-token next-token logprobs [B,S].
         mask = batch["loss_mask"] > 0
         old_logp = batch["old_logp"]
         adv = batch["advantages"]
-        ratio = jnp.exp(jnp.where(mask, new_logp - old_logp, 0.0))
+        prox_logp = batch["prox_logp"] if decoupled else old_logp
+        ratio = jnp.exp(jnp.where(mask, new_logp - prox_logp, 0.0))
         clipped = jnp.clip(ratio, 1.0 - eps_clip, 1.0 + eps_clip)
         pg = -jnp.minimum(ratio * adv, clipped * adv)
+        stats = {}
+        if decoupled:
+            behav = jnp.exp(jnp.where(mask, prox_logp - old_logp, 0.0))
+            capped = mask & (behav > behav_imp_weight_cap)
+            pg = pg * jnp.where(capped, 0.0, behav)
+            stats["behav_imp_weight_sum"] = jnp.where(mask, behav, 0.0).sum()
+            stats["behav_cap_clip_sum"] = capped.sum().astype(jnp.float32)
         loss = jnp.where(mask, pg, 0.0).sum()
         n_clipped = (
             jnp.where(mask, (ratio * adv > clipped * adv), False)
         ).sum()
         approx_kl = jnp.where(mask, old_logp - new_logp, 0.0).sum()
-        return loss, {
-            "actor_loss_sum": loss,
-            "importance_weight_sum": jnp.where(mask, ratio, 0.0).sum(),
-            "clip_ratio_sum": n_clipped.astype(jnp.float32),
-            "approx_kl_sum": approx_kl,
+        stats.update(
+            actor_loss_sum=loss,
+            importance_weight_sum=jnp.where(mask, ratio, 0.0).sum(),
+            clip_ratio_sum=n_clipped.astype(jnp.float32),
+            approx_kl_sum=approx_kl,
             # |adv| rides the device stats (not host numpy) so the value
             # is exact under sharded dispatch, where host arrays are
             # zero-filled for other members' rows but the placed batch is
             # globally real.
-            "advantage_abs_sum": jnp.where(mask, jnp.abs(adv), 0.0).sum(),
-        }
+            advantage_abs_sum=jnp.where(mask, jnp.abs(adv), 0.0).sum(),
+        )
+        return loss, stats
 
     return loss_fn
 
@@ -231,6 +253,14 @@ class PPOActorInterface(ModelInterface):
     # reward_delta uses consecutive-score differences (potential shaping).
     use_dense_reward: bool = False
     reward_delta: bool = True
+    # Decoupled PPO for asynchronous RL (reference: ppo_functional.py
+    # `proximal_logprobs` + behav_imp_weight_cap): when set, the proximal
+    # policy is recomputed under the CURRENT weights at train-step start
+    # and anchors the clipped ratio; the behavior (generator) logprobs
+    # enter as an importance weight capped at this value (tokens above
+    # the cap are masked out).  None = standard PPO — exactly today's
+    # numerics, which is what `max_head_offpolicyness=0` configures.
+    behav_imp_weight_cap: Optional[float] = None
 
     def _kl(self):
         if getattr(self, "_kl_inst", None) is None:
@@ -329,6 +359,23 @@ class PPOActorInterface(ModelInterface):
 
         # --- behavior logprobs, ref logprobs, values: full-length aligned
         old_logp = _seq_align_minus1(sample, "packed_logprobs")
+        # Decoupled PPO: one extra forward pass under the CURRENT weights
+        # gives the proximal logprobs.  Runs before any update so all
+        # minibatches share the same anchor (reference recomputes in the
+        # inference MFC; here train_step owns it so the sync path pays
+        # nothing when the cap is unset).
+        prox_logp = None
+        if self.behav_imp_weight_cap is not None:
+            prox_out = model.engine.forward(
+                sample.select_keys({"packed_input_ids"}),
+                mb_spec,
+                post_fn=_logprob_post,
+                output_key="prox_logp",
+                token_key="packed_input_ids",
+            )
+            prox_logp = np.asarray(
+                prox_out.data["prox_logp"], np.float32
+            )
         ref_logp = (
             _seq_align_minus1(sample, "packed_ref_logprobs")
             if "packed_ref_logprobs" in sample.keys
@@ -510,14 +557,16 @@ class PPOActorInterface(ModelInterface):
         train_sample = sample.select_keys(
             {"packed_input_ids", "prompt_mask"}
         )
-        _add_aligned_keys(
-            train_sample,
-            {
-                "old_logp": old_logp,
-                "advantages": adv_full,
-                "loss_mask": loss_mask,
-            },
-        )
+        aligned = {
+            "old_logp": old_logp,
+            "advantages": adv_full,
+            "loss_mask": loss_mask,
+        }
+        extra_keys = ("old_logp", "advantages", "loss_mask")
+        if prox_logp is not None:
+            aligned["prox_logp"] = prox_logp
+            extra_keys = extra_keys + ("prox_logp",)
+        _add_aligned_keys(train_sample, aligned)
 
         loss_fn = self._get_loss_fn()
         all_stats = []
@@ -532,7 +581,7 @@ class PPOActorInterface(ModelInterface):
                 loss_fn=loss_fn,
                 loss_weight_fn=_mask_count,
                 token_key="packed_input_ids",
-                extra_keys=("old_logp", "advantages", "loss_mask"),
+                extra_keys=extra_keys,
                 version_steps=model.version,
             )
             all_stats.append(stats)
@@ -592,7 +641,11 @@ class PPOActorInterface(ModelInterface):
     def _get_loss_fn(self):
         if self._loss_fn_cache is None:
             object.__setattr__(
-                self, "_loss_fn_cache", _ppo_actor_loss_factory(self.eps_clip)
+                self,
+                "_loss_fn_cache",
+                _ppo_actor_loss_factory(
+                    self.eps_clip, self.behav_imp_weight_cap
+                ),
             )
         return self._loss_fn_cache
 

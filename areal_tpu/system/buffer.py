@@ -25,25 +25,71 @@ class _Entry:
 
 
 class SequenceBuffer:
-    def __init__(self, consumers: Dict[str, Sequence[str]]):
+    def __init__(
+        self,
+        consumers: Dict[str, Sequence[str]],
+        max_age_steps: Optional[int] = None,
+    ):
         """consumers: rpc_name -> its input keys (to know who must consume
-        an entry before eviction)."""
+        an entry before eviction).  `max_age_steps` bounds how many master
+        steps an entry may sit unconsumed: anything older is evicted on the
+        next put (counted in `evicted_aged`) — stragglers from long-dead
+        steps never reach an MFC."""
         self._entries: Dict[str, _Entry] = {}
         self._consumers = {k: tuple(v) for k, v in consumers.items()}
         self._cond = asyncio.Condition()
+        self.max_age_steps = max_age_steps
+        self._step = 0
+        self.evicted_aged = 0
 
     def __len__(self):
         return len(self._entries)
 
     async def put_batch(self, sample: SequenceSample, step: int = 0) -> None:
         async with self._cond:
+            self._step = max(self._step, step)
             for one in sample.unpack():
                 (sid,) = one.ids
                 if sid in self._entries:
                     self._entries[sid].sample.update_(one)
                 else:
                     self._entries[sid] = _Entry(sample=one, birth_step=step)
+            self._evict_aged()
             self._cond.notify_all()
+
+    def staleness_histogram(self) -> Dict[int, int]:
+        """Resident-entry count by age (current step - birth_step)."""
+        hist: Dict[int, int] = {}
+        for e in self._entries.values():
+            age = self._step - e.birth_step
+            hist[age] = hist.get(age, 0) + 1
+        return hist
+
+    def stats(self) -> Dict[str, int]:
+        """Per-step occupancy snapshot (logged by the master each step)."""
+        hist = self.staleness_histogram()
+        return {
+            "size": len(self._entries),
+            "evicted_aged": self.evicted_aged,
+            "max_age": max(hist) if hist else 0,
+        }
+
+    def _evict_aged(self):
+        if self.max_age_steps is None:
+            return
+        dead = [
+            sid
+            for sid, e in self._entries.items()
+            if self._step - e.birth_step > self.max_age_steps
+        ]
+        for sid in dead:
+            del self._entries[sid]
+            self.evicted_aged += 1
+        if dead:
+            logger.warning(
+                f"evicted {len(dead)} entries older than "
+                f"{self.max_age_steps} steps"
+            )
 
     async def amend_batch(self, sample: SequenceSample) -> None:
         """Merge new keys produced by an MFC into existing entries."""
@@ -55,6 +101,14 @@ class SequenceBuffer:
                 else:
                     self._entries[sid].sample.update_(one)
             self._cond.notify_all()
+
+    async def drop_ids(self, ids: Sequence[str]) -> None:
+        """Remove entries outright — async-RL batches rejected or aged out
+        by the replay buffer's staleness rule must vanish from the ledger
+        too, or a downstream MFC would train on them."""
+        async with self._cond:
+            for sid in ids:
+                self._entries.pop(sid, None)
 
     def _ready_ids(self, rpc: MFCDef) -> List[str]:
         need = set(rpc.input_keys)

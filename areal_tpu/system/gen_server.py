@@ -48,6 +48,7 @@ from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model_api import (
     APIGenerateInput,
     APIGenerateOutput,
+    BoundedAgenerateMixin,
     Engine,
     GenerationHyperparameters,
     LLMAPIClient,
@@ -114,6 +115,14 @@ class GenerationServer:
         # must run wholly under one weight version, and its outputs must be
         # stamped with that version.
         self._engine_lock = threading.Lock()
+        # pause/resume control (async RL): pause() interrupts the engine
+        # at its next chunk boundary; the parked _run_subgroup releases
+        # the engine lock and waits here until resume().
+        self._pause_evt = threading.Event()
+        self._resume_cond = threading.Condition()
+        # Serializes in-memory weight pushes (each is pause→swap→resume).
+        self._update_mutex = threading.Lock()
+        self.inmem_updates = 0
 
         srv = self
 
@@ -131,9 +140,7 @@ class GenerationServer:
 
             def do_GET(self):
                 if self.path == "/health":
-                    self._send(
-                        200, {"status": "ok", "version": srv.version}
-                    )
+                    self._send(200, srv.health_info())
                 else:
                     self._send(404, {"error": "unknown path"})
 
@@ -150,6 +157,16 @@ class GenerationServer:
                         self._send(200, srv._handle_generate(req))
                     elif self.path == "/update_weights":
                         self._send(200, srv._handle_update(req))
+                    elif self.path == "/pause":
+                        srv.pause()
+                        self._send(
+                            200, {"paused": True, "version": srv.version}
+                        )
+                    elif self.path == "/resume":
+                        srv.resume()
+                        self._send(
+                            200, {"paused": False, "version": srv.version}
+                        )
                     else:
                         self._send(404, {"error": "unknown path"})
                 except Exception as e:  # noqa: BLE001 — report to client
@@ -245,8 +262,16 @@ class GenerationServer:
                     return
                 cmd = req.get("cmd")
                 if cmd == "health":
+                    reply(ident, rid, self.health_info())
+                elif cmd == "pause":
+                    self.pause()
                     reply(ident, rid, {
-                        "status": "ok", "version": self.version,
+                        "paused": True, "version": self.version,
+                    })
+                elif cmd == "resume":
+                    self.resume()
+                    reply(ident, rid, {
+                        "paused": False, "version": self.version,
                     })
                 elif cmd == "generate":
                     p = _Pending(
@@ -313,6 +338,68 @@ class GenerationServer:
             except Exception:  # noqa: BLE001
                 pass
         router.close(linger=200)
+
+    # ---------------- pause / resume / in-memory weight sync ----------------
+
+    def health_info(self) -> Dict:
+        """Liveness + the load signals a rollout controller balances on:
+        collector queue depth, slots live in the current decode loop, and
+        KV-pool utilization (all racily read — gauges, not invariants)."""
+        eng = self.engine
+        return {
+            "status": "ok",
+            "version": self.version,
+            "queue_depth": self._queue.qsize(),
+            "live_slots": int(getattr(eng, "live_slots", 0)),
+            "kv_utilization": float(getattr(eng, "kv_utilization", 0.0)),
+            "capacity": int(getattr(eng, "max_decode_batch", 0) or 0),
+            "paused": self._pause_evt.is_set(),
+        }
+
+    def pause(self) -> None:
+        """Stop decoding at the next chunk boundary: the in-flight
+        generate call parks (releasing the engine lock) and new batches
+        wait until resume().  Engines without interrupt support simply
+        drain their current call first."""
+        self._pause_evt.set()
+        if hasattr(self.engine, "interrupt"):
+            self.engine.interrupt()
+
+    def resume(self) -> None:
+        self._pause_evt.clear()
+        if hasattr(self.engine, "clear_interrupt"):
+            self.engine.clear_interrupt()
+        with self._resume_cond:
+            self._resume_cond.notify_all()
+
+    def update_weights_inmem(self, params) -> int:
+        """Interruptible in-memory weight push (async RL): pause at a
+        chunk boundary, hot-swap the given params pytree directly into
+        the engine (no disk checkpoint), bump the version, resume —
+        interrupted requests continue on their existing KV pages, so the
+        push costs one chunk of replay instead of a full drain.  Python
+        API only: a params pytree cannot ship over the JSON transports."""
+        with self._update_mutex:
+            self.pause()
+            try:
+                with self._engine_lock:
+                    self.engine.set_params(params)
+                    self.version += 1
+                    self.inmem_updates += 1
+                    v = self.version
+            finally:
+                self.resume()
+        logger.info(f"weights updated in memory -> version {v}")
+        return v
+
+    def _await_resume(self) -> None:
+        """Block a parked _run_subgroup until resume() (engine lock NOT
+        held by the caller — the weight swap needs it)."""
+        while self._pause_evt.is_set():
+            if self._stop.is_set():
+                raise RuntimeError("generation server shutting down")
+            with self._resume_cond:
+                self._resume_cond.wait(timeout=0.2)
 
     # ---------------- request handling ----------------
 
@@ -466,15 +553,32 @@ class GenerationServer:
             # Uncategorized on purpose: the engine's own compute spans
             # attribute the time; this shows engine-lock wait + call shape.
             with tracer.span("gen_batch", n_reqs=len(group)):
-                with self._engine_lock:
-                    version = self.version
+                self._engine_lock.acquire()
+                locked = True
+                try:
+                    version_start = self.version
                     out = self.engine.generate(
                         sample, MicroBatchSpec(), g, seed=seed
                     )
+                    while out is None:
+                        # Parked by pause(): free the engine for the
+                        # weight swap, wait for resume(), continue the
+                        # interrupted decode on its existing KV pages.
+                        self._engine_lock.release()
+                        locked = False
+                        self._await_resume()
+                        self._engine_lock.acquire()
+                        locked = True
+                        out = self.engine.resume_generate()
+                    version = self.version
+                finally:
+                    if locked:
+                        self._engine_lock.release()
             per_id = {s.ids[0]: s for s in out.unpack()}
             for uid, p in zip(uids, group):
                 p.result = _extract_output(
-                    per_id[uid], len(p.prompt_ids), g.n, version
+                    per_id[uid], len(p.prompt_ids), g.n, version,
+                    version_start,
                 )
         except Exception as e:  # noqa: BLE001 — fail the whole group
             logger.error(f"generation batch failed: {e!r}")
@@ -501,7 +605,8 @@ class GenerationServer:
 
 
 def _extract_output(
-    s: SequenceSample, prompt_len: int, n: int, version: int
+    s: SequenceSample, prompt_len: int, n: int, version: int,
+    version_start: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Slice one request's SequenceSample (GeneratorEngine._assemble
     layout) back into API JSON: per-response generated ids + logprobs."""
@@ -526,10 +631,14 @@ def _extract_output(
         "output_logprobs": out_lps,
         "no_eos": [bool(x) for x in noe[:n]],
         "version": version,
+        # Head version: the weights sampling STARTED under — what
+        # bounded-staleness admission keys on (an interrupted request
+        # finishes under a newer version than it started).
+        "version_start": version if version_start is None else version_start,
     }
 
 
-class ZMQGenClient:
+class ZMQGenClient(BoundedAgenerateMixin):
     """High-throughput client for a GenerationServer's ZMQ transport.
 
     One DEALER connection pipelines any number of in-flight requests
@@ -537,11 +646,18 @@ class ZMQGenClient:
     connection, unlike the HTTP path's urllib fan-out.  Same surface as
     LLMAPIClient where RemoteGeneratorEngine needs it."""
 
-    def __init__(self, url: str, timeout_s: float = 7200.0, token: str = ""):
+    def __init__(
+        self,
+        url: str,
+        timeout_s: float = 7200.0,
+        token: str = "",
+        max_inflight: int = 64,
+    ):
         assert url.startswith("zmq://"), url
         self.url = url
         self.timeout_s = timeout_s
         self.token = token or os.environ.get("AREAL_GEN_TOKEN", "")
+        self.max_inflight = max_inflight
         # ZMQ sockets are not thread-safe, so ONE IO thread owns the
         # DEALER; callers enqueue frames and wait on per-rid futures.  A
         # simple send+recv-under-lock design would serialize CONCURRENT
@@ -722,6 +838,9 @@ class ZMQGenClient:
                 output_logprobs=out["output_logprobs"],
                 no_eos=out["no_eos"],
                 version=int(out.get("version", 0)),
+                version_start=int(
+                    out.get("version_start", out.get("version", 0))
+                ),
             )
             for inp, out in zip(inps, outs)
         ]
@@ -729,14 +848,15 @@ class ZMQGenClient:
     def generate(self, inp: APIGenerateInput) -> APIGenerateOutput:
         return self.generate_batch([inp])[0]
 
-    async def agenerate(self, inp: APIGenerateInput) -> APIGenerateOutput:
-        import asyncio
-
-        return await asyncio.to_thread(self.generate, inp)
-
     def update_weights_from_disk(self, path: str) -> int:
         out = self._call_many([{"cmd": "update_weights", "path": path}])[0]
         return int(out["version"])
+
+    def pause(self) -> Dict:
+        return self._call_many([{"cmd": "pause"}])[0]
+
+    def resume(self) -> Dict:
+        return self._call_many([{"cmd": "resume"}])[0]
 
 
 def make_gen_client(url: str, **kw):
@@ -759,8 +879,13 @@ class RemoteGeneratorEngine(Engine):
         url,  # str | List[str] — one client per serving rank
         model_type: str = "qwen2",
         sync_dir: Optional[str] = None,
+        # Interruptible weight sync (async RL): pause the servers at a
+        # chunk boundary around the push, so a sync costs one chunk of
+        # decode latency instead of a full drain of in-flight requests.
+        inmem_sync: bool = False,
     ):
         self.cfg = cfg
+        self.inmem_sync = inmem_sync
         # Multiple URLs = the reference's one-server-per-DP-rank shape
         # (sglang.py:161-226): prompts round-robin across servers, weight
         # updates broadcast to all.
@@ -841,11 +966,23 @@ class RemoteGeneratorEngine(Engine):
         # load, not one per serving rank.
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(len(self.clients)) as pool:
-            list(pool.map(
-                lambda c: c.update_weights_from_disk(self.sync_dir),
-                self.clients,
-            ))
+        if self.inmem_sync:
+            # Interrupt in-flight decode at the next chunk boundary; the
+            # parked requests resume on their existing KV pages under the
+            # new weights (version_start keeps their head stamp).  Without
+            # this the update waits for a full drain of the engine.
+            for c in self.clients:
+                c.pause()
+        try:
+            with ThreadPoolExecutor(len(self.clients)) as pool:
+                list(pool.map(
+                    lambda c: c.update_weights_from_disk(self.sync_dir),
+                    self.clients,
+                ))
+        finally:
+            if self.inmem_sync:
+                for c in self.clients:
+                    c.resume()
 
 
 register_backend(

@@ -8,6 +8,7 @@ to the worker hosting its model, and amends the buffer with the outputs.
 """
 
 import asyncio
+import collections
 import contextvars
 import dataclasses
 import os
@@ -20,6 +21,7 @@ from areal_tpu.base import logging, recover, timeutil, tracer
 from areal_tpu.base.monitor import StatsLogger
 from areal_tpu.base.stats import merge_stats
 from areal_tpu.system.buffer import SequenceBuffer
+from areal_tpu.system.replay import ReplayBuffer, Trajectory
 
 logger = logging.getLogger("master")
 
@@ -100,6 +102,20 @@ class MasterWorker:
         # Step wall-clock becomes ~max(gen, train) instead of gen + train
         # on disjoint gen/train placements.
         rollout_ahead: int = 0,
+        # Asynchronous RL (reference: AReaL's bounded-staleness pipeline,
+        # arxiv 2505.24298): when set, K = max_head_offpolicyness + 1
+        # rollout batches stay outstanding, each stamped with the trainer
+        # version at generation start, and the trainer consumes them
+        # through a staleness-bounded ReplayBuffer.  0 degrades to the
+        # synchronous ordering (one batch generated and consumed inside
+        # each step).  Mutually exclusive with rollout_ahead.
+        max_head_offpolicyness: Optional[int] = None,
+        # Replay capacity in BATCHES for the async-RL pipeline (clamped
+        # below to at least K so admission, not capacity, rules).
+        replay_capacity: int = 4,
+        # Evict SequenceBuffer entries older than this many steps (async
+        # stragglers from long-dead batches); None = keep forever.
+        buffer_max_age_steps: Optional[int] = None,
     ):
         self.dfg = dfg
         self.pool = pool
@@ -117,7 +133,8 @@ class MasterWorker:
         self.trial_name = trial_name
 
         self.buffer = SequenceBuffer(
-            consumers={n.name: n.input_keys for n in dfg.nodes}
+            consumers={n.name: n.input_keys for n in dfg.nodes},
+            max_age_steps=buffer_max_age_steps,
         )
         self.step_info = recover.StepInfo()
         self.save_ctl = timeutil.FrequencyControl(
@@ -147,10 +164,50 @@ class MasterWorker:
         if rollout_ahead not in (0, 1):
             raise ValueError(
                 "rollout_ahead supports 0 (synchronous) or 1 (one-step "
-                "overlap); deeper pipelines would need staleness control "
-                "beyond the PPO ratio"
+                "overlap); deeper pipelines need the staleness-bounded "
+                "async-RL mode (max_head_offpolicyness)"
             )
         self.rollout_ahead = rollout_ahead
+        self._async_rl = max_head_offpolicyness is not None
+        self.max_head_offpolicyness = (
+            int(max_head_offpolicyness) if self._async_rl else 0
+        )
+        if self._async_rl:
+            if rollout_ahead:
+                raise ValueError(
+                    "rollout_ahead and max_head_offpolicyness are mutually "
+                    "exclusive (async RL subsumes the one-step overlap)"
+                )
+            if self.max_head_offpolicyness < 0:
+                raise ValueError(
+                    "max_head_offpolicyness must be >= 0, got "
+                    f"{self.max_head_offpolicyness}"
+                )
+        self._async_K = self.max_head_offpolicyness + 1
+        self._replay_dropped: List[Trajectory] = []
+        self.replay: Optional[ReplayBuffer] = (
+            ReplayBuffer(
+                capacity=max(int(replay_capacity), self._async_K),
+                max_head_offpolicyness=self.max_head_offpolicyness,
+                on_drop=self._replay_dropped.append,
+            )
+            if self._async_rl
+            else None
+        )
+        # Completed train steps == the weight version rollout batches are
+        # stamped against.
+        self._trainer_version = 0
+        self._ahead_queue: "collections.deque[asyncio.Task]" = (
+            collections.deque()
+        )
+        self._batches_launched = 0
+        self._batch_seq = 0
+        # Serialize dataset fetches and generator occupancy across
+        # concurrently-outstanding prefetch tasks (the in-process workers
+        # have no internal locking; two generates on one engine would
+        # race).  Created lazily — asyncio primitives want a running loop.
+        self._fetch_lock: Optional[asyncio.Lock] = None
+        self._gen_lock: Optional[asyncio.Lock] = None
         # Prefetchable sources: GENERATE nodes fed purely by the dataset.
         self._source_nodes = [
             n
@@ -251,7 +308,9 @@ class MasterWorker:
         # live dict (wall-clock attribution — a transfer counts toward the
         # step during which it actually moved bytes).
         self._xfer_acc.clear()
-        if self.rollout_ahead > 0 and self._source_nodes:
+        if self._async_rl and self._source_nodes:
+            await self._execute_step_async_rl(results)
+        elif self.rollout_ahead > 0 and self._source_nodes:
             await self._execute_step_async(results)
         else:
             coros = [self._load_data()]
@@ -273,6 +332,8 @@ class MasterWorker:
                 merged[f"{name}/{k}" if len(results) > 1 else k] = v
         for k, v in self._xfer_acc.items():
             merged[f"transfer/{k}"] = v
+        for k, v in self.buffer.stats().items():
+            merged[f"buffer/{k}"] = float(v)
         return merged
 
     async def _execute_step_async(self, results: Dict) -> None:
@@ -322,20 +383,155 @@ class MasterWorker:
         finally:
             _IN_PREFETCH.reset(token)
 
-    async def _load_data(self):
-        with tracer.span("load_data", cat="host"):
-            resps = await asyncio.gather(
-                *[
-                    self.pool.request(w, {"type": "fetch"})
-                    for w in self.data_worker_ids
-                ]
+    # ---------------- asynchronous RL (staleness-bounded pipeline) ------
+
+    def _topup_prefetch(self) -> None:
+        """Keep at most K = max_head_offpolicyness + 1 rollout batches
+        launched AHEAD of consumption (trainer_version counts consumed
+        batches: one per step).  The n-th batch then launches no earlier
+        than step n-K, stamps a head version >= n-K, and FIFO consumption
+        reads it at step n-1 — staleness <= K-1 = the cap, so admission
+        never rejects in steady state and K=1 degrades to the synchronous
+        generate-then-train ordering.  Bounding by queue length instead
+        would relaunch a full step early (the queue drains at step START,
+        before this step's weight update) and stamp a version that is
+        cap+1 stale at consumption."""
+        limit = self._trainer_version + self._async_K
+        if self._total_steps is not None:
+            limit = min(limit, self._total_steps)
+        while self._batches_launched < limit:
+            self._batches_launched += 1
+            self._ahead_queue.append(
+                asyncio.create_task(self._prefetch_rollout_batch())
             )
-            for w, r in zip(self.data_worker_ids, resps):
-                meta = r["meta"]
-                self._record_owner(meta, w)
-                await self.buffer.put_batch(
-                    meta, step=self.step_info.global_step
+
+    async def _prefetch_rollout_batch(self):
+        """One stamped rollout batch: fetch a dataset batch, then run the
+        source GENERATE nodes once the (serialized) generator frees up.
+        The trainer version at GENERATION START is the head-version stamp
+        the replay buffer's admission rule keys on — a weight sync landing
+        mid-generation does not change the stamp, mirroring the gen
+        server's interruptible in-memory push where the tail of a request
+        decodes under newer weights than its head."""
+        if self._gen_lock is None:
+            self._gen_lock = asyncio.Lock()
+        ids = await self._load_data()
+        token = _IN_PREFETCH.set(True)
+        try:
+            results: Dict[str, Dict[str, float]] = {}
+            async with self._gen_lock:
+                v0 = self._trainer_version
+                await asyncio.gather(
+                    *[self._run_mfc(n, results) for n in self._source_nodes]
                 )
+            return results, v0, ids
+        finally:
+            _IN_PREFETCH.reset(token)
+
+    async def _execute_step_async_rl(self, results: Dict) -> None:
+        """One step of the replay-buffer-driven pipeline (reference:
+        AReaL's asynchronous RL, arxiv 2505.24298 §4.1).
+
+        Unlike rollout_ahead, weight syncs (the train node's realloc
+        post-hook) apply WITHOUT draining the pipeline: a batch
+        mid-generation keeps its head-version stamp and finishes under
+        the new weights; decoupled PPO (behav_imp_weight_cap on the actor
+        interface) corrects the off-policy gap admission lets through.
+        With max_head_offpolicyness=0, exactly one batch is generated and
+        consumed inside each step — today's synchronous ordering and
+        numerics."""
+        self._topup_prefetch()
+        while self.replay is None or len(self.replay) == 0:
+            if not self._ahead_queue:
+                raise RuntimeError(
+                    "async_rl: replay buffer empty with no rollout batches "
+                    "in flight (admission rejected everything?)"
+                )
+            gen_stats, v0, ids = await self._ahead_queue.popleft()
+            self._topup_prefetch()
+            self._batch_seq += 1
+            traj = Trajectory(
+                qid=f"rollout_batch{self._batch_seq}",
+                prompt_ids=[],
+                output_ids=[],
+                output_logprobs=[],
+                no_eos=[],
+                version_start=v0,
+                version_end=self._trainer_version,
+                data={"stats": gen_stats, "ids": ids},
+            )
+            if not self.replay.put(traj):
+                logger.warning(
+                    f"async_rl: rejected {traj.qid} (head version {v0} vs "
+                    f"trainer {self._trainer_version}, cap "
+                    f"{self.max_head_offpolicyness})"
+                )
+                await self._drop_batch_ids(ids)
+                # The rejected batch will never be consumed: release its
+                # launch slot so a replacement (stamped with the CURRENT
+                # version) can keep the step budget whole.
+                self._batches_launched -= 1
+                self._topup_prefetch()
+        # Resident => returns immediately; FIFO gives the oldest
+        # admissible batch.
+        traj = self.replay.get_batch(1, timeout=0)[0]
+        await self._flush_replay_drops()
+        staleness = traj.staleness(self._trainer_version)
+        results.update(traj.data["stats"])
+        rest = [n for n in self.dfg.nodes if n not in self._source_nodes]
+        await asyncio.gather(*[self._run_mfc(n, results) for n in rest])
+        self._trainer_version += 1
+        self.replay.set_version(self._trainer_version)
+        await self._flush_replay_drops()
+        wm = self.replay.watermarks()
+        results["replay"] = {
+            "staleness": float(staleness),
+            "size": float(wm["size"]),
+            "in_flight_batches": float(len(self._ahead_queue)),
+            "accepted": float(wm["accepted"]),
+            "rejected": float(wm["rejected"]),
+            "dropped_stale": float(wm["dropped_stale"]),
+            "evicted": float(wm["evicted"]),
+        }
+
+    async def _flush_replay_drops(self) -> None:
+        """Purge the ledger entries of batches the replay buffer discarded
+        (capacity eviction or aged past the cap) via its on_drop hook."""
+        if not self._replay_dropped:
+            return
+        dropped, self._replay_dropped = self._replay_dropped, []
+        for traj in dropped:
+            await self._drop_batch_ids((traj.data or {}).get("ids") or [])
+
+    async def _drop_batch_ids(self, ids: List[str]) -> None:
+        if not ids:
+            return
+        await self.buffer.drop_ids(ids)
+        for sid in ids:
+            self._owners.pop(sid, None)
+
+    async def _load_data(self) -> List[str]:
+        if self._fetch_lock is None:
+            self._fetch_lock = asyncio.Lock()
+        ids: List[str] = []
+        # The lock keeps concurrently-outstanding async-RL prefetches from
+        # racing two `next()` calls on one dataloader iterator.
+        async with self._fetch_lock:
+            with tracer.span("load_data", cat="host"):
+                resps = await asyncio.gather(
+                    *[
+                        self.pool.request(w, {"type": "fetch"})
+                        for w in self.data_worker_ids
+                    ]
+                )
+                for w, r in zip(self.data_worker_ids, resps):
+                    meta = r["meta"]
+                    self._record_owner(meta, w)
+                    await self.buffer.put_batch(
+                        meta, step=self.step_info.global_step
+                    )
+                    ids.extend(meta.ids)
+        return ids
 
     def _record_owner(self, meta, worker: int, replace: bool = False):
         for sid in meta.ids:
@@ -481,8 +677,11 @@ class MasterWorker:
             await self._run_hook(hook, node, group)
         if (
             self.rollout_ahead == 0
+            and not self._async_rl
             and node.interface_type == ModelInterfaceType.TRAIN_STEP
         ):
+            # Skipped in async modes: a prefetch may be mid-generation on
+            # the aliased weights while this node trains.
             await self._release_aliased_generators(node)
         replicas = self.replicas.get(str(node.model_name))
         splittable = (
@@ -847,6 +1046,15 @@ class MasterWorker:
         )
 
     async def _clear_worker_caches(self):
+        if self._fetch_lock is None:
+            self._fetch_lock = asyncio.Lock()
+        async with self._fetch_lock:
+            await self._clear_worker_caches_locked()
+
+    async def _clear_worker_caches_locked(self):
+        # Under _fetch_lock: an async-RL prefetch's fetch registers its ids
+        # in the buffer inside the same critical section, so the keep-set
+        # snapshot below can never miss data already cached on a worker.
         keep = list(self.buffer._entries.keys())
         keep_set = set(keep)
         for sid in list(self._owners):
@@ -937,6 +1145,19 @@ class MasterWorker:
                     if s["states"]
                 },
                 used_data_ids=list(self._filtered_ids),
+                replay_watermarks=(
+                    self.replay.watermarks()
+                    if self.replay is not None
+                    else {}
+                ),
+                rollout_state=(
+                    {
+                        "trainer_version": self._trainer_version,
+                        "batch_seq": self._batch_seq,
+                    }
+                    if self._async_rl
+                    else {}
+                ),
             )
             recover.dump(
                 info,
@@ -1040,3 +1261,20 @@ class MasterWorker:
                 for w, states in iface_states.items()
             ]
         )
+        if self._async_rl:
+            # Resume admission where the crashed trial stopped: version
+            # watermarks + counters from the replay buffer, the pipeline
+            # cursor rewound to consumed batches (in-flight prefetches
+            # died with the process — one lost batch per outstanding
+            # prefetch, the async-RL recover tradeoff).
+            wm = getattr(info, "replay_watermarks", None) or {}
+            if wm:
+                self.replay.load_watermarks(wm)
+            rs = getattr(info, "rollout_state", None) or {}
+            self._trainer_version = int(
+                rs.get("trainer_version", self.step_info.global_step)
+            )
+            self._batch_seq = int(rs.get("batch_seq", 0))
+            if self.replay.version < self._trainer_version:
+                self.replay.set_version(self._trainer_version)
+            self._batches_launched = self.step_info.global_step

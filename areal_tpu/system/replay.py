@@ -1,0 +1,258 @@
+"""Staleness-bounded replay buffer for asynchronous RL.
+
+The decoupling point between the rollout plane and the trainer
+(reference: AReaL's `max_head_offpolicyness` admission rule,
+realhf/system/rollout_worker.py + arxiv 2505.24298 §4.1): trajectories
+arrive stamped with the weight version they *started* sampling under
+(head version); the trainer advances its own version as it steps.  A
+trajectory is admissible iff
+
+    trainer_version - traj.version_start <= max_head_offpolicyness
+
+Admission control rejects trajectories that are already too stale when
+they arrive, and `get()` re-checks on the way out so entries that aged
+past the cap while queued are dropped rather than trained on.  With
+``max_head_offpolicyness=0`` only trajectories sampled under the
+current weights are ever returned — the synchronous regime.
+
+Thread-safe: the rollout plane puts from asyncio/executor threads while
+the trainer gets from its own loop.  Occupancy by staleness offset is
+exported as tracer gauges (``replay_buffer`` / ``replay_staleness``
+counter tracks) so a Perfetto timeline shows how off-policy the stream
+runs.
+"""
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from areal_tpu.base import tracer
+
+
+@dataclasses.dataclass
+class Trajectory:
+    """One rollout group (a prompt and its ``n`` responses) with the
+    weight-version stamps the async plane keys on."""
+
+    qid: str
+    prompt_ids: list  # List[int]
+    output_ids: list  # List[List[int]]
+    output_logprobs: list  # List[List[float]]
+    no_eos: list  # List[bool]
+    version_start: int = 0  # weight version when sampling STARTED (head)
+    version_end: int = 0  # weight version when sampling finished
+    birth_time: float = 0.0
+    # Arbitrary payload (e.g. the reward row, or a prebuilt
+    # SequenceSample) — the buffer never inspects it.
+    data: Any = None
+
+    def staleness(self, trainer_version: int) -> int:
+        return trainer_version - self.version_start
+
+
+class StaleTrajectoryError(ValueError):
+    pass
+
+
+class ReplayBuffer:
+    """FIFO buffer with bounded-staleness admission and capacity eviction.
+
+    Args:
+        capacity: max resident trajectories; a put at capacity evicts the
+            oldest (counted in ``evicted``).
+        max_head_offpolicyness: admission cap on
+            ``trainer_version - version_start``.  0 = synchronous.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 128,
+        max_head_offpolicyness: int = 0,
+        on_drop: Optional[Callable[[Trajectory], None]] = None,
+    ):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        if max_head_offpolicyness < 0:
+            raise ValueError(
+                f"max_head_offpolicyness must be >= 0, got {max_head_offpolicyness}"
+            )
+        self.capacity = capacity
+        self.max_head_offpolicyness = max_head_offpolicyness
+        # Called for every trajectory the buffer discards WITHOUT handing
+        # it to the trainer (capacity eviction or aged past the cap) —
+        # owners use it to release side-band state (e.g. the master drops
+        # the batch's SequenceBuffer entries).  Runs with the buffer lock
+        # held: must be cheap and must not call back into the buffer.
+        self.on_drop = on_drop
+        self._entries: List[Trajectory] = []
+        self._cond = threading.Condition()
+        self._version = 0
+        # Monotonic counters (survive into watermarks()).
+        self.accepted = 0
+        self.rejected = 0
+        self.evicted = 0  # capacity evictions
+        self.dropped_stale = 0  # aged past the cap while queued
+        self.consumed = 0
+
+    # ---------------- trainer side ----------------
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def set_version(self, v: int) -> None:
+        """Trainer advances its weight version.  Entries that aged past
+        the cap are purged immediately so occupancy gauges stay honest."""
+        with self._cond:
+            if v < self._version:
+                raise ValueError(
+                    f"version must be monotonic: {v} < {self._version}"
+                )
+            self._version = v
+            self._purge_stale_locked()
+            self._emit_gauges_locked()
+            self._cond.notify_all()
+
+    def get_batch(
+        self, n: int, timeout: Optional[float] = None
+    ) -> List[Trajectory]:
+        """Block until ``n`` admissible trajectories are resident; return
+        the oldest ``n`` (FIFO).  Raises TimeoutError on expiry."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                self._purge_stale_locked()
+                if len(self._entries) >= n:
+                    out = self._entries[:n]
+                    del self._entries[:n]
+                    self.consumed += n
+                    self._emit_gauges_locked()
+                    return out
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"replay buffer: waited {timeout}s for {n} "
+                            f"admissible trajectories, have {len(self._entries)}"
+                        )
+                    self._cond.wait(timeout=remaining)
+                else:
+                    self._cond.wait(timeout=1.0)
+
+    # ---------------- rollout side ----------------
+
+    def put(self, traj: Trajectory, strict: bool = False) -> bool:
+        """Admit a trajectory.  Returns False (or raises when ``strict``)
+        if its head version lags the trainer by more than the cap."""
+        with self._cond:
+            if traj.staleness(self._version) > self.max_head_offpolicyness:
+                self.rejected += 1
+                self._emit_gauges_locked()
+                if strict:
+                    raise StaleTrajectoryError(
+                        f"trajectory {traj.qid}: version_start="
+                        f"{traj.version_start} lags trainer version "
+                        f"{self._version} by more than "
+                        f"max_head_offpolicyness={self.max_head_offpolicyness}"
+                    )
+                return False
+            if not traj.birth_time:
+                traj.birth_time = time.monotonic()
+            while len(self._entries) >= self.capacity:
+                old = self._entries.pop(0)
+                self.evicted += 1
+                if self.on_drop is not None:
+                    self.on_drop(old)
+            self._entries.append(traj)
+            self.accepted += 1
+            self._emit_gauges_locked()
+            self._cond.notify_all()
+            return True
+
+    def can_accept(self, version_start: Optional[int] = None) -> bool:
+        """Backpressure probe: True iff a put would neither evict nor be
+        rejected.  The rollout controller polls this before dispatching."""
+        with self._cond:
+            if len(self._entries) >= self.capacity:
+                return False
+            if version_start is not None and (
+                self._version - version_start > self.max_head_offpolicyness
+            ):
+                return False
+            return True
+
+    # ---------------- introspection ----------------
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._entries)
+
+    def staleness_histogram(self) -> Dict[int, int]:
+        """Occupancy by staleness offset (trainer_version - version_start)."""
+        with self._cond:
+            hist: Dict[int, int] = {}
+            for t in self._entries:
+                off = t.staleness(self._version)
+                hist[off] = hist.get(off, 0) + 1
+            return hist
+
+    def watermarks(self) -> Dict[str, int]:
+        """Version watermarks + counters, persisted in RecoverInfo so a
+        restarted trial resumes admission where it left off."""
+        with self._cond:
+            versions = [t.version_start for t in self._entries]
+            return {
+                "version": self._version,
+                "size": len(self._entries),
+                "min_version": min(versions) if versions else self._version,
+                "max_version": max(versions) if versions else self._version,
+                "accepted": self.accepted,
+                "rejected": self.rejected,
+                "evicted": self.evicted,
+                "dropped_stale": self.dropped_stale,
+                "consumed": self.consumed,
+            }
+
+    def load_watermarks(self, wm: Dict[str, int]) -> None:
+        with self._cond:
+            self._version = int(wm.get("version", 0))
+            self.accepted = int(wm.get("accepted", 0))
+            self.rejected = int(wm.get("rejected", 0))
+            self.evicted = int(wm.get("evicted", 0))
+            self.dropped_stale = int(wm.get("dropped_stale", 0))
+            self.consumed = int(wm.get("consumed", 0))
+            self._cond.notify_all()
+
+    # ---------------- internals (lock held) ----------------
+
+    def _purge_stale_locked(self) -> None:
+        keep = []
+        for t in self._entries:
+            if t.staleness(self._version) > self.max_head_offpolicyness:
+                self.dropped_stale += 1
+                if self.on_drop is not None:
+                    self.on_drop(t)
+            else:
+                keep.append(t)
+        self._entries = keep
+
+    def _emit_gauges_locked(self) -> None:
+        tracer.counter(
+            "replay_buffer",
+            size=len(self._entries),
+            capacity=self.capacity,
+            accepted=self.accepted,
+            rejected=self.rejected,
+            evicted=self.evicted,
+            dropped_stale=self.dropped_stale,
+        )
+        hist: Dict[int, int] = {}
+        for t in self._entries:
+            off = t.staleness(self._version)
+            hist[off] = hist.get(off, 0) + 1
+        if hist:
+            tracer.counter(
+                "replay_staleness",
+                **{f"off{k}": v for k, v in sorted(hist.items())},
+            )

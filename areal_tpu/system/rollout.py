@@ -1,0 +1,267 @@
+"""Rollout controller: the actor plane of asynchronous RL.
+
+Continuously pulls prompts from a data stream and fans them out to
+generation servers (reference: AReaL's rollout worker +
+`GenerationServer` pairing, realhf/system/rollout_worker.py; the
+Podracer "actor plane", arxiv 2104.06272):
+
+- **Queue-depth-aware load balancing**: each dispatch picks the client
+  whose server reports the least load (collector queue depth + live
+  decode slots from the enriched ``/health``) plus the controller's own
+  not-yet-acknowledged dispatches to it — the cached health signal is
+  refreshed at a bounded rate so balancing never becomes a health-poll
+  storm.
+- **Version stamping**: every trajectory records the weight version it
+  STARTED sampling under (``version_start``, the head version) and the
+  one it finished under — bounded-staleness admission in the
+  ``ReplayBuffer`` keys on the head version.
+- **Backpressure**: when the replay buffer cannot accept (at capacity),
+  the controller stops pulling prompts instead of overrunning the
+  buffer and evicting samples the trainer never saw.
+- **Bounded fan-out**: a controller-level semaphore caps in-flight
+  dispatches, on top of each client's per-loop ``agenerate`` bound.
+
+The ``cursor`` (prompts consumed from the stream) is persisted in
+``RecoverInfo`` so a recovered trial resumes the stream where it
+stopped instead of re-sampling consumed prompts.
+"""
+
+import asyncio
+import dataclasses
+import time
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
+
+from areal_tpu.api.model_api import APIGenerateInput, GenerationHyperparameters
+from areal_tpu.base import logging, tracer
+from areal_tpu.system.replay import ReplayBuffer, Trajectory
+
+logger = logging.getLogger("rollout")
+
+
+@dataclasses.dataclass
+class RolloutStat:
+    """Reference: AReaL's RolloutStat (submitted/accepted/running)."""
+
+    submitted: int = 0
+    completed: int = 0
+    accepted: int = 0
+    rejected: int = 0
+    failed: int = 0
+    in_flight: int = 0
+    backpressure_waits: int = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+
+def _normalize_prompt(item, cursor: int):
+    """Accept (qid, prompt_ids) pairs, {"qid", "prompt_ids"} dicts, or
+    bare token lists (qid auto-assigned from the cursor)."""
+    if isinstance(item, dict):
+        return str(item.get("qid", f"prompt{cursor}")), list(
+            map(int, item["prompt_ids"])
+        )
+    if (
+        isinstance(item, (tuple, list))
+        and len(item) == 2
+        and isinstance(item[0], str)
+    ):
+        return item[0], list(map(int, item[1]))
+    return f"prompt{cursor}", [int(t) for t in item]
+
+
+class RolloutController:
+    """Pumps a prompt stream through gen servers into a ReplayBuffer."""
+
+    def __init__(
+        self,
+        clients: Sequence[Any],  # LLMAPIClient / ZMQGenClient-compatible
+        replay: ReplayBuffer,
+        gconfig: GenerationHyperparameters,
+        seed: Optional[int] = None,
+        max_concurrency: int = 0,  # 0 = sum of client capacities
+        health_refresh_s: float = 0.5,
+        backpressure_poll_s: float = 0.05,
+        autosize_inflight: bool = True,
+    ):
+        if not clients:
+            raise ValueError("rollout controller needs at least one client")
+        self.clients = list(clients)
+        self.replay = replay
+        self.gconfig = gconfig
+        self.seed = seed
+        self.health_refresh_s = health_refresh_s
+        self.backpressure_poll_s = backpressure_poll_s
+        # When True, each health poll resizes the client's agenerate
+        # bound to the server-reported decode capacity; False keeps the
+        # client's own max_inflight (e.g. to oversubscribe the collector
+        # queue on purpose).
+        self.autosize_inflight = autosize_inflight
+        self.stat = RolloutStat()
+        # Prompts consumed from the data stream since trial start
+        # (persisted via state_dict -> RecoverInfo).
+        self.cursor = 0
+        self._skip_on_run = 0
+        self._stop = False
+        self._health: List[Dict] = [{} for _ in self.clients]
+        self._health_ts = 0.0
+        # Dispatches sent but not yet completed, per client — the live
+        # correction on top of the (staler) polled queue depth.
+        self._local_load = [0] * len(self.clients)
+        cap = max_concurrency or sum(
+            max(1, int(getattr(c, "max_inflight", 1))) for c in self.clients
+        )
+        self._sem = asyncio.Semaphore(cap)
+        self.max_concurrency = cap
+
+    # ---------------- recover ----------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"cursor": self.cursor, "stat": self.stat.as_dict()}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.cursor = int(sd.get("cursor", 0))
+        st = sd.get("stat", {})
+        for k, v in st.items():
+            if hasattr(self.stat, k) and k != "in_flight":
+                setattr(self.stat, k, int(v))
+        self.stat.in_flight = 0
+        # On the next run(), fast-forward the (restarted) prompt stream
+        # past everything the pre-restart trial already consumed.
+        self._skip_on_run = self.cursor
+
+    def stop(self) -> None:
+        self._stop = True
+
+    # ---------------- load balancing ----------------
+
+    def _refresh_health(self) -> None:
+        for i, c in enumerate(self.clients):
+            try:
+                self._health[i] = c.health()
+                cap = int(self._health[i].get("capacity", 0))
+                if cap > 0 and self.autosize_inflight:
+                    # Size each client's agenerate bound to what its
+                    # server can actually co-decode.
+                    c.max_inflight = max(cap, 1)
+            except Exception as e:  # noqa: BLE001 — deprioritize, don't die
+                logger.warning(f"health poll failed for client {i}: {e!r}")
+                self._health[i] = {"queue_depth": 1 << 30}
+
+    def _load_score(self, i: int) -> float:
+        h = self._health[i]
+        return (
+            float(h.get("queue_depth", 0))
+            + float(h.get("live_slots", 0))
+            + self._local_load[i]
+        )
+
+    async def _choose_client(self) -> int:
+        now = time.monotonic()
+        if now - self._health_ts >= self.health_refresh_s or not any(
+            self._health
+        ):
+            self._health_ts = now
+            await asyncio.to_thread(self._refresh_health)
+        return min(range(len(self.clients)), key=self._load_score)
+
+    # ---------------- the pump ----------------
+
+    async def run(
+        self,
+        prompt_source: Iterable,
+        max_prompts: Optional[int] = None,
+    ) -> RolloutStat:
+        """Pump prompts until the source is exhausted, `max_prompts` are
+        dispatched, or stop() — then await all in-flight dispatches."""
+        it: Iterator = iter(prompt_source)
+        while self._skip_on_run > 0:
+            if next(it, None) is None:
+                break
+            self._skip_on_run -= 1
+        tasks: "set[asyncio.Task]" = set()
+        dispatched = 0
+        while not self._stop and (
+            max_prompts is None or dispatched < max_prompts
+        ):
+            # Backpressure: a full buffer means the trainer is behind —
+            # pulling more prompts would only evict unconsumed samples.
+            while not self.replay.can_accept() and not self._stop:
+                self.stat.backpressure_waits += 1
+                tracer.counter(
+                    "rollout_controller",
+                    in_flight=self.stat.in_flight,
+                    backpressured=1,
+                )
+                await asyncio.sleep(self.backpressure_poll_s)
+            if self._stop:
+                break
+            item = next(it, None)
+            if item is None:
+                break
+            qid, prompt_ids = _normalize_prompt(item, self.cursor)
+            self.cursor += 1
+            dispatched += 1
+            t = asyncio.create_task(self._dispatch(qid, prompt_ids))
+            tasks.add(t)
+            t.add_done_callback(tasks.discard)
+            # Yield so dispatches start promptly even on a fast source.
+            await asyncio.sleep(0)
+        if tasks:
+            await asyncio.gather(*tasks)
+        return self.stat
+
+    async def _dispatch(self, qid: str, prompt_ids: List[int]) -> None:
+        async with self._sem:
+            idx = await self._choose_client()
+            client = self.clients[idx]
+            self._local_load[idx] += 1
+            self.stat.submitted += 1
+            self.stat.in_flight += 1
+            tracer.counter(
+                "rollout_controller",
+                in_flight=self.stat.in_flight,
+                backpressured=0,
+            )
+            try:
+                out = await client.agenerate(
+                    APIGenerateInput(
+                        qid=qid,
+                        prompt_ids=prompt_ids,
+                        gconfig=self.gconfig,
+                        seed=self.seed,
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 — one prompt, not the pump
+                self.stat.failed += 1
+                logger.warning(f"rollout {qid} failed: {e!r}")
+                return
+            finally:
+                self._local_load[idx] -= 1
+                self.stat.in_flight -= 1
+                self.stat.completed += 1
+        # Lossless backpressure on the put side too: a completed response
+        # holds until the trainer drains a slot rather than evicting an
+        # unconsumed sample.  Too-stale responses fall through to put()
+        # and are rejected — waiting would not freshen them.
+        while (
+            not self._stop
+            and len(self.replay) >= self.replay.capacity
+            and self.replay.version - out.version_start
+            <= self.replay.max_head_offpolicyness
+        ):
+            self.stat.backpressure_waits += 1
+            await asyncio.sleep(self.backpressure_poll_s)
+        traj = Trajectory(
+            qid=out.qid,
+            prompt_ids=list(out.prompt_ids),
+            output_ids=out.output_ids,
+            output_logprobs=out.output_logprobs,
+            no_eos=out.no_eos,
+            version_start=out.version_start,
+            version_end=out.version,
+        )
+        if self.replay.put(traj):
+            self.stat.accepted += 1
+        else:
+            self.stat.rejected += 1

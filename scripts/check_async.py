@@ -1,0 +1,321 @@
+#!/usr/bin/env python
+"""Asynchronous-RL smoke check: the full decoupled loop on CPU.
+
+    python scripts/check_async.py [--prompts 24] [--versions 3]
+
+Part 1 drives the serving plane end to end: a RolloutController pumps a
+prompt stream through a live GenerationServer into a staleness-bounded
+ReplayBuffer while a fake trainer consumes batches and pushes fresh
+weights IN MEMORY between steps.  Verified:
+
+  - the controller feeds the buffer across >= 3 weight versions;
+  - at least one in-flight request is interrupted by a weight push and
+    RESUMED on its existing KV pages (engine.resume_replays), finishing
+    under a newer version than it started (version_start < version);
+  - every consumed trajectory obeys the max_head_offpolicyness bound.
+
+Part 2 runs the trainer plane: a tiny PPO trial through the master's
+replay-driven pipeline with max_head_offpolicyness=1 (decoupled-PPO
+stats must appear in the step stats), then the degradation check —
+max_head_offpolicyness=0 must reproduce the synchronous trial's stats
+and final weights bit for bit.
+
+Exit 0 iff every check passes.  CI-friendly: CPU-only, tiny random
+model, under a minute end to end.
+"""
+
+import argparse
+import asyncio
+import concurrent.futures
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def check_serving_plane(n_prompts: int, n_versions: int) -> int:
+    import jax
+    import numpy as np
+
+    from areal_tpu.api.model_api import (
+        GenerationHyperparameters,
+        LLMAPIClient,
+    )
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.engines.generator import GeneratorEngine
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.system.gen_server import GenerationServer
+    from areal_tpu.system.replay import ReplayBuffer
+    from areal_tpu.system.rollout import RolloutController
+
+    cfg = tiny_config()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+    # max_decode_batch=2 with 6-way client concurrency forces the
+    # interruptible inflight paged path (static paths drain instead);
+    # an unreachable EOS keeps every decode running the full window so
+    # weight pushes reliably land mid-flight.
+    engine = GeneratorEngine(
+        cfg, params, mesh, eos_token_id=cfg.vocab_size + 7,
+        max_decode_batch=2,
+    )
+    server = GenerationServer(engine, max_wait_ms=20.0)
+    cap = 2
+    replay = ReplayBuffer(capacity=8, max_head_offpolicyness=cap)
+    client = LLMAPIClient(server.url, max_inflight=6)
+    # 160 new tokens = 5 decode chunks per request: a multi-wave run
+    # lasts long enough that a push issued while live_slots > 0 hits a
+    # chunk boundary before the run drains.
+    ctl = RolloutController(
+        [client],
+        replay,
+        GenerationHyperparameters(n=1, max_new_tokens=160),
+        max_concurrency=6,
+        backpressure_poll_s=0.01,
+        autosize_inflight=False,
+    )
+    # Materialize the pushed weights up front: jitting init_params
+    # inside the push loop would stall the push past the decode window.
+    push_params = [
+        jax.block_until_ready(tfm.init_params(cfg, jax.random.PRNGKey(100 + i)))
+        for i in range(n_versions)
+    ]
+    rng = np.random.default_rng(0)
+    prompts = [
+        (f"q{i}", [int(t) for t in rng.integers(8, cfg.vocab_size, size=6)])
+        for i in range(n_prompts)
+    ]
+
+    consumed = []
+    staleness_seen = []
+    # The trainer side gets its own executor: the controller's in-flight
+    # agenerate posts park one default-executor thread each for a whole
+    # decode, so asyncio.to_thread would queue the weight push behind
+    # them and it would land only after the run drains — exactly the
+    # interruption this check must exercise.
+    trainer_pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=1, thread_name_prefix="trainer"
+    )
+
+    async def drive():
+        loop = asyncio.get_running_loop()
+        pump = asyncio.create_task(ctl.run(prompts))
+        pushes = 0
+        try:
+            while pushes < n_versions:
+                # Drain most of a wave so the pump's backpressure lifts
+                # and the next wave of decodes launches.
+                trajs = await loop.run_in_executor(
+                    trainer_pool, replay.get_batch, 4, 60.0
+                )
+                for t in trajs:
+                    staleness_seen.append(t.staleness(replay.version))
+                consumed.extend(trajs)
+                # "Train step": push fresh weights in memory while decode
+                # is in flight (wait for live slots so the push actually
+                # interrupts something).
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    if server.health_info()["live_slots"] > 0:
+                        break
+                    await asyncio.sleep(0.002)
+                v = await loop.run_in_executor(
+                    trainer_pool, server.update_weights_inmem,
+                    push_params[pushes],
+                )
+                replay.set_version(v)
+                pushes += 1
+        finally:
+            ctl.stop()
+            await pump
+
+    try:
+        asyncio.run(drive())
+    finally:
+        server.close()
+        trainer_pool.shutdown(wait=False)
+
+    failures = []
+    if server.version < n_versions:
+        failures.append(
+            f"expected >= {n_versions} weight versions, got {server.version}"
+        )
+    if any(s > cap for s in staleness_seen):
+        failures.append(
+            f"trainer consumed staleness beyond the cap {cap}: "
+            f"{sorted(set(staleness_seen))}"
+        )
+    if not consumed:
+        failures.append("trainer consumed nothing")
+    spanned = [t for t in consumed if t.version_end > t.version_start]
+    if not spanned:
+        failures.append(
+            "no trajectory finished under a newer version than it started "
+            "(no in-flight request was interrupted by a weight push)"
+        )
+    if engine.resume_replays < 1:
+        failures.append(
+            "engine never resumed an interrupted decode on existing KV "
+            f"pages (resume_replays={engine.resume_replays})"
+        )
+    head_versions = sorted({t.version_start for t in consumed})
+    if len(head_versions) < 2:
+        failures.append(
+            f"consumed trajectories span too few head versions: "
+            f"{head_versions}"
+        )
+    for f in failures:
+        print(f"FAIL[serving]: {f}")
+    if not failures:
+        print(
+            f"OK[serving]: {len(consumed)} trajectories consumed across "
+            f"head versions {head_versions} (server at v{server.version}); "
+            f"{len(spanned)} interrupted+resumed in flight "
+            f"(resume_replays={engine.resume_replays}); "
+            f"staleness seen {sorted(set(staleness_seen))} <= cap {cap}; "
+            f"controller stat {ctl.stat.as_dict()}"
+        )
+    return len(failures)
+
+
+def check_trainer_plane(fileroot: str) -> int:
+    import jax
+    import numpy as np
+
+    from areal_tpu.api.config import ModelAbstraction
+    from areal_tpu.api.data_api import DatasetAbstraction
+    from areal_tpu.api.model_api import (
+        GenerationHyperparameters,
+        OptimizerConfig,
+    )
+    from areal_tpu.experiments.common import (
+        PPOMathConfig,
+        build_ppo_math,
+        run_experiment,
+    )
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.system.master import ExperimentSaveEvalControl
+    from tests import fixtures
+
+    tok = fixtures.make_tokenizer()
+    rows = fixtures.build_math_rows(16, seed=7)
+
+    def make(mho, sub):
+        return PPOMathConfig(
+            actor=ModelAbstraction("random", {"config": tiny_config()}),
+            dataset=DatasetAbstraction(
+                "math_code_prompt",
+                {"dataset_builder": lambda: rows, "max_length": 64},
+            ),
+            reward_interface_args={
+                "id2info": {r["query_id"]: r for r in rows}
+            },
+            gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+            ppo_kwargs={"n_minibatches": 1, "kl_ctl": 0.0},
+            optimizer=OptimizerConfig(lr=5e-3, warmup_steps_proportion=0.0),
+            max_head_offpolicyness=mho,
+            batch_size=4,
+            total_train_epochs=1,
+            seed=1,
+            ctrl=ExperimentSaveEvalControl(),
+            fileroot=os.path.join(fileroot, sub),
+        )
+
+    failures = []
+
+    # Async pipeline with a real staleness budget: decoupled-PPO stats
+    # must be in the step stats and the bound must hold at every step.
+    _, stats = run_experiment(
+        build_ppo_math(make(1, "async"), tok), tokenizer=tok
+    )
+    for s in stats:
+        if not np.isfinite(s.get("actor_train/behav_imp_weight", np.nan)):
+            failures.append("behav_imp_weight missing from step stats")
+            break
+        if not 0.0 <= s.get("actor_train/behav_cap_clip", -1.0) <= 1.0:
+            failures.append("behav_cap_clip missing or out of [0, 1]")
+            break
+        if s["replay/staleness"] > 1 or s["replay/rejected"] > 0:
+            failures.append(
+                f"staleness bound violated: {s['replay/staleness']} "
+                f"(rejected={s['replay/rejected']})"
+            )
+            break
+    if not any(s["replay/staleness"] == 1 for s in stats):
+        failures.append("pipeline never reached steady-state staleness 1")
+
+    # Degradation: cap=0 must equal the synchronous trial bit for bit.
+    m_sync, s_sync = run_experiment(
+        build_ppo_math(make(None, "sync"), tok), tokenizer=tok
+    )
+    m_async, s_async = run_experiment(
+        build_ppo_math(make(0, "cap0"), tok), tokenizer=tok
+    )
+    keys = (
+        "actor_train/loss", "actor_train/actor_loss",
+        "actor_train/approx_kl", "actor_train/importance_weight",
+        "actor_train/grad_norm", "actor_train/task_reward",
+    )
+    for t, (a, b) in enumerate(zip(s_sync, s_async)):
+        for k in keys:
+            if a[k] != b[k]:
+                failures.append(
+                    f"cap=0 diverged from sync at step {t}: {k} "
+                    f"{a[k]} != {b[k]}"
+                )
+    pa = m_sync.pool.workers[0].models["actor@0"].engine.get_params()
+    pb = m_async.pool.workers[0].models["actor@0"].engine.get_params()
+    diff = max(
+        float(
+            np.abs(
+                np.asarray(x, np.float32) - np.asarray(y, np.float32)
+            ).max()
+        )
+        for x, y in zip(jax.tree.leaves(pa), jax.tree.leaves(pb))
+    )
+    if diff != 0.0:
+        failures.append(f"cap=0 final weights differ from sync by {diff}")
+
+    for f in failures:
+        print(f"FAIL[trainer]: {f}")
+    if not failures:
+        print(
+            f"OK[trainer]: async steps={len(stats)} with decoupled-PPO "
+            f"stats (behav_imp_weight last="
+            f"{stats[-1]['actor_train/behav_imp_weight']:.6f}, "
+            f"behav_cap_clip last="
+            f"{stats[-1]['actor_train/behav_cap_clip']:.4f}); "
+            f"cap=0 == sync exactly over {len(s_sync)} steps "
+            f"(max param diff {diff})"
+        )
+    return len(failures)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(prog="check_async")
+    p.add_argument("--prompts", type=int, default=24)
+    p.add_argument("--versions", type=int, default=3,
+                   help="in-memory weight pushes in the serving check")
+    p.add_argument("--dir", default=None,
+                   help="fileroot for the trainer check (default: tempdir)")
+    args = p.parse_args()
+    fileroot = args.dir or tempfile.mkdtemp(prefix="areal_tpu_async_check_")
+
+    n_fail = check_serving_plane(args.prompts, args.versions)
+    n_fail += check_trainer_plane(fileroot)
+    if n_fail:
+        print(f"FAIL: {n_fail} check(s) failed")
+        return 1
+    print("OK: asynchronous RL loop verified end to end")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,6 +26,14 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second end-to-end trials, excluded from the tier-1 "
+        "`-m 'not slow'` run (scripts/check_async.py covers the async e2e)",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _fresh_name_resolve():
     from areal_tpu.base import name_resolve

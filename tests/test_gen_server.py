@@ -378,3 +378,150 @@ class TestZMQTransport:
         for i, o in results.items():
             assert o.prompt_ids == [9, 10, 11 + i]
             assert len(o.output_ids[0]) > 0
+
+
+class TestAsyncServing:
+    """Async-RL serving surface: enriched /health load signals,
+    pause/resume at a chunk boundary, and the interruptible in-memory
+    weight push that resumes in-flight decodes on their KV pages."""
+
+    def test_health_reports_load_signals(self, server):
+        h = LLMAPIClient(server.url).health()
+        assert h["status"] == "ok"
+        for key in ("version", "queue_depth", "live_slots",
+                    "kv_utilization", "capacity", "paused"):
+            assert key in h, key
+        assert h["paused"] is False
+        assert h["capacity"] >= 1
+
+    def test_pause_parks_generation_until_resume(self, server):
+        import threading as _t
+
+        client = LLMAPIClient(server.url)
+        client.pause()
+        assert client.health()["paused"] is True
+        g = GenerationHyperparameters(n=1, max_new_tokens=4, greedy=True)
+        box = {}
+
+        def run():
+            box["out"] = client.generate(APIGenerateInput(
+                qid="p", prompt_ids=[10, 11, 12], gconfig=g,
+            ))
+
+        th = _t.Thread(target=run)
+        th.start()
+        # Parked: the request must NOT complete while paused.
+        th.join(timeout=0.3)
+        assert th.is_alive() and "out" not in box
+        client.resume()
+        th.join(timeout=60)
+        assert not th.is_alive()
+        assert len(box["out"].output_ids[0]) >= 1
+        assert client.health()["paused"] is False
+
+    def test_update_weights_inmem_bumps_version(self, cfg, params):
+        mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+        eng = GeneratorEngine(cfg, params, mesh, eos_token_id=EOS)
+        srv = GenerationServer(eng, max_wait_ms=2.0)
+        try:
+            client = LLMAPIClient(srv.url)
+            g = GenerationHyperparameters(n=1, max_new_tokens=8, greedy=True)
+            inp = APIGenerateInput(
+                qid="q", prompt_ids=list(range(10, 20)), gconfig=g
+            )
+            before = client.generate(inp)
+            assert before.version == before.version_start == 0
+
+            v = srv.update_weights_inmem(
+                tfm.init_params(cfg, jax.random.PRNGKey(99))
+            )
+            assert v == srv.version == 1
+            after = client.generate(inp)
+            # A request submitted after the push starts AND ends on v1.
+            assert after.version == after.version_start == 1
+            assert before.output_ids != after.output_ids
+            assert client.health()["paused"] is False
+        finally:
+            srv.close()
+
+    def test_remote_engine_inmem_sync_pause_wraps_push(self, cfg, params):
+        """inmem_sync=True: set_params pauses every serving rank, pushes
+        the checkpoint, and resumes — the server ends live and versioned."""
+        mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+        eng = GeneratorEngine(cfg, params, mesh, eos_token_id=EOS)
+        srv = GenerationServer(eng, max_wait_ms=2.0)
+        try:
+            remote = RemoteGeneratorEngine(cfg, srv.url, inmem_sync=True)
+            remote.set_params(tfm.init_params(cfg, jax.random.PRNGKey(5)))
+            assert srv.version == 1
+            h = LLMAPIClient(srv.url).health()
+            assert h["paused"] is False and h["version"] == 1
+        finally:
+            srv.close()
+
+    def test_inmem_push_interrupts_and_resumes_inflight(self, cfg):
+        """The tentpole behavior: a weight push lands MID-DECODE, the
+        in-flight requests halt at a chunk boundary, the swap happens,
+        and they resume on their existing KV pages — finishing under the
+        new version while keeping their original head version stamp."""
+        import time as _time
+        import threading as _t
+
+        mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+        params = tfm.init_params(cfg, jax.random.PRNGKey(11))
+        # Force the interruptible inflight path: more concurrent requests
+        # than max_decode_batch (static/dense paths drain instead).
+        eng = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, max_decode_batch=2
+        )
+        srv = GenerationServer(eng, max_wait_ms=20.0)
+        try:
+            client = LLMAPIClient(srv.url)
+            g = GenerationHyperparameters(
+                n=1, max_new_tokens=96, greedy=True
+            )
+            inps = [
+                APIGenerateInput(
+                    qid=f"q{i}", prompt_ids=[10 + i, 11, 12, 13],
+                    gconfig=g,
+                )
+                for i in range(4)
+            ]
+            box = {}
+
+            def run():
+                box["outs"] = client.generate_batch(inps)
+
+            th = _t.Thread(target=run)
+            th.start()
+            # Wait for decode to actually be in flight, then push.
+            deadline = _time.monotonic() + 60
+            while _time.monotonic() < deadline:
+                if client.health()["live_slots"] > 0:
+                    break
+                _time.sleep(0.002)
+            assert client.health()["live_slots"] > 0, "decode never started"
+            v = srv.update_weights_inmem(
+                tfm.init_params(cfg, jax.random.PRNGKey(99))
+            )
+            assert v == 1
+            th.join(timeout=120)
+            assert not th.is_alive()
+            outs = box["outs"]
+            assert len(outs) == 4
+            # Interrupted requests: head version 0, finished under v1.
+            spanned = [
+                o for o in outs
+                if o.version_start == 0 and o.version == 1
+            ]
+            assert spanned, [
+                (o.qid, o.version_start, o.version) for o in outs
+            ]
+            # ...and they were resumed (tail-replay on existing pages),
+            # not restarted from scratch.
+            assert eng.resume_replays >= 1
+            for o in outs:
+                assert len(o.output_ids[0]) == len(o.output_logprobs[0])
+                assert len(o.output_ids[0]) >= 1
+        finally:
+            srv.close()

@@ -1,0 +1,502 @@
+"""Asynchronous RL subsystem (reference: AReaL, arxiv 2505.24298 §4):
+staleness-bounded replay admission, the rollout controller's load
+balancing / version stamping / backpressure, recover round-trips, and
+the master's replay-driven async pipeline — including the cap=0
+degradation to exactly synchronous numerics."""
+
+import asyncio
+import pickle
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.data_api import SequenceSample
+from areal_tpu.api.model_api import (
+    APIGenerateInput,
+    APIGenerateOutput,
+    GenerationHyperparameters,
+)
+from areal_tpu.base import recover
+from areal_tpu.system.replay import (
+    ReplayBuffer,
+    StaleTrajectoryError,
+    Trajectory,
+)
+from areal_tpu.system.rollout import RolloutController
+
+
+def _traj(qid="q", v=0, v_end=None):
+    return Trajectory(
+        qid=qid,
+        prompt_ids=[1, 2],
+        output_ids=[[3, 4]],
+        output_logprobs=[[0.0, 0.0]],
+        no_eos=[False],
+        version_start=v,
+        version_end=v if v_end is None else v_end,
+    )
+
+
+class TestReplayBuffer:
+    def test_admission_by_head_version(self):
+        rb = ReplayBuffer(capacity=8, max_head_offpolicyness=1)
+        rb.set_version(2)
+        assert rb.put(_traj("fresh", v=2))
+        assert rb.put(_traj("edge", v=1))  # staleness 1 == cap
+        assert not rb.put(_traj("stale", v=0))  # staleness 2 > cap
+        assert rb.accepted == 2 and rb.rejected == 1
+        with pytest.raises(StaleTrajectoryError):
+            rb.put(_traj("stale2", v=0), strict=True)
+
+    def test_get_batch_fifo_and_timeout(self):
+        rb = ReplayBuffer(capacity=8, max_head_offpolicyness=0)
+        for i in range(3):
+            rb.put(_traj(f"t{i}"))
+        out = rb.get_batch(2, timeout=0)
+        assert [t.qid for t in out] == ["t0", "t1"]
+        assert rb.consumed == 2 and len(rb) == 1
+        with pytest.raises(TimeoutError):
+            rb.get_batch(2, timeout=0.01)
+
+    def test_capacity_eviction_calls_on_drop(self):
+        dropped = []
+        rb = ReplayBuffer(
+            capacity=2, max_head_offpolicyness=0, on_drop=dropped.append
+        )
+        for i in range(3):
+            rb.put(_traj(f"t{i}"))
+        assert len(rb) == 2 and rb.evicted == 1
+        assert [t.qid for t in dropped] == ["t0"]  # oldest went first
+        assert [t.qid for t in rb.get_batch(2, timeout=0)] == ["t1", "t2"]
+
+    def test_version_advance_purges_stale(self):
+        dropped = []
+        rb = ReplayBuffer(
+            capacity=8, max_head_offpolicyness=1, on_drop=dropped.append
+        )
+        rb.put(_traj("old", v=0))
+        rb.put(_traj("new", v=0))
+        rb.set_version(1)  # both at staleness 1 == cap: still admissible
+        assert len(rb) == 2 and not dropped
+        rb.set_version(2)  # staleness 2 > cap: purged, never trained on
+        assert len(rb) == 0
+        assert rb.dropped_stale == 2
+        assert {t.qid for t in dropped} == {"old", "new"}
+        with pytest.raises(ValueError):
+            rb.set_version(1)  # versions are monotonic
+
+    def test_can_accept_backpressure_probe(self):
+        rb = ReplayBuffer(capacity=1, max_head_offpolicyness=0)
+        assert rb.can_accept()
+        rb.put(_traj("a"))
+        assert not rb.can_accept()  # full: a put would evict unconsumed
+        rb.get_batch(1, timeout=0)
+        assert rb.can_accept()
+        rb.set_version(3)
+        assert not rb.can_accept(version_start=1)  # would be rejected
+        assert rb.can_accept(version_start=3)
+
+    def test_staleness_histogram_and_watermarks_roundtrip(self):
+        rb = ReplayBuffer(capacity=8, max_head_offpolicyness=3)
+        rb.set_version(2)
+        for v in (2, 2, 1, 0):
+            rb.put(_traj(f"v{v}", v=v))
+        assert rb.staleness_histogram() == {0: 2, 1: 1, 2: 1}
+        wm = rb.watermarks()
+        assert wm["version"] == 2 and wm["size"] == 4
+        assert wm["min_version"] == 0 and wm["max_version"] == 2
+        rb2 = ReplayBuffer(capacity=8, max_head_offpolicyness=3)
+        rb2.load_watermarks(wm)
+        assert rb2.version == 2 and rb2.accepted == 4
+        # Restored admission picks up where the old trial stopped.
+        assert not rb2.put(_traj("ancient", v=-2))
+
+
+class TestSequenceBufferAsyncRL:
+    def _sample(self, sid, length=4):
+        return SequenceSample.from_default(
+            ids=[sid],
+            seqlens=[length],
+            data={"packed_prompts": np.arange(length, dtype=np.int32)},
+        ).meta()
+
+    def test_staleness_histogram_and_max_age_eviction(self):
+        from areal_tpu.system.buffer import SequenceBuffer
+
+        async def go():
+            buf = SequenceBuffer(
+                consumers={"train": ["packed_prompts"]}, max_age_steps=2
+            )
+            await buf.put_batch(self._sample("a"), step=0)
+            await buf.put_batch(self._sample("b"), step=1)
+            assert buf.staleness_histogram() == {0: 1, 1: 1}
+            assert buf.stats() == {
+                "size": 2, "evicted_aged": 0, "max_age": 1,
+            }
+            # Step 3 makes "a" 3 steps old (> max_age_steps=2): evicted.
+            await buf.put_batch(self._sample("c"), step=3)
+            assert buf.stats()["size"] == 2
+            assert buf.stats()["evicted_aged"] == 1
+            assert buf.staleness_histogram() == {0: 1, 2: 1}
+            await buf.drop_ids(["b", "c"])
+            assert len(buf) == 0
+
+        asyncio.run(go())
+
+
+class TestRecoverRoundTrip:
+    def test_async_fields_roundtrip(self, tmp_path):
+        info = recover.RecoverInfo(
+            replay_watermarks={"version": 5, "accepted": 9},
+            rollout_state={"trainer_version": 5, "cursor": 40},
+        )
+        recover.dump(info, str(tmp_path))
+        back = recover.load(str(tmp_path))
+        assert back.replay_watermarks == {"version": 5, "accepted": 9}
+        assert back.rollout_state == {"trainer_version": 5, "cursor": 40}
+
+    def test_old_pickle_without_async_fields_backfills(self, tmp_path):
+        """Pickles restore __dict__, not __init__: a recover file written
+        before the async-RL fields existed must still load (with empty
+        defaults), or every upgrade would strand recoverable trials."""
+        info = recover.RecoverInfo()
+        del info.__dict__["replay_watermarks"]
+        del info.__dict__["rollout_state"]
+        path = tmp_path / recover.RECOVER_FILE
+        with open(path, "wb") as f:
+            pickle.dump(info, f)
+        back = recover.load(str(tmp_path))
+        assert back.replay_watermarks == {}
+        assert back.rollout_state == {}
+
+
+class _FakeClient:
+    """LLMAPIClient-shaped stub: records dispatches, serves a canned
+    health signal, and stamps outputs with its weight version."""
+
+    def __init__(self, version=0, queue_depth=0, capacity=4, delay=0.0,
+                 health_error=False):
+        self.version = version
+        self.queue_depth = queue_depth
+        self.capacity = capacity
+        self.delay = delay
+        self.health_error = health_error
+        self.max_inflight = 1
+        self.calls = []
+
+    def health(self):
+        if self.health_error:
+            raise ConnectionError("server down")
+        return {
+            "status": "ok",
+            "version": self.version,
+            "queue_depth": self.queue_depth,
+            "live_slots": 0,
+            "kv_utilization": 0.0,
+            "capacity": self.capacity,
+            "paused": False,
+        }
+
+    async def agenerate(self, inp: APIGenerateInput) -> APIGenerateOutput:
+        self.calls.append(inp.qid)
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        return APIGenerateOutput(
+            qid=inp.qid,
+            prompt_ids=list(inp.prompt_ids),
+            output_ids=[[1, 2]],
+            output_logprobs=[[-0.1, -0.2]],
+            no_eos=[False],
+            version=self.version,
+            version_start=self.version,
+        )
+
+
+class TestRolloutController:
+    def _gconfig(self):
+        return GenerationHyperparameters(n=1, max_new_tokens=4)
+
+    def test_dispatch_stamps_versions_and_counts(self):
+        client = _FakeClient(version=3)
+        rb = ReplayBuffer(capacity=8, max_head_offpolicyness=8)
+        rb.set_version(3)
+        ctl = RolloutController([client], rb, self._gconfig())
+        stat = asyncio.run(ctl.run([[5, 6, 7]] * 4))
+        assert stat.submitted == stat.completed == stat.accepted == 4
+        assert stat.failed == stat.rejected == 0 and stat.in_flight == 0
+        assert ctl.cursor == 4
+        trajs = rb.get_batch(4, timeout=0)
+        assert all(t.version_start == 3 and t.version_end == 3
+                   for t in trajs)
+        # qids auto-assigned from the stream cursor.
+        assert [t.qid for t in trajs] == [f"prompt{i}" for i in range(4)]
+
+    def test_load_balancing_prefers_shallow_queue(self):
+        busy = _FakeClient(queue_depth=100)
+        idle = _FakeClient(queue_depth=0)
+        rb = ReplayBuffer(capacity=16, max_head_offpolicyness=8)
+        ctl = RolloutController([busy, idle], rb, self._gconfig())
+        asyncio.run(ctl.run([("q%d" % i, [1, 2]) for i in range(6)]))
+        assert not busy.calls and len(idle.calls) == 6
+        # The health capacity resized the client's agenerate bound.
+        assert idle.max_inflight == idle.capacity
+
+    def test_dead_server_deprioritized_not_fatal(self):
+        dead = _FakeClient(health_error=True)
+        alive = _FakeClient()
+        rb = ReplayBuffer(capacity=16, max_head_offpolicyness=8)
+        ctl = RolloutController([dead, alive], rb, self._gconfig())
+        stat = asyncio.run(ctl.run([[1, 2]] * 4))
+        assert stat.accepted == 4
+        assert not dead.calls and len(alive.calls) == 4
+
+    def test_backpressure_waits_for_trainer(self):
+        """Buffer of 1: the controller must stall (not evict) until the
+        consumer drains, and every sample reaches the trainer."""
+        client = _FakeClient(delay=0.001)
+        rb = ReplayBuffer(capacity=1, max_head_offpolicyness=8)
+        ctl = RolloutController(
+            [client], rb, self._gconfig(), backpressure_poll_s=0.005
+        )
+        consumed = []
+
+        async def consume():
+            while len(consumed) < 4:
+                try:
+                    consumed.extend(rb.get_batch(1, timeout=0))
+                except TimeoutError:
+                    pass
+                await asyncio.sleep(0.05)
+
+        async def go():
+            c = asyncio.create_task(consume())
+            stat = await ctl.run([[1, 2]] * 4)
+            await c
+            return stat
+
+        stat = asyncio.run(go())
+        assert stat.accepted == 4 and rb.evicted == 0
+        assert stat.backpressure_waits > 0
+        assert len(consumed) == 4
+
+    def test_state_dict_fast_forwards_prompt_stream(self):
+        prompts = [("q%d" % i, [1, 2]) for i in range(6)]
+        rb = ReplayBuffer(capacity=16, max_head_offpolicyness=8)
+        c1 = _FakeClient()
+        ctl1 = RolloutController([c1], rb, self._gconfig())
+        asyncio.run(ctl1.run(prompts, max_prompts=2))
+        sd = ctl1.state_dict()
+        assert sd["cursor"] == 2
+
+        # A restarted controller replays the SAME stream but must skip
+        # what the crashed trial already consumed.
+        c2 = _FakeClient()
+        ctl2 = RolloutController([c2], rb, self._gconfig())
+        ctl2.load_state_dict(sd)
+        stat = asyncio.run(ctl2.run(prompts))
+        assert c2.calls == ["q2", "q3", "q4", "q5"]
+        assert ctl2.cursor == 6
+        assert stat.submitted == 6  # counters carried across the restart
+        assert stat.in_flight == 0
+
+
+class TestAsyncRLExperiment:
+    """The master's replay-driven pipeline, end to end on CPU."""
+
+    def _cfg(self, tmp_path, rows, **kw):
+        from areal_tpu.api.config import ModelAbstraction
+        from areal_tpu.api.data_api import DatasetAbstraction
+        from areal_tpu.api.model_api import OptimizerConfig
+        from areal_tpu.experiments.common import PPOMathConfig
+        from areal_tpu.models.config import tiny_config
+        from areal_tpu.system.master import ExperimentSaveEvalControl
+
+        kw.setdefault("ctrl", ExperimentSaveEvalControl())
+        return PPOMathConfig(
+            actor=ModelAbstraction("random", {"config": tiny_config()}),
+            dataset=DatasetAbstraction(
+                "math_code_prompt",
+                {"dataset_builder": lambda: rows, "max_length": 64},
+            ),
+            reward_interface_args={
+                "id2info": {r["query_id"]: r for r in rows}
+            },
+            gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+            ppo_kwargs={"n_minibatches": 1, "kl_ctl": 0.0},
+            optimizer=OptimizerConfig(lr=5e-3, warmup_steps_proportion=0.0),
+            batch_size=4,
+            total_train_epochs=1,
+            seed=1,
+            fileroot=str(tmp_path),
+            **kw,
+        )
+
+    @pytest.mark.slow
+    def test_async_pipeline_bounded_staleness_and_decoupled_stats(
+        self, tmp_path
+    ):
+        """max_head_offpolicyness=1: the trial completes, every consumed
+        batch obeys the staleness bound (no admission rejections in
+        steady state), and the decoupled-PPO stats appear."""
+        from areal_tpu.experiments.common import (
+            build_ppo_math,
+            run_experiment,
+        )
+        from tests import fixtures
+
+        tok = fixtures.make_tokenizer()
+        rows = fixtures.build_math_rows(16, seed=7)
+        cfg = self._cfg(
+            tmp_path, rows, max_head_offpolicyness=1, replay_capacity=4
+        )
+        master, stats = run_experiment(build_ppo_math(cfg, tok),
+                                       tokenizer=tok)
+        assert len(stats) == 4
+        for s in stats:
+            assert np.isfinite(s["actor_train/actor_loss"])
+            assert s["replay/staleness"] <= 1
+            assert s["replay/rejected"] == 0
+            assert s["replay/dropped_stale"] == 0
+            # Decoupled PPO ran: behavior importance weight + clip stats.
+            assert np.isfinite(s["actor_train/behav_imp_weight"])
+            assert 0.0 <= s["actor_train/behav_cap_clip"] <= 1.0
+            assert "buffer/size" in s
+        # Steady state runs one version behind: staleness reaches the cap.
+        assert stats[-1]["replay/staleness"] == 1
+        assert stats[-1]["replay/accepted"] == 4
+        assert master._trainer_version == 4
+
+    @pytest.mark.slow
+    def test_cap_zero_matches_synchronous_numerics(self, tmp_path):
+        """max_head_offpolicyness=0 is the synchronous regime: identical
+        per-step stats AND identical final weights, bit for bit."""
+        import jax
+
+        from areal_tpu.experiments.common import (
+            build_ppo_math,
+            run_experiment,
+        )
+        from tests import fixtures
+
+        tok = fixtures.make_tokenizer()
+        rows = fixtures.build_math_rows(8, seed=3)
+        m_sync, s_sync = run_experiment(
+            build_ppo_math(self._cfg(tmp_path / "sync", rows), tok),
+            tokenizer=tok,
+        )
+        m_async, s_async = run_experiment(
+            build_ppo_math(
+                self._cfg(
+                    tmp_path / "async", rows, max_head_offpolicyness=0
+                ),
+                tok,
+            ),
+            tokenizer=tok,
+        )
+        assert len(s_sync) == len(s_async) == 2
+        for a, b in zip(s_sync, s_async):
+            for k in (
+                "actor_train/loss",
+                "actor_train/actor_loss",
+                "actor_train/approx_kl",
+                "actor_train/importance_weight",
+                "actor_train/grad_norm",
+                "actor_train/task_reward",
+            ):
+                assert a[k] == b[k], (k, a[k], b[k])
+            assert b["replay/staleness"] == 0
+        pa = m_sync.pool.workers[0].models["actor@0"].engine.get_params()
+        pb = m_async.pool.workers[0].models["actor@0"].engine.get_params()
+        for x, y in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+            np.testing.assert_array_equal(
+                np.asarray(x, np.float32), np.asarray(y, np.float32)
+            )
+
+    def test_rollout_ahead_and_offpolicyness_mutually_exclusive(
+        self, tmp_path
+    ):
+        from areal_tpu.experiments.common import build_ppo_math
+        from tests import fixtures
+
+        tok = fixtures.make_tokenizer()
+        rows = fixtures.build_math_rows(8, seed=3)
+        cfg = self._cfg(
+            tmp_path, rows, max_head_offpolicyness=1, rollout_ahead=1
+        )
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            build_ppo_math(cfg, tok)
+
+
+class TestInterruptResumeParity:
+    def test_interrupted_resume_is_token_identical(self):
+        """Interrupting a greedy paged decode mid-flight and resuming
+        under UNCHANGED weights must reproduce the uninterrupted run
+        token for token — the tail-replay re-prefill rebuilds the exact
+        logits the loop would have seen."""
+        import jax
+
+        from areal_tpu.api.data_api import MicroBatchSpec
+        from areal_tpu.base.topology import ParallelConfig, make_mesh
+        from areal_tpu.engines.generator import GeneratorEngine
+        from areal_tpu.models import transformer as tfm
+        from areal_tpu.models.config import tiny_config
+
+        cfg = tiny_config()
+        params = tfm.init_params(cfg, jax.random.PRNGKey(5))
+        mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+        rng = np.random.default_rng(3)
+        data = np.concatenate(
+            [rng.integers(8, cfg.vocab_size, size=l) for l in (5, 7, 6, 4)]
+        ).astype(np.int32)
+        sample = SequenceSample(
+            keys={"packed_prompts"},
+            ids=[f"p{i}" for i in range(4)],
+            seqlens={"packed_prompts": [[5], [7], [6], [4]]},
+            data={"packed_prompts": data},
+        )
+        g = GenerationHyperparameters(
+            n=1, max_new_tokens=48, greedy=True
+        )
+
+        def build():
+            # 4 reqs > max_decode_batch=2 routes to the interruptible
+            # inflight paged path; unreachable EOS keeps every request
+            # decoding the full window so the interrupt lands mid-flight.
+            return GeneratorEngine(
+                cfg, params, mesh,
+                eos_token_id=cfg.vocab_size + 7, max_decode_batch=2,
+            )
+
+        ref_eng = build()
+        ref = ref_eng.generate(sample, MicroBatchSpec(), g, seed=0)
+
+        eng = build()
+        real_get = eng._get_paged_decode_fn
+        calls = {"n": 0}
+
+        def hooked(*a, **kw):
+            fn = real_get(*a, **kw)
+
+            def wrapped(*fa, **fkw):
+                calls["n"] += 1
+                if calls["n"] == 2:
+                    eng.interrupt()
+                return fn(*fa, **fkw)
+
+            return wrapped
+
+        eng._get_paged_decode_fn = hooked
+        out = eng.generate(sample, MicroBatchSpec(), g, seed=0)
+        assert out is None and eng.interrupted  # parked mid-decode
+        assert calls["n"] >= 2
+        eng.clear_interrupt()
+        out = eng.resume_generate()
+        assert out is not None and eng.resume_replays == 1
+        np.testing.assert_array_equal(
+            np.asarray(out.data["packed_input_ids"]),
+            np.asarray(ref.data["packed_input_ids"]),
+        )
+        assert (
+            out.seqlens["packed_input_ids"]
+            == ref.seqlens["packed_input_ids"]
+        )
