@@ -1,0 +1,28 @@
+"""arealint — TPU-hot-path static analysis for areal_tpu.
+
+An AST-based (stdlib-only) rule engine guarding the framework's runtime
+invariants at lint time: decode compiles once per generate call, no hidden
+host syncs in hot loops, the async serving plane never blocks its event
+loop, PartitionSpecs only name declared mesh axes, and stats/trace keys
+stay disciplined.  Run it as::
+
+    python -m areal_tpu.apps.lint areal_tpu/
+
+Suppress a finding with a reasoned annotation on the offending line (or
+the line directly above)::
+
+    x = float(dev[i])  # arealint: ignore[host-sync] -- drain boundary
+
+A suppression without a ``-- reason`` is itself an error.
+"""
+
+from areal_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Severity,
+    Suppression,
+    analyze_paths,
+    lint_source,
+    render_human,
+    render_json,
+)
+from areal_tpu.analysis.rules import ALL_RULES, get_rules  # noqa: F401

@@ -1,0 +1,312 @@
+"""arealint engine: findings, suppressions, file/project contexts, runner.
+
+Rules are small classes (see ``areal_tpu.analysis.rules``) that receive a
+parsed :class:`FileContext` and yield :class:`Finding`s.  The engine owns
+everything rule-independent: discovering files, parsing, reading
+``# arealint: ignore[...] -- reason`` comments, filtering suppressed
+findings, and rendering human/JSON output with a stable schema.
+"""
+
+import ast
+import dataclasses
+import enum
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Output schema version: bump ONLY on breaking changes to the JSON shape
+# (tests/test_lint_rules.py pins the format).
+JSON_VERSION = 1
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    @property
+    def label(self) -> str:
+        return self.name.lower()
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: Severity
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule, self.message)
+
+    def to_dict(self) -> Dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity.label,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.severity.label}[{self.rule}] {self.message}"
+        )
+
+
+# Comment shape: ``arealint: ignore[rule1,rule2] -- reason`` after a hash
+# (rule ``*`` matches all rules).
+_SUPPRESS_RE = re.compile(
+    r"#\s*arealint:\s*ignore\[([^\]]*)\]\s*(?:--\s*(.*\S)\s*)?$"
+)
+_ANY_SUPPRESS_RE = re.compile(r"#\s*arealint:")
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int  # line the suppression COVERS (the comment line itself for
+    # trailing comments; the following line for own-line comments)
+    comment_line: int
+    rules: Tuple[str, ...]
+    reason: str
+    used: bool = False
+
+    def matches(self, finding: Finding) -> bool:
+        return finding.line == self.line and (
+            "*" in self.rules or finding.rule in self.rules
+        )
+
+
+def parse_suppressions(
+    source: str, path: str
+) -> Tuple[List[Suppression], List[Finding]]:
+    """Extract suppression comments; malformed ones become findings."""
+    sups: List[Suppression] = []
+    problems: List[Finding] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return sups, problems
+    lines = source.splitlines()
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        text = tok.string
+        if not _ANY_SUPPRESS_RE.search(text):
+            continue
+        lineno, col = tok.start
+        m = _SUPPRESS_RE.search(text)
+        if m is None:
+            problems.append(Finding(
+                "suppression", Severity.ERROR, path, lineno, col,
+                "malformed arealint comment: expected "
+                "'# arealint: ignore[rule] -- reason'",
+            ))
+            continue
+        rules = tuple(
+            r.strip() for r in m.group(1).split(",") if r.strip()
+        )
+        reason = (m.group(2) or "").strip()
+        if not rules:
+            problems.append(Finding(
+                "suppression", Severity.ERROR, path, lineno, col,
+                "arealint suppression names no rules: use ignore[rule] "
+                "or ignore[*]",
+            ))
+            continue
+        if not reason:
+            problems.append(Finding(
+                "suppression", Severity.ERROR, path, lineno, col,
+                "arealint suppression missing its reason: append "
+                "'-- <why this is safe>'",
+            ))
+            continue
+        own_line = lineno <= len(lines) and lines[lineno - 1][:col].strip() == ""
+        covers = lineno
+        if own_line:
+            # An own-line suppression covers the next code line, skipping
+            # blank lines and the rest of its own comment block.
+            covers = lineno + 1
+            while covers <= len(lines) and (
+                not lines[covers - 1].strip()
+                or lines[covers - 1].lstrip().startswith("#")
+            ):
+                covers += 1
+        sups.append(Suppression(covers, lineno, rules, reason))
+    return sups, problems
+
+
+@dataclasses.dataclass
+class ProjectContext:
+    """Cross-file facts rules may consult (filled by rule ``prepare``)."""
+
+    files: "List[FileContext]" = dataclasses.field(default_factory=list)
+    mesh_axes: set = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class FileContext:
+    path: str  # as-reported path (relative where possible)
+    source: str
+    tree: ast.AST
+    suppressions: List[Suppression]
+    project: ProjectContext
+
+
+class Rule:
+    """Base class: subclasses set ``name`` and implement ``check``."""
+
+    name: str = ""
+
+    def prepare(self, project: ProjectContext) -> None:
+        """Optional cross-file prepass (runs once, before any check)."""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+def collect_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git") and not d.startswith(".")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        else:
+            raise FileNotFoundError(f"arealint: no such path: {p}")
+    return out
+
+
+def _build_context(
+    path: str, source: str, project: ProjectContext
+) -> Tuple[Optional[FileContext], List[Finding]]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return None, [Finding(
+            "parse", Severity.ERROR, path, e.lineno or 1, e.offset or 0,
+            f"syntax error: {e.msg}",
+        )]
+    sups, problems = parse_suppressions(source, path)
+    return FileContext(path, source, tree, sups, project), problems
+
+
+def _run(
+    contexts: List[FileContext],
+    pre_findings: List[Finding],
+    rules: Sequence[Rule],
+) -> List[Finding]:
+    project = contexts[0].project if contexts else ProjectContext()
+    project.files = contexts
+    for rule in rules:
+        rule.prepare(project)
+    findings: List[Finding] = list(pre_findings)
+    for ctx in contexts:
+        raw: List[Finding] = []
+        for rule in rules:
+            raw.extend(rule.check(ctx))
+        for f in raw:
+            suppressed = False
+            for sup in ctx.suppressions:
+                if sup.matches(f):
+                    sup.used = True
+                    suppressed = True
+            if not suppressed:
+                findings.append(f)
+        for sup in ctx.suppressions:
+            if not sup.used:
+                findings.append(Finding(
+                    "unused-suppression", Severity.INFO, ctx.path,
+                    sup.comment_line, 0,
+                    f"suppression for [{', '.join(sup.rules)}] matched no "
+                    f"finding (reason: {sup.reason})",
+                ))
+    return sorted(findings, key=Finding.sort_key)
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence[Rule]] = None,
+    relative_to: Optional[str] = None,
+) -> List[Finding]:
+    """Lint files/directories; returns sorted, suppression-filtered findings."""
+    from areal_tpu.analysis.rules import get_rules
+
+    rules = list(rules) if rules is not None else get_rules()
+    project = ProjectContext()
+    contexts: List[FileContext] = []
+    pre: List[Finding] = []
+    for fp in collect_py_files(paths):
+        rel = fp
+        if relative_to:
+            try:
+                rel = os.path.relpath(fp, relative_to)
+            except ValueError:
+                rel = fp
+        try:
+            with open(fp, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            pre.append(Finding(
+                "io", Severity.ERROR, rel, 1, 0, f"cannot read file: {e}"
+            ))
+            continue
+        ctx, problems = _build_context(rel, source, project)
+        pre.extend(problems)
+        if ctx is not None:
+            contexts.append(ctx)
+    return _run(contexts, pre, rules)
+
+
+def lint_source(
+    source: str,
+    path: str = "<snippet>",
+    rules: Optional[Sequence[Rule]] = None,
+) -> List[Finding]:
+    """Lint a single in-memory source string (the fixture-test entry)."""
+    from areal_tpu.analysis.rules import get_rules
+
+    rules = list(rules) if rules is not None else get_rules()
+    project = ProjectContext()
+    ctx, pre = _build_context(path, source, project)
+    return _run([ctx] if ctx else [], pre, rules)
+
+
+def counts_by_severity(findings: Iterable[Finding]) -> Dict[str, int]:
+    counts = {"error": 0, "warning": 0, "info": 0}
+    for f in findings:
+        counts[f.severity.label] += 1
+    return counts
+
+
+def render_human(findings: Sequence[Finding]) -> str:
+    lines = [f.render() for f in findings]
+    c = counts_by_severity(findings)
+    lines.append(
+        f"arealint: {c['error']} error(s), {c['warning']} warning(s), "
+        f"{c['info']} info(s)"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    payload = {
+        "version": JSON_VERSION,
+        "counts": counts_by_severity(findings),
+        "findings": [f.to_dict() for f in findings],
+    }
+    return json.dumps(payload, indent=2, sort_keys=False)

@@ -1,0 +1,34 @@
+"""arealint rule registry: the five TPU-hot-path rule families."""
+
+from typing import List, Optional, Sequence
+
+from areal_tpu.analysis.core import Rule
+from areal_tpu.analysis.rules.async_blocking import AsyncBlockingRule
+from areal_tpu.analysis.rules.host_sync import HostSyncRule
+from areal_tpu.analysis.rules.retrace import RetraceRule
+from areal_tpu.analysis.rules.sharding import ShardingRule
+from areal_tpu.analysis.rules.stats_keys import StatsKeysRule
+
+ALL_RULES = (
+    HostSyncRule,
+    RetraceRule,
+    AsyncBlockingRule,
+    ShardingRule,
+    StatsKeysRule,
+)
+
+RULE_NAMES = tuple(r.name for r in ALL_RULES)
+
+
+def get_rules(names: Optional[Sequence[str]] = None) -> List[Rule]:
+    """Instantiate rules; ``names`` filters to a subset (all by default)."""
+    if names is None:
+        return [cls() for cls in ALL_RULES]
+    by_name = {cls.name: cls for cls in ALL_RULES}
+    unknown = [n for n in names if n not in by_name]
+    if unknown:
+        raise KeyError(
+            f"unknown rule(s): {', '.join(unknown)} "
+            f"(known: {', '.join(RULE_NAMES)})"
+        )
+    return [by_name[n]() for n in names]

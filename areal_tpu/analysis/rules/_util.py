@@ -1,0 +1,83 @@
+"""Shared AST helpers for arealint rules."""
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.lax.axis_index`` -> "jax.lax.axis_index"; None if not a pure
+    Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def base_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of a Name/Attribute/Subscript chain (``a.b[i].c`` -> a)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def iter_functions(
+    tree: ast.AST,
+) -> Iterator[Tuple[ast.AST, Tuple[str, ...]]]:
+    """Yield every (Async)FunctionDef with its qualified-name path."""
+
+    def walk(node: ast.AST, stack: Tuple[str, ...]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = stack + (child.name,)
+                yield child, q
+                yield from walk(child, q)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, stack + (child.name,))
+            else:
+                yield from walk(child, stack)
+
+    yield from walk(tree, ())
+
+
+def walk_scoped(
+    fn: ast.AST, *, into_nested: bool = False
+) -> Iterator[Tuple[ast.AST, int]]:
+    """Walk a function body yielding (node, loop_depth).
+
+    ``loop_depth`` counts enclosing for/while loops within this function.
+    Nested function/class definitions are skipped unless ``into_nested``
+    (they get their own visit from :func:`iter_functions`).
+    """
+
+    def walk(node: ast.AST, depth: int):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ) and not into_nested:
+                continue
+            d = depth + 1 if isinstance(
+                child, (ast.For, ast.While, ast.AsyncFor)
+            ) else depth
+            yield child, d
+            yield from walk(child, d)
+
+    yield from walk(fn, 0)
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def string_constants(node: ast.AST) -> Iterator[ast.Constant]:
+    """Yield string-Constant leaves of a (possibly nested) tuple/list."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            yield from string_constants(elt)

@@ -1,0 +1,156 @@
+"""async-blocking: event-loop stalls inside ``async def`` bodies.
+
+The serving plane (system/master.py, system/rollout.py,
+system/gen_server.py) multiplexes every gen server, the replay buffer and
+the trainer step over ONE event loop; a single synchronous wait there is
+a fleet-wide outage, not a local slowdown (RLAX, arxiv 2512.06392).
+Flagged inside any coroutine:
+
+- ``time.sleep`` -> error (use ``await asyncio.sleep`` or hop to a
+  thread/executor);
+- ``requests.*`` / ``urllib.request.*`` -> error (sync HTTP holds the
+  loop for the full round trip; use an executor);
+- sync ZMQ/socket sends/receives (``.recv*``/``.send*`` not awaited)
+  -> error (zmq blocks until a peer frame arrives);
+- ``subprocess.run/call/check_*`` -> error;
+- blocking ``queue.Queue.get``/``put`` (no ``_nowait``, no awaiting)
+  -> warning;
+- ``open(...)`` -> warning (sync file I/O; fine for rare small config
+  reads, deadly per request — justify with a suppression or hop to an
+  executor);
+- ``await`` while holding a synchronous lock (``with <...lock...>:``)
+  -> error: every other coroutine contending that lock deadlocks against
+  the loop until the awaited I/O completes; narrow the critical section
+  or use ``asyncio.Lock``.
+"""
+
+import ast
+import re
+from typing import Iterable
+
+from areal_tpu.analysis.core import FileContext, Finding, Rule, Severity
+from areal_tpu.analysis.rules._util import call_name, iter_functions
+
+_LOCK_NAME_RE = re.compile(r"(lock|mutex)", re.IGNORECASE)
+_RECV_SEND_RE = re.compile(r"^(recv|send)(_\w+)?$")
+
+
+class _CoroChecker(ast.NodeVisitor):
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.findings = []
+        self._await_depth = 0
+
+    def visit_FunctionDef(self, node):  # do not descend into nested sync defs
+        pass
+
+    def visit_AsyncFunctionDef(self, node):  # nested coroutine: own pass
+        pass
+
+    def visit_ClassDef(self, node):
+        pass
+
+    def visit_Await(self, node: ast.Await) -> None:
+        self._await_depth += 1
+        self.generic_visit(node)
+        self._await_depth -= 1
+
+    def visit_With(self, node: ast.With) -> None:
+        # `with <lock>:` containing an await
+        for item in node.items:
+            expr = item.context_expr
+            txt = ast.unparse(expr) if hasattr(ast, "unparse") else ""
+            if _LOCK_NAME_RE.search(txt):
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.Await,)):
+                        self.findings.append(Finding(
+                            "async-blocking", Severity.ERROR, self.ctx.path,
+                            sub.lineno, sub.col_offset,
+                            "await while holding a synchronous lock "
+                            f"({txt}): contending coroutines deadlock "
+                            "against the event loop until the awaited I/O "
+                            "returns; release before awaiting or use "
+                            "asyncio.Lock",
+                        ))
+                        break
+                break
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._await_depth == 0:
+            self._check_blocking(node)
+        self.generic_visit(node)
+
+    def _check_blocking(self, node: ast.Call) -> None:
+        name = call_name(node) or ""
+        sev_msg = None
+        if name == "time.sleep":
+            sev_msg = (Severity.ERROR, (
+                "time.sleep inside a coroutine stalls the whole event "
+                "loop (every gen server and the trainer share it); use "
+                "`await asyncio.sleep(...)`"
+            ))
+        elif name.split(".")[0] == "requests":
+            sev_msg = (Severity.ERROR, (
+                f"sync HTTP ({name}) inside a coroutine holds the event "
+                "loop for the full round trip; use "
+                "`await loop.run_in_executor(...)` or an async client"
+            ))
+        elif name.startswith("urllib.request."):
+            sev_msg = (Severity.ERROR, (
+                f"sync HTTP ({name}) inside a coroutine blocks the event "
+                "loop; hop to an executor"
+            ))
+        elif name in ("subprocess.run", "subprocess.call",
+                      "subprocess.check_output", "subprocess.check_call"):
+            sev_msg = (Severity.ERROR, (
+                f"{name} blocks the event loop for the child's lifetime; "
+                "use asyncio.create_subprocess_exec or an executor"
+            ))
+        elif name == "open":
+            sev_msg = (Severity.WARNING, (
+                "sync file I/O (open) inside a coroutine blocks the event "
+                "loop; hop to an executor, or suppress with a reason if "
+                "this is a rare small read off the hot path"
+            ))
+        elif isinstance(node.func, ast.Attribute) and _RECV_SEND_RE.match(
+            node.func.attr
+        ):
+            sev_msg = (Severity.ERROR, (
+                f"sync socket/ZMQ .{node.func.attr}() inside a coroutine "
+                "blocks the event loop until a peer frame arrives; use "
+                "zmq.asyncio / an awaited transport or an executor"
+            ))
+        elif isinstance(node.func, ast.Attribute) and node.func.attr in (
+            "get", "put"
+        ):
+            # Only queue-ish receivers; dict.get etc. share the attr name,
+            # so require a blocking timeout kwarg or a queue-named base.
+            base = node.func.value
+            base_txt = ast.unparse(base) if hasattr(ast, "unparse") else ""
+            if re.search(r"(queue|_q\b|\bq\b)", base_txt, re.IGNORECASE):
+                sev_msg = (Severity.WARNING, (
+                    f"blocking {base_txt}.{node.func.attr}() inside a "
+                    "coroutine parks the event loop until an item "
+                    "arrives; use get_nowait/put_nowait + asyncio.sleep, "
+                    "an asyncio.Queue, or an executor"
+                ))
+        if sev_msg is not None:
+            sev, msg = sev_msg
+            self.findings.append(Finding(
+                "async-blocking", sev, self.ctx.path,
+                node.lineno, node.col_offset, msg,
+            ))
+
+
+class AsyncBlockingRule(Rule):
+    name = "async-blocking"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn, _qual in iter_functions(ctx.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            checker = _CoroChecker(ctx)
+            for stmt in fn.body:
+                checker.visit(stmt)
+            yield from checker.findings

@@ -1,0 +1,298 @@
+"""host-sync: device→host conversions inside decode/chunk/train hot loops.
+
+One ``float(x)`` on a device array inside the decode loop turns a fully
+pipelined chunk into one blocking transfer per scalar (PR 1's paged decode
+and PR 2's stall attribution both die by this).  The rule fires inside
+functions whose name marks them hot (decode/chunk/prefill/generate/
+inflight/drain/train_step) and tracks a three-state host/device/unknown
+lattice per local name so that properly batched transfers
+(``to_host(...)``/``.tolist()`` once per chunk) stay clean:
+
+- ``float()``/``bool()``/``.item()``/``np.asarray()`` on a DEVICE value
+  inside a loop -> error (a known device→host sync per iteration);
+- the same on an UNKNOWN value inside a loop -> warning (can't prove the
+  operand is host-resident; convert via one batched ``to_host``/
+  ``.tolist()`` or annotate the drain boundary);
+- ``if``/``while`` on a bare DEVICE value -> error (implicit ``bool()``);
+- ``block_until_ready()`` anywhere in a hot function outside a
+  ``with tracer.span(...)`` -> error (unattributed stall: PERF.md requires
+  syncs to be visible to stall attribution).
+
+DEVICE sources: results of ``jnp.*``/``jax.*`` calls (minus
+``jax.device_get``), calls to ``*_fn`` names/attributes (the codebase's
+jitted-callable convention), and subscripts/tuple-unpacks thereof.
+HOST sources: ``to_host``/``np.*``/``jax.device_get`` results,
+``int()``/``float()``/``len()``/``.tolist()``, literals, and ``range``/
+``enumerate`` loop targets.
+"""
+
+import ast
+import re
+from typing import Dict, Iterable
+
+from areal_tpu.analysis.core import FileContext, Finding, Rule, Severity
+from areal_tpu.analysis.rules._util import (
+    base_name,
+    call_name,
+    dotted_name,
+    iter_functions,
+)
+
+HOT_NAME_RE = re.compile(
+    r"(decode|chunk|prefill|generate|inflight|drain_chunk|train_step"
+    r"|hot_loop)",
+    re.IGNORECASE,
+)
+
+HOST, DEVICE, UNKNOWN = "host", "device", "unknown"
+
+_HOST_CALLS = {
+    "to_host", "int", "float", "bool", "len", "str", "list", "tuple",
+    "sorted", "range", "enumerate", "zip", "jax.device_get",
+}
+_HOST_METHODS = {"tolist", "copy", "item", "append", "pop", "qsize"}
+_CONVERSIONS = {"float", "bool"}
+
+
+def _is_device_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    if name is None:
+        # ``self._get_decode_fn(...)(args)`` — a call whose func is itself
+        # a call to a ``*_fn``-getter returns a jitted callable.
+        if isinstance(node.func, ast.Call):
+            inner = call_name(node.func)
+            return bool(inner and inner.split(".")[-1].endswith("_fn"))
+        return False
+    last = name.split(".")[-1]
+    root = name.split(".")[0]
+    if name == "jax.device_get":
+        return False
+    return root in ("jnp", "jax") or last.endswith("_fn")
+
+
+def _is_host_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    if name is None:
+        return False
+    return (
+        name in _HOST_CALLS
+        or name.split(".")[0] in ("np", "numpy", "math")
+    )
+
+
+class _FnChecker(ast.NodeVisitor):
+    def __init__(self, ctx: FileContext, fn: ast.AST, qual: str):
+        self.ctx = ctx
+        self.fn = fn
+        self.qual = qual
+        self.findings = []
+        self.state: Dict[str, str] = {}
+        self.loop_depth = 0
+        self.span_depth = 0
+
+    # ---- state lattice ----
+
+    def _expr_state(self, node: ast.AST) -> str:
+        if isinstance(node, ast.Constant):
+            return HOST
+        if isinstance(node, (ast.List, ast.Tuple, ast.Dict, ast.Set,
+                             ast.ListComp, ast.DictComp, ast.SetComp,
+                             ast.JoinedStr, ast.Compare, ast.BoolOp)):
+            return HOST
+        if isinstance(node, ast.Name):
+            return self.state.get(node.id, UNKNOWN)
+        if isinstance(node, (ast.Subscript, ast.Attribute)):
+            b = base_name(node)
+            if b is not None:
+                return self.state.get(b, UNKNOWN)
+            return UNKNOWN
+        if isinstance(node, ast.Call):
+            if _is_device_call(node):
+                return DEVICE
+            if _is_host_call(node):
+                return HOST
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in _HOST_METHODS:
+                    return HOST
+                return self._expr_state(node.func.value)
+            return UNKNOWN
+        if isinstance(node, ast.BinOp):
+            l = self._expr_state(node.left)
+            r = self._expr_state(node.right)
+            if DEVICE in (l, r):
+                return DEVICE
+            if l == r == HOST:
+                return HOST
+            return UNKNOWN
+        if isinstance(node, ast.UnaryOp):
+            return self._expr_state(node.operand)
+        if isinstance(node, ast.IfExp):
+            b = self._expr_state(node.body)
+            o = self._expr_state(node.orelse)
+            return b if b == o else UNKNOWN
+        return UNKNOWN
+
+    def _bind(self, target: ast.AST, state: str) -> None:
+        if isinstance(target, ast.Name):
+            self.state[target.id] = state
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, state)
+        # attribute/subscript stores don't change a name's residency
+
+    # ---- statements ----
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        st = self._expr_state(node.value)
+        for t in node.targets:
+            self._bind(t, st)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._bind(node.target, self._expr_state(node.value))
+        self.generic_visit(node)
+
+    def _visit_loop(self, node) -> None:
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            it_state = self._expr_state(node.iter)
+            if isinstance(node.iter, ast.Call) and call_name(node.iter) in (
+                "range", "enumerate", "zip", "reversed", "sorted"
+            ):
+                it_state = HOST
+            self._bind(node.target, it_state)
+        elif isinstance(node, ast.While):
+            self._check_implicit_bool(node.test)
+        self.loop_depth += 1
+        for child in node.body:
+            self.visit(child)
+        self.loop_depth -= 1
+        for child in node.orelse:
+            self.visit(child)
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_implicit_bool(node.test)
+        self.generic_visit(node)
+
+    def _check_implicit_bool(self, test: ast.AST) -> None:
+        if isinstance(test, (ast.Name, ast.Subscript)):
+            if self._expr_state(test) == DEVICE:
+                self.findings.append(Finding(
+                    "host-sync", Severity.ERROR, self.ctx.path,
+                    test.lineno, test.col_offset,
+                    "implicit bool() of a device value in a branch "
+                    "condition forces a blocking device→host sync; compute "
+                    "the flag on device and transfer it once per chunk",
+                ))
+
+    def visit_With(self, node: ast.With) -> None:
+        is_span = any(
+            isinstance(item.context_expr, ast.Call)
+            and (call_name(item.context_expr) or "").split(".")[-1] == "span"
+            for item in node.items
+        )
+        if is_span:
+            self.span_depth += 1
+        self.generic_visit(node)
+        if is_span:
+            self.span_depth -= 1
+
+    def visit_FunctionDef(self, node):  # nested defs get their own pass
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        pass
+
+    # ---- the conversions ----
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func)
+        # block_until_ready: must be inside a tracer span (hot fns only).
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready"
+            and self.span_depth == 0
+        ):
+            self.findings.append(Finding(
+                "host-sync", Severity.ERROR, self.ctx.path,
+                node.lineno, node.col_offset,
+                "block_until_ready() outside a tracer.span: the stall is "
+                "invisible to stall attribution (PERF.md evidence bar); "
+                "wrap the sync in `with tracer.span(...)`",
+            ))
+        if self.loop_depth > 0:
+            self._check_conversion(node, name)
+        self.generic_visit(node)
+
+    def _check_conversion(self, node: ast.Call, name) -> None:
+        sev_msg = None
+        if name in _CONVERSIONS and len(node.args) >= 1:
+            st = self._expr_state(node.args[0])
+            if st == DEVICE:
+                sev_msg = (Severity.ERROR, (
+                    f"{name}() on a device value inside a hot loop is one "
+                    "blocking device→host sync per call; batch the whole "
+                    "chunk with to_host()/.tolist() once"
+                ))
+            elif st == UNKNOWN:
+                sev_msg = (Severity.WARNING, (
+                    f"{name}() inside a hot loop on a value that may be "
+                    "device-resident; if it is, this is a per-scalar sync "
+                    "— batch via to_host()/.tolist(), or annotate the "
+                    "drain boundary"
+                ))
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+        ):
+            st = self._expr_state(node.func.value)
+            if st == DEVICE:
+                sev_msg = (Severity.ERROR, (
+                    ".item() on a device value inside a hot loop is one "
+                    "blocking device→host sync per call; batch the chunk "
+                    "with to_host()/.tolist()"
+                ))
+            elif st == UNKNOWN:
+                sev_msg = (Severity.WARNING, (
+                    ".item() inside a hot loop on a value that may be "
+                    "device-resident; batch via to_host()/.tolist() or "
+                    "annotate the drain boundary"
+                ))
+        elif name in ("np.asarray", "numpy.asarray", "np.array",
+                      "numpy.array") and node.args:
+            st = self._expr_state(node.args[0])
+            if st == DEVICE:
+                sev_msg = (Severity.ERROR, (
+                    f"{name}() on a device value inside a hot loop "
+                    "transfers per iteration; hoist one batched to_host() "
+                    "out of the loop"
+                ))
+        if sev_msg is not None:
+            sev, msg = sev_msg
+            self.findings.append(Finding(
+                "host-sync", sev, self.ctx.path,
+                node.lineno, node.col_offset, msg,
+            ))
+
+
+class HostSyncRule(Rule):
+    name = "host-sync"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn, qual in iter_functions(ctx.tree):
+            if not HOT_NAME_RE.search(fn.name):
+                continue
+            checker = _FnChecker(ctx, fn, ".".join(qual))
+            for stmt in fn.body:
+                checker.visit(stmt)
+            yield from checker.findings

@@ -1,0 +1,151 @@
+"""retrace-hazard: callsite patterns that silently recompile jitted code.
+
+The framework's headline invariant is ``decode_compiles == 1`` per
+generate call (PR 1): decode shapes are bucketed and every jitted callable
+is built once, cached, and re-fed fixed-shape buffers.  Three callsite
+patterns break that quietly:
+
+- ``jax.jit(f)(...)`` inlined inside a loop: a fresh jit wrapper per
+  iteration means a fresh trace per iteration -> error;
+- ``jnp.asarray(<list-comp or variable-length list>)`` inside a loop fed
+  to a call: the array's shape follows ``len(list)``, and every new length
+  is a new compile -> error for a list-comp argument, warning when a name
+  bound to an append-grown list flows in (pad to a bucketed shape the way
+  ``_pack_admits`` does);
+- calling a ``jax.jit(f)`` result (jitted WITHOUT static_argnums /
+  static_argnames) with a ``len(...)``/``.shape[...]`` argument ->
+  warning: if that scalar selects program structure it must be static
+  (and then each new value is a legitimate, counted recompile), and if
+  it doesn't it should be an array, not a Python scalar.
+"""
+
+import ast
+from typing import Dict, Iterable, Set
+
+from areal_tpu.analysis.core import FileContext, Finding, Rule, Severity
+from areal_tpu.analysis.rules._util import (
+    call_name,
+    dotted_name,
+    iter_functions,
+    walk_scoped,
+)
+
+_ASARRAY = {"jnp.asarray", "jnp.array", "jax.numpy.asarray",
+            "jax.numpy.array"}
+_JIT = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    if name in _JIT:
+        return True
+    # functools.partial(jax.jit, ...) idiom
+    if name in ("functools.partial", "partial") and node.args:
+        return dotted_name(node.args[0]) in _JIT
+    return False
+
+
+def _has_static(node: ast.Call) -> bool:
+    return any(
+        kw.arg in ("static_argnums", "static_argnames")
+        for kw in node.keywords
+    )
+
+
+def _is_shape_scalar(arg: ast.AST) -> bool:
+    """``len(x)`` or ``x.shape[0]`` — a Python scalar derived from shape."""
+    if isinstance(arg, ast.Call) and call_name(arg) == "len":
+        return True
+    if isinstance(arg, ast.Subscript):
+        v = arg.value
+        if isinstance(v, ast.Attribute) and v.attr == "shape":
+            return True
+    return False
+
+
+class RetraceRule(Rule):
+    name = "retrace-hazard"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn, _qual in iter_functions(ctx.tree):
+            yield from self._check_fn(ctx, fn)
+
+    def _check_fn(self, ctx: FileContext, fn: ast.AST):
+        # Pass 1: names bound to append-grown lists, and names bound to
+        # jitted callables (with/without static argnums).
+        grown_lists: Set[str] = set()
+        jit_nonstatic: Set[str] = set()
+        list_births: Dict[str, int] = {}
+        for node, _depth in walk_scoped(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    if isinstance(node.value, (ast.List, ast.ListComp)):
+                        list_births[t.id] = node.lineno
+                    if isinstance(node.value, ast.Call) and _is_jit_call(
+                        node.value
+                    ) and not _has_static(node.value):
+                        jit_nonstatic.add(t.id)
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ) and node.func.attr == "append":
+                obj = node.func.value
+                if isinstance(obj, ast.Name) and obj.id in list_births:
+                    grown_lists.add(obj.id)
+
+        for node, depth in walk_scoped(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            # (1) inline jax.jit(...)(...) or bare jax.jit(...) in a loop
+            if depth > 0 and isinstance(node.func, ast.Call) and \
+                    _is_jit_call(node.func):
+                yield Finding(
+                    "retrace-hazard", Severity.ERROR, ctx.path,
+                    node.lineno, node.col_offset,
+                    "jax.jit(...) applied inside a loop builds a fresh "
+                    "wrapper (and a fresh trace) every iteration; hoist "
+                    "the jitted callable and cache it (cf. _get_*_fn "
+                    "memoization)",
+                )
+            elif depth > 0 and _is_jit_call(node):
+                yield Finding(
+                    "retrace-hazard", Severity.ERROR, ctx.path,
+                    node.lineno, node.col_offset,
+                    "jax.jit(...) constructed inside a loop retraces per "
+                    "iteration; build it once outside and reuse it",
+                )
+            # (2) jnp.asarray of a fresh variable-length Python list
+            if depth > 0 and call_name(node) in _ASARRAY and node.args:
+                arg = node.args[0]
+                if isinstance(arg, (ast.ListComp, ast.GeneratorExp)):
+                    yield Finding(
+                        "retrace-hazard", Severity.ERROR, ctx.path,
+                        node.lineno, node.col_offset,
+                        "jnp.asarray of a per-iteration list comprehension: "
+                        "the shape follows the comprehension length and "
+                        "every new length recompiles the consumer; pad to "
+                        "a bucketed fixed shape (cf. _pack_admits)",
+                    )
+                elif isinstance(arg, ast.Name) and arg.id in grown_lists:
+                    yield Finding(
+                        "retrace-hazard", Severity.WARNING, ctx.path,
+                        node.lineno, node.col_offset,
+                        f"jnp.asarray of '{arg.id}', a list grown with "
+                        ".append(): if its length varies per iteration, "
+                        "each new length recompiles; pad to a bucketed "
+                        "fixed shape",
+                    )
+            # (3) non-static jitted callable fed a shape-derived scalar
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in jit_nonstatic:
+                for arg in node.args:
+                    if _is_shape_scalar(arg):
+                        yield Finding(
+                            "retrace-hazard", Severity.WARNING, ctx.path,
+                            arg.lineno, arg.col_offset,
+                            "shape-derived Python scalar fed to a jitted "
+                            "callable with no static_argnums: mark it "
+                            "static (structure) or pass it as an array "
+                            "(data) — as a bare scalar it bakes into the "
+                            "trace unpredictably",
+                        )

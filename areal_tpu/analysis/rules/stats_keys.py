@@ -1,0 +1,62 @@
+"""stats-keys: span/stats key discipline.
+
+``base/stats.py`` weights a mean ``k`` by its paired ``k_denominator``
+when merging shards; a denominator whose mean key is absent (or a dict
+literal that silently drops a duplicated key) corrupts merged metrics
+without any runtime error — the numbers just come out wrong, which is the
+worst possible failure for the repo's "measure before/after" evidence
+bar.  Checks on every dict literal:
+
+- duplicate constant keys -> error (Python keeps the LAST value; the
+  first is silently dropped);
+- a ``<k>_denominator`` key whose mean ``<k>`` is missing from the same
+  literal -> error (merge_stats will find no mean to weight).
+"""
+
+import ast
+from typing import Iterable
+
+from areal_tpu.analysis.core import FileContext, Finding, Rule, Severity
+
+
+class StatsKeysRule(Rule):
+    name = "stats-keys"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            const_keys = []
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(
+                    k.value, (str, int, float, bool)
+                ):
+                    const_keys.append(k)
+            seen = {}
+            for k in const_keys:
+                if k.value in seen:
+                    yield Finding(
+                        "stats-keys", Severity.ERROR, ctx.path,
+                        k.lineno, k.col_offset,
+                        f"duplicate key {k.value!r} in dict literal: the "
+                        "earlier value is silently dropped",
+                    )
+                else:
+                    seen[k.value] = k
+            str_keys = {
+                k.value for k in const_keys if isinstance(k.value, str)
+            }
+            for k in const_keys:
+                if isinstance(k.value, str) and k.value.endswith(
+                    "_denominator"
+                ):
+                    mean = k.value[: -len("_denominator")]
+                    if mean not in str_keys:
+                        yield Finding(
+                            "stats-keys", Severity.ERROR, ctx.path,
+                            k.lineno, k.col_offset,
+                            f"'{k.value}' has no paired mean '{mean}' in "
+                            "the same dict: merge_stats "
+                            "(base/stats.py) weights means by their "
+                            "_denominator and this one weights nothing",
+                        )

@@ -1,0 +1,85 @@
+"""arealint CLI: TPU-hot-path static analysis over the areal_tpu tree.
+
+    python -m areal_tpu.apps.lint [paths...] [--json] [--rules a,b]
+                                  [--strict] [--min-severity LEVEL]
+
+Exit status: 0 when no gating findings, 1 when errors exist (or warnings
+under ``--strict``), 2 on usage errors.  Importing jax is deliberately
+avoided: the linter must run on a bare CPU CI box in milliseconds.
+
+Rule families (see areal_tpu/analysis/rules/): host-sync,
+retrace-hazard, async-blocking, sharding, stats-keys.  Suppress a finding
+with ``# arealint: ignore[rule] -- reason`` on the offending line or the
+line directly above; reasonless suppressions are themselves errors.
+"""
+
+import argparse
+import os
+import sys
+
+from areal_tpu.analysis import (
+    Severity,
+    analyze_paths,
+    get_rules,
+    render_human,
+    render_json,
+)
+from areal_tpu.analysis.rules import RULE_NAMES
+
+_LEVELS = {"info": Severity.INFO, "warning": Severity.WARNING,
+           "error": Severity.ERROR}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m areal_tpu.apps.lint",
+        description="arealint: TPU-hot-path static analysis",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to lint (default: areal_tpu/)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (stable schema, v1)")
+    p.add_argument("--rules", default=None,
+                   help=f"comma-separated subset of: {', '.join(RULE_NAMES)}")
+    p.add_argument("--min-severity", default="info",
+                   choices=sorted(_LEVELS),
+                   help="hide findings below this level (default: info)")
+    p.add_argument("--strict", action="store_true",
+                   help="warnings also gate (nonzero exit)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print rule names and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for name in RULE_NAMES:
+            print(name)
+        return 0
+
+    paths = args.paths or ["areal_tpu"]
+    try:
+        rules = get_rules(
+            [r.strip() for r in args.rules.split(",") if r.strip()]
+            if args.rules else None
+        )
+    except KeyError as e:
+        print(f"arealint: {e.args[0]}", file=sys.stderr)
+        return 2
+    try:
+        findings = analyze_paths(paths, rules, relative_to=os.getcwd())
+    except FileNotFoundError as e:
+        print(f"arealint: {e}", file=sys.stderr)
+        return 2
+
+    floor = _LEVELS[args.min_severity]
+    shown = [f for f in findings if f.severity >= floor]
+    if args.json:
+        print(render_json(shown))
+    else:
+        print(render_human(shown))
+
+    gate = Severity.WARNING if args.strict else Severity.ERROR
+    return 1 if any(f.severity >= gate for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

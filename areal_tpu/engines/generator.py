@@ -697,16 +697,18 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         for s in range(len(active)):
             if active[s] is None:
                 continue
-            for t in range(out_toks.shape[1]):
-                if len(toks_acc[s]) >= max_new:
-                    break
-                tok = int(out_toks[s, t])
-                if tok < 0:  # slot was already done within the chunk
-                    break
-                toks_acc[s].append(tok)
-                logps_acc[s].append(float(out_logps[s, t]))
-                if tok == self.eos_token_id:
-                    break
+            row = out_toks[s]
+            stop = np.flatnonzero(row < 0)  # -1-terminated within the chunk
+            limit = int(stop[0]) if stop.size else row.shape[0]
+            limit = min(limit, max(0, max_new - len(toks_acc[s])))
+            eos = np.flatnonzero(row[:limit] == self.eos_token_id)
+            if eos.size:  # keep the EOS token itself, drop the tail
+                limit = int(eos[0]) + 1
+            # One batched host conversion per slot per chunk — a per-token
+            # float()/int() here would be a per-scalar sync if a caller
+            # ever passed device arrays (rule host-sync).
+            toks_acc[s].extend(row[:limit].tolist())
+            logps_acc[s].extend(out_logps[s, :limit].tolist())
             finished = (
                 len(toks_acc[s]) >= max_new
                 or (toks_acc[s] and toks_acc[s][-1] == self.eos_token_id)
@@ -723,7 +725,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 if on_retire is not None:
                     on_retire(s)
             else:
-                done_host[s] = bool(new_done[s])
+                done_host[s] = new_done[s]
 
     def _take_admits(self, active, pending, n_slots):
         """Assign pending requests to free slots (longest-prompt first —
@@ -1488,6 +1490,9 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                         )
                     )
                     self.prefill_dispatches += 1
+                    # ONE host sync per refill cycle (mirrors the spec
+                    # path): the per-admit float()/int() below read these
+                    # host arrays, not the device.
                     toks0 = to_host(toks0)
                     logps0 = to_host(logps0)
                 for j, (s, i, rep, toks) in enumerate(admits):

@@ -129,4 +129,7 @@ def sft_label_count(arrays: Dict) -> float:
     label_is_prompt = np.pad(
         arrays["prompt_mask"][:, 1:], ((0, 0), (0, 1)), constant_values=True
     )
+    # Host-side by construction: inputs are numpy (loss_weight_fn runs on
+    # the data path before device placement), so this float() is one cheap
+    # host reduction, not a device sync.
     return float((shift_ok & ~label_is_prompt).sum())

@@ -74,6 +74,9 @@ def _ring_shard(q, k, v, segment_ids, axis_name: str, axis_size: int,
     lower axis_index.
     """
     b, sq, h, d = q.shape
+    # arealint: ignore[sharding] -- guarded: callers on old-jax
+    # partial-manual paths (CP+PP pipeline) pass my_index explicitly;
+    # the axis_index default only runs under new-jax shard_map.
     my = jax.lax.axis_index(axis_name) if my_index is None else my_index
     q_pos = my * sq + jnp.arange(sq, dtype=jnp.int32)
 
@@ -134,6 +137,9 @@ def _zigzag_shard(q, k, v, segment_ids, axis_name: str, axis_size: int,
     n = axis_size
     b, sq, h, d = q.shape
     sh = sq // 2
+    # arealint: ignore[sharding] -- zigzag runs only under new-jax
+    # shard_map (ring path is causal-only and gated at the dispatcher);
+    # the old-jax full-manual fallback never lowers this body.
     c = jax.lax.axis_index(axis_name)
     ar = jnp.arange(sh, dtype=jnp.int32)
 

@@ -471,6 +471,9 @@ class GenerationServer:
             # this guards the batching glue and fails the batch loudly.
             try:
                 # Linger briefly so concurrent clients land in one call.
+                # Blocking sleep is correct here: _collect_loop runs on
+                # the dedicated batcher THREAD, never on an event loop
+                # (rule async-blocking only fires inside coroutines).
                 time.sleep(self.max_wait_ms / 1000.0)
                 while len(batch) < self.max_batch:
                     try:
@@ -758,6 +761,8 @@ class ZMQGenClient(BoundedAgenerateMixin):
                 logger.exception("gen client io error")
                 fail_all(f"generation client io error: {e!r}")
                 # Persistent socket errors must not become a hot loop.
+                # Thread context: this IO loop owns its own daemon thread
+                # (no event loop to stall), so a blocking backoff is fine.
                 time.sleep(0.05)
         # Clean stop must not strand blocked callers until their timeout.
         fail_all("generation client closed")
@@ -1031,8 +1036,16 @@ def main():
     mesh = make_mesh(pc, jax.devices()[: pc.world_size])
     eos = args.eos_token_id
     if eos is None:
-        with open(os.path.join(args.path, "config.json")) as f:
-            eos = json.load(f).get("eos_token_id")
+        cfg_path = os.path.join(args.path, "config.json")
+        try:
+            with open(cfg_path) as f:
+                eos = json.load(f).get("eos_token_id")
+        except (OSError, json.JSONDecodeError) as e:
+            raise RuntimeError(
+                f"gen_server config missing/unreadable at {cfg_path}: {e}; "
+                "pass --eos-token-id explicitly or point --path at a "
+                "checkpoint directory containing config.json"
+            ) from e
     engine = GeneratorEngine(
         cfg, params, mesh, eos_token_id=eos,
         max_decode_batch=args.max_decode_batch,

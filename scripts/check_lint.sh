@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# arealint CI gate: run the TPU-hot-path static analyzer in JSON mode and
+# fail on any unsuppressed error.  No jax import, runs in milliseconds on
+# a bare CPU box.  Usage: scripts/check_lint.sh [paths...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+paths=("$@")
+[ ${#paths[@]} -eq 0 ] && paths=(areal_tpu)
+
+out=$(python -m areal_tpu.apps.lint "${paths[@]}" --json) || {
+    rc=$?
+    echo "$out"
+    echo "arealint: FAILED (unsuppressed errors above; fix or annotate" >&2
+    echo "with '# arealint: ignore[rule] -- reason')" >&2
+    exit $rc
+}
+# Sanity-parse the JSON so a malformed analyzer output also fails CI.
+echo "$out" | python -c 'import json,sys; json.load(sys.stdin)'
+echo "arealint: clean (0 errors) over: ${paths[*]}"
+exit 0
