@@ -324,6 +324,8 @@ def cmd_ppo_math(args):
         kv_paged=False if args.no_paged_kv else None,
         kv_page_size=args.kv_page_size,
         kv_pool_pages=args.kv_pool_pages,
+        prefill_chunk_tokens=args.prefill_chunk_tokens,
+        kv_share_prefix=False if args.no_kv_share_prefix else None,
         train_backend_args={
             k: v
             for k, v in (
@@ -429,6 +431,14 @@ def main(argv=None):
                     help="fixed KV pool size in pages (0 = auto-size "
                          "for the worst case; positive caps KV HBM and "
                          "bounds concurrent admissions)")
+    pp.add_argument("--prefill-chunk-tokens", type=int, default=None,
+                    help="serving plane: prompt tokens forwarded per "
+                         "decode step inside the unified chunk (0 = "
+                         "legacy two-program admit; default from "
+                         "AREAL_PREFILL_CHUNK_TOKENS)")
+    pp.add_argument("--no-kv-share-prefix", action="store_true",
+                    help="disable copy-on-write prompt page sharing "
+                         "across a sampling group (parity/debug)")
     pp.add_argument("--master-dtype", default=None,
                     choices=(None, "float32", "bfloat16"),
                     help="optimizer master/Adam dtype; bfloat16 halves "

@@ -95,6 +95,26 @@ class _PagedGenSession:
     prompt_key: str = "packed_prompts"
     prompt_lens: Any = None
     n: int = 1
+    # ---- unified serving plane (chunked prefill, prefill_chunk > 0) ----
+    # Per-row prefill progress lives HERE, not in a second compiled
+    # program: prompt_buf[slot] holds the not-yet-forwarded prompt
+    # remainder, prefill_rem counts tokens still to consume, prompt_off
+    # indexes the next prompt_buf read.  A row with prefill_rem > 0 is
+    # an admitting row inside the serving chunk; 0 means decoding.
+    prefill_chunk: int = 0  # W = query lanes per row per inner step
+    prompt_buf: Any = None  # host np [n_slots, pbw] int32
+    prefill_rem: Any = None  # host np [n_slots] int32
+    prompt_off: Any = None  # host np [n_slots] int32
+    # First PRIVATE flat token position per slot (shared prompt pages
+    # end here): 0 for owners, sp*page_size for prefix-cache followers.
+    # Resume replay must never write below it.
+    shared_from: Any = None  # host np [n_slots] int32
+    slot_hash: Any = None  # Dict[slot, bytes] prompt hash per live slot
+    # hash -> owner slot currently prefilling it; followers stay pending
+    # until the owner registers the prefix (keeps a GRPO group's k
+    # members sharing instead of racing k private prefills).
+    inflight_prefix: Any = None  # Dict[bytes, int]
+    peak_live: int = 0  # max simultaneously live slots (capacity sweep)
 
 
 def _spec_emit(
@@ -177,6 +197,8 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         kv_paged: Optional[bool] = None,
         kv_page_size: int = 128,
         kv_pool_pages: int = 0,
+        prefill_chunk_tokens: Optional[int] = None,
+        kv_share_prefix: Optional[bool] = None,
     ):
         if cfg.is_critic:
             raise ValueError("cannot generate from a critic model")
@@ -230,6 +252,34 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         # makes admission wait for freed pages (PagePoolExhausted if a
         # LIVE slot cannot grow).
         self.kv_pool_pages = int(kv_pool_pages)
+        # Unified serving plane (plain paged inflight only): admitted
+        # prompts consume their tokens in W-sized slices INSIDE the same
+        # ragged chunk step that advances live decodes — no stop-the-
+        # world prefill program, no admission-shape zoo, decode_compiles
+        # stays 1 under continuous admission.  W > 1 rides the decode
+        # step's streamed weights (decode is bandwidth-bound; extra
+        # query lanes reuse the stream, same economics as spec decode).
+        # 0 = legacy two-program admit path (kept for parity tests).
+        if prefill_chunk_tokens is None:
+            prefill_chunk_tokens = int(
+                os.environ.get("AREAL_PREFILL_CHUNK_TOKENS", "8")
+            )
+        if prefill_chunk_tokens < 0:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 0 (0 = legacy admit "
+                f"path), got {prefill_chunk_tokens}"
+            )
+        self.prefill_chunk_tokens = int(prefill_chunk_tokens)
+        # Copy-on-write prompt sharing (serving plane only): a GRPO
+        # group's k responses — and any cross-request repeat of the same
+        # prompt — map the owner's full prompt pages and re-forward only
+        # the sub-page tail, multiplying effective pool capacity by the
+        # group size.  AREAL_KV_SHARE_PREFIX=0 disables.
+        if kv_share_prefix is None:
+            kv_share_prefix = (
+                os.environ.get("AREAL_KV_SHARE_PREFIX", "1") != "0"
+            )
+        self.kv_share_prefix = bool(kv_share_prefix)
         # When True (default), set_params COPIES any leaf whose buffers
         # alias the source tree — required when generation can overlap a
         # train step that donates those buffers (rollout_ahead).  In a
@@ -310,6 +360,26 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         if not self.kv_paged or self.kv_pool_pages == 0:
             return None
         return self.kv_pool_pages * self.kv_page_size
+
+    def group_footprint_tokens(
+        self, prompt_len: int, max_new_tokens: int, n: int
+    ) -> int:
+        """Worst-case KV pool footprint (in tokens) of a group of `n`
+        same-prompt requests, CoW-aware: when the serving plane shares
+        prompt pages, the prompt's full pages are paid ONCE and each
+        member adds only the sub-page tail plus its new-token budget —
+        gen_server splits request groups against page_budget_tokens
+        using this instead of the dense n*(prompt+new) product."""
+        plen, mnew, n = int(prompt_len), int(max_new_tokens), int(n)
+        if (
+            not self.kv_paged
+            or self.prefill_chunk_tokens <= 0
+            or not self.kv_share_prefix
+            or n <= 1
+        ):
+            return n * (plen + mnew)
+        sp = max(0, (plen - 1) // self.kv_page_size)
+        return sp * self.kv_page_size + n * ((plen - sp * self.kv_page_size) + mnew)
 
     # ---------------- weights ----------------
 
@@ -509,23 +579,40 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             write_pos0 = np.zeros((st.n_slots,), np.int32)
             take_idx = np.zeros((st.n_slots,), np.int32)
             live_mask = np.zeros((st.n_slots,), bool)
+            q_lens = np.zeros((st.n_slots,), np.int32)
             for s in live:
                 hist = np.concatenate(
                     [st.slot_prompt[s], np.asarray(st.toks_acc[s], np.int32)]
                 )
-                L = int(st.cache_len[s])  # == len(hist): one KV per token
+                # One KV per FORWARDED token: L == len(hist) for decoding
+                # rows; a serving row parked mid-prefill has only
+                # hist[:L] in cache (the rest still waits in prompt_buf)
+                # and replays from that prefix.
+                L = int(st.cache_len[s])
+                hl = hist[:L]
                 # Replay window: the last chunk's emissions (>= 1 so the
                 # fresh logits always come from a real forward).  Padding
-                # columns write at positions < the slot's pre-interrupt
-                # reservation and are overwritten by the next decode
-                # chunk — harmless by the same argument as done-row
-                # rewrites in the decode step.
-                r = int(min(max(int(st.last_emit[s]), 1), Q, L))
-                tokens[s, :r] = hist[L - r :]
+                # columns are DEAD queries (q_lens=r): their writes drop
+                # in-kernel, so they can never scribble pad-token k/v
+                # past the row's valid tail.  SHARED prompt pages
+                # (prefix-cache followers) are read-only: clamp the
+                # window to the slot's private region so the teacher-
+                # forced rewrite can never touch a page other rows map.
+                priv = (
+                    int(st.shared_from[s])
+                    if st.shared_from is not None
+                    else 0
+                )
+                r = int(min(max(int(st.last_emit[s]), 1), Q, L - priv))
+                if r <= 0:
+                    continue  # nothing private to replay (cannot happen
+                    # for rows that ran a chunk; kept as a guard)
+                tokens[s, :r] = hl[L - r :]
                 write_pos0[s] = L - r
                 positions[s] = (L - r) + np.arange(Q)
                 take_idx[s] = r - 1
                 live_mask[s] = True
+                q_lens[s] = r
             with tracer.span("resume_replay", cat="compute", n=len(live)):
                 st.logits_buf, st.pool = self._get_paged_replay_fn(
                     st.n_slots, st.n_pages, st.max_pages, st.chunk_t
@@ -534,9 +621,28 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                     st.pool, jnp.asarray(st.alloc.table),
                     jnp.asarray(write_pos0), st.logits_buf,
                     jnp.asarray(take_idx), jnp.asarray(live_mask),
+                    jnp.asarray(q_lens),
                 )
         self.resume_replays += 1
-        if not self._run_paged_loop(st):
+        if st.prefill_chunk > 0:
+            # The weight push invalidated every cached prompt KV: drop
+            # the prefix-cache holds so post-resume admissions re-prefill
+            # under the new weights instead of sharing stale pages (live
+            # followers keep their mappings — their whole history KV is
+            # equally pre-push, the accepted resume approximation).
+            st.alloc.prefix_clear()
+            if st.inflight_prefix is not None:
+                st.inflight_prefix.clear()
+            if st.slot_hash is not None:
+                # Rows live across the push carry mixed-weight KV; if one
+                # later finishes its prefill it must NOT register the
+                # prefix (followers would inherit the mix — a fresh
+                # admission re-prefills cleanly instead).
+                st.slot_hash.clear()
+            finished = self._run_serving_loop(st)
+        else:
+            finished = self._run_paged_loop(st)
+        if not finished:
             return None
         return self._assemble(
             st.sample, st.prompt_key, st.prompt_lens, st.results, st.n
@@ -557,9 +663,15 @@ class GeneratorEngine(HostOffloadMixin, Engine):
 
         @functools.partial(jax.jit, donate_argnums=(3, 6))
         def fn(params, tokens, positions, pool, page_table, write_pos0,
-               logits_buf, take_idx, live_mask):
+               logits_buf, take_idx, live_mask, q_lens):
+            # Ragged replay: only the r real history columns per row are
+            # live.  Padding columns and parked rows are DEAD queries —
+            # their cache writes drop and their attention is fully masked,
+            # so a short replay window can never scribble garbage k/v past
+            # a row's valid tail (pages later rows would gather).
             logits_all, pool = tfm.decode_step_spec_paged(
-                params, cfg, tokens, positions, pool, page_table, write_pos0
+                params, cfg, tokens, positions, pool, page_table, write_pos0,
+                q_lens=q_lens,
             )
             fresh = jnp.take_along_axis(
                 logits_all, take_idx[:, None, None], axis=1
@@ -580,12 +692,28 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         routes to the paged-pool variants: fixed shapes, one decode
         compilation, zero grow copies."""
         if gconfig.spec_decode_k > 0:
+            # Speculative decoding keeps its two-program admit (the
+            # draft buffers make admission stateful); the serving plane
+            # covers the plain path only — pinned by
+            # tests/test_paged_kv.py::TestServingPlaneEquivalence.
             if self.kv_paged:
                 return self._generate_inflight_spec_paged(
                     reqs, gconfig, key, results
                 )
             return self._generate_inflight_spec(reqs, gconfig, key, results)
         if self.kv_paged:
+            # int8 KV keeps the two-program admit: chunked prefill
+            # scores later prompt chunks against the QUANTIZED cache of
+            # earlier ones, while the one-shot prefill is full-precision
+            # — routing int8 through serving would break its bit-parity
+            # contract with the dense window (test_plain_greedy_int8).
+            if (
+                self.prefill_chunk_tokens > 0
+                and self.kv_cache_dtype != "int8"
+            ):
+                return self._generate_inflight_serving(
+                    reqs, gconfig, key, results
+                )
             return self._generate_inflight_plain_paged(
                 reqs, gconfig, key, results
             )
@@ -1207,6 +1335,462 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         logger.info(
             f"compiled paged inflight decoder n_slots={n_slots} "
             f"pool={n_pages}x{self.kv_page_size} chunk={chunk_t}"
+        )
+        return fn
+
+    # -- unified serving plane (chunked prefill + CoW page sharing) --
+
+    def _generate_inflight_serving(self, reqs, gconfig, key, results) -> None:
+        """`_generate_inflight_plain_paged` with admission folded INTO the
+        chunk step: an admitted prompt is consumed in `prefill_chunk_tokens`
+        (W)-sized slices by the same ragged compiled program that advances
+        live decodes, so admission never stalls running rows behind a
+        stop-the-world prefill and never compiles a second program —
+        decode_compiles stays 1 under continuous admission.  Same-prompt
+        repeats (a GRPO group's k responses) share the owner's full prompt
+        pages copy-on-write via the allocator's prefix cache, multiplying
+        the pool's effective concurrency by ~the group size."""
+        n_slots = min(max(self.batch_shard, self.max_decode_batch), len(reqs))
+        while n_slots % self.batch_shard:
+            n_slots += 1
+        ps = self.kv_page_size
+        chunk_t = min(32, gconfig.max_new_tokens)
+        max_prompt = max(len(t) for (_, _, t) in reqs)
+        max_pages = -(-(max_prompt + gconfig.max_new_tokens + chunk_t) // ps)
+        n_pages = self.kv_pool_pages or n_slots * max_pages
+        pbw = max(max_prompt, 1)
+        st = _PagedGenSession(
+            gconfig=gconfig,
+            key=key,
+            results=results,
+            n_slots=n_slots,
+            n_pages=n_pages,
+            max_pages=max_pages,
+            chunk_t=chunk_t,
+            alloc=PageAllocator(n_pages, ps, n_slots, max_pages),
+            pool=tfm.init_paged_kv_cache(
+                self.cfg, n_pages, ps, dtype=self._paged_kv_dtype()
+            ),
+            logits_buf=jnp.zeros((n_slots, self.cfg.vocab_size), jnp.float32),
+            cache_len=np.zeros((n_slots,), np.int32),
+            gen_count=np.zeros((n_slots,), np.int32),
+            done_host=np.ones((n_slots,), bool),
+            active=[None] * n_slots,
+            toks_acc={},
+            logps_acc={},
+            pending=list(reversed(reqs)),
+            slot_prompt={},
+            last_emit=np.zeros((n_slots,), np.int32),
+            prefill_chunk=max(1, self.prefill_chunk_tokens),
+            prompt_buf=np.full((n_slots, pbw), self.pad_token_id, np.int32),
+            prefill_rem=np.zeros((n_slots,), np.int32),
+            prompt_off=np.zeros((n_slots,), np.int32),
+            shared_from=np.zeros((n_slots,), np.int32),
+            slot_hash={},
+            inflight_prefix={},
+        )
+        self._run_serving_loop(st)
+
+    def _run_serving_loop(self, st: "_PagedGenSession") -> bool:
+        """The serving chunk loop: every iteration admits into free slots
+        (host bookkeeping only — no device dispatch), maps pages for the
+        chunk's worst-case advance, privatises any shared page a write
+        could touch (CoW safety net), then runs ONE compiled ragged chunk
+        in which prefilling rows consume W prompt tokens per inner step
+        while decoding rows emit one token.  Interruptible at chunk
+        boundaries exactly like `_run_paged_loop` (returns False parked,
+        True finished)."""
+        gconfig = st.gconfig
+        alloc = st.alloc
+        n_slots, ps, chunk_t = st.n_slots, alloc.page_size, st.chunk_t
+        W = st.prefill_chunk
+        pbw = st.prompt_buf.shape[1]
+        chunk_fn = self._get_serving_chunk_fn(
+            n_slots, st.n_pages, st.max_pages, chunk_t, W, pbw, gconfig
+        )
+        while st.pending or any(a is not None for a in st.active):
+            if self._interrupt_evt.is_set():
+                self._session = st
+                tracer.counter(
+                    "gen_interrupt",
+                    parked_live=sum(a is not None for a in st.active),
+                    parked_pending=len(st.pending),
+                )
+                return False
+            self._take_admits_serving(st)
+            # Map pages covering this chunk's worst-case advance per live
+            # slot: a prefilling row consumes up to chunk_t*W prompt
+            # tokens (but never more than its remainder + the decode
+            # steps that may follow); a decoding row advances at most
+            # chunk_t, clamped to its remaining emission budget — tokens
+            # past max_new are drained away anyway, so reserving for
+            # them would make a nearly-finished row hold pages it never
+            # usefully writes (over-budget writes drop via the sentinel,
+            # like done-row rewrites).  Host-side int appends only.
+            max_new = gconfig.max_new_tokens
+            for s in range(n_slots):
+                if st.active[s] is not None:
+                    rem = int(st.prefill_rem[s])
+                    left = max(0, max_new - int(st.gen_count[s]))
+                    target = int(st.cache_len[s]) + max(
+                        1, min(chunk_t * W, rem + chunk_t, rem + left)
+                    )
+                    self._reserve_with_evict(alloc, s, target)
+            self._privatize_write_windows(st)
+            self._accum_pool_stats(
+                "paged", int(st.cache_len.sum()), alloc.allocated_pages() * ps
+            )
+
+            st.key, sub = jax.random.split(st.key)
+            prev_gen = st.gen_count.copy()
+            prev_rem = st.prefill_rem.copy()
+            with tracer.span(
+                "serving_chunk", cat="compute", t=chunk_t, w=W
+            ):
+                (
+                    out_toks, out_logps, st.logits_buf, st.pool,
+                    new_cache_len, new_gen_count, new_done, new_rem,
+                    new_off,
+                ) = chunk_fn(
+                    self.params, st.pool, st.logits_buf,
+                    jnp.asarray(alloc.table), jnp.asarray(st.prompt_buf),
+                    jnp.asarray(st.prompt_off), jnp.asarray(st.prefill_rem),
+                    jnp.asarray(st.cache_len), jnp.asarray(st.gen_count),
+                    jnp.asarray(st.done_host), sub,
+                )
+                out_toks = to_host(out_toks)
+                out_logps = to_host(out_logps)
+            st.cache_len = to_host(new_cache_len).copy()
+            st.gen_count = to_host(new_gen_count).copy()
+            st.prefill_rem = to_host(new_rem).copy()
+            st.prompt_off = to_host(new_off).copy()
+            st.last_emit = st.gen_count - prev_gen
+
+            # Register prefixes that FINISHED prefilling this chunk,
+            # before any retirement below can release the owner's pages:
+            # the cache's per-page holds then keep them alive for
+            # followers regardless of when the owner finishes decoding.
+            if self.kv_share_prefix:
+                for s in range(n_slots):
+                    if (
+                        st.active[s] is not None
+                        and prev_rem[s] > 0
+                        and st.prefill_rem[s] == 0
+                    ):
+                        self._register_prefix(st, s)
+
+            def _retire(s):
+                alloc.release(s)
+                st.slot_prompt.pop(s, None)
+                h = st.slot_hash.pop(s, None)
+                if h is not None and st.inflight_prefix.get(h) == s:
+                    del st.inflight_prefix[h]
+
+            self._drain_chunk_outputs(
+                out_toks, out_logps, to_host(new_done), st.active,
+                st.toks_acc, st.logps_acc, st.results, st.done_host,
+                st.cache_len, gconfig.max_new_tokens, on_retire=_retire,
+            )
+        self.last_pool_stats.update(
+            pool_pages=st.n_pages, page_size=ps,
+            pages_recycled=alloc.pages_recycled,
+            peak_pages_used=alloc.peak_pages_used,
+            cow_copies=alloc.cow_copies,
+            shared_mappings=alloc.shared_mappings,
+            prefix_hits=alloc.prefix_hits,
+            prefix_misses=alloc.prefix_misses,
+            peak_live_slots=st.peak_live,
+        )
+        self.live_slots = 0
+        return True
+
+    def _take_admits_serving(self, st: "_PagedGenSession") -> int:
+        """Admission for the serving loop: pure host bookkeeping (the
+        compiled chunk does the prompt forwards).  A request whose prompt
+        hash is in the prefix cache maps the cached FULL prompt pages
+        (refcount bump, zero copies) and re-forwards only the sub-page
+        tail — its marginal footprint is tail + decode budget instead of
+        prompt + decode budget.  A request whose hash an in-flight owner
+        is still prefilling WAITS (admitting it now would duplicate the
+        owner's pages); the owner is live, so waiting cannot deadlock.
+        Raises PagePoolExhausted via reserve() when nothing is live and
+        the head request still cannot fit (undersized pool)."""
+        alloc, gconfig = st.alloc, st.gconfig
+        n_slots, ps, chunk_t = st.n_slots, alloc.page_size, st.chunk_t
+        slack = chunk_t
+        admitted = 0
+        for s in range(n_slots):
+            if st.active[s] is not None or not st.pending:
+                continue
+            i, rep, toks = st.pending[-1]
+            toks = np.asarray(toks, np.int32)
+            plen = len(toks)
+            # Only FULL pages are shareable, and the tail must keep >= 1
+            # token so the follower's re-forward produces its own
+            # end-of-prompt logits: sp = (plen-1)//ps pages cover
+            # positions [0, sp*ps), the follower prefills [sp*ps, plen).
+            sp = (plen - 1) // ps
+            h = toks.tobytes() if (self.kv_share_prefix and sp > 0) else None
+            shared = alloc.prefix_lookup(h) if h is not None else None
+            if shared is None and h is not None and h in st.inflight_prefix:
+                break  # wait one chunk for the owner to register
+            if shared is not None:
+                need = alloc.pages_for(plen + slack) - len(shared)
+                if need > len(alloc.free):
+                    alloc.prefix_evict(need)
+                if need > len(alloc.free):
+                    break
+                alloc.share(s, shared)
+                start = sp * ps
+                alloc.reserve(s, plen + slack)
+            else:
+                if not alloc.can_reserve(s, plen + slack):
+                    alloc.prefix_evict(
+                        alloc.pages_for(plen + slack) - int(alloc.used[s])
+                    )
+                if not alloc.can_reserve(s, plen + slack):
+                    break
+                alloc.reserve(s, plen + slack)
+                start = 0
+                if h is not None:
+                    st.inflight_prefix[h] = s
+                    st.slot_hash[s] = h
+            st.pending.pop()
+            st.active[s] = (i, rep)
+            st.cache_len[s] = start
+            st.gen_count[s] = 0
+            st.done_host[s] = False
+            st.toks_acc[s] = []
+            st.logps_acc[s] = []
+            st.slot_prompt[s] = toks
+            st.shared_from[s] = start
+            rem = plen - start
+            st.prompt_buf[s, :] = self.pad_token_id
+            st.prompt_buf[s, :rem] = toks[start:]
+            st.prefill_rem[s] = rem
+            st.prompt_off[s] = 0
+            st.last_emit[s] = 0
+            admitted += 1
+        if (
+            admitted == 0
+            and st.pending
+            and not any(a is not None for a in st.active)
+        ):
+            # Nothing live to retire and the head request does not fit:
+            # waiting would spin forever.  (The admission loop above
+            # already tried prefix eviction, and inflight_prefix cannot
+            # block here — owners are by definition live.)  reserve()
+            # raises the clean capacity error.
+            free_slot = next(
+                s2 for s2 in range(n_slots) if st.active[s2] is None
+            )
+            alloc.reserve(
+                free_slot, len(st.pending[-1][2]) + slack
+            )  # raises
+        self.live_slots = sum(a is not None for a in st.active)
+        st.peak_live = max(st.peak_live, self.live_slots)
+        tracer.counter(
+            "gen_slots", live=self.live_slots, pending=len(st.pending)
+        )
+        return admitted
+
+    def _register_prefix(self, st: "_PagedGenSession", s: int) -> None:
+        """Publish slot `s`'s full prompt pages in the prefix cache (one
+        hold per page) now that its prefill is complete — followers with
+        the same prompt hash admit against these pages from the next
+        chunk on.  Only owners carry a slot_hash entry; a no-op for
+        followers and for slots admitted before a weight push (resume
+        clears slot_hash so mixed-weight KV is never published)."""
+        h = st.slot_hash.get(s)
+        if h is None:
+            return
+        alloc = st.alloc
+        sp = (len(st.slot_prompt[s]) - 1) // alloc.page_size
+        if sp > 0:
+            alloc.prefix_insert(h, alloc.table[s, :sp])
+        st.inflight_prefix.pop(h, None)
+        del st.slot_hash[s]
+
+    def _reserve_with_evict(
+        self, alloc: PageAllocator, s: int, tokens: int
+    ) -> None:
+        """reserve() that first evicts LRU prefix-cache holds when the
+        free list is short — a live slot's growth outranks cached
+        prefixes.  Still raises PagePoolExhausted when eviction cannot
+        free enough (pool genuinely too small for what is live)."""
+        if not alloc.can_reserve(s, tokens):
+            alloc.prefix_evict(
+                alloc.pages_for(tokens) - int(alloc.used[s])
+            )
+        alloc.reserve(s, tokens)
+
+    def _privatize_write_windows(self, st: "_PagedGenSession") -> None:
+        """Copy-on-write safety net, run before every chunk: privatise
+        any SHARED page inside a live row's write window [cache_len,
+        used*page_size) and execute the page copies on device.  By
+        construction the serving plane never maps a shared page at or
+        past a row's write cursor (followers share only pages strictly
+        below their starting cache_len), so the steady state is zero
+        pairs — but the read-only contract for shared pages is enforced
+        here rather than assumed."""
+        alloc = st.alloc
+        pairs: List[Tuple[int, int]] = []
+        for s in range(st.n_slots):
+            if st.active[s] is None:
+                continue
+            pairs.extend(
+                alloc.ensure_writable(
+                    s,
+                    int(st.cache_len[s]),
+                    int(alloc.used[s]) * alloc.page_size,
+                )
+            )
+        if not pairs:
+            return
+        fn = self._get_copy_pages_fn()
+        width = 16  # fixed batch width: one compiled shape, sentinel-padded
+        for lo in range(0, len(pairs), width):
+            batch = pairs[lo : lo + width]
+            src = np.full((width,), alloc.sentinel, np.int32)
+            dst = np.full((width,), alloc.sentinel, np.int32)
+            for j, (a, b) in enumerate(batch):
+                src[j], dst[j] = a, b
+            st.pool = fn(st.pool, jnp.asarray(src), jnp.asarray(dst))
+
+    def _get_copy_pages_fn(self):
+        sig = ("copy_pages",)
+        if sig in self._gen_fns:
+            return self._gen_fns[sig]
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fn(pool, src, dst):
+            return tfm.copy_pages(pool, src, dst)
+
+        self._gen_fns[sig] = fn
+        return fn
+
+    def _get_serving_chunk_fn(
+        self, n_slots: int, n_pages: int, max_pages: int, chunk_t: int,
+        W: int, pbw: int, g: GenerationHyperparameters,
+    ):
+        """The unified serving chunk: chunk_t inner steps, each ONE
+        ragged W-wide `decode_step_spec_paged` forward in which a
+        prefilling row teacher-forces up to W prompt tokens (emitting
+        nothing), a decoding row samples and forwards 1 token, and a
+        done/parked row contributes 0 live queries.  W > 1 rides the
+        decode step's streamed weights — decode is bandwidth-bound, so
+        the extra query lanes reuse the same weight stream (the spec-
+        decode economics).  Like the legacy decode fn its signature
+        depends only on pool geometry, so it compiles EXACTLY ONCE per
+        generate call even under continuous admission — the admission-
+        shape zoo (`_get_prefill_pages_fn` bucketed shapes) is gone.
+
+        Emission is FILL-INDEXED, not step-indexed: a row's sampled
+        tokens pack contiguously from column 0 of its out row whatever
+        inner steps it spent prefilling, preserving the -1-termination
+        contract `_drain_chunk_outputs` relies on."""
+        sig = (
+            "serving_chunk", n_slots, n_pages, max_pages, chunk_t, W, pbw,
+            g.min_new_tokens, g.greedy, g.top_p, g.top_k, g.temperature,
+        )
+        if sig in self._gen_fns:
+            return self._gen_fns[sig]
+        cfg = self.cfg
+        eos = self.eos_token_id
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def fn(params, pool, logits, page_table, prompt_buf, prompt_off,
+               prefill_rem, cache_len, gen_count, done, key):
+            out_toks = jnp.full((n_slots, chunk_t), -1, jnp.int32)
+            out_logps = jnp.zeros((n_slots, chunk_t), jnp.float32)
+            out_fill = jnp.zeros((n_slots,), jnp.int32)
+            rows = jnp.arange(n_slots)
+            lanes = jnp.arange(W)
+
+            def body(t, st):
+                (logits, pool, cache_len, gen_count, done, prefill_rem,
+                 prompt_off, out_toks, out_logps, out_fill) = st
+                is_pref = prefill_rem > 0
+                c = jnp.where(is_pref, jnp.minimum(prefill_rem, W), 1)
+                sub = jax.random.fold_in(key, t)
+                lg = logits
+                if g.min_new_tokens > 0:
+                    lg = jnp.where(
+                        (gen_count < g.min_new_tokens)[:, None]
+                        & (jnp.arange(cfg.vocab_size) == eos)[None, :],
+                        -1e10,
+                        lg,
+                    )
+                # Sampling consumes one fold_in(key, t) per inner step
+                # regardless of row mode, so the key chain matches the
+                # legacy decode chunk token-for-token on decode rows
+                # (prefilling rows' samples are discarded below).
+                tok, logp = sample_token(
+                    lg, sub,
+                    temperature=g.temperature, top_k=g.top_k, top_p=g.top_p,
+                    greedy=g.greedy,
+                )
+                emitting = (~done) & (~is_pref)
+                out_toks = out_toks.at[rows, out_fill].set(
+                    jnp.where(emitting, tok, out_toks[rows, out_fill])
+                )
+                out_logps = out_logps.at[rows, out_fill].set(
+                    jnp.where(emitting, logp, out_logps[rows, out_fill])
+                )
+                out_fill = out_fill + emitting.astype(jnp.int32)
+                # W-wide token slab: prompt slice for prefilling rows
+                # (teacher-forced), sampled token in lane 0 for decoding
+                # rows (done rows rewrite their position with EOS, the
+                # legacy convention — the allocator keeps it mapped).
+                idx = jnp.minimum(
+                    prompt_off[:, None] + lanes[None, :], pbw - 1
+                )
+                pref_toks = jnp.take_along_axis(prompt_buf, idx, axis=1)
+                lane0 = jnp.where(
+                    is_pref, pref_toks[:, 0], jnp.where(done, eos, tok)
+                )
+                slab = jnp.where(is_pref[:, None], pref_toks, 0)
+                slab = slab.at[:, 0].set(lane0)
+                positions = cache_len[:, None] + lanes[None, :]
+                logits_all, pool2 = tfm.decode_step_spec_paged(
+                    params, cfg, slab, positions, pool, page_table,
+                    cache_len, q_lens=c,
+                )
+                # Next-token logits = each row's LAST live query's output
+                # (query c-1): end-of-slice for prefill, the single lane
+                # for decode — uniform take, no per-mode branch.
+                logits = jnp.take_along_axis(
+                    logits_all, (c - 1)[:, None, None], axis=1
+                )[:, 0]
+                done = jnp.where(is_pref, done, done | (tok == eos))
+                # Decode rows advance by their emission (a row emitting
+                # its EOS still wrote that token); done rows rewrote in
+                # place and stay put — same rule as the legacy chunk.
+                cache_len = cache_len + jnp.where(
+                    is_pref, c, emitting.astype(jnp.int32)
+                )
+                gen_count = gen_count + emitting.astype(jnp.int32)
+                prompt_off = prompt_off + jnp.where(is_pref, c, 0)
+                prefill_rem = prefill_rem - jnp.where(is_pref, c, 0)
+                return (logits, pool2, cache_len, gen_count, done,
+                        prefill_rem, prompt_off, out_toks, out_logps,
+                        out_fill)
+
+            st = (logits, pool, cache_len, gen_count, done, prefill_rem,
+                  prompt_off, out_toks, out_logps, out_fill)
+            st = jax.lax.fori_loop(0, chunk_t, body, st)
+            (logits, pool, cache_len, gen_count, done, prefill_rem,
+             prompt_off, out_toks, out_logps, _) = st
+            return (
+                out_toks, out_logps, logits, pool, cache_len, gen_count,
+                done, prefill_rem, prompt_off,
+            )
+
+        self._gen_fns[sig] = fn
+        self.decode_compiles += 1
+        logger.info(
+            f"compiled serving chunk n_slots={n_slots} "
+            f"pool={n_pages}x{self.kv_page_size} chunk={chunk_t} W={W}"
         )
         return fn
 

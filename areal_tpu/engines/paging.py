@@ -1,19 +1,36 @@
-"""Host-side free-list page allocator for the paged KV pool.
+"""Host-side refcounted free-list page allocator for the paged KV pool.
 
 The device side (`models/transformer.py PagedKVCache`) is a dumb pool of
 `n_pages` fixed-size pages; ALL placement policy lives here, on the
 host, between jitted decode chunks: which pool pages belong to which
-slot, in what order, and which are free.  The allocator's `table` array
-is shipped to the device as the page table each chunk (a few KB), so
-"growing" a sequence is appending one int to a row — no cache copy, no
-recompile — and a retired slot's pages go back on the free list for the
-next admission.
+slot, in what order, which are free, and — new in the unified serving
+plane — which pages are *shared* between slots.  The allocator's `table`
+array is shipped to the device as the page table each chunk (a few KB),
+so "growing" a sequence is appending one int to a row — no cache copy,
+no recompile — and a retired slot's pages go back on the free list for
+the next admission.
+
+Sharing model (copy-on-write): a page may be mapped by several slots at
+once (a GRPO group's k responses mapping the same prompt pages, or a
+prefix-cache hit on a shared system prompt).  `refcount[p]` counts the
+mappings (plus one for a prefix-cache hold).  Shared pages are
+read-only by contract: before any device write that lands inside a
+slot's window, the engine calls `ensure_writable(slot, lo, hi)` which
+privatises still-shared pages in that window (allocates a fresh page,
+remaps the slot, returns (src, dst) pairs for the device page-copy) —
+classic copy-on-write.  In the steady serving plane the engine arranges
+windows so writes only ever hit private pages and `ensure_writable` is
+a no-op safety net, but the contract is enforced either way (and under
+``AREAL_PAGING_CHECK=1`` every mutation re-validates the full
+free/mapped/refcount partition).
 
 Reference role: vLLM's BlockAllocator / the block tables behind TPU
-ragged paged attention.
+ragged paged attention, plus its prefix-caching refcount scheme.
 """
 
-from typing import List
+import os
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -27,14 +44,25 @@ class PagePoolExhausted(RuntimeError):
     fewer requests), never corruption."""
 
 
+class PagingInvariantError(AssertionError):
+    """The allocator's free/mapped/refcount partition is broken.
+
+    Only raised by `check()` (wired to every mutation under
+    ``AREAL_PAGING_CHECK=1``); seeing one means a host-side paging bug,
+    not a capacity condition."""
+
+
 class PageAllocator:
-    """Free-list allocator over `n_pages` pages of `page_size` tokens.
+    """Refcounted free-list allocator over `n_pages` pages of
+    `page_size` tokens.
 
     Each of `n_slots` decode slots owns an ordered, contiguous-from-zero
     list of pages: `table[slot, j]` is the pool page holding the slot's
     flat positions [j*page_size, (j+1)*page_size).  Unmapped entries
     hold the sentinel `n_pages` (device scatters drop it, gathers clamp
-    + mask)."""
+    + mask).  A page may appear in several rows (prompt sharing); its
+    `refcount` tracks the mappings and the page returns to the free
+    list only when the last mapping is released."""
 
     def __init__(
         self, n_pages: int, page_size: int, n_slots: int, max_pages: int
@@ -48,17 +76,44 @@ class PageAllocator:
         self.free: List[int] = list(range(n_pages - 1, -1, -1))
         self.table = np.full((n_slots, max_pages), self.sentinel, np.int32)
         self.used = np.zeros((n_slots,), np.int32)
+        self.refcount = np.zeros((n_pages,), np.int32)
+        # Prefix cache: prompt-hash -> page list, LRU-ordered.  Each
+        # cached entry holds one ref per page so retiring the inserting
+        # slot cannot free pages a later request may still hit.
+        self._prefix_cache: "OrderedDict[object, List[int]]" = OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_misses = 0
         # Stats for the bench/tests: recycled counts pages handed out
-        # again after having been freed by a retired slot.
+        # again after having been freed; cow_copies counts pages
+        # privatised by ensure_writable; shared_mappings counts table
+        # references served by an already-mapped page (capacity saved).
         self._freed_ever: set = set()
         self.pages_recycled = 0
         self.peak_pages_used = 0
+        self.cow_copies = 0
+        self.shared_mappings = 0
+        self.debug_check = os.environ.get("AREAL_PAGING_CHECK") == "1"
+
+    # ---------------------------------------------------------------- core
 
     def pages_for(self, tokens: int) -> int:
         return -(-int(tokens) // self.page_size)
 
     def allocated_pages(self) -> int:
         return self.n_pages - len(self.free)
+
+    def _alloc_page(self) -> int:
+        p = self.free.pop()
+        if p in self._freed_ever:
+            self.pages_recycled += 1
+        self.refcount[p] = 1
+        return p
+
+    def _unref(self, p: int) -> None:
+        self.refcount[p] -= 1
+        if self.refcount[p] == 0:
+            self.free.append(p)
+            self._freed_ever.add(p)
 
     def can_reserve(self, slot: int, tokens: int) -> bool:
         need = self.pages_for(tokens)
@@ -87,25 +142,220 @@ class PageAllocator:
                 f"raise kv_pool_pages or admit fewer concurrent requests"
             )
         while self.used[slot] < need:
-            p = self.free.pop()
-            if p in self._freed_ever:
-                self.pages_recycled += 1
-            self.table[slot, self.used[slot]] = p
+            self.table[slot, self.used[slot]] = self._alloc_page()
             self.used[slot] += 1
         self.peak_pages_used = max(
             self.peak_pages_used, self.allocated_pages()
         )
+        self.maybe_check()
 
     def release(self, slot: int) -> None:
-        """Return all of `slot`'s pages to the free list."""
+        """Drop all of `slot`'s mappings; pages whose last reference
+        this was go back on the free list (prefix-cache holds keep
+        theirs alive)."""
         for j in range(int(self.used[slot])):
-            p = int(self.table[slot, j])
-            self.free.append(p)
-            self._freed_ever.add(p)
+            self._unref(int(self.table[slot, j]))
         self.table[slot, :] = self.sentinel
         self.used[slot] = 0
+        self.maybe_check()
 
     def page_rows(self, slot: int, tokens: int) -> np.ndarray:
         """The slot's first `pages_for(tokens)` mapped pages (for the
         admission prefill scatter); caller must have reserve()d them."""
         return self.table[slot, : self.pages_for(tokens)].copy()
+
+    # ------------------------------------------------------------- sharing
+
+    def share(self, slot: int, pages: Sequence[int]) -> None:
+        """Map `pages` (another slot's or the prefix cache's prompt
+        pages, in order) into the FRONT of `slot`'s table, bumping each
+        page's refcount.  `slot` must have no mappings yet — sharing is
+        an admission-time operation."""
+        if int(self.used[slot]) != 0:
+            raise ValueError(
+                f"share() into non-empty slot {slot} "
+                f"(used={int(self.used[slot])})"
+            )
+        if len(pages) > self.max_pages:
+            raise PagePoolExhausted(
+                f"slot {slot} cannot map {len(pages)} shared pages: the "
+                f"page table holds max_pages={self.max_pages}"
+            )
+        for j, p in enumerate(pages):
+            p = int(p)
+            if self.refcount[p] <= 0:
+                raise ValueError(f"share() of unmapped page {p}")
+            self.refcount[p] += 1
+            self.table[slot, j] = p
+            self.shared_mappings += 1
+        self.used[slot] = len(pages)
+        self.peak_pages_used = max(
+            self.peak_pages_used, self.allocated_pages()
+        )
+        self.maybe_check()
+
+    def is_shared(self, slot: int, page_idx: int) -> bool:
+        p = int(self.table[slot, page_idx])
+        return p != self.sentinel and int(self.refcount[p]) > 1
+
+    def ensure_writable(
+        self, slot: int, lo_tok: int, hi_tok: int
+    ) -> List[Tuple[int, int]]:
+        """Copy-on-write: privatise every still-shared page of `slot`
+        covering flat token positions [lo_tok, hi_tok).  Returns the
+        (src_page, dst_page) pairs the caller must copy ON DEVICE before
+        the next scatter into that window (the allocator only remaps the
+        table — it never touches KV data).  No-op ([]) when the window's
+        pages are already private."""
+        if hi_tok <= lo_tok:
+            return []
+        j_lo = int(lo_tok) // self.page_size
+        j_hi = (int(hi_tok) - 1) // self.page_size
+        pairs: List[Tuple[int, int]] = []
+        for j in range(j_lo, min(j_hi + 1, int(self.used[slot]))):
+            src = int(self.table[slot, j])
+            if src == self.sentinel or int(self.refcount[src]) <= 1:
+                continue
+            if not self.free:
+                raise PagePoolExhausted(
+                    f"KV page pool exhausted: slot {slot} needs 1 page to "
+                    f"privatise shared page {src} (copy-on-write) but 0 of "
+                    f"{self.n_pages} are free (page_size={self.page_size}); "
+                    f"raise kv_pool_pages or admit fewer concurrent requests"
+                )
+            dst = self._alloc_page()
+            self.refcount[src] -= 1  # never hits 0: it was > 1
+            self.table[slot, j] = dst
+            self.cow_copies += 1
+            pairs.append((src, dst))
+        self.peak_pages_used = max(
+            self.peak_pages_used, self.allocated_pages()
+        )
+        self.maybe_check()
+        return pairs
+
+    def private_page_count(self, slot: int) -> int:
+        """Pages mapped by `slot` alone (its marginal pool footprint)."""
+        n = 0
+        for j in range(int(self.used[slot])):
+            if int(self.refcount[int(self.table[slot, j])]) == 1:
+                n += 1
+        return n
+
+    # -------------------------------------------------------- prefix cache
+
+    def prefix_lookup(self, key) -> Optional[List[int]]:
+        """Pages cached for prompt-hash `key` (LRU-refreshed), or None."""
+        pages = self._prefix_cache.get(key)
+        if pages is None:
+            self.prefix_misses += 1
+            return None
+        self._prefix_cache.move_to_end(key)
+        self.prefix_hits += 1
+        return list(pages)
+
+    def prefix_insert(self, key, pages: Sequence[int]) -> None:
+        """Hold `pages` (a slot's full prompt pages) in the prefix cache
+        under `key`, taking one ref per page so they survive the
+        inserting slot's retirement."""
+        if key in self._prefix_cache or len(pages) == 0:
+            return
+        for p in pages:
+            p = int(p)
+            if self.refcount[p] <= 0:
+                raise ValueError(f"prefix_insert of unmapped page {p}")
+            self.refcount[p] += 1
+        self._prefix_cache[key] = [int(p) for p in pages]
+        self.maybe_check()
+
+    def prefix_evict(self, need_free: int = 1) -> int:
+        """Drop least-recently-used prefix entries until `need_free`
+        pages are free (or the cache is empty).  Returns entries
+        evicted.  Entries whose pages are still mapped by live slots
+        free nothing immediately but still drop the cache hold."""
+        evicted = 0
+        while self._prefix_cache and len(self.free) < need_free:
+            _, pages = self._prefix_cache.popitem(last=False)
+            for p in pages:
+                self._unref(int(p))
+            evicted += 1
+        if evicted:
+            self.maybe_check()
+        return evicted
+
+    def prefix_clear(self) -> int:
+        """Drop every prefix-cache hold (weight updates invalidate all
+        cached KV).  Returns entries dropped."""
+        n = len(self._prefix_cache)
+        while self._prefix_cache:
+            _, pages = self._prefix_cache.popitem(last=False)
+            for p in pages:
+                self._unref(int(p))
+        if n:
+            self.maybe_check()
+        return n
+
+    def prefix_len(self) -> int:
+        return len(self._prefix_cache)
+
+    # ----------------------------------------------------------- invariants
+
+    def maybe_check(self) -> None:
+        if self.debug_check:
+            self.check()
+
+    def check(self) -> None:
+        """Validate the full allocator state; raises
+        `PagingInvariantError` on any violation.
+
+        Invariants: (1) free list ∪ {pages with refcount > 0} is an
+        exact partition of the pool, no duplicates on the free list;
+        (2) refcounts are nonnegative and each page's refcount equals
+        its table mappings + prefix-cache holds (so a shared page can
+        never be silently freed or double-freed — the host-side half of
+        "CoW never mutates a shared page in place"; the device half is
+        that writes only target windows `ensure_writable` has already
+        privatised, which this refcount accounting makes checkable);
+        (3) every table row is contiguous-from-zero with `used[slot]`
+        mapped entries then sentinels."""
+        free_set = set(self.free)
+        if len(free_set) != len(self.free):
+            raise PagingInvariantError("duplicate pages on the free list")
+        refs: Dict[int, int] = {}
+        n_slots = self.table.shape[0]
+        for s in range(n_slots):
+            u = int(self.used[s])
+            for j in range(self.max_pages):
+                p = int(self.table[s, j])
+                if j < u:
+                    if p == self.sentinel:
+                        raise PagingInvariantError(
+                            f"slot {s} entry {j} < used={u} is sentinel"
+                        )
+                    refs[p] = refs.get(p, 0) + 1
+                elif p != self.sentinel:
+                    raise PagingInvariantError(
+                        f"slot {s} entry {j} >= used={u} maps page {p}"
+                    )
+        for pages in self._prefix_cache.values():
+            for p in pages:
+                refs[int(p)] = refs.get(int(p), 0) + 1
+        for p in range(self.n_pages):
+            rc = int(self.refcount[p])
+            if rc < 0:
+                raise PagingInvariantError(f"page {p} refcount {rc} < 0")
+            if rc != refs.get(p, 0):
+                raise PagingInvariantError(
+                    f"page {p} refcount {rc} != {refs.get(p, 0)} "
+                    f"mappings (table + prefix cache)"
+                )
+            if (p in free_set) != (rc == 0):
+                raise PagingInvariantError(
+                    f"page {p} refcount {rc} but "
+                    f"{'on' if p in free_set else 'not on'} the free list"
+                )
+        if len(free_set) + sum(1 for p in refs if refs[p] > 0) != self.n_pages:
+            raise PagingInvariantError(
+                f"free ({len(free_set)}) + mapped ({len(refs)}) pages do "
+                f"not partition the pool of {self.n_pages}"
+            )

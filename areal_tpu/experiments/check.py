@@ -148,19 +148,27 @@ def check_ppo_math(cfg) -> None:
             f"kv_pool_pages must be >= 0 (0 = auto-size), got "
             f"{cfg.kv_pool_pages}"
         )
+    pct = getattr(cfg, "prefill_chunk_tokens", None)
+    if pct is not None and pct < 0:
+        _fail(
+            f"prefill_chunk_tokens must be >= 0 (0 = legacy two-program "
+            f"admit, None = env default), got {pct}"
+        )
     if cfg.gen_server_url and (
         getattr(cfg, "kv_paged", None) is not None
         or getattr(cfg, "kv_page_size", 128) != 128
         or getattr(cfg, "kv_pool_pages", 0)
+        or getattr(cfg, "prefill_chunk_tokens", None) is not None
+        or getattr(cfg, "kv_share_prefix", None) is not None
     ):
         # Same reasoning as gen_backend_args below: these configure the
         # in-process GeneratorEngine, which decoupled serving never
         # builds — a silently ignored capacity knob is a footgun.
         _fail(
-            "kv_paged/kv_page_size/kv_pool_pages apply to the "
-            "in-process GeneratorEngine and are ignored under "
-            "gen_server_url (configure the standalone gen_server "
-            "instead)"
+            "kv_paged/kv_page_size/kv_pool_pages/prefill_chunk_tokens/"
+            "kv_share_prefix apply to the in-process GeneratorEngine "
+            "and are ignored under gen_server_url (configure the "
+            "standalone gen_server instead)"
         )
     if (cfg.rollout_ahead > 0 or mho is not None) and getattr(
         cfg, "gen_backend_args", {}

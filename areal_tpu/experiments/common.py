@@ -229,6 +229,13 @@ class PPOMathConfig:
     kv_paged: Optional[bool] = None
     kv_page_size: int = 128
     kv_pool_pages: int = 0
+    # Serving-plane knobs: prefill_chunk_tokens>0 folds admission
+    # prefill INTO the decode chunk (one compiled program, no admission
+    # stall); 0 = legacy two-program admit; None = env default
+    # (AREAL_PREFILL_CHUNK_TOKENS).  kv_share_prefix maps a group's common
+    # prompt pages copy-on-write across rows (None = on when serving).
+    prefill_chunk_tokens: Optional[int] = None
+    kv_share_prefix: Optional[bool] = None
     # Extra TrainEngine kwargs for actor/critic (remat_policy,
     # master_dtype, pipe_schedule) — the single-chip 1.5B fit needs
     # master_dtype="bfloat16" here, exactly like bench.py.
@@ -569,6 +576,8 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                         "kv_paged": cfg.kv_paged,
                         "kv_page_size": cfg.kv_page_size,
                         "kv_pool_pages": cfg.kv_pool_pages,
+                        "prefill_chunk_tokens": cfg.prefill_chunk_tokens,
+                        "kv_share_prefix": cfg.kv_share_prefix,
                         **cfg.gen_backend_args,
                     },
                 ),

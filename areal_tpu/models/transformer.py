@@ -152,11 +152,16 @@ def _norm(
 def _embed(
     params: Params, cfg: ModelConfig, tokens: jax.Array, positions: jax.Array
 ) -> jax.Array:
-    x = jnp.take(params["embed"], tokens, axis=0)
+    # mode="clip", not the jit default "fill": out-of-vocab ids (the pad /
+    # eos sentinels sit past the table in some configs) must embed to
+    # FINITE garbage.  A NaN here is not locally harmless — pad lanes write
+    # their k/v into cache pages, and masked attention still reads them as
+    # weight*NaN = NaN, poisoning every later query on the page.
+    x = jnp.take(params["embed"], tokens, axis=0, mode="clip")
     if cfg.embed_scale:  # gemma normalizer, computed in fp32
         x = (x.astype(jnp.float32) * (cfg.hidden_dim**0.5)).astype(x.dtype)
     if cfg.pos_emb == "learned":
-        x = x + jnp.take(params["pos_embed"], positions, axis=0)
+        x = x + jnp.take(params["pos_embed"], positions, axis=0, mode="clip")
     return x
 
 
@@ -1218,18 +1223,34 @@ def decode_step_spec_paged(
     cache: PagedKVCache,
     page_table: jax.Array,  # [B, max_pages] int32, sentinel = n_pages
     write_pos0: jax.Array,  # [B] int32 — flat position of tokens[:, 0]
+    q_lens: "jax.Array | None" = None,  # [B] int32 — live queries per row
 ) -> Tuple[jax.Array, PagedKVCache]:
     """`decode_step_spec` over a paged pool: Q consecutive tokens per row
     in one forward, k/v written at flat positions write_pos0..+Q-1
     through the page table, fp32 logits [B, Q, V].  Same exact-
     verification semantics (quantized cache included) as the dense
-    speculative step."""
+    speculative step.
+
+    `q_lens` makes the step RAGGED — the unified serving chunk's mixed
+    prefill+decode forward: row b's queries i >= q_lens[b] are dead
+    (their cache writes DROP and their attention is fully masked), so a
+    decoding row contributes 1 query, an admitting row a prompt slice of
+    up to Q, and a parked row 0, all in one compiled program.  Dead-
+    query logits are garbage the caller ignores, exactly like padding
+    rows in `prefill_into_pages`."""
     b, q_len = tokens.shape
     x = _embed(params, cfg, tokens.reshape(-1), positions.reshape(-1))
     x = x.reshape(b, q_len, cfg.hidden_dim)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
     col = write_pos0[:, None] + jnp.arange(q_len)[None, :]  # [B, Q]
     wp_page, wp_off = _page_of(page_table, col, cache.page_size)
+    if q_lens is not None:
+        # Dead queries must not scatter: route their page index out of
+        # range (2**30, the `_page_of` OOB convention) so mode="drop"
+        # discards them — this is what keeps garbage lanes from ever
+        # touching pool pages (shared ones included).
+        dead = jnp.arange(q_len)[None, :] >= q_lens[:, None]
+        wp_page = jnp.where(dead, jnp.int32(2**30), wp_page)
     quant = cache.quantized
 
     def body(carry, blk):
@@ -1244,7 +1265,7 @@ def decode_step_spec_paged(
         )
         attn = paged_decode_attention_chunk(
             q, k_pool_l, v_pool_l, page_table, write_pos0 + 1,
-            k_scale=ks_l, v_scale=vs_l,
+            k_scale=ks_l, v_scale=vs_l, q_lens=q_lens,
         )
         ao = attn.reshape(b, q_len, cfg.q_dim) @ blk["wo"]
         if cfg.proj_bias:
@@ -1330,3 +1351,39 @@ def prefill_into_pages(
         v=cache.v.at[:, flat].set(chunked(row_cache.v), mode="drop"),
         page_size=ps,
     )
+
+
+def copy_pages(
+    cache: PagedKVCache,
+    src_pages: jax.Array,  # [N] int32 pool page ids (sentinel = padding)
+    dst_pages: jax.Array,  # [N] int32 pool page ids (sentinel = padding)
+) -> PagedKVCache:
+    """Copy whole KV pages src -> dst inside the pool in one gather +
+    scatter per tensor — the device half of copy-on-write (the allocator
+    hands out the (src, dst) pairs, `PageAllocator.ensure_writable`).
+    Padding pairs use the sentinel (>= n_pages): their gather clamps to
+    a legal page and the scatter DROPS, so one compiled shape serves any
+    number of live copies up to N."""
+    n = cache.n_pages
+    src = jnp.minimum(src_pages.astype(jnp.int32), n - 1)
+    dst = jnp.where(
+        dst_pages.astype(jnp.int32) >= n,
+        jnp.int32(2**30),
+        dst_pages.astype(jnp.int32),
+    )
+    new = PagedKVCache(
+        k=cache.k.at[:, dst].set(cache.k[:, src], mode="drop"),
+        v=cache.v.at[:, dst].set(cache.v[:, src], mode="drop"),
+        page_size=cache.page_size,
+    )
+    if cache.quantized:
+        new = dataclasses.replace(
+            new,
+            k_scale=cache.k_scale.at[:, dst].set(
+                cache.k_scale[:, src], mode="drop"
+            ),
+            v_scale=cache.v_scale.at[:, dst].set(
+                cache.v_scale[:, src], mode="drop"
+            ),
+        )
+    return new

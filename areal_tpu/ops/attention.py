@@ -179,13 +179,21 @@ def decode_attention_chunk(
     valid_to0: jax.Array,  # [B] int — one past query 0's last visible slot
     k_scale: "Optional[jax.Array]" = None,  # [B, S_max, n_kv]: int8 cache
     v_scale: "Optional[jax.Array]" = None,
+    q_lens: "Optional[jax.Array]" = None,  # [B] int — live queries per row
 ) -> jax.Array:
     """Multi-query decode attention for speculative decoding: query i
     attends the window [valid_from, valid_to0 + i) — the causal extension
     of `decode_attention` to a chunk of Q drafted positions (each draft
     sees the cache up to and including its own just-written slot).
-    Same GQA-grouped, bf16-operand/fp32-accumulate formulation."""
-    if _decode_kernel_enabled():
+    Same GQA-grouped, bf16-operand/fp32-accumulate formulation.
+
+    `q_lens` makes the chunk RAGGED: only row queries i < q_lens[row]
+    are live (a decoding slot contributes 1, an admitting slot its
+    prompt slice, a parked slot 0); dead queries are fully masked and
+    emit exact zeros.  The dense Pallas chunk kernel stays uniform-Q, so
+    ragged calls take the XLA formulation (only the paged pool path —
+    which has its own ragged kernel — passes q_lens)."""
+    if _decode_kernel_enabled() and q_lens is None:
         from areal_tpu.ops.pallas.decode_attention import (
             decode_attention_chunk_kernel,
         )
@@ -217,6 +225,11 @@ def decode_attention_chunk(
         idx[None, None, :]
         < (valid_to0[:, None] + jnp.arange(nq_tok)[None, :])[:, :, None]
     )  # [B, Q, S]
+    if q_lens is not None:
+        valid = valid & (
+            jnp.arange(nq_tok)[None, :, None]
+            < jnp.broadcast_to(q_lens, (b,))[:, None, None]
+        )
     logits = jnp.where(valid[:, None, :, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     # Zero fully-masked (empty-window) rows: see decode_attention.
@@ -237,18 +250,29 @@ def decode_attention_chunk(
 # --------------------------------------------------------------------------
 
 
+def clamp_page_table(page_table: jax.Array, n_pool: int) -> jax.Array:
+    """The ONE sentinel rule for paged reads, shared by the Pallas
+    kernel and the XLA gather fallback: unmapped entries (>= n_pool)
+    clamp to the LAST pool page so every dereference is a legal index,
+    and correctness comes from masking — pages are mapped contiguously
+    from flat position 0, so any position addressed through a sentinel
+    entry lies at or past the row's live window and the causal/ragged
+    mask removes it.  Never rely on the clamped page's CONTENTS (it
+    aliases whatever sequence owns that page)."""
+    return jnp.minimum(page_table.astype(jnp.int32), n_pool - 1)
+
+
 def paged_gather_layer(
     pool_layer: jax.Array,  # [P, ps, ...] one layer's pool view
     page_table: jax.Array,  # [B, max_pages] int32 (sentinel >= P)
 ) -> jax.Array:
     """Gather a row-major dense window [B, max_pages*ps, ...] from the
     pool through the page table.  Sentinel (unmapped) entries clamp to
-    the last page — their positions lie past every row's live window,
-    so the attention mask removes them.  This reads each slot's MAPPED
-    pages only (plus the clamped repeats for unmapped slots), not the
-    whole pool."""
-    p = pool_layer.shape[0]
-    pt = jnp.minimum(page_table.astype(jnp.int32), p - 1)
+    the last page (`clamp_page_table`) — their positions lie past every
+    row's live window, so the attention mask removes them.  This reads
+    each slot's MAPPED pages only (plus the clamped repeats for unmapped
+    slots), not the whole pool."""
+    pt = clamp_page_table(page_table, pool_layer.shape[0])
     g = jnp.take(pool_layer, pt, axis=0)  # [B, mp, ps, ...]
     b, mp, ps = g.shape[:3]
     return g.reshape(b, mp * ps, *pool_layer.shape[2:])
@@ -293,16 +317,21 @@ def paged_decode_attention_chunk(
     valid_to0: jax.Array,  # [B] int — one past query 0's window
     k_scale: "Optional[jax.Array]" = None,
     v_scale: "Optional[jax.Array]" = None,
+    q_lens: "Optional[jax.Array]" = None,  # [B] int live queries per row
 ) -> jax.Array:
-    """Speculative-chunk decode attention through a page table: query i
-    attends [0, valid_to0 + i)."""
+    """Chunk decode attention through a page table: query i attends
+    [0, valid_to0 + i).  With `q_lens` the chunk is RAGGED — row b
+    contributes q_lens[b] live queries (mixed prefill+decode serving
+    chunks); dead queries emit exact zeros on both the Pallas kernel and
+    the XLA gather fallback."""
     if _decode_kernel_enabled():
         from areal_tpu.ops.pallas.paged_attention import (
             paged_decode_attention_chunk_kernel,
         )
 
         return paged_decode_attention_chunk_kernel(
-            q, k_pool, v_pool, page_table, valid_to0, k_scale, v_scale
+            q, k_pool, v_pool, page_table, valid_to0, k_scale, v_scale,
+            q_lens=q_lens,
         )
     b = q.shape[0]
     k_cache = paged_gather_layer(k_pool, page_table)
@@ -311,7 +340,7 @@ def paged_decode_attention_chunk(
     vs = None if v_scale is None else paged_gather_layer(v_scale, page_table)
     return decode_attention_chunk(
         q, k_cache, v_cache, jnp.zeros((b,), jnp.int32), valid_to0,
-        k_scale=ks, v_scale=vs,
+        k_scale=ks, v_scale=vs, q_lens=q_lens,
     )
 
 

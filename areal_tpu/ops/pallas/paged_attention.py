@@ -44,7 +44,8 @@ def _vmem(shape, dtype):
 
 
 def _paged_chunk_kernel(
-    pt_ref, hi_ref,  # scalar prefetch: [B, mp] page table, [B] hi0
+    pt_ref, hi_ref, ql_ref,  # scalar prefetch: [B, mp] page table,
+    # [B] hi0, [B] live query counts (ragged rows)
     q_ref, k_ref, v_ref, ks_ref, vs_ref,  # inputs
     o_ref,  # output
     m_scr, l_scr, acc_scr,  # scratch
@@ -54,7 +55,12 @@ def _paged_chunk_kernel(
     """Query i's live window is [0, hi0 + i): paged rows are left-aligned
     from flat position 0, so there is no `lo` — pages are mapped
     contiguously and page `pi` covers flat positions
-    [pi*page_size, (pi+1)*page_size)."""
+    [pi*page_size, (pi+1)*page_size).
+
+    Ragged rows: only queries i < ql_ref[bi] are live — a decoding slot
+    contributes 1, an admitting slot its prompt slice, a parked slot 0.
+    Dead queries output exact zeros (fully masked); rows with ql == 0
+    skip every page's compute."""
     bi = pl.program_id(0)
     pi = pl.program_id(2)
 
@@ -65,9 +71,10 @@ def _paged_chunk_kernel(
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     hi0 = hi_ref[bi]
-    # The widest query sees up to hi0 + nq_tok - 1; later pages hold no
+    ql = ql_ref[bi]
+    # The widest LIVE query sees up to hi0 + ql - 1; later pages hold no
     # live positions for this row (contiguous mapping) and are skipped.
-    run = pi * page_size < hi0 + nq_tok - 1
+    run = (ql > 0) & (pi * page_size < hi0 + ql - 1)
 
     @pl.when(run)
     def _compute():
@@ -85,7 +92,7 @@ def _paged_chunk_kernel(
             jnp.int32, s.shape, 1
         )
         qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // rep
-        mask = pos < hi0 + qi
+        mask = (pos < hi0 + qi) & (qi < ql)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:]
@@ -117,6 +124,7 @@ def paged_decode_attention_chunk_kernel(
     valid_to0: jax.Array,  # [B] int32 — one past query 0's window
     k_scale: Optional[jax.Array] = None,  # [P, ps, n_kv] when int8
     v_scale: Optional[jax.Array] = None,
+    q_lens: Optional[jax.Array] = None,  # [B] int32 live queries per row
 ) -> jax.Array:
     from jax.experimental.pallas import tpu as pltpu
 
@@ -126,9 +134,16 @@ def paged_decode_attention_chunk_kernel(
     rep = n_q // n_kv
     quant = k_scale is not None
     # Unmapped sentinel entries must still produce a legal index for the
-    # prefetched index_map (their compute is skipped / masked anyway).
-    pt = jnp.minimum(page_table.astype(jnp.int32), n_pool - 1)
+    # prefetched index_map (their compute is skipped / masked anyway) —
+    # the one clamp-then-mask rule shared with the XLA gather fallback.
+    from areal_tpu.ops.attention import clamp_page_table
+
+    pt = clamp_page_table(page_table, n_pool)
     hi = jnp.broadcast_to(valid_to0, (b,)).astype(jnp.int32)
+    if q_lens is None:
+        ql = jnp.full((b,), nq_tok, jnp.int32)
+    else:
+        ql = jnp.broadcast_to(q_lens, (b,)).astype(jnp.int32)
     qh = q.reshape(b, nq_tok, n_kv, rep, d).transpose(0, 2, 1, 3, 4)
     qh = qh.reshape(b, n_kv, nq_tok * rep, d)
     if quant:
@@ -144,29 +159,31 @@ def paged_decode_attention_chunk_kernel(
     )
     qr = nq_tok * rep
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(b, n_kv, mp),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, qr, d), lambda bi, g, pi, pt, hi: (bi, g, 0, 0)
+                (1, 1, qr, d), lambda bi, g, pi, pt, hi, ql: (bi, g, 0, 0)
             ),
             pl.BlockSpec(
                 (1, ps, 1, d),
-                lambda bi, g, pi, pt, hi: (pt[bi, pi], 0, g, 0),
+                lambda bi, g, pi, pt, hi, ql: (pt[bi, pi], 0, g, 0),
             ),
             pl.BlockSpec(
                 (1, ps, 1, d),
-                lambda bi, g, pi, pt, hi: (pt[bi, pi], 0, g, 0),
+                lambda bi, g, pi, pt, hi, ql: (pt[bi, pi], 0, g, 0),
             ),
             pl.BlockSpec(
-                (1, ps, 1), lambda bi, g, pi, pt, hi: (pt[bi, pi], 0, g)
+                (1, ps, 1),
+                lambda bi, g, pi, pt, hi, ql: (pt[bi, pi], 0, g),
             ),
             pl.BlockSpec(
-                (1, ps, 1), lambda bi, g, pi, pt, hi: (pt[bi, pi], 0, g)
+                (1, ps, 1),
+                lambda bi, g, pi, pt, hi, ql: (pt[bi, pi], 0, g),
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, qr, d), lambda bi, g, pi, pt, hi: (bi, g, 0, 0)
+            (1, 1, qr, d), lambda bi, g, pi, pt, hi, ql: (bi, g, 0, 0)
         ),
         scratch_shapes=[
             _vmem((qr, 1), jnp.float32),
@@ -179,7 +196,7 @@ def paged_decode_attention_chunk_kernel(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, n_kv, qr, d), jnp.float32),
         interpret=_interpret(),
-    )(pt, hi, qh, k_pool, v_pool, ks, vs)
+    )(pt, hi, ql, qh, k_pool, v_pool, ks, vs)
     out = out.reshape(b, n_kv, nq_tok, rep, d).transpose(0, 2, 1, 3, 4)
     return out.reshape(b, nq_tok, n_q, d).astype(q.dtype)
 

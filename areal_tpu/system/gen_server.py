@@ -515,16 +515,40 @@ class GenerationServer:
         tokens; admitting a group whose worst-case footprint exceeds it
         would either exhaust the pool mid-flight or serialize behind
         the allocator — splitting up front keeps every call feasible.
-        A single oversized request still runs alone (the engine raises
-        a clean PagePoolExhausted that fails only that sub-group)."""
+        Footprints are CoW-aware when the engine exposes
+        `group_footprint_tokens` (the serving plane's prompt-page
+        sharing makes a group of n responses cost prompt + n*(tail+new),
+        not n*(prompt+new) — without this the splitter would shard
+        groups the pool can in fact hold whole).  A request whose
+        worst-case footprint exceeds the budget EVEN ALONE — singletons
+        included, which previously bypassed the check entirely — fails
+        up front with the capacity error instead of burning a generate
+        call destined to exhaust the pool mid-flight."""
         budget = getattr(self.engine, "page_budget_tokens", None)
-        if budget is None or len(group) <= 1:
+        if budget is None:
             return self._run_subgroup(group)
+        foot = getattr(self.engine, "group_footprint_tokens", None)
+
+        def need_of(p: _Pending) -> int:
+            g = p.gconfig
+            if foot is not None:
+                return foot(len(p.prompt_ids), g.max_new_tokens, g.n)
+            return g.n * (len(p.prompt_ids) + g.max_new_tokens)
+
         sub: List[_Pending] = []
         used = 0
         for p in group:
-            g = p.gconfig
-            need = g.n * (len(p.prompt_ids) + g.max_new_tokens)
+            need = need_of(p)
+            if need > budget:
+                self._fail_request(
+                    p,
+                    f"request footprint {need} tokens (n={p.gconfig.n}, "
+                    f"prompt {len(p.prompt_ids)} + max_new "
+                    f"{p.gconfig.max_new_tokens}) exceeds the KV page "
+                    f"budget of {budget} tokens; raise kv_pool_pages or "
+                    f"shrink the request",
+                )
+                continue
             if sub and used + need > budget:
                 self._run_subgroup(sub)
                 sub, used = [], 0
@@ -532,6 +556,20 @@ class GenerationServer:
             used += need
         if sub:
             self._run_subgroup(sub)
+
+    def _fail_request(self, p: _Pending, msg: str) -> None:
+        logger.error(f"rejecting {p.qid}: {msg}")
+        p.error = msg
+        if p.t_enq is not None:
+            tracer.complete(
+                f"request:{p.qid}",
+                start_ns=p.t_enq,
+                qid=p.qid,
+                n=p.gconfig.n,
+                prompt_len=len(p.prompt_ids),
+                error=True,
+            )
+        p.done.set()
 
     def _run_subgroup(self, group: List[_Pending]):
         try:
@@ -1023,6 +1061,13 @@ def main():
     p.add_argument("--no-paged-kv", action="store_true",
                    help="dense grow-by-doubling KV window instead of "
                         "the paged pool")
+    p.add_argument("--prefill-chunk-tokens", type=int, default=None,
+                   help="prompt tokens consumed per inner step inside "
+                        "the serving chunk (0 = legacy two-program "
+                        "admit; default 8, or AREAL_PREFILL_CHUNK_TOKENS)")
+    p.add_argument("--no-kv-share-prefix", action="store_true",
+                   help="disable copy-on-write prompt page sharing "
+                        "(prefix cache) in the serving plane")
     p.add_argument("--token", default="",
                    help="shared secret (or AREAL_GEN_TOKEN)")
     p.add_argument("--zmq-port", type=int, default=None,
@@ -1052,6 +1097,8 @@ def main():
         kv_paged=False if args.no_paged_kv else None,
         kv_page_size=args.kv_page_size,
         kv_pool_pages=args.kv_pool_pages,
+        prefill_chunk_tokens=args.prefill_chunk_tokens,
+        kv_share_prefix=False if args.no_kv_share_prefix else None,
     )
     server = GenerationServer(
         engine, host=args.host, port=args.port, token=args.token,

@@ -34,6 +34,8 @@ import threading
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Paranoid page allocator: validate every allocator transition.
+os.environ.setdefault("AREAL_PAGING_CHECK", "1")
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )

@@ -14,11 +14,30 @@ line with the contract metrics:
   - kv_pool_utilization: live tokens / allocated cache tokens (chunk-
                         averaged) — paged must be >= dense
 
+Two serving-plane legs ride along (--mode stall / sweep / all):
+
+  - stall: the SAME oversubscribed workload traced twice — legacy
+    two-program admit (prefill_chunk_tokens=0, a separate prefill
+    dispatch stalls the decode stream at every admission) vs the
+    serving plane (chunked prefill inside the decode chunk, zero
+    prefill dispatches, decode_compiles == 1) — and prints both
+    stall-attribution reports (areal_tpu.apps.trace_report).
+  - sweep: group-size sweep (n in {1,4,8}) of one long prompt at a
+    FIXED kv_pool_pages, kv_share_prefix on vs off: with copy-on-write
+    prefix sharing the group's prompt pages are mapped once, so the
+    same pool holds >= 3x as many concurrently live rows
+    (peak_live_slots) at group size 8.
+
+Runs with AREAL_PAGING_CHECK=1 so every allocator transition is
+invariant-checked while the numbers are gathered.
+
 Usage (from the repo root; takes a few minutes):
-    python scripts/measure_paged.py [--max-new 4096] [--out FILE]
+    python scripts/measure_paged.py [--mode all] [--max-new 4096]
+                                    [--out FILE]
 
 The committed artifact is the stdout of one run, saved under a
-timestamped name (bench_paged_cpu8_<UTC>.log) and cited from PERF.md.
+timestamped name (bench_paged_cpu8_<UTC>.log for the compare leg,
+bench_serving_cpu8_<UTC>.log for stall+sweep) and cited from PERF.md.
 """
 
 import argparse
@@ -28,6 +47,9 @@ import sys
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Measure under the paranoid allocator: every reserve/share/release is
+# invariant-checked, so a perf number can never come from a refcount bug.
+os.environ.setdefault("AREAL_PAGING_CHECK", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -46,6 +68,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-new", type=int, default=4096)
     ap.add_argument("--page-size", type=int, default=128)
+    ap.add_argument("--mode", default="all",
+                    choices=("compare", "stall", "sweep", "all"))
     ap.add_argument("--out", default=None,
                     help="also append JSON lines to this file")
     args = ap.parse_args()
@@ -121,35 +145,179 @@ def main():
         })
         return out, eng, dt
 
-    out_d, eng_d, _ = leg(paged=False)
-    out_p, eng_p, _ = leg(paged=True)
+    ok = True
 
-    toks_equal = bool(
-        np.array_equal(
-            np.asarray(out_d.data["packed_input_ids"]),
-            np.asarray(out_p.data["packed_input_ids"]),
+    def run_compare():
+        out_d, eng_d, _ = leg(paged=False)
+        out_p, eng_p, _ = leg(paged=True)
+        toks_equal = bool(
+            np.array_equal(
+                np.asarray(out_d.data["packed_input_ids"]),
+                np.asarray(out_p.data["packed_input_ids"]),
+            )
         )
-    )
-    emit({
-        "leg": "compare",
-        "greedy_tokens_identical": toks_equal,
-        "paged_compiles_once": eng_p.decode_compiles == 1,
-        "paged_zero_copy": eng_p.cache_copy_bytes == 0,
-        "dense_copy_bytes": eng_d.cache_copy_bytes,
-        "dense_decode_compiles": eng_d.decode_compiles,
-        "utilization_paged_ge_dense": (
-            eng_p.last_pool_stats.get("utilization", 0.0)
-            >= eng_d.last_pool_stats.get("utilization", 0.0)
-        ),
-    })
+        emit({
+            "leg": "compare",
+            "greedy_tokens_identical": toks_equal,
+            "paged_compiles_once": eng_p.decode_compiles == 1,
+            "paged_zero_copy": eng_p.cache_copy_bytes == 0,
+            "dense_copy_bytes": eng_d.cache_copy_bytes,
+            "dense_decode_compiles": eng_d.decode_compiles,
+            "utilization_paged_ge_dense": (
+                eng_p.last_pool_stats.get("utilization", 0.0)
+                >= eng_d.last_pool_stats.get("utilization", 0.0)
+            ),
+        })
+        return (
+            toks_equal
+            and eng_p.decode_compiles == 1
+            and eng_p.cache_copy_bytes == 0
+        )
+
+    def run_stall():
+        """Admission-stall attribution: legacy two-program admit vs the
+        serving plane, same oversubscribed workload, traced."""
+        import tempfile
+
+        from areal_tpu.apps import trace_report
+        from areal_tpu.base import tracer
+
+        stall_new = min(args.max_new, 192)
+        gs = GenerationHyperparameters(
+            n=1, max_new_tokens=stall_new, min_new_tokens=stall_new,
+            greedy=True,
+        )
+        results = {}
+        for name, chunk_tokens in (("two_program", 0), ("serving", None)):
+            tdir = tempfile.mkdtemp(prefix=f"areal_tpu_stall_{name}_")
+            tracer.configure(
+                role=name, rank=0, dir=tdir, enabled=True, force=True
+            )
+            eng = GeneratorEngine(
+                cfg, params, mesh, eos_token_id=EOS, max_decode_batch=8,
+                kv_paged=True, kv_page_size=args.page_size,
+                prefill_chunk_tokens=chunk_tokens,
+            )
+            t0 = time.time()
+            out = eng.generate(sample, MicroBatchSpec(), gs, inflight=True)
+            dt = time.time() - t0
+            tracer.flush()
+            trace = tracer.merge_shards(
+                tdir, out_path=os.path.join(tdir, "trace.json")
+            )
+            evs = trace["traceEvents"]
+            spans = [e for e in evs if e.get("ph") == "X"]
+            n_prefill = sum(1 for e in spans if e["name"] == "prefill")
+            prefill_us = sum(
+                e.get("dur", 0) for e in spans if e["name"] == "prefill"
+            )
+            results[name] = (out, eng, n_prefill)
+            emit({
+                "leg": f"stall_{name}",
+                "prompts": len(PROMPT_LENS),
+                "max_new_tokens": stall_new,
+                "wall_seconds": round(dt, 2),
+                "decode_compiles": eng.decode_compiles,
+                "prefill_dispatches": eng.prefill_dispatches,
+                "admission_prefill_spans": n_prefill,
+                "admission_prefill_ms": round(prefill_us / 1000.0, 1),
+            })
+            print(f"--- stall attribution: {name} ---", flush=True)
+            print(trace_report.format_report(trace), flush=True)
+        tracer.configure(
+            role="measure", rank=0, enabled=False, force=True
+        )
+        out_b, eng_b, n_prefill_b = results["two_program"]
+        out_a, eng_a, n_prefill_a = results["serving"]
+        toks_equal = bool(
+            np.array_equal(
+                np.asarray(out_b.data["packed_input_ids"]),
+                np.asarray(out_a.data["packed_input_ids"]),
+            )
+        )
+        emit({
+            "leg": "stall_compare",
+            "greedy_tokens_identical": toks_equal,
+            "admission_bubble_eliminated": (
+                n_prefill_b > 0
+                and n_prefill_a == 0
+                and eng_a.prefill_dispatches == 0
+            ),
+            "serving_decode_compiles": eng_a.decode_compiles,
+        })
+        return (
+            toks_equal
+            and n_prefill_b > 0
+            and n_prefill_a == 0
+            and eng_a.decode_compiles == 1
+        )
+
+    def run_sweep():
+        """Group-size sweep at a FIXED pool: prefix sharing multiplies
+        how many rows the same pages keep concurrently live."""
+        ps, plen, mnew, pool = 64, 385, 16, 14
+        toks = rng.integers(8, cfg.vocab_size, size=plen).astype(np.int32)
+        peak = {}
+        for n in (1, 4, 8):
+            for share in (False, True):
+                s1 = SequenceSample(
+                    keys={"packed_prompts"},
+                    ids=["p0"],
+                    seqlens={"packed_prompts": [[plen]]},
+                    data={"packed_prompts": toks},
+                )
+                eng = GeneratorEngine(
+                    cfg, params, mesh, eos_token_id=EOS,
+                    max_decode_batch=8, kv_paged=True, kv_page_size=ps,
+                    kv_pool_pages=pool, prefill_chunk_tokens=8,
+                    kv_share_prefix=share,
+                )
+                gg = GenerationHyperparameters(
+                    n=n, max_new_tokens=mnew, min_new_tokens=mnew,
+                    greedy=True,
+                )
+                t0 = time.time()
+                out = eng.generate(s1, MicroBatchSpec(), gg, inflight=True)
+                dt = time.time() - t0
+                assert out is not None
+                st = eng.last_pool_stats
+                peak[(n, share)] = int(st.get("peak_live_slots", 0))
+                emit({
+                    "leg": "sweep",
+                    "group_n": n,
+                    "kv_share_prefix": share,
+                    "kv_pool_pages": pool,
+                    "page_size": ps,
+                    "prompt_len": plen,
+                    "max_new_tokens": mnew,
+                    "wall_seconds": round(dt, 2),
+                    "decode_compiles": eng.decode_compiles,
+                    "peak_live_slots": st.get("peak_live_slots"),
+                    "shared_mappings": st.get("shared_mappings"),
+                    "prefix_hits": st.get("prefix_hits"),
+                    "cow_copies": st.get("cow_copies"),
+                    "peak_pages_used": st.get("peak_pages_used"),
+                })
+        ratio = peak[(8, True)] / max(1, peak[(8, False)])
+        emit({
+            "leg": "sweep_compare",
+            "peak_live_no_share_n8": peak[(8, False)],
+            "peak_live_share_n8": peak[(8, True)],
+            "capacity_multiplier_n8": round(ratio, 2),
+            "capacity_3x_or_better": ratio >= 3.0,
+        })
+        return ratio >= 3.0
+
+    if args.mode in ("compare", "all"):
+        ok = run_compare() and ok
+    if args.mode in ("stall", "all"):
+        ok = run_stall() and ok
+    if args.mode in ("sweep", "all"):
+        ok = run_sweep() and ok
+
     if args.out:
         with open(args.out, "a") as f:
             f.write("\n".join(lines) + "\n")
-    ok = (
-        toks_equal
-        and eng_p.decode_compiles == 1
-        and eng_p.cache_copy_bytes == 0
-    )
     sys.exit(0 if ok else 1)
 
 

@@ -106,6 +106,7 @@ class TestSFTExperiment:
         )
         assert os.path.exists(os.path.join(ckpt, "model.safetensors"))
 
+    @pytest.mark.slow
     def test_recover_roundtrip(self, tmp_path):
         """Interrupt-and-resume must reproduce the uninterrupted run: the
         recover checkpoint carries weights, Adam moments/schedule position,
@@ -499,6 +500,7 @@ class TestAsyncRollout:
 
 
 class TestGlobalReshard:
+    @pytest.mark.slow
     def test_every_mfc_different_layout(self, tmp_path):
         """The reference's 'global reshard' case (test_math_ppo.py:124-199):
         every MFC runs under a DIFFERENT 3D layout on the same two devices

@@ -202,23 +202,32 @@ class TestGenerate:
                 si += 1
 
     def test_inflight_admissions_are_batched(self, cfg, params, rng):
-        """One jitted prefill dispatch per refill cycle — NOT one per
-        admitted request.  12 uniform requests through 4 slots with a
-        uniform token budget retire in lockstep: exactly ⌈12/4⌉ = 3 refill
-        cycles, so exactly 3 prefill dispatches (the serial-admission
-        formulation paid 12)."""
+        """Admission dispatch contract, both serving-plane generations:
+        the default unified serving plane admits INSIDE the chunk step
+        (ZERO standalone prefill dispatches, ever); the legacy two-
+        program path (prefill_chunk_tokens=0) batches one jitted prefill
+        per refill cycle — 12 uniform requests through 4 slots with a
+        uniform token budget retire in lockstep, exactly ⌈12/4⌉ = 3
+        dispatches (the serial-admission formulation paid 12)."""
         mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
-        eng = GeneratorEngine(
-            cfg, params, mesh, eos_token_id=EOS, max_decode_batch=4
-        )
         sample = _prompt_sample(rng, cfg, lens=(6,) * 12)
         # min_new == max_new masks EOS for the whole budget, so every slot
         # retires at exactly max_new tokens (lockstep cycles).
         g = GenerationHyperparameters(
             n=1, max_new_tokens=8, min_new_tokens=8, greedy=True
         )
+        eng = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, max_decode_batch=4
+        )
         eng.generate(sample, MicroBatchSpec(), g, inflight=True)
-        assert eng.prefill_dispatches == 3
+        assert eng.prefill_dispatches == 0
+        assert eng.decode_compiles == 1
+        legacy = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, max_decode_batch=4,
+            prefill_chunk_tokens=0,
+        )
+        legacy.generate(sample, MicroBatchSpec(), g, inflight=True)
+        assert legacy.prefill_dispatches == 3
 
     def test_spec_admissions_are_batched(self, cfg, params, rng):
         """Same contract on the speculative path (which previously also

@@ -133,6 +133,7 @@ def test_microbatch_split_at_reference_budgets(cfg, rng):
         assert sum(lens[i] for i in g) <= 30720
 
 
+@pytest.mark.slow
 def test_train_long_rows_one_microbatch(cfg, params, rng):
     """Device-side packing: 4x1024-token rows under a 4096-token budget
     run as ONE jitted microbatch (the 16k/30720 equivalents differ only

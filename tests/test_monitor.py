@@ -157,6 +157,7 @@ def test_mfc_trace_dump(tmp_path, monkeypatch):
     assert found, list(trace_dir.rglob("*"))
 
 
+@pytest.mark.slow
 def test_mfc_trace_dump_concurrent_mfcs(tmp_path, monkeypatch):
     """Tracing must survive MFCs that overlap in one process (JAX allows a
     single active trace; contenders run untraced instead of crashing)."""

@@ -78,7 +78,9 @@ def test_decompose_parallel_configs():
     assert len({p.to_str() for p in pcs}) == len(pcs)
 
 
-@pytest.mark.parametrize("n_devices", [1, 4])
+@pytest.mark.parametrize(
+    "n_devices", [1, pytest.param(4, marks=pytest.mark.slow)]
+)
 def test_profile_exp(tmp_path, n_devices):
     rows = run_profile(
         ProfileConfig(

@@ -13,6 +13,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model_api import GenerationHyperparameters
@@ -289,3 +290,389 @@ class TestGenServerPageBudget:
             kv_page_size=16, kv_pool_pages=8,
         )
         assert capped.page_budget_tokens == 128
+
+
+class TestPageSharing:
+    """The allocator's copy-on-write sharing + prefix cache contract:
+    refcounts track every mapping, shared pages privatise before writes,
+    and NOTHING leaks — after every slot releases and the cache clears,
+    the whole pool is free and `check()` still holds."""
+
+    def _alloc(self, **kw):
+        a = PageAllocator(
+            n_pages=kw.pop("n_pages", 8), page_size=kw.pop("page_size", 4),
+            n_slots=kw.pop("n_slots", 3), max_pages=kw.pop("max_pages", 4),
+        )
+        a.debug_check = True  # every mutation re-validates invariants
+        return a
+
+    def test_share_diverge_release_leaks_nothing(self):
+        a = self._alloc()
+        a.reserve(0, 8)  # owner: 2 pages
+        owner_pages = [int(p) for p in a.table[0, :2]]
+        a.share(1, owner_pages)
+        a.share(2, owner_pages)
+        assert a.allocated_pages() == 2  # 3 slots, still 2 physical pages
+        assert (a.refcount[owner_pages] == 3).all()
+        assert a.shared_mappings == 4
+        # Follower 1 diverges: privatise its second page before writing.
+        pairs = a.ensure_writable(1, 4, 8)
+        assert len(pairs) == 1 and pairs[0][0] == owner_pages[1]
+        assert a.cow_copies == 1
+        assert int(a.table[1, 1]) != owner_pages[1]
+        assert int(a.table[1, 0]) == owner_pages[0]  # untouched window
+        # Owner's view never moved; refcount dropped by the remap.
+        assert [int(p) for p in a.table[0, :2]] == owner_pages
+        assert int(a.refcount[owner_pages[1]]) == 2
+        for s in (0, 1, 2):
+            a.release(s)
+        assert a.allocated_pages() == 0
+        assert len(a.free) == a.n_pages
+        a.check()  # full partition holds: zero leaked pages
+
+    def test_ensure_writable_noop_on_private(self):
+        a = self._alloc()
+        a.reserve(0, 8)
+        assert a.ensure_writable(0, 0, 8) == []
+        assert a.cow_copies == 0
+
+    def test_cow_exhaustion_is_clean(self):
+        a = self._alloc(n_pages=2)
+        a.reserve(0, 8)  # whole pool
+        a.share(1, [int(a.table[0, 0])])
+        with pytest.raises(PagePoolExhausted, match="privatise"):
+            a.ensure_writable(1, 0, 4)
+        a.check()  # failed CoW left a consistent state
+
+    def test_prefix_cache_holds_survive_owner_release(self):
+        a = self._alloc()
+        a.reserve(0, 8)
+        pages = [int(p) for p in a.table[0, :2]]
+        a.prefix_insert("h", pages)
+        a.release(0)  # owner gone; the cache hold keeps the pages live
+        assert a.allocated_pages() == 2
+        hit = a.prefix_lookup("h")
+        assert hit == pages and a.prefix_hits == 1
+        a.share(1, hit)
+        assert (a.refcount[pages] == 2).all()  # cache hold + slot 1
+        a.release(1)
+        a.prefix_evict(need_free=a.n_pages)
+        assert a.allocated_pages() == 0
+        a.check()
+
+    def test_prefix_evict_is_lru(self):
+        a = self._alloc(n_pages=4, n_slots=2, max_pages=2)
+        a.reserve(0, 8)
+        a.prefix_insert("old", [int(a.table[0, 0])])
+        a.prefix_insert("new", [int(a.table[0, 1])])
+        a.release(0)
+        a.prefix_lookup("old")  # refresh: "new" becomes the LRU entry
+        a.prefix_evict(need_free=3)
+        assert a.prefix_lookup("new") is None
+        assert a.prefix_lookup("old") is not None
+
+    def test_invariant_checker_catches_corruption(self):
+        from areal_tpu.engines.paging import PagingInvariantError
+
+        a = self._alloc()
+        a.reserve(0, 8)
+        a.table[0, 0] = a.table[0, 1]  # double-map without refcount
+        with pytest.raises(PagingInvariantError):
+            a.check()
+
+
+class TestSentinelAlignment:
+    """Unmapped (sentinel) page-table entries must contribute ZERO
+    attention mass in BOTH paged read paths — the Pallas kernel clamps
+    the prefetched index and masks, the XLA fallback clamps the gather
+    and masks; poisoning the clamp-target page must not change any live
+    row's output (the rule lives in ops.attention.clamp_page_table)."""
+
+    def _setup(self, rng):
+        b, nq, n_kv, d, ps, n_pool, mp = 2, 4, 2, 8, 4, 6, 3
+        q = jnp.asarray(rng.standard_normal((b, nq, n_kv, d)), jnp.float32)
+        k = jnp.asarray(
+            rng.standard_normal((n_pool, ps, n_kv, d)), jnp.float32
+        )
+        v = jnp.asarray(
+            rng.standard_normal((n_pool, ps, n_kv, d)), jnp.float32
+        )
+        # Row 0 lives in page 2 only (one mapped entry); row 1 in pages
+        # 0 and 4.  Everything else is the sentinel (= n_pool).
+        pt = np.full((b, mp), n_pool, np.int32)
+        pt[0, 0] = 2
+        pt[1, :2] = (0, 4)
+        # Caller contract: the widest query's window hi0 + nq - 1 stays
+        # within each row's MAPPED pages (row 0: 1+3 <= 4 tokens, row 1:
+        # 5+3 <= 8); sentinel entries only ever cover positions past it.
+        hi0 = np.array([1, 5], np.int32)
+        return q, k, v, jnp.asarray(pt), jnp.asarray(hi0)
+
+    def test_sentinel_rows_add_no_mass_xla_and_kernel(self, rng):
+        from areal_tpu.ops.attention import (
+            decode_attention_chunk,
+            paged_gather_layer,
+        )
+        from areal_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_chunk_kernel,
+        )
+
+        q, k, v, pt, hi0 = self._setup(rng)
+        n_pool = k.shape[0]
+        # Poison the clamp target (page n_pool - 1, where sentinel
+        # entries land after clamping) with huge values: if either path
+        # let a sentinel row through its mask, the output would explode.
+        k_bad = k.at[n_pool - 1].set(1e9)
+        v_bad = v.at[n_pool - 1].set(1e9)
+
+        out_kern = paged_decode_attention_chunk_kernel(q, k, v, pt, hi0)
+        out_kern_bad = paged_decode_attention_chunk_kernel(
+            q, k_bad, v_bad, pt, hi0
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_kern), np.asarray(out_kern_bad)
+        )
+
+        # XLA fallback: gather the pages then run the dense chunk math.
+        def xla(kp, vp):
+            kk = paged_gather_layer(kp, pt)
+            vv = paged_gather_layer(vp, pt)
+            return decode_attention_chunk(
+                q, kk, vv, jnp.zeros_like(hi0), hi0
+            )
+
+        out_xla = xla(k, v)
+        out_xla_bad = xla(k_bad, v_bad)
+        np.testing.assert_array_equal(
+            np.asarray(out_xla), np.asarray(out_xla_bad)
+        )
+        # And the two paths agree on the clean pool.
+        np.testing.assert_allclose(
+            np.asarray(out_kern), np.asarray(out_xla), rtol=2e-5, atol=2e-5
+        )
+
+
+class TestServingPlaneEquivalence:
+    """The unified serving plane (chunked prefill inside the decode
+    chunk + CoW page sharing, the default) must be token-identical to
+    the legacy two-program admit path it replaces — while dispatching
+    ZERO standalone prefills and compiling exactly ONE program."""
+
+    LENS = (4, 11, 6, 9, 5)
+
+    def _pair(self, cfg, params, mesh, **kw):
+        legacy = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
+            kv_page_size=8, prefill_chunk_tokens=0, **kw
+        )
+        serving = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
+            kv_page_size=8, prefill_chunk_tokens=4, **kw
+        )
+        return legacy, serving
+
+    def test_token_identical_to_two_program_path(
+        self, cfg, params, mesh, rng
+    ):
+        legacy, serving = self._pair(cfg, params, mesh, max_decode_batch=2)
+        sample = _prompt_sample(rng, cfg, self.LENS)
+        g = GenerationHyperparameters(n=1, max_new_tokens=8, greedy=True)
+        ol = legacy.generate(sample, MicroBatchSpec(), g, inflight=True)
+        os_ = serving.generate(sample, MicroBatchSpec(), g, inflight=True)
+        _assert_same_output(ol, os_)
+        assert legacy.prefill_dispatches > 0  # the zoo being replaced
+        assert serving.prefill_dispatches == 0
+        assert serving.decode_compiles == 1
+        assert serving.cache_copy_bytes == 0
+
+    def test_group_sampling_shares_prompt_pages(
+        self, cfg, params, mesh, rng
+    ):
+        """n=4 same-prompt responses: identical tokens to the legacy
+        path, but the prompt's full pages are mapped (not copied) into
+        the followers via the prefix cache — visible as shared mappings
+        and prefix hits in the pool stats."""
+        legacy, serving = self._pair(cfg, params, mesh, max_decode_batch=2)
+        sample = _prompt_sample(rng, cfg, (17, 9))
+        g = GenerationHyperparameters(n=4, max_new_tokens=8, greedy=True)
+        ol = legacy.generate(sample, MicroBatchSpec(), g, inflight=True)
+        os_ = serving.generate(sample, MicroBatchSpec(), g, inflight=True)
+        _assert_same_output(ol, os_)
+        st = serving.last_pool_stats
+        assert st["shared_mappings"] > 0
+        assert st["prefix_hits"] > 0
+        assert st["cow_copies"] == 0  # steady state: no write ever lands
+        # on a shared page, so the CoW safety net stays idle
+
+    def test_share_disabled_still_token_identical(
+        self, cfg, params, mesh, rng
+    ):
+        legacy, _ = self._pair(cfg, params, mesh, max_decode_batch=2)
+        noshare = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
+            kv_page_size=8, prefill_chunk_tokens=4, kv_share_prefix=False,
+            max_decode_batch=2,
+        )
+        sample = _prompt_sample(rng, cfg, (17, 9))
+        g = GenerationHyperparameters(n=4, max_new_tokens=8, greedy=True)
+        ol = legacy.generate(sample, MicroBatchSpec(), g, inflight=True)
+        on = noshare.generate(sample, MicroBatchSpec(), g, inflight=True)
+        _assert_same_output(ol, on)
+        assert noshare.last_pool_stats["shared_mappings"] == 0
+
+    def test_resume_on_shared_pages_token_identical(
+        self, cfg, params, mesh, rng
+    ):
+        """Interrupt + resume under UNCHANGED weights while followers
+        map the owner's prompt pages: the tail replay clamps to each
+        row's private region (never rewriting a shared page), so the
+        resumed run reproduces the uninterrupted one token for token."""
+
+        def build():
+            # Unreachable EOS keeps rows decoding; max_decode_batch=2
+            # forces slot reuse so the interrupt lands with live shares.
+            return GeneratorEngine(
+                cfg, params, mesh, eos_token_id=cfg.vocab_size + 7,
+                kv_paged=True, kv_page_size=8, prefill_chunk_tokens=4,
+                max_decode_batch=2,
+            )
+
+        sample = _prompt_sample(rng, cfg, (17, 9))
+        g = GenerationHyperparameters(n=4, max_new_tokens=24, greedy=True)
+        ref = build().generate(sample, MicroBatchSpec(), g, seed=0)
+
+        eng = build()
+        real_get = eng._get_serving_chunk_fn
+        calls = {"n": 0}
+
+        def hooked(*a, **kw):
+            fn = real_get(*a, **kw)
+
+            def wrapped(*fa, **fkw):
+                calls["n"] += 1
+                if calls["n"] == 2:
+                    eng.interrupt()
+                return fn(*fa, **fkw)
+
+            return wrapped
+
+        eng._get_serving_chunk_fn = hooked
+        out = eng.generate(sample, MicroBatchSpec(), g, seed=0)
+        assert out is None and eng.interrupted
+        st = eng._session
+        # The interrupt parked mid-flight with at least one follower
+        # still mapping shared pages (the scenario under test).
+        assert any(
+            st.alloc.is_shared(s, 0)
+            for s in range(st.n_slots)
+            if st.active[s] is not None and int(st.shared_from[s]) > 0
+        )
+        eng.clear_interrupt()
+        out = eng.resume_generate()
+        assert out is not None and eng.resume_replays == 1
+        _assert_same_output(ref, out)
+
+    def test_spec_path_keeps_two_program_admit(
+        self, cfg, params, mesh, rng
+    ):
+        """Documented degradation: speculative decoding does NOT ride
+        the serving plane (draft buffers make admission stateful) — it
+        keeps the legacy prefill-program admit, so a spec generate still
+        dispatches standalone prefills even with prefill_chunk_tokens
+        set.  If this starts failing because spec admissions became
+        chunked, delete this test and extend TestServingPlaneEquivalence
+        to the spec path instead."""
+        eng = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
+            kv_page_size=8, prefill_chunk_tokens=4, max_decode_batch=2,
+        )
+        sample = _prompt_sample(rng, cfg, self.LENS)
+        g = GenerationHyperparameters(
+            n=1, max_new_tokens=10, greedy=True, spec_decode_k=2
+        )
+        eng.generate(sample, MicroBatchSpec(), g)
+        assert eng.prefill_dispatches > 0
+
+    def test_int8_keeps_two_program_admit(self, cfg, params, mesh, rng):
+        """int8 KV also keeps the legacy admit: chunked prefill would
+        score later prompt chunks against the quantized cache of earlier
+        ones, breaking the int8 bit-parity contract with the dense
+        window (see _generate_inflight)."""
+        eng = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
+            kv_page_size=8, prefill_chunk_tokens=4, max_decode_batch=2,
+            kv_cache_dtype="int8",
+        )
+        sample = _prompt_sample(rng, cfg, self.LENS)
+        g = GenerationHyperparameters(n=1, max_new_tokens=8, greedy=True)
+        eng.generate(sample, MicroBatchSpec(), g, inflight=True)
+        assert eng.prefill_dispatches > 0
+
+
+class TestGenServerBudgetValidation:
+    """The splitter's capacity check covers EVERY request — singletons
+    included (they previously bypassed it entirely) — and uses the
+    engine's CoW-aware footprint when available."""
+
+    def _srv(self, engine):
+        import threading  # noqa: F401
+
+        from areal_tpu.system.gen_server import GenerationServer
+
+        srv = GenerationServer.__new__(GenerationServer)
+        srv.engine = engine
+        return srv
+
+    def _pend(self, plen, n=1, max_new=10):
+        import threading
+
+        from areal_tpu.system.gen_server import _Pending
+
+        g = GenerationHyperparameters(
+            n=n, max_new_tokens=max_new, greedy=True
+        )
+        return _Pending(
+            qid="q", prompt_ids=list(range(plen)), gconfig=g,
+            done=threading.Event(),
+        )
+
+    def test_oversized_singleton_fails_cleanly(self):
+        class _Eng:
+            page_budget_tokens = 100
+
+        srv = self._srv(_Eng())
+        calls = []
+        srv._run_subgroup = lambda grp: calls.append(len(grp))
+        big = self._pend(200)  # 210 tokens > 100 even alone
+        ok = self._pend(15)  # 25 tokens
+        srv._run_group([big, ok])
+        assert calls == [1]  # only the feasible request ran
+        assert big.done.is_set()
+        assert big.error and "exceeds the KV page budget" in big.error
+        assert ok.error is None
+
+    def test_split_uses_cow_aware_footprint(self, cfg, params, mesh):
+        """A real serving engine: a 4-response group over a 60-token
+        prompt costs 56 (shared prompt pages) + 4*(tail + max_new), not
+        4*(60 + max_new) — so a budget that the dense formula would
+        split (or reject) admits the group WHOLE."""
+        eng = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
+            kv_page_size=8, kv_pool_pages=20,  # budget: 160 tokens
+        )
+        # sp = (60-1)//8 = 7 full pages -> 56 + 4*(4 + 10) = 112 <= 160;
+        # the dense product 4*70 = 280 would have rejected it outright.
+        assert eng.group_footprint_tokens(60, 10, 4) == 112
+        srv = self._srv(eng)
+        calls = []
+        srv._run_subgroup = lambda grp: calls.append(len(grp))
+        p = self._pend(60, n=4)
+        srv._run_group([p])
+        assert calls == [1] and p.error is None
+        # Sharing off -> dense product -> rejected up front.
+        eng.kv_share_prefix = False
+        assert eng.group_footprint_tokens(60, 10, 4) == 280
+        p2 = self._pend(60, n=4)
+        srv._run_group([p2])
+        assert calls == [1]  # no new call
+        assert p2.error and "exceeds the KV page budget" in p2.error

@@ -197,7 +197,9 @@ def test_1f1b_mem_bound_lower_peak_at_equal_microbatch_size(rng):
     )
 
 
-@pytest.mark.parametrize("layout", ["p2", "p2f2"])
+@pytest.mark.parametrize(
+    "layout", ["p2", pytest.param("p2f2", marks=pytest.mark.slow)]
+)
 def test_train_engine_1f1b_mem_schedule_e2e(layout):
     """TrainEngine(pipe_schedule='1f1b-mem') trains on pipelined meshes
     (pure and FSDP-composed) and matches the gpipe engine's first-step

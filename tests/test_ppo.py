@@ -497,6 +497,7 @@ class TestValueNorm:
         # Rewards are +-5-ish; the return moments must reflect that scale.
         assert 0.5 < std < 20.0, (mean, std)
 
+    @pytest.mark.slow
     def test_value_norm_survives_recover(self, tmp_path):
         """Recover checkpoints carry the interface state: the restored
         critic resumes with the SAME running moments (otherwise inference
@@ -817,6 +818,7 @@ class TestEarlyStop:
 
 
 class TestAdaptiveKLRecover:
+    @pytest.mark.slow
     def test_kl_controller_survives_recover(self, tmp_path):
         """The adaptive KL coefficient is algorithm state: a restored
         trial must resume from the drifted value, not restart the

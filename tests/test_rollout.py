@@ -471,7 +471,10 @@ class TestInterruptResumeParity:
         ref = ref_eng.generate(sample, MicroBatchSpec(), g, seed=0)
 
         eng = build()
-        real_get = eng._get_paged_decode_fn
+        # The default serving plane runs one compiled "serving chunk"
+        # per loop iteration; hook its getter so the interrupt lands on
+        # the SECOND chunk — mid-flight, with live prefill+decode rows.
+        real_get = eng._get_serving_chunk_fn
         calls = {"n": 0}
 
         def hooked(*a, **kw):
@@ -485,7 +488,7 @@ class TestInterruptResumeParity:
 
             return wrapped
 
-        eng._get_paged_decode_fn = hooked
+        eng._get_serving_chunk_fn = hooked
         out = eng.generate(sample, MicroBatchSpec(), g, seed=0)
         assert out is None and eng.interrupted  # parked mid-decode
         assert calls["n"] >= 2

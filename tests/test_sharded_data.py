@@ -357,6 +357,7 @@ class TestMasterShardedDispatch:
             ModelWorker._handle_shard_info = orig
         return master, stats
 
+    @pytest.mark.slow
     def test_sharded_ships_fewer_bytes_end_to_end(self, tmp_path):
         m_full, st_full = self._run(tmp_path / "full", sharded=False)
         m_sh, st_sh = self._run(tmp_path / "sh", sharded=True)

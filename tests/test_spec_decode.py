@@ -249,6 +249,7 @@ class TestSpecGeneratorE2E:
             rtol=5e-4, atol=5e-4,
         )
 
+    @pytest.mark.slow
     def test_sampled_spec_valid_outputs(self, setup):
         """Sampled spec decoding: outputs are well-formed (logprobs match a
         recompute through the model) even with refills and mixed lengths."""
