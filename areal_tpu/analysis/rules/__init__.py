@@ -1,10 +1,11 @@
-"""arealint rule registry: the five TPU-hot-path rule families."""
+"""arealint rule registry: the six TPU-hot-path rule families."""
 
 from typing import List, Optional, Sequence
 
 from areal_tpu.analysis.core import Rule
 from areal_tpu.analysis.rules.async_blocking import AsyncBlockingRule
 from areal_tpu.analysis.rules.host_sync import HostSyncRule
+from areal_tpu.analysis.rules.metrics_names import MetricsNamesRule
 from areal_tpu.analysis.rules.retrace import RetraceRule
 from areal_tpu.analysis.rules.sharding import ShardingRule
 from areal_tpu.analysis.rules.stats_keys import StatsKeysRule
@@ -15,6 +16,7 @@ ALL_RULES = (
     AsyncBlockingRule,
     ShardingRule,
     StatsKeysRule,
+    MetricsNamesRule,
 )
 
 RULE_NAMES = tuple(r.name for r in ALL_RULES)

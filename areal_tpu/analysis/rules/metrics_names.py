@@ -1,0 +1,149 @@
+"""metrics-names: live-metrics-plane naming discipline.
+
+The metrics registry (``base/metrics.py``) is get-or-create: a second
+registration of a name with a matching spec silently returns the first
+metric, and a MISMATCHED spec raises at import time on whichever module
+loads second — so name collisions between modules are load-order bugs
+waiting to happen, and sloppy names leak straight into the Prometheus
+exposition that dashboards and the SLO watchdog key on.  Checked on
+every registration call (``<registry>.counter/gauge/histogram(name,
+help, ...)`` with constant name+help — the two-positional-string shape
+distinguishes registrations from ``tracer.counter(name, **values)``):
+
+- the name must match ``^areal_[a-z0-9_]+$`` (one namespace, one case);
+- counters must end ``_total``; gauges/histograms must NOT (the suffix
+  is how exposition consumers spot a monotonic series);
+- unit-bearing names must use base units: ``_seconds`` not
+  ``_ms``/``_millis``/``_msec``/``_time``, ``_bytes`` not
+  ``_kb``/``_mb``/``_gb``;
+- ``_bucket``/``_sum``/``_count`` suffixes are reserved for the series
+  a histogram expands into;
+- one name, one registration site: the same metric name registered at
+  two distinct source locations (cross-file prepass) is an error even
+  when the specs agree today — specs drift apart silently.
+"""
+
+import ast
+import re
+from typing import Dict, Iterable, List, Tuple
+
+from areal_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    ProjectContext,
+    Rule,
+    Severity,
+)
+
+_NAME_RE = re.compile(r"^areal_[a-z0-9_]+$")
+_METHODS = ("counter", "gauge", "histogram")
+_RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
+_UNIT_FIXES = (
+    ("_ms", "_seconds"),
+    ("_millis", "_seconds"),
+    ("_msec", "_seconds"),
+    ("_time", "_seconds"),
+    ("_kb", "_bytes"),
+    ("_mb", "_bytes"),
+    ("_gb", "_bytes"),
+)
+
+Site = Tuple[str, int, str]  # (path, lineno, kind)
+
+
+def _registrations(tree: ast.AST):
+    """Yield (call_node, kind, name) for metric registration calls: an
+    attribute call named counter/gauge/histogram whose first two
+    positional args are string constants (name, help).  tracer.counter
+    takes ONE positional + keywords, so it never matches."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute) or fn.attr not in _METHODS:
+            continue
+        args = node.args
+        if len(args) < 2:
+            continue
+        if not all(
+            isinstance(a, ast.Constant) and isinstance(a.value, str)
+            for a in args[:2]
+        ):
+            continue
+        yield node, fn.attr, args[0].value
+
+
+class MetricsNamesRule(Rule):
+    name = "metrics-names"
+
+    def __init__(self):
+        self._sites: Dict[str, List[Site]] = {}
+
+    def prepare(self, project: ProjectContext) -> None:
+        for ctx in project.files:
+            for node, kind, mname in _registrations(ctx.tree):
+                self._sites.setdefault(mname, []).append(
+                    (ctx.path, node.lineno, kind)
+                )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node, kind, mname in _registrations(ctx.tree):
+            loc = (ctx.path, node.lineno, node.col_offset)
+            if not _NAME_RE.match(mname):
+                yield Finding(
+                    self.name, Severity.ERROR, *loc,
+                    f"metric name {mname!r} must match "
+                    f"'^areal_[a-z0-9_]+$' (one namespace, snake_case)",
+                )
+                continue
+            if kind == "counter" and not mname.endswith("_total"):
+                yield Finding(
+                    self.name, Severity.ERROR, *loc,
+                    f"counter {mname!r} must end '_total' (monotonic "
+                    "series convention)",
+                )
+            if kind != "counter" and mname.endswith("_total"):
+                yield Finding(
+                    self.name, Severity.ERROR, *loc,
+                    f"{kind} {mname!r} must not end '_total': the suffix "
+                    "marks monotonic counters",
+                )
+            for suf in _RESERVED_SUFFIXES:
+                if mname.endswith(suf):
+                    yield Finding(
+                        self.name, Severity.ERROR, *loc,
+                        f"metric name {mname!r} ends {suf!r}, reserved "
+                        "for the series a histogram expands into",
+                    )
+            for bad, good in _UNIT_FIXES:
+                if mname.endswith(bad):
+                    yield Finding(
+                        self.name, Severity.ERROR, *loc,
+                        f"metric name {mname!r} uses a non-base unit: "
+                        f"use '{mname[: -len(bad)]}{good}' (seconds/"
+                        "bytes base units only)",
+                    )
+            sites = self._sites.get(mname, [])
+            distinct = sorted(set(sites))
+            if len(distinct) > 1:
+                first = distinct[0]
+                here = (ctx.path, node.lineno, kind)
+                if here != first:
+                    yield Finding(
+                        self.name, Severity.ERROR, *loc,
+                        f"metric {mname!r} is also registered at "
+                        f"{first[0]}:{first[1]} — one name, one "
+                        "registration site (get-or-create makes spec "
+                        "drift a load-order bug)",
+                    )
+                kinds = {k for _, _, k in distinct}
+                if len(kinds) > 1 and here == first:
+                    others = ", ".join(
+                        f"{p}:{ln} ({k})" for p, ln, k in distinct[1:]
+                    )
+                    yield Finding(
+                        self.name, Severity.ERROR, *loc,
+                        f"metric {mname!r} registered with conflicting "
+                        f"types: {kind} here vs {others} — the second "
+                        "import to run raises",
+                    )

@@ -8,7 +8,8 @@ under ``--strict``), 2 on usage errors.  Importing jax is deliberately
 avoided: the linter must run on a bare CPU CI box in milliseconds.
 
 Rule families (see areal_tpu/analysis/rules/): host-sync,
-retrace-hazard, async-blocking, sharding, stats-keys.  Suppress a finding
+retrace-hazard, async-blocking, sharding, stats-keys,
+metrics-names.  Suppress a finding
 with ``# arealint: ignore[rule] -- reason`` on the offending line or the
 line directly above; reasonless suppressions are themselves errors.
 """

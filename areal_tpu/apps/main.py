@@ -22,7 +22,7 @@ import pickle
 import sys
 from typing import Dict, List, Optional
 
-from areal_tpu.base import logging, name_resolve, tracer
+from areal_tpu.base import logging, metrics, name_resolve, tracer
 from areal_tpu.experiments.common import ExperimentPlan
 from areal_tpu.scheduler import JobException, make_scheduler
 from areal_tpu.system.master import MasterWorker
@@ -172,6 +172,13 @@ def run_experiment(
             env=env,
             **(scheduler_kwargs or {}),
         )
+        # Live metrics plane for the master (which runs in THIS process):
+        # serve the default registry and announce the URL so
+        # apps/metrics_report.py finds the trainer role next to the
+        # workers' own servers (apps/worker.py announces those).
+        metrics_server = metrics.MetricsServer(
+            announce=(plan.experiment_name, plan.trial_name, "master")
+        )
         sched.submit_array(
             "model_worker",
             lambda i: [
@@ -196,5 +203,6 @@ def run_experiment(
                 raise
             logger.info(f"recovering (attempt {attempt + 1})...")
         finally:
+            metrics_server.close()
             sched.stop_all()
     raise last_err  # pragma: no cover

@@ -18,11 +18,25 @@ buckets every step's wall-clock into compute / comms / host / idle:
 
 Uncategorized spans (request lifetimes, dispatch waits) shape the
 timeline but never count toward a bucket.
+
+``--json`` emits the report as one JSON object with a stable schema
+(``json_report``) instead of the human tables, for dashboards and the
+regression tooling:
+
+    {"version": 1,
+     "rows": [{"step", "pid", "process", "window_us", "compute_us",
+               "comms_us", "host_us", "idle_us"}, ...],
+     "bubbles": [{"process", "step", "start_us", "dur_us",
+                  "after_span", "before_span"}, ...]}
+
+``version`` bumps on any breaking change; consumers must reject
+versions they don't know.
 """
 
 import argparse
 import json
 import os
+import sys
 from collections import defaultdict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -233,6 +247,24 @@ def format_report(trace, top: int = 5) -> str:
     return "\n".join(lines)
 
 
+JSON_VERSION = 1
+
+
+def json_report(trace, top: int = 5) -> Dict[str, Any]:
+    """Machine-readable report, schema v1 (see module docstring).  The
+    internal ``_covered`` interval list is stripped from rows — it is an
+    implementation detail of the precedence subtraction, not contract."""
+    rows = [
+        {k: v for k, v in r.items() if not k.startswith("_")}
+        for r in attribute(trace)
+    ]
+    return {
+        "version": JSON_VERSION,
+        "rows": rows,
+        "bubbles": bubbles(trace, top=top),
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="areal_tpu.apps.trace_report")
     p.add_argument(
@@ -245,20 +277,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--out", default=None,
         help="where to write the merged trace.json (dir input only)",
     )
+    p.add_argument(
+        "--json", action="store_true",
+        help="emit the stable v1 JSON report instead of tables",
+    )
     args = p.parse_args(argv)
     if os.path.isdir(args.path):
         out = args.out or os.path.join(args.path, "trace.json")
         trace = tracer.merge_shards(args.path, out_path=out)
-        print(f"merged {args.path} -> {out}")
+        if not args.json:
+            print(f"merged {args.path} -> {out}")
     else:
         trace = load_trace(args.path)
     errors = tracer.validate_trace(trace)
     if errors:
-        print("trace schema problems:")
+        print("trace schema problems:", file=sys.stderr)
         for e in errors:
-            print(f"  - {e}")
+            print(f"  - {e}", file=sys.stderr)
         return 1
-    print(format_report(trace, top=args.top))
+    if args.json:
+        print(json.dumps(json_report(trace, top=args.top)))
+    else:
+        print(format_report(trace, top=args.top))
     return 0
 
 

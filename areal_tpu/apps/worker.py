@@ -32,12 +32,23 @@ def main():
             "jax_platforms", os.environ["AREAL_WORKER_PLATFORM"]
         )
 
-    from areal_tpu.base import compilation_cache, logging, seeding, tracer
+    from areal_tpu.base import (
+        compilation_cache,
+        logging,
+        metrics,
+        seeding,
+        tracer,
+    )
 
     compilation_cache.enable()
     # Shard name: trace_worker_<index>.jsonl (dir comes from
     # AREAL_TRACE_DIR, exported by the launcher when tracing is on).
     tracer.configure(role="worker", rank=args.index)
+    # Live metrics plane: every role exposes /metrics and announces the
+    # URL under the trial's metrics subtree for apps/metrics_report.py.
+    metrics_server = metrics.MetricsServer(
+        announce=(args.experiment, args.trial, f"model_worker/{args.index}")
+    )
     from areal_tpu.system.stream import run_worker_stream
     from areal_tpu.system.transfer import ZMQTransfer
     from areal_tpu.system.worker import ModelWorker
@@ -77,6 +88,7 @@ def main():
         )
     finally:
         tracer.flush()
+        metrics_server.close()
         transfer.close()
         control.stop()
     logger.info(f"worker {args.index} exiting")

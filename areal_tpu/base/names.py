@@ -57,3 +57,14 @@ def worker_control(experiment_name: str, trial_name: str, worker_name: str) -> s
 
 def worker_keepalive(experiment_name: str, trial_name: str, worker_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/keepalive/{worker_name}"
+
+
+def metrics_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/metrics"
+
+
+def metrics_endpoint(experiment_name: str, trial_name: str, role: str) -> str:
+    """One `/metrics` base URL per process role (e.g. master,
+    model_worker/0, gen_server/1); metrics_report discovers the fleet
+    by listing the subtree."""
+    return f"{metrics_root(experiment_name, trial_name)}/{role}"

@@ -6,10 +6,16 @@ master logs merged values per step.
 """
 
 import dataclasses
+import logging
 from collections import defaultdict
 from typing import Dict, List
 
 import numpy as np
+
+logger = logging.getLogger("areal_tpu.stats")
+
+# Keys already warned about by merge_stats (log-once).
+_warned_partial_denominator = set()
 
 
 @dataclasses.dataclass
@@ -66,7 +72,13 @@ def merge_stats(stats: List[Dict[str, float]]) -> Dict[str, float]:
     denominator-weighted mean (token-weighted loss/KL): unequal DP shards
     mean-merged unweighted would skew toward small shards.  Denominator
     keys themselves SUM (the merged denominator of the merged mean);
-    everything else keeps the unweighted mean."""
+    everything else keeps the unweighted mean.
+
+    A key that has a denominator in SOME shards but not all cannot be
+    merged correctly (positional pairing is broken and an unweighted
+    mean would silently skew toward small shards): the key is DROPPED
+    from the merge with a log-once warning instead of emitting a wrong
+    number."""
     merged: Dict[str, List[float]] = defaultdict(list)
     for s in stats:
         for k, v in s.items():
@@ -77,9 +89,19 @@ def merge_stats(stats: List[Dict[str, float]]) -> Dict[str, float]:
             out[k] = float(np.sum(vals))
             continue
         weights = merged.get(f"{k}_denominator")
-        # Pairing is positional: only weight when every shard reported
-        # both the value and its denominator.
-        if weights is not None and len(weights) == len(vals):
+        # Pairing is positional: weighting is only sound when every
+        # shard reported both the value and its denominator.
+        if weights is not None:
+            if len(weights) != len(vals):
+                if k not in _warned_partial_denominator:
+                    _warned_partial_denominator.add(k)
+                    logger.warning(
+                        "merge_stats: %r has a denominator in %d/%d "
+                        "shards; dropping the key instead of computing "
+                        "a skewed unweighted mean",
+                        k, len(weights), len(vals),
+                    )
+                continue
             total = float(np.sum(weights))
             if total > 0:
                 out[k] = float(np.dot(vals, weights) / total)

@@ -25,6 +25,7 @@ import dataclasses
 import functools
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -34,7 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model_api import Engine, GenerationHyperparameters
-from areal_tpu.base import logging, tracer
+from areal_tpu.base import logging, metrics, tracer
 from areal_tpu.base.distributed import to_host
 from areal_tpu.base.topology import batch_sharding_degree
 from areal_tpu.engines.offload import HostOffloadMixin
@@ -330,12 +331,47 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         self._interrupt_evt = threading.Event()
         self._session: Optional[_PagedGenSession] = None
         self.resume_replays = 0
-        # Load gauges read racily by gen_server /health for queue-depth-
-        # aware balancing: slots live in the current chunk loop and the
-        # last sampled KV-pool utilization.
-        self.live_slots = 0
+        # Load gauges for gen_server /health queue-depth-aware balancing:
+        # slots live in the current chunk loop and the last sampled
+        # KV-pool utilization.  `load_state` is the atomically replaced
+        # (live_slots, kv_utilization) pair — a single tuple assignment,
+        # so a cross-thread health poll can never see the two fields
+        # from different chunk boundaries.
+        reg = metrics.default_registry()
+        self._m_tokens = reg.counter(
+            "areal_gen_tokens_total", "response tokens generated"
+        )
+        self._m_goodput = reg.gauge(
+            "areal_gen_goodput_tokens_per_second",
+            "tokens/s over the last completed generate call",
+        )
+        self._m_decode_compiles = reg.counter(
+            "areal_gen_decode_compiles_total",
+            "jitted decode-chunk program compiles",
+        )
+        self._m_kv_util = reg.gauge(
+            "areal_gen_kv_utilization_ratio",
+            "live KV tokens / allocated cache tokens, last chunk",
+        )
+        self._m_kv_live = reg.gauge(
+            "areal_gen_kv_live_tokens", "live KV tokens, last chunk"
+        )
+        self._m_kv_alloc = reg.gauge(
+            "areal_gen_kv_allocated_tokens",
+            "allocated KV cache tokens, last chunk",
+        )
+        self._m_live_slots = reg.gauge(
+            "areal_gen_live_slots", "slots live in the current chunk loop"
+        )
         self.kv_utilization = 0.0
+        self.live_slots = 0
+        self.load_state = (0, 0.0)
         self.set_params(params)
+
+    def _set_live_slots(self, n: int) -> None:
+        self.live_slots = int(n)
+        self.load_state = (int(n), self.kv_utilization)
+        self._m_live_slots.set(n)
 
     # ---------------- interruption (async weight sync) ----------------
 
@@ -494,6 +530,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         self.decode_compiles = 0
         self.cache_copy_bytes = 0
         self.last_pool_stats = {}
+        self._gen_t0 = time.monotonic()
         prompt_lens = sample.seqlens_of(prompt_key)
         bounds = sample.cu_seqlens(prompt_key)
         prompts = np.asarray(sample.data[prompt_key])
@@ -863,7 +900,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             if active[s] is None and pending:
                 i, rep, toks = pending.pop()
                 admits.append((s, i, rep, toks))
-        self.live_slots = sum(a is not None for a in active) + len(admits)
+        self._set_live_slots(sum(a is not None for a in active) + len(admits))
         tracer.counter(
             "gen_slots", live=self.live_slots, pending=len(pending)
         )
@@ -982,6 +1019,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
 
         self._gen_fns[sig] = fn
         self.decode_compiles += 1
+        self._m_decode_compiles.inc()
         logger.info(
             f"compiled inflight decoder n_slots={n_slots} s_max={s_max} "
             f"chunk={chunk_t}"
@@ -1034,6 +1072,10 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         st["utilization"] = st["live_tokens"] / max(st["allocated_tokens"], 1)
         # Instantaneous utilization, exposed through gen_server /health.
         self.kv_utilization = int(live_tokens) / max(int(allocated_tokens), 1)
+        self.load_state = (self.live_slots, self.kv_utilization)
+        self._m_kv_util.set(self.kv_utilization)
+        self._m_kv_live.set(int(live_tokens))
+        self._m_kv_alloc.set(int(allocated_tokens))
         # Per-chunk sampled gauge: KV pool pressure over time in the trace.
         tracer.counter(
             "kv_pool",
@@ -1182,7 +1224,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             pages_recycled=alloc.pages_recycled,
             peak_pages_used=alloc.peak_pages_used,
         )
-        self.live_slots = 0
+        self._set_live_slots(0)
         return True
 
     def _take_admits_paged(self, active, pending, n_slots, alloc, slack):
@@ -1210,7 +1252,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 s for s in range(n_slots) if active[s] is None
             )
             alloc.reserve(free_slot, len(pending[-1][2]) + slack)  # raises
-        self.live_slots = sum(a is not None for a in active) + len(admits)
+        self._set_live_slots(sum(a is not None for a in active) + len(admits))
         tracer.counter(
             "gen_slots", live=self.live_slots, pending=len(pending)
         )
@@ -1332,6 +1374,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
 
         self._gen_fns[sig] = fn
         self.decode_compiles += 1
+        self._m_decode_compiles.inc()
         logger.info(
             f"compiled paged inflight decoder n_slots={n_slots} "
             f"pool={n_pages}x{self.kv_page_size} chunk={chunk_t}"
@@ -1501,7 +1544,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             prefix_misses=alloc.prefix_misses,
             peak_live_slots=st.peak_live,
         )
-        self.live_slots = 0
+        self._set_live_slots(0)
         return True
 
     def _take_admits_serving(self, st: "_PagedGenSession") -> int:
@@ -1587,7 +1630,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             alloc.reserve(
                 free_slot, len(st.pending[-1][2]) + slack
             )  # raises
-        self.live_slots = sum(a is not None for a in st.active)
+        self._set_live_slots(sum(a is not None for a in st.active))
         st.peak_live = max(st.peak_live, self.live_slots)
         tracer.counter(
             "gen_slots", live=self.live_slots, pending=len(st.pending)
@@ -1788,6 +1831,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
 
         self._gen_fns[sig] = fn
         self.decode_compiles += 1
+        self._m_decode_compiles.inc()
         logger.info(
             f"compiled serving chunk n_slots={n_slots} "
             f"pool={n_pages}x{self.kv_page_size} chunk={chunk_t} W={W}"
@@ -2008,6 +2052,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
 
         self._gen_fns[sig] = fn
         self.decode_compiles += 1
+        self._m_decode_compiles.inc()
         logger.info(
             f"compiled spec decoder n_slots={n_slots} s_max={s_max} "
             f"steps={n_steps} K={K}"
@@ -2229,6 +2274,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
 
         self._gen_fns[sig] = fn
         self.decode_compiles += 1
+        self._m_decode_compiles.inc()
         logger.info(
             f"compiled paged spec decoder n_slots={n_slots} "
             f"pool={n_pages}x{self.kv_page_size} steps={n_steps} K={K}"
@@ -2348,6 +2394,13 @@ class GeneratorEngine(HostOffloadMixin, Engine):
     # -- output assembly --
 
     def _assemble(self, sample, prompt_key, prompt_lens, results, n):
+        toks = sum(len(t[0]) for t in results.values())
+        self._m_tokens.inc(toks)
+        dt = time.monotonic() - getattr(self, "_gen_t0", time.monotonic())
+        if dt > 0:
+            # Wall-clock goodput of the whole call, park time included —
+            # the per-server throughput the fleet table reports.
+            self._m_goodput.set(toks / dt)
         return assemble_rollout(
             sample, prompt_key, n,
             lambda i, r: results[(i, r)],

@@ -34,6 +34,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from areal_tpu.base import metrics
+
 
 class PagePoolExhausted(RuntimeError):
     """The KV page pool has no free page for a required allocation.
@@ -93,6 +95,24 @@ class PageAllocator:
         self.cow_copies = 0
         self.shared_mappings = 0
         self.debug_check = os.environ.get("AREAL_PAGING_CHECK") == "1"
+        # Process-wide counters (the allocator itself is per-session):
+        # the prefix-cache hit rate and CoW traffic the fleet watchdog
+        # trends across generate calls.
+        reg = metrics.default_registry()
+        self._m_prefix_hits = reg.counter(
+            "areal_kv_prefix_hits_total", "prefix-cache page-list hits"
+        )
+        self._m_prefix_misses = reg.counter(
+            "areal_kv_prefix_misses_total", "prefix-cache lookups missed"
+        )
+        self._m_cow_copies = reg.counter(
+            "areal_kv_cow_copies_total",
+            "pages privatised by copy-on-write",
+        )
+        self._m_shared = reg.counter(
+            "areal_kv_shared_mappings_total",
+            "table references served by an already-mapped page",
+        )
 
     # ---------------------------------------------------------------- core
 
@@ -188,6 +208,7 @@ class PageAllocator:
             self.refcount[p] += 1
             self.table[slot, j] = p
             self.shared_mappings += 1
+            self._m_shared.inc()
         self.used[slot] = len(pages)
         self.peak_pages_used = max(
             self.peak_pages_used, self.allocated_pages()
@@ -227,6 +248,7 @@ class PageAllocator:
             self.refcount[src] -= 1  # never hits 0: it was > 1
             self.table[slot, j] = dst
             self.cow_copies += 1
+            self._m_cow_copies.inc()
             pairs.append((src, dst))
         self.peak_pages_used = max(
             self.peak_pages_used, self.allocated_pages()
@@ -249,9 +271,11 @@ class PageAllocator:
         pages = self._prefix_cache.get(key)
         if pages is None:
             self.prefix_misses += 1
+            self._m_prefix_misses.inc()
             return None
         self._prefix_cache.move_to_end(key)
         self.prefix_hits += 1
+        self._m_prefix_hits.inc()
         return list(pages)
 
     def prefix_insert(self, key, pages: Sequence[int]) -> None:

@@ -54,9 +54,44 @@ from areal_tpu.api.model_api import (
     LLMAPIClient,
     register_backend,
 )
-from areal_tpu.base import logging, tracer
+from areal_tpu.base import logging, metrics, tracer
 
 logger = logging.getLogger("gen_server")
+
+# Module-level registration (replay.py idiom): the registry's
+# get-or-create already makes every server in a process share one
+# series per name, so per-instance handles would alias these anyway —
+# and helper methods like _fail_request must work on partially
+# constructed instances (tests build them via __new__).
+_REG = metrics.default_registry()
+_M_QUEUE_DEPTH = _REG.gauge(
+    "areal_gen_queue_depth",
+    "requests waiting in the batching collector queue",
+)
+_M_REQUESTS = _REG.counter(
+    "areal_gen_requests_total",
+    "generate requests finished, by terminal status",
+    ("status",),
+)
+_M_REQUEST_SECONDS = _REG.histogram(
+    "areal_gen_request_seconds",
+    "request latency, enqueue to reply",
+)
+_M_BATCHES = _REG.counter(
+    "areal_gen_batches_total", "collector batches dispatched"
+)
+_M_WEIGHT_VERSION = _REG.gauge(
+    "areal_gen_weight_version", "current serving weight version"
+)
+_M_WEIGHT_UPDATES = _REG.counter(
+    "areal_gen_weight_updates_total", "weight swaps applied"
+)
+_M_CAPACITY = _REG.gauge(
+    "areal_gen_capacity_slots", "max concurrent decode slots"
+)
+_M_PAUSED = _REG.gauge(
+    "areal_gen_paused", "1 while paused for a weight swap"
+)
 
 
 @dataclasses.dataclass
@@ -123,6 +158,11 @@ class GenerationServer:
         # Serializes in-memory weight pushes (each is pause→swap→resume).
         self._update_mutex = threading.Lock()
         self.inmem_updates = 0
+        # Guards the (version, paused) pair health_info() reports: a
+        # poll landing mid-swap must see a consistent snapshot, not a
+        # new version with stale pause state (or vice versa).
+        self._health_lock = threading.Lock()
+        _M_CAPACITY.set(int(getattr(engine, "max_decode_batch", 0) or 0))
 
         srv = self
 
@@ -141,6 +181,16 @@ class GenerationServer:
             def do_GET(self):
                 if self.path == "/health":
                     self._send(200, srv.health_info())
+                elif self.path.split("?")[0] == "/metrics":
+                    body = metrics.default_registry().expose().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._send(404, {"error": "unknown path"})
 
@@ -342,18 +392,37 @@ class GenerationServer:
     # ---------------- pause / resume / in-memory weight sync ----------------
 
     def health_info(self) -> Dict:
-        """Liveness + the load signals a rollout controller balances on:
-        collector queue depth, slots live in the current decode loop, and
-        KV-pool utilization (all racily read — gauges, not invariants)."""
+        """Liveness + the load signals a rollout controller balances on.
+
+        Snapshot discipline (a mid-admission poll must not report torn
+        state): (version, paused) are read together under _health_lock —
+        the same lock every weight swap bumps them under; the engine's
+        (live_slots, kv_utilization) pair comes from its atomically
+        replaced `load_state` tuple, so the two can never be from
+        different chunk boundaries; queue depth is one qsize() call.
+        The same snapshot feeds the /metrics gauges, so /health and the
+        metrics plane agree."""
         eng = self.engine
+        with self._health_lock:
+            version = self.version
+            paused = self._pause_evt.is_set()
+        load = getattr(eng, "load_state", None)
+        if load is not None:
+            live, kvu = load
+        else:
+            live = getattr(eng, "live_slots", 0)
+            kvu = getattr(eng, "kv_utilization", 0.0)
+        qd = self._queue.qsize()
+        _M_QUEUE_DEPTH.set(qd)
+        _M_WEIGHT_VERSION.set(version)
         return {
             "status": "ok",
-            "version": self.version,
-            "queue_depth": self._queue.qsize(),
-            "live_slots": int(getattr(eng, "live_slots", 0)),
-            "kv_utilization": float(getattr(eng, "kv_utilization", 0.0)),
+            "version": version,
+            "queue_depth": qd,
+            "live_slots": int(live),
+            "kv_utilization": float(kvu),
             "capacity": int(getattr(eng, "max_decode_batch", 0) or 0),
-            "paused": self._pause_evt.is_set(),
+            "paused": paused,
         }
 
     def pause(self) -> None:
@@ -361,12 +430,16 @@ class GenerationServer:
         generate call parks (releasing the engine lock) and new batches
         wait until resume().  Engines without interrupt support simply
         drain their current call first."""
-        self._pause_evt.set()
+        with self._health_lock:
+            self._pause_evt.set()
+        _M_PAUSED.set(1)
         if hasattr(self.engine, "interrupt"):
             self.engine.interrupt()
 
     def resume(self) -> None:
-        self._pause_evt.clear()
+        with self._health_lock:
+            self._pause_evt.clear()
+        _M_PAUSED.set(0)
         if hasattr(self.engine, "clear_interrupt"):
             self.engine.clear_interrupt()
         with self._resume_cond:
@@ -384,9 +457,12 @@ class GenerationServer:
             try:
                 with self._engine_lock:
                     self.engine.set_params(params)
-                    self.version += 1
+                    with self._health_lock:
+                        self.version += 1
+                        v = self.version
                     self.inmem_updates += 1
-                    v = self.version
+                    _M_WEIGHT_VERSION.set(v)
+                    _M_WEIGHT_UPDATES.inc()
             finally:
                 self.resume()
         logger.info(f"weights updated in memory -> version {v}")
@@ -450,7 +526,10 @@ class GenerationServer:
         _, params = hf.load_hf_checkpoint(path)
         with self._engine_lock:
             self.engine.set_params(params)
-            self.version += 1
+            with self._health_lock:
+                self.version += 1
+            _M_WEIGHT_VERSION.set(self.version)
+            _M_WEIGHT_UPDATES.inc()
         logger.info(
             f"weights updated from {req['path']} -> version {self.version}"
         )
@@ -487,6 +566,8 @@ class GenerationServer:
                     depth=self._queue.qsize(),
                     batch=len(batch),
                 )
+                _M_QUEUE_DEPTH.set(self._queue.qsize())
+                _M_BATCHES.inc()
                 by_g: Dict[Any, List[_Pending]] = {}
                 for p in batch:
                     by_g.setdefault(_gkey(p), []).append(p)
@@ -560,6 +641,11 @@ class GenerationServer:
     def _fail_request(self, p: _Pending, msg: str) -> None:
         logger.error(f"rejecting {p.qid}: {msg}")
         p.error = msg
+        _M_REQUESTS.labels("rejected").inc()
+        if p.t_enq is not None:
+            _M_REQUEST_SECONDS.observe(
+                (time.monotonic_ns() - p.t_enq) / 1e9
+            )
         if p.t_enq is not None:
             tracer.complete(
                 f"request:{p.qid}",
@@ -573,6 +659,13 @@ class GenerationServer:
 
     def _run_subgroup(self, group: List[_Pending]):
         try:
+            # Park BEFORE dispatch while paused.  The inflight path parks
+            # itself at the next chunk boundary, but the static
+            # (short-decode) path is one uninterruptible program — without
+            # this gate a request arriving mid-pause would race the weight
+            # swap for the engine lock instead of waiting for resume().
+            if self._pause_evt.is_set():
+                self._await_resume()
             g = group[0].gconfig
             # Internal ids are positional: client qids may collide across
             # concurrent trainers sharing this server.
@@ -627,7 +720,13 @@ class GenerationServer:
                 p.error = repr(e)
         finally:
             for p in group:
+                _M_REQUESTS.labels(
+                    "error" if p.error else "ok"
+                ).inc()
                 if p.t_enq is not None:
+                    _M_REQUEST_SECONDS.observe(
+                        (time.monotonic_ns() - p.t_enq) / 1e9
+                    )
                     tracer.complete(
                         f"request:{p.qid}",
                         start_ns=p.t_enq,
@@ -1073,6 +1172,11 @@ def main():
     p.add_argument("--zmq-port", type=int, default=None,
                    help="also serve the pipelined ZMQ transport on this "
                         "port (0 = random); clients use zmq://host:port")
+    p.add_argument("--experiment", default="",
+                   help="announce this server's /metrics endpoint into "
+                        "name_resolve under the experiment/trial metrics "
+                        "subtree (see apps/metrics_report.py)")
+    p.add_argument("--trial", default="trial")
     args = p.parse_args()
 
     tracer.configure(role="gen_server", rank=args.port)
@@ -1104,6 +1208,17 @@ def main():
         engine, host=args.host, port=args.port, token=args.token,
         zmq_port=args.zmq_port,
     )
+    if args.experiment:
+        # The server's own HTTP plane serves /metrics; announce its base
+        # URL so the fleet poller finds this role.
+        from areal_tpu.base import name_resolve, names
+
+        name_resolve.add(
+            names.metrics_endpoint(
+                args.experiment, args.trial, f"gen_server/{server.port}"
+            ),
+            server.url, replace=True, delete_on_exit=True,
+        )
     logger.info(
         f"serving {args.path} at {server.url}"
         + (f" + {server.zmq_url}" if server.zmq_url else "")

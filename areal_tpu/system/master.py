@@ -17,7 +17,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from areal_tpu.api.config import ModelInterfaceType
 from areal_tpu.api.dfg import DFG, MFCDef, OffloadHook, ParamReallocHook
-from areal_tpu.base import logging, recover, timeutil, tracer
+from areal_tpu.base import logging, metrics, recover, timeutil, tracer
 from areal_tpu.base.monitor import StatsLogger
 from areal_tpu.base.stats import merge_stats
 from areal_tpu.system.buffer import SequenceBuffer
@@ -149,6 +149,30 @@ class MasterWorker:
         )
         self.stats_history: List[Dict[str, float]] = []
         self.stats_logger = StatsLogger(fileroot, experiment_name, trial_name)
+        reg = metrics.default_registry()
+        self._m_steps = reg.counter(
+            "areal_master_steps_total", "train steps completed"
+        )
+        self._m_step_seconds = reg.histogram(
+            "areal_master_step_seconds",
+            "wall time per train step",
+            buckets=(0.1, 0.5, 1, 2, 5, 10, 30, 60, 120, 300),
+        )
+        self._m_mfc_seconds = reg.gauge(
+            "areal_mfc_wall_seconds",
+            "last step's wall seconds, per MFC",
+            ("mfc",),
+        )
+        self._m_mfc_mfu = reg.gauge(
+            "areal_mfc_mfu_ratio",
+            "last step's model FLOP utilization, per MFC",
+            ("mfc",),
+        )
+        self._m_mfc_tflops = reg.gauge(
+            "areal_mfc_tflops",
+            "last step's achieved TFLOP/s, per MFC",
+            ("mfc",),
+        )
         # Span tracing (AREAL_TRACE): resolve the trial's shared shard dir
         # before claiming this process's identity so in-process workers
         # and the master write one coherent shard set.
@@ -276,6 +300,7 @@ class MasterWorker:
                     stats = await self.execute_step()
                 dt = time.monotonic() - t0
                 stats["time/step_s"] = dt
+                self._export_step_metrics(stats, dt)
                 self.stats_history.append(stats)
                 logger.info(
                     f"step {self.step_info.global_step + 1}/{total_steps} "
@@ -290,6 +315,26 @@ class MasterWorker:
             self.stats_logger.close()
             tracer.flush()
         return self.stats_history
+
+    def _export_step_metrics(
+        self, stats: Dict[str, float], step_seconds: float
+    ) -> None:
+        """Mirror the merged per-MFC perf keys (worker `_mfc_perf`, fed
+        by monitor.py's analytic FLOP counters) into labeled gauges —
+        the per-MFC wall/MFU view the fleet watchdog trends."""
+        self._m_steps.inc()
+        self._m_step_seconds.observe(step_seconds)
+        suffixes = (
+            ("perf/time_s", self._m_mfc_seconds),
+            ("perf/mfu", self._m_mfc_mfu),
+            ("perf/tflops", self._m_mfc_tflops),
+        )
+        for k, v in stats.items():
+            for suffix, gauge in suffixes:
+                if k == suffix:
+                    gauge.labels("all").set(float(v))
+                elif k.endswith("/" + suffix):
+                    gauge.labels(k[: -(len(suffix) + 1)]).set(float(v))
 
     async def _post_step(self):
         if self.save_ctl.check():

@@ -27,7 +27,32 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from areal_tpu.base import tracer
+from areal_tpu.base import metrics, tracer
+
+# Registered at module import (one canonical site; see arealint rule
+# metrics-names): all ReplayBuffer instances in a process share these —
+# in practice one buffer per trainer process.
+_reg = metrics.default_registry()
+_M_STALENESS = _reg.histogram(
+    "areal_replay_staleness",
+    "staleness (trainer_version - version_start) of consumed trajectories",
+    buckets=(0, 1, 2, 4, 8, 16, 32),
+)
+_M_SIZE = _reg.gauge("areal_replay_size", "resident trajectories")
+_M_CAPACITY = _reg.gauge("areal_replay_capacity", "buffer capacity")
+_M_VERSION = _reg.gauge("areal_replay_version", "trainer weight version")
+_M_MIN_VERSION = _reg.gauge(
+    "areal_replay_min_version", "oldest resident head version"
+)
+_M_MAX_VERSION = _reg.gauge(
+    "areal_replay_max_version", "newest resident head version"
+)
+_M_EVENTS = _reg.counter(
+    "areal_replay_events_total",
+    "admission outcomes: accepted / rejected / evicted / "
+    "dropped_stale / consumed",
+    ("event",),
+)
 
 
 @dataclasses.dataclass
@@ -127,6 +152,11 @@ class ReplayBuffer:
                     out = self._entries[:n]
                     del self._entries[:n]
                     self.consumed += n
+                    _M_EVENTS.labels("consumed").inc(n)
+                    for t in out:
+                        # Staleness the trainer actually trains on — the
+                        # distribution the staleness_p99 SLO watches.
+                        _M_STALENESS.observe(t.staleness(self._version))
                     self._emit_gauges_locked()
                     return out
                 if deadline is not None:
@@ -148,6 +178,7 @@ class ReplayBuffer:
         with self._cond:
             if traj.staleness(self._version) > self.max_head_offpolicyness:
                 self.rejected += 1
+                _M_EVENTS.labels("rejected").inc()
                 self._emit_gauges_locked()
                 if strict:
                     raise StaleTrajectoryError(
@@ -162,10 +193,12 @@ class ReplayBuffer:
             while len(self._entries) >= self.capacity:
                 old = self._entries.pop(0)
                 self.evicted += 1
+                _M_EVENTS.labels("evicted").inc()
                 if self.on_drop is not None:
                     self.on_drop(old)
             self._entries.append(traj)
             self.accepted += 1
+            _M_EVENTS.labels("accepted").inc()
             self._emit_gauges_locked()
             self._cond.notify_all()
             return True
@@ -231,6 +264,7 @@ class ReplayBuffer:
         for t in self._entries:
             if t.staleness(self._version) > self.max_head_offpolicyness:
                 self.dropped_stale += 1
+                _M_EVENTS.labels("dropped_stale").inc()
                 if self.on_drop is not None:
                     self.on_drop(t)
             else:
@@ -238,6 +272,12 @@ class ReplayBuffer:
         self._entries = keep
 
     def _emit_gauges_locked(self) -> None:
+        _M_SIZE.set(len(self._entries))
+        _M_CAPACITY.set(self.capacity)
+        _M_VERSION.set(self._version)
+        versions = [t.version_start for t in self._entries]
+        _M_MIN_VERSION.set(min(versions) if versions else self._version)
+        _M_MAX_VERSION.set(max(versions) if versions else self._version)
         tracer.counter(
             "replay_buffer",
             size=len(self._entries),

@@ -32,7 +32,7 @@ import time
 from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
 
 from areal_tpu.api.model_api import APIGenerateInput, GenerationHyperparameters
-from areal_tpu.base import logging, tracer
+from areal_tpu.base import logging, metrics, tracer
 from areal_tpu.system.replay import ReplayBuffer, Trajectory
 
 logger = logging.getLogger("rollout")
@@ -113,6 +113,24 @@ class RolloutController:
         )
         self._sem = asyncio.Semaphore(cap)
         self.max_concurrency = cap
+        reg = metrics.default_registry()
+        self._m_in_flight = reg.gauge(
+            "areal_rollout_in_flight", "dispatches awaiting a response"
+        )
+        self._m_backpressure = reg.counter(
+            "areal_rollout_backpressure_total",
+            "waits because the replay buffer could not accept",
+        )
+        self._m_dispatched = reg.counter(
+            "areal_rollout_dispatched_total",
+            "prompt dispatches, by terminal status",
+            ("status",),
+        )
+        self._m_version_lag = reg.gauge(
+            "areal_rollout_version_lag",
+            "trainer weight version minus the dispatched server's "
+            "serving version, at dispatch time",
+        )
 
     # ---------------- recover ----------------
 
@@ -188,6 +206,7 @@ class RolloutController:
             # pulling more prompts would only evict unconsumed samples.
             while not self.replay.can_accept() and not self._stop:
                 self.stat.backpressure_waits += 1
+                self._m_backpressure.inc()
                 tracer.counter(
                     "rollout_controller",
                     in_flight=self.stat.in_flight,
@@ -218,6 +237,15 @@ class RolloutController:
             self._local_load[idx] += 1
             self.stat.submitted += 1
             self.stat.in_flight += 1
+            self._m_in_flight.set(self.stat.in_flight)
+            srv_version = self._health[idx].get("version")
+            if srv_version is not None:
+                # Dispatch-time lag between the trainer head and the
+                # chosen server's serving weights — a persistently
+                # positive gauge means weight sync is falling behind.
+                self._m_version_lag.set(
+                    self.replay.version - int(srv_version)
+                )
             tracer.counter(
                 "rollout_controller",
                 in_flight=self.stat.in_flight,
@@ -234,12 +262,14 @@ class RolloutController:
                 )
             except Exception as e:  # noqa: BLE001 — one prompt, not the pump
                 self.stat.failed += 1
+                self._m_dispatched.labels("failed").inc()
                 logger.warning(f"rollout {qid} failed: {e!r}")
                 return
             finally:
                 self._local_load[idx] -= 1
                 self.stat.in_flight -= 1
                 self.stat.completed += 1
+                self._m_in_flight.set(self.stat.in_flight)
         # Lossless backpressure on the put side too: a completed response
         # holds until the trainer drains a slot rather than evicting an
         # unconsumed sample.  Too-stale responses fall through to put()
@@ -251,6 +281,7 @@ class RolloutController:
             <= self.replay.max_head_offpolicyness
         ):
             self.stat.backpressure_waits += 1
+            self._m_backpressure.inc()
             await asyncio.sleep(self.backpressure_poll_s)
         traj = Trajectory(
             qid=out.qid,
@@ -263,5 +294,7 @@ class RolloutController:
         )
         if self.replay.put(traj):
             self.stat.accepted += 1
+            self._m_dispatched.labels("accepted").inc()
         else:
             self.stat.rejected += 1
+            self._m_dispatched.labels("rejected").inc()

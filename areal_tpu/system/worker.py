@@ -37,7 +37,7 @@ from areal_tpu.api.model_api import (
     OptimizerConfig,
     make_interface,
 )
-from areal_tpu.base import logging, tracer
+from areal_tpu.base import logging, metrics, tracer
 from areal_tpu.base.monitor import Timers
 from areal_tpu.base.topology import ParallelConfig, make_mesh
 from areal_tpu.models.config import ModelConfig
@@ -181,6 +181,23 @@ class ModelWorker:
         # (time/mfc_<itype>, _cnt, _avg) so the master's per-step log shows
         # where worker time went without a tracer attached.
         self.timers = Timers()
+        reg = metrics.default_registry()
+        self._m_mfc_seconds = reg.histogram(
+            "areal_worker_mfc_seconds",
+            "MFC wall time on this worker",
+            ("mfc",),
+            buckets=(0.05, 0.1, 0.5, 1, 2, 5, 10, 30, 60, 120),
+        )
+        self._m_mfc_mfu = reg.gauge(
+            "areal_worker_mfc_mfu_ratio",
+            "last model FLOP utilization, per MFC",
+            ("mfc",),
+        )
+        self._m_mfc_tokens = reg.counter(
+            "areal_worker_mfc_tokens_total",
+            "tokens processed, per MFC",
+            ("mfc",),
+        )
         self._setup()
 
     # ---------------- setup ----------------
@@ -419,6 +436,13 @@ class ModelWorker:
                 out_sample.remap_keys_(remap_out)
             perf = self._mfc_perf(model, itype, sample, out_sample, mfc_seconds)
             perf.update(self.timers.drain())
+            mfc_label = f"{model_key}:{itype.value}"
+            self._m_mfc_seconds.labels(mfc_label).observe(mfc_seconds)
+            if "perf/mfu" in perf:
+                self._m_mfc_mfu.labels(mfc_label).set(perf["perf/mfu"])
+            self._m_mfc_tokens.labels(mfc_label).inc(
+                int(sum(sum(s) for s in sample.seqlens[next(iter(sample.keys))]))
+            )
             if tracer.enabled():
                 targs["mfc"] = f"{model_key}:{itype.value}"
                 key0 = next(iter(sample.keys))

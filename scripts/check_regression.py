@@ -1,0 +1,215 @@
+"""Perf-regression gate: fresh bench_*.json vs a committed baseline.
+
+    python scripts/check_regression.py --baseline bench_paged_cpu8_*.json \
+        --fresh /tmp/bench_new.json
+    python scripts/check_regression.py --self-check   # baseline vs itself (CI)
+
+Bench files are JSONL: one object per "leg" (see scripts/bench_paged.py /
+bench_serving.py).  Legs are matched between baseline and fresh by their
+``leg`` value plus any discriminator keys present (``group_n``,
+``kv_share_prefix``, ``prompt_len``), then each metric is compared under a
+noise-aware rule:
+
+- direction "higher" (throughput): fresh must be >= baseline*(1-rel_tol)
+- direction "lower" (wall time):   fresh must be <= baseline*(1+rel_tol)
+- direction "max"   (counters like decode_compiles): fresh <= baseline+abs_tol
+- direction "exact" (invariants like cache_copy_bytes==0 on paged legs):
+  fresh == baseline
+
+rel_tol is deliberately loose for wall-clock metrics (CI machines are
+noisy); throughput is the primary SLO with a tighter band.  A metric
+missing from the fresh run is a failure (benches must not silently drop
+coverage); a metric missing from the baseline is skipped (new metrics
+need a baseline refresh first, not a red gate).
+
+Exit status: 0 = within noise, 1 = regression(s), 2 = usage error.
+"""
+
+import argparse
+import dataclasses
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DISCRIMINATORS = ("group_n", "kv_share_prefix", "prompt_len")
+
+# Legs carrying boolean invariants, not perf metrics — every boolean that
+# was true in the baseline must stay true.
+INVARIANT_LEGS = ("compare", "stall_compare")
+
+
+@dataclasses.dataclass
+class MetricRule:
+    direction: str  # "higher" | "lower" | "max" | "exact"
+    rel_tol: float = 0.0
+    abs_tol: float = 0.0
+
+
+RULES: Dict[str, MetricRule] = {
+    "gen_tokens_per_sec": MetricRule("higher", rel_tol=0.15),
+    "wall_seconds": MetricRule("lower", rel_tol=0.25),
+    "decode_compiles": MetricRule("max", abs_tol=0),
+    "cache_copy_bytes": MetricRule("exact"),
+    "kv_pool_utilization": MetricRule("higher", rel_tol=0.10),
+    "peak_pages_used": MetricRule("max", abs_tol=2),
+    "shared_mappings": MetricRule("higher", rel_tol=0.0),
+    "prefix_hits": MetricRule("higher", rel_tol=0.0),
+    "cow_copies": MetricRule("max", abs_tol=0),
+    "admission_prefill_ms": MetricRule("lower", rel_tol=0.50),
+}
+
+
+def leg_key(rec: Dict) -> Tuple:
+    return (rec.get("leg"),) + tuple(
+        (k, rec[k]) for k in DISCRIMINATORS if k in rec
+    )
+
+
+def load_bench(path: str) -> Dict[Tuple, Dict]:
+    out: Dict[Tuple, Dict] = {}
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            rec = json.loads(ln)
+            out[leg_key(rec)] = rec
+    return out
+
+
+def compare_metric(
+    name: str, rule: MetricRule, base: float, fresh: float
+) -> Optional[str]:
+    """Return a failure message, or None when fresh is within the rule."""
+    if rule.direction == "higher":
+        floor = base * (1.0 - rule.rel_tol)
+        if fresh < floor:
+            pct = 100.0 * (base - fresh) / base if base else float("inf")
+            return (
+                f"{name}: {fresh:g} is {pct:.1f}% below baseline {base:g} "
+                f"(allowed drop {100 * rule.rel_tol:.0f}%)"
+            )
+    elif rule.direction == "lower":
+        ceil = base * (1.0 + rule.rel_tol)
+        if fresh > ceil:
+            pct = 100.0 * (fresh - base) / base if base else float("inf")
+            return (
+                f"{name}: {fresh:g} is {pct:.1f}% above baseline {base:g} "
+                f"(allowed growth {100 * rule.rel_tol:.0f}%)"
+            )
+    elif rule.direction == "max":
+        if fresh > base + rule.abs_tol:
+            return (
+                f"{name}: {fresh:g} exceeds baseline {base:g} "
+                f"(+{rule.abs_tol:g} allowed)"
+            )
+    elif rule.direction == "exact":
+        if fresh != base:
+            return f"{name}: {fresh!r} != baseline {base!r}"
+    return None
+
+
+def compare_benches(
+    baseline: Dict[Tuple, Dict], fresh: Dict[Tuple, Dict]
+) -> Tuple[List[str], List[str]]:
+    """(failures, notes).  Failures make the gate red."""
+    failures: List[str] = []
+    notes: List[str] = []
+    for key, brec in sorted(baseline.items(), key=repr):
+        leg = brec.get("leg")
+        frec = fresh.get(key)
+        tag = "/".join(
+            str(p[1]) if isinstance(p, tuple) else str(p) for p in key
+        )
+        if frec is None:
+            failures.append(f"[{tag}] leg missing from fresh run")
+            continue
+        if leg in INVARIANT_LEGS:
+            for k, v in brec.items():
+                if v is True and frec.get(k) is not True:
+                    failures.append(
+                        f"[{tag}] invariant {k} no longer holds "
+                        f"(fresh={frec.get(k)!r})"
+                    )
+            continue
+        for k, rule in RULES.items():
+            if k not in brec or brec[k] is None:
+                continue
+            if k not in frec or frec[k] is None:
+                failures.append(f"[{tag}] metric {k} missing from fresh run")
+                continue
+            msg = compare_metric(k, rule, float(brec[k]), float(frec[k]))
+            if msg is not None:
+                failures.append(f"[{tag}] {msg}")
+    extra = set(fresh) - set(baseline)
+    if extra:
+        notes.append(
+            f"{len(extra)} fresh leg(s) with no baseline (skipped): "
+            + ", ".join(sorted(str(k[0]) for k in extra))
+        )
+    return failures, notes
+
+
+def default_baselines() -> List[str]:
+    pats = ("bench_paged_cpu8_*.json", "bench_serving_cpu8_*.json")
+    out: List[str] = []
+    for pat in pats:
+        hits = sorted(glob.glob(os.path.join(REPO_ROOT, pat)))
+        if hits:
+            out.append(hits[-1])  # newest committed baseline per family
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="check_regression")
+    p.add_argument("--baseline", action="append", default=[],
+                   help="baseline bench JSONL (repeatable; default: newest "
+                        "committed bench_paged/bench_serving files)")
+    p.add_argument("--fresh", action="append", default=[],
+                   help="fresh bench JSONL to gate (repeatable)")
+    p.add_argument("--self-check", action="store_true",
+                   help="compare each baseline against itself — exercises "
+                        "the full pipeline in CI without running benches")
+    args = p.parse_args(argv)
+
+    baselines = args.baseline or default_baselines()
+    if not baselines:
+        print("FAIL[usage] no baseline files found", file=sys.stderr)
+        return 2
+    if args.self_check:
+        pairs = [(b, b) for b in baselines]
+    else:
+        if not args.fresh:
+            print("FAIL[usage] pass --fresh (or --self-check)",
+                  file=sys.stderr)
+            return 2
+        if len(args.fresh) != len(baselines):
+            print(
+                f"FAIL[usage] {len(baselines)} baseline(s) vs "
+                f"{len(args.fresh)} fresh file(s)", file=sys.stderr)
+            return 2
+        pairs = list(zip(baselines, args.fresh))
+
+    total_failures = 0
+    for bpath, fpath in pairs:
+        failures, notes = compare_benches(load_bench(bpath), load_bench(fpath))
+        rel = os.path.relpath(bpath, REPO_ROOT)
+        if failures:
+            print(f"FAIL[{rel}] {len(failures)} regression(s) "
+                  f"vs {os.path.basename(fpath)}:")
+            for msg in failures:
+                print(f"  {msg}")
+        else:
+            print(f"OK[{rel}] within noise vs {os.path.basename(fpath)}")
+        for n in notes:
+            print(f"  note: {n}")
+        total_failures += len(failures)
+    return 1 if total_failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

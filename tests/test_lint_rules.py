@@ -495,7 +495,7 @@ class TestOutputFormats:
     def test_rule_registry_names(self):
         assert RULE_NAMES == (
             "host-sync", "retrace-hazard", "async-blocking", "sharding",
-            "stats-keys",
+            "stats-keys", "metrics-names",
         )
         with pytest.raises(KeyError):
             get_rules(["no-such-rule"])

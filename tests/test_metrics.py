@@ -1,0 +1,406 @@
+"""Metrics plane tests: registry semantics, Prometheus exposition,
+ring-buffer windows, HTTP server + name_resolve announce, the SLO
+evaluator and fleet signals of apps/metrics_report.py, the
+check_regression gate, the trace_report --json schema, and the
+arealint metrics-names rule.
+
+Everything here is jax-free and sub-second: the metrics plane must stay
+testable on the bare-CPU lint box.
+"""
+
+import importlib.util
+import json
+import os
+import textwrap
+import urllib.request
+
+import pytest
+
+from areal_tpu.analysis import Severity, get_rules, lint_source
+from areal_tpu.apps import metrics_report as mr
+from areal_tpu.apps.trace_report import json_report
+from areal_tpu.base import metrics, name_resolve, names
+from areal_tpu.base.metrics import (
+    MAX_LABEL_SETS,
+    MetricsServer,
+    Registry,
+    parse_prometheus_text,
+    quantile_from_buckets,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def reg():
+    return Registry(window=4)
+
+
+class TestRegistry:
+    def test_counter_monotonic(self, reg):
+        c = reg.counter("areal_t_events_total", "h")
+        c.inc()
+        c.inc(2.5)
+        assert c.get() == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        assert c.get() == 3.5
+
+    def test_counter_name_must_end_total(self, reg):
+        with pytest.raises(ValueError, match="_total"):
+            reg.counter("areal_t_events", "h")
+
+    def test_bad_names_rejected(self, reg):
+        with pytest.raises(ValueError):
+            reg.gauge("0bad", "h")
+        with pytest.raises(ValueError):
+            reg.gauge("areal_ok", "h", labelnames=("bad-label",))
+
+    def test_get_or_create_and_conflict(self, reg):
+        g1 = reg.gauge("areal_t_depth", "h")
+        g2 = reg.gauge("areal_t_depth", "other help tolerated")
+        assert g1 is g2
+        with pytest.raises(ValueError, match="conflicting"):
+            reg.gauge("areal_t_depth", "h", labelnames=("x",))
+        with pytest.raises(ValueError, match="conflicting"):
+            reg.histogram("areal_t_depth", "h", buckets=(1,))
+
+    def test_histogram_bucketing(self, reg):
+        h = reg.histogram("areal_t_lat_seconds", "h", buckets=(0.1, 1, 10))
+        for v in (0.05, 0.5, 0.5, 5, 50):
+            h.observe(v)
+        counts, s, n = h.snapshot()
+        # Per-bucket (non-cumulative) counts for (0.1, 1, 10) plus the
+        # +Inf overflow slot where the 50 lands.
+        assert counts == (1, 2, 1, 1)
+        assert n == 5
+        assert s == pytest.approx(56.05)
+
+    def test_gauge_set_inc_dec(self, reg):
+        g = reg.gauge("areal_t_gauge", "h")
+        g.set(5)
+        g.inc(2)
+        g.dec()
+        assert g.get() == 6
+
+    def test_label_cardinality_guard(self, reg):
+        c = reg.counter("areal_t_lbl_total", "h", labelnames=("k",))
+        for i in range(MAX_LABEL_SETS + 10):
+            c.labels(f"v{i}").inc()
+        kids = dict(c.children())
+        assert len(kids) == MAX_LABEL_SETS + 1  # cap + _overflow child
+        assert kids[("_overflow",)].get() == 10
+
+    def test_labels_positional_and_kw(self, reg):
+        c = reg.counter("areal_t_kw_total", "h", labelnames=("a", "b"))
+        c.labels("x", "y").inc()
+        c.labels(a="x", b="y").inc()
+        assert c.labels("x", "y").get() == 2
+
+    def test_disabled_registry_is_inert(self, reg):
+        c = reg.counter("areal_t_off_total", "h")
+        metrics.configure(enabled=False)
+        try:
+            c.inc(100)
+        finally:
+            metrics.configure(enabled=True)
+        assert c.get() == 0
+        c.inc()
+        assert c.get() == 1
+
+
+class TestExposition:
+    def test_round_trip(self, reg):
+        c = reg.counter("areal_t_req_total", "h", labelnames=("status",))
+        c.labels("ok").inc(3)
+        c.labels('we"ird\n').inc()
+        reg.gauge("areal_t_depth", "queue depth").set(7)
+        h = reg.histogram("areal_t_lat_seconds", "h", buckets=(1, 10))
+        h.observe(0.5)
+        h.observe(20)
+        text = reg.expose()
+        samples, types = parse_prometheus_text(text)
+        assert types == {
+            "areal_t_req_total": "counter",
+            "areal_t_depth": "gauge",
+            "areal_t_lat_seconds": "histogram",
+        }
+        sd = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+        assert sd[("areal_t_req_total", (("status", "ok"),))] == 3
+        assert sd[("areal_t_req_total", (("status", 'we"ird\n'),))] == 1
+        assert sd[("areal_t_depth", ())] == 7
+        # Cumulative histogram buckets + _sum/_count.
+        assert sd[("areal_t_lat_seconds_bucket", (("le", "1"),))] == 1
+        assert sd[("areal_t_lat_seconds_bucket", (("le", "10"),))] == 1
+        assert sd[("areal_t_lat_seconds_bucket", (("le", "+Inf"),))] == 2
+        assert sd[("areal_t_lat_seconds_count", ())] == 2
+        assert sd[("areal_t_lat_seconds_sum", ())] == pytest.approx(20.5)
+
+    def test_quantile_from_buckets(self):
+        pairs = [(0.1, 1), (1.0, 1), (10.0, 2), (float("inf"), 2)]
+        assert quantile_from_buckets(pairs, 0.5) == pytest.approx(0.1)
+        assert quantile_from_buckets(pairs, 0.99) == pytest.approx(
+            9.82, abs=0.01
+        )
+        assert quantile_from_buckets([], 0.5) != quantile_from_buckets(
+            [], 0.5
+        )  # NaN on no data
+
+
+class TestWindows:
+    def test_ring_buffer_window(self, reg):
+        g = reg.gauge("areal_t_w", "h")
+        for i in range(6):  # window=4: first two scrapes fall off
+            g.set(i)
+            reg.scrape(now=float(i))
+        win = reg.window("areal_t_w")
+        assert [(t, v) for t, v in win] == [
+            (2.0, 2.0), (3.0, 3.0), (4.0, 4.0), (5.0, 5.0)
+        ]
+        assert reg.scrapes == 6
+
+    def test_histogram_scalar_series(self, reg):
+        h = reg.histogram("areal_t_h_seconds", "h", buckets=(1,))
+        h.observe(0.5)
+        reg.scrape(now=1.0)
+        h.observe(2.0)
+        reg.scrape(now=2.0)
+        assert reg.window("areal_t_h_seconds_count") == [
+            (1.0, 1.0), (2.0, 2.0)
+        ]
+        assert reg.window("areal_t_h_seconds_sum")[-1] == (2.0, 2.5)
+
+    def test_labeled_window(self, reg):
+        c = reg.counter("areal_t_lw_total", "h", labelnames=("s",))
+        c.labels("a").inc()
+        reg.scrape(now=1.0)
+        assert reg.window("areal_t_lw_total", ("a",)) == [(1.0, 1.0)]
+        assert reg.window("areal_t_lw_total", ("zz",)) == []
+
+
+class TestServer:
+    def test_http_scrape_and_announce(self):
+        reg = Registry()
+        reg.gauge("areal_t_live", "h").set(3)
+        srv = MetricsServer(registry=reg)
+        try:
+            with urllib.request.urlopen(srv.url + "/metrics", timeout=5) as r:
+                body = r.read().decode()
+                ctype = r.headers["Content-Type"]
+            assert "text/plain" in ctype
+            samples, _ = parse_prometheus_text(body)
+            assert ("areal_t_live", {}, 3.0) in samples
+            srv.announce("e2e_t", "t0", "gen_server/1")
+            key = names.metrics_endpoint("e2e_t", "t0", "gen_server/1")
+            assert name_resolve.get(key) == srv.url
+        finally:
+            srv.close()
+        with pytest.raises(name_resolve.NameEntryNotFoundError):
+            name_resolve.get(key)
+
+
+class TestSLO:
+    def test_parse_defaults_to_crit(self):
+        r = mr.parse_slo_rule("staleness_p99 <= 4")
+        assert (r.severity, r.signal, r.op, r.value) == (
+            "crit", "staleness_p99", "<=", 4.0
+        )
+
+    def test_threshold_violation_and_pass(self):
+        r = mr.parse_slo_rule("warn: queue_depth < 10")
+        assert r.evaluate([{"queue_depth": 12.0}]) is not None
+        assert r.evaluate([{"queue_depth": 3.0}]) is None
+        assert r.evaluate([{}]) is None  # absent signal: not a violation
+
+    def test_drop_rule_percent_and_window(self):
+        r = mr.parse_slo_rule("crit: drop(goodput) < 20% over 3")
+        assert r.value == pytest.approx(0.2)
+        hist = [{"goodput": 50.0}, {"goodput": 100.0}, {"goodput": 75.0}]
+        msg = r.evaluate(hist)
+        assert msg is not None and "25.0%" in msg
+        assert r.evaluate([{"goodput": 100.0}, {"goodput": 90.0}]) is None
+        # Window slides: the old peak of 100 ages out.
+        hist2 = [{"goodput": 100.0}] + [{"goodput": 60.0}] * 3
+        assert r.evaluate(hist2) is None
+
+    def test_parse_errors(self):
+        with pytest.raises(ValueError):
+            mr.parse_slo_rule("drop(goodput) < 0.2")  # no window
+        with pytest.raises(ValueError):
+            mr.parse_slo_rule("fatal: x < 1")  # unknown severity
+        with pytest.raises(ValueError):
+            mr.parse_slo_rule("x ~ 1")
+
+    def test_fleet_signals(self):
+        samples = [
+            ("areal_gen_tokens_total", {}, 480.0),
+            ("areal_gen_goodput_tokens_per_second", {}, 32.0),
+            ("areal_gen_queue_depth", {}, 2.0),
+            ("areal_gen_kv_utilization_ratio", {}, 0.5),
+            ("areal_gen_live_slots", {}, 1.0),
+            ("areal_gen_capacity_slots", {}, 2.0),
+            ("areal_gen_weight_version", {}, 3.0),
+            ("areal_replay_staleness_bucket", {"le": "1"}, 4.0),
+            ("areal_replay_staleness_bucket", {"le": "+Inf"}, 4.0),
+        ]
+        roles = [mr.RoleScrape("gen_server/0", t=10.0, samples=samples)]
+        sig, rows = mr.fleet_signals(roles, prev=None)
+        assert sig["goodput"] == 32.0  # gauge fallback without a prev scrape
+        assert sig["queue_depth"] == 2.0
+        assert sig["idle_frac"] == pytest.approx(0.5)
+        assert sig["version_skew"] == 0.0
+        assert sig["staleness_p99"] <= 1.0
+        assert rows[0]["role"] == "gen_server/0" and rows[0]["ok"]
+        # With a prev scrape 10s earlier the counter rate wins.
+        prev_samples = [("areal_gen_tokens_total", {}, 160.0)] + samples[1:]
+        prev = {"gen_server/0": mr.RoleScrape(
+            "gen_server/0", t=0.0, samples=prev_samples)}
+        sig2, _ = mr.fleet_signals(roles, prev=prev)
+        assert sig2["goodput"] == pytest.approx(32.0)  # (480-160)/10
+
+
+def _load_script(name):
+    path = os.path.join(REPO_ROOT, "scripts", name)
+    spec = importlib.util.spec_from_file_location(name[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestCheckRegression:
+    @pytest.fixture(scope="class")
+    def cr(self):
+        return _load_script("check_regression.py")
+
+    def _baseline(self):
+        return {
+            ("paged",): {
+                "leg": "paged", "gen_tokens_per_sec": 50.0,
+                "wall_seconds": 100.0, "decode_compiles": 1,
+                "cache_copy_bytes": 0, "kv_pool_utilization": 0.9,
+            },
+        }
+
+    def test_25pct_goodput_regression_flagged(self, cr):
+        base = self._baseline()
+        fresh = {("paged",): dict(base[("paged",)],
+                                  gen_tokens_per_sec=37.5)}
+        failures, _ = cr.compare_benches(base, fresh)
+        assert any("gen_tokens_per_sec" in f and "25.0%" in f
+                   for f in failures)
+
+    def test_within_noise_passes(self, cr):
+        base = self._baseline()
+        fresh = {("paged",): dict(
+            base[("paged",)],
+            gen_tokens_per_sec=48.5,  # -3%
+            wall_seconds=108.0,       # +8%
+        )}
+        failures, _ = cr.compare_benches(base, fresh)
+        assert failures == []
+
+    def test_exact_and_max_rules(self, cr):
+        base = self._baseline()
+        fresh = {("paged",): dict(base[("paged",)],
+                                  cache_copy_bytes=4096,
+                                  decode_compiles=3)}
+        failures, _ = cr.compare_benches(base, fresh)
+        assert any("cache_copy_bytes" in f for f in failures)
+        assert any("decode_compiles" in f for f in failures)
+
+    def test_missing_leg_and_metric_fail(self, cr):
+        base = self._baseline()
+        failures, _ = cr.compare_benches(base, {})
+        assert any("missing from fresh run" in f for f in failures)
+        fresh = {("paged",): {"leg": "paged"}}
+        failures, _ = cr.compare_benches(base, fresh)
+        assert any("metric gen_tokens_per_sec missing" in f
+                   for f in failures)
+
+    def test_invariant_leg(self, cr):
+        base = {("compare",): {"leg": "compare",
+                               "greedy_tokens_identical": True}}
+        fresh = {("compare",): {"leg": "compare",
+                                "greedy_tokens_identical": False}}
+        failures, _ = cr.compare_benches(base, fresh)
+        assert any("greedy_tokens_identical" in f for f in failures)
+
+    def test_self_check_green_on_committed_baselines(self, cr):
+        assert cr.main(["--self-check"]) == 0
+
+
+class TestTraceReportJSON:
+    def test_v1_schema(self):
+        trace = {
+            "traceEvents": [
+                {"ph": "M", "name": "process_name", "pid": 1,
+                 "args": {"name": "master_0"}},
+                {"ph": "X", "name": "step", "pid": 1, "tid": 1,
+                 "ts": 0, "dur": 100, "args": {"step": 0}},
+                {"ph": "X", "name": "mfc", "cat": "compute", "pid": 1,
+                 "tid": 1, "ts": 10, "dur": 50},
+            ]
+        }
+        rep = json_report(trace)
+        assert rep["version"] == 1
+        assert set(rep) == {"version", "rows", "bubbles"}
+        row = rep["rows"][0]
+        assert set(row) == {"step", "pid", "process", "window_us",
+                            "compute_us", "comms_us", "host_us", "idle_us"}
+        assert "_covered" not in row
+        assert row["compute_us"] == 50 and row["idle_us"] == 50
+        json.dumps(rep)  # must be pure-JSON serializable
+
+
+def _lint(src):
+    return [
+        f for f in lint_source(
+            textwrap.dedent(src), path="snippet.py",
+            rules=get_rules(["metrics-names"]),
+        )
+        if f.severity == Severity.ERROR
+    ]
+
+
+class TestMetricsNamesRule:
+    def test_clean_registrations_pass(self):
+        assert _lint('''
+            reg.counter("areal_gen_tokens_total", "h")
+            reg.gauge("areal_gen_queue_depth", "h")
+            reg.histogram("areal_gen_request_seconds", "h", ("s",))
+        ''') == []
+
+    def test_bad_prefix_and_case(self):
+        assert len(_lint('reg.gauge("queue_depth", "h")')) == 1
+        assert len(_lint('reg.gauge("areal_Queue", "h")')) == 1
+
+    def test_counter_total_suffix(self):
+        assert any("_total" in f.message for f in _lint(
+            'reg.counter("areal_gen_tokens", "h")'))
+        assert any("must not end" in f.message for f in _lint(
+            'reg.gauge("areal_gen_tokens_total", "h")'))
+
+    def test_unit_suffixes(self):
+        assert any("areal_lat_seconds" in f.message for f in _lint(
+            'reg.histogram("areal_lat_ms", "h")'))
+        assert any("areal_heap_bytes" in f.message for f in _lint(
+            'reg.gauge("areal_heap_mb", "h")'))
+
+    def test_reserved_suffixes(self):
+        assert any("reserved" in f.message for f in _lint(
+            'reg.gauge("areal_q_count", "h")'))
+
+    def test_duplicate_registration(self):
+        findings = _lint('''
+            reg.gauge("areal_dup", "h")
+            reg.gauge("areal_dup", "h")
+        ''')
+        assert len(findings) == 1 and "also registered" in findings[0].message
+
+    def test_tracer_counter_not_flagged(self):
+        assert _lint('tracer.counter("gen_queue", depth=3)') == []
+
+    def test_suppression(self):
+        assert _lint('''
+            reg.gauge("legacy_name", "h")  # arealint: ignore[metrics-names] -- grandfathered
+        ''') == []

@@ -59,6 +59,120 @@ class TestFlops:
         assert monitor.mfu(1e12, 0.1, 1) == pytest.approx(0.1)
         assert monitor.mfu(1e12, 0.1, 2) == pytest.approx(0.05)
 
+    def test_matmul_params_moe_counts_active_experts(self):
+        """MoE counts only routed (active) experts at the MoE intermediate
+        width — not the full expert pool, not the dense width."""
+        dense = tiny_config()
+        moe = tiny_config(n_experts=8)
+        n_mats = 3  # gated mlp
+        dense_mlp = n_mats * dense.hidden_dim * dense.intermediate_dim
+        moe_mlp = (
+            n_mats * moe.hidden_dim * moe.moe_intermediate_dim
+            * moe.n_experts_per_tok
+        )
+        got_diff = monitor.matmul_params(moe) - monitor.matmul_params(dense)
+        assert got_diff == moe.n_layers * (moe_mlp - dense_mlp)
+        # Pool size must NOT enter the per-token count.
+        moe_big_pool = tiny_config(n_experts=64)
+        assert monitor.matmul_params(moe_big_pool) == monitor.matmul_params(
+            moe
+        )
+
+    def test_matmul_params_critic_drops_lm_head(self):
+        lm = tiny_config()
+        critic = tiny_config(is_critic=True)
+        assert monitor.matmul_params(lm) - monitor.matmul_params(
+            critic
+        ) == lm.hidden_dim * lm.vocab_size
+
+    def test_matmul_params_ungated_mlp(self):
+        import dataclasses
+
+        cfg = tiny_config()
+        ungated = dataclasses.replace(cfg, mlp_gated=False)
+        assert monitor.matmul_params(cfg) - monitor.matmul_params(
+            ungated
+        ) == cfg.n_layers * cfg.hidden_dim * cfg.intermediate_dim
+
+    def test_flops_forward_packed_sum_sq(self):
+        """Packed-batch attention must be charged per sequence (sum of
+        squared seqlens), not over the packed total squared."""
+        cfg = tiny_config()
+        n = 4 * 128
+        packed = monitor.flops_forward(cfg, n, sum_sq_seqlens=4 * 128**2)
+        mm = 2.0 * monitor.matmul_params(cfg) * n
+        attn = (
+            4.0 * cfg.n_q_heads * cfg.head_dim * (4 * 128**2) * cfg.n_layers
+        )
+        assert packed == pytest.approx(mm + attn)
+        # Default (one contiguous sequence) charges n^2 — strictly more
+        # than the same tokens packed as 4 separate sequences.
+        assert monitor.flops_forward(cfg, n) > packed
+
+
+class TestMergeStats:
+    def test_denominator_weighted_mean(self):
+        from areal_tpu.base.stats import merge_stats
+
+        out = merge_stats([
+            {"loss": 1.0, "loss_denominator": 100.0},
+            {"loss": 3.0, "loss_denominator": 300.0},
+        ])
+        # Token-weighted: (1*100 + 3*300) / 400, and denominators SUM.
+        assert out["loss"] == pytest.approx(2.5)
+        assert out["loss_denominator"] == 400.0
+
+    def test_plain_keys_unweighted(self):
+        from areal_tpu.base.stats import merge_stats
+
+        out = merge_stats([{"kl": 1.0}, {"kl": 3.0}])
+        assert out["kl"] == pytest.approx(2.0)
+
+    def test_partial_denominator_drops_key(self, caplog):
+        """A denominator present in some-but-not-all shards breaks the
+        positional value/weight pairing: the key must be dropped (with a
+        one-time warning), never averaged unweighted."""
+        import logging
+
+        from areal_tpu.base.stats import merge_stats
+
+        shards = [
+            {"pd_loss": 1.0, "pd_loss_denominator": 100.0},
+            {"pd_loss": 3.0},
+        ]
+        # The repo's logging module sets propagate=False on the
+        # "areal_tpu" parent, so capture at the stats logger itself.
+        slog = logging.getLogger("areal_tpu.stats")
+        slog.addHandler(caplog.handler)
+        try:
+            with caplog.at_level(logging.WARNING, logger="areal_tpu.stats"):
+                out = merge_stats(shards)
+                assert "pd_loss" not in out
+                assert out["pd_loss_denominator"] == 100.0
+                warned = [
+                    r for r in caplog.records
+                    if "pd_loss" in r.getMessage()
+                ]
+                assert len(warned) == 1
+                # Log-once: the second merge stays quiet.
+                caplog.clear()
+                merge_stats(shards)
+                assert not [
+                    r for r in caplog.records
+                    if "pd_loss" in r.getMessage()
+                ]
+        finally:
+            slog.removeHandler(caplog.handler)
+
+    def test_zero_denominator_falls_back_to_mean(self):
+        from areal_tpu.base.stats import merge_stats
+
+        out = merge_stats([
+            {"acc": 1.0, "acc_denominator": 0.0},
+            {"acc": 3.0, "acc_denominator": 0.0},
+        ])
+        assert out["acc"] == pytest.approx(2.0)
+
 
 def test_timers_accumulate():
     t = monitor.Timers()

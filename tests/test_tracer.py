@@ -273,13 +273,17 @@ def test_merge_stats_zero_denominator_falls_back():
     assert merged["kl_denominator"] == 0.0
 
 
-def test_merge_stats_partial_denominator_unweighted():
+def test_merge_stats_partial_denominator_drops_key():
     # One shard lacks the denominator: positional pairing is broken, so
-    # the value must NOT be dot-producted against a shorter weight list.
+    # the value can neither be dot-producted against a shorter weight
+    # list NOR silently averaged unweighted (a 10-token shard would
+    # count as much as a 10k-token one).  The key is dropped; the
+    # denominator itself (a plain summable count) survives.
     merged = merge_stats(
         [{"loss": 1.0, "loss_denominator": 10.0}, {"loss": 3.0}]
     )
-    assert merged["loss"] == pytest.approx(2.0)
+    assert "loss" not in merged
+    assert merged["loss_denominator"] == pytest.approx(10.0)
 
 
 # ---------------- gen_server integration ----------------
