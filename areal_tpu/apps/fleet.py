@@ -1,0 +1,114 @@
+"""Fleet supervisor entrypoint: SLO-driven autoscaling of gen servers.
+
+Runs :class:`areal_tpu.system.fleet.FleetSupervisor` against a trial's
+live metrics plane, spawning/draining LOCAL gen-server processes via
+:class:`~areal_tpu.system.fleet.LocalProcessFleet`::
+
+    python -m areal_tpu.apps.fleet \\
+        --experiment exp0 --trial t0 \\
+        --slo "crit: staleness_p99 <= 4" \\
+        --spawn-cmd "python -m areal_tpu.system.gen_server \\
+                     --path /ckpt --port {port} \\
+                     --experiment {experiment} --trial {trial}" \\
+        --min-servers 1 --max-servers 4
+
+A CRIT violation on a capacity signal (staleness_p99 / queue_depth /
+backpressure) adds one server; a sustained idle window (goodput ~0,
+fleet idle) drains one.  Membership epochs persist through the trial's
+``RecoverInfo`` when ``--recover-root`` is given, so a restarted
+supervisor resumes its epoch counter instead of re-counting from 0.
+"""
+
+import argparse
+import shlex
+import sys
+from typing import List, Optional
+
+from areal_tpu.base import logging
+from areal_tpu.system.fleet import FleetSupervisor, LocalProcessFleet
+
+logger = logging.getLogger("fleet")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="areal_tpu.apps.fleet")
+    p.add_argument("--experiment", required=True)
+    p.add_argument("--trial", default="trial")
+    p.add_argument("--slo", action="append", default=[],
+                   help="SLO rule (metrics_report grammar; the rule "
+                        "states the invariant that must HOLD), e.g. "
+                        "'crit: staleness_p99 <= 4'; repeatable")
+    p.add_argument("--slo-file", default=None,
+                   help="file of SLO rules, one per line (# comments)")
+    p.add_argument("--spawn-cmd", default="",
+                   help="gen-server launch command; {port}/{experiment}/"
+                        "{trial} are substituted per spawn")
+    p.add_argument("--base-port", type=int, default=8101)
+    p.add_argument("--min-servers", type=int, default=1)
+    p.add_argument("--max-servers", type=int, default=8)
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between scrape/evaluate rounds")
+    p.add_argument("--count", type=int, default=None,
+                   help="rounds to run (default: forever)")
+    p.add_argument("--action-cooldown", type=float, default=30.0,
+                   help="minimum seconds between scale actions")
+    p.add_argument("--idle-rounds", type=int, default=3,
+                   help="consecutive idle scrapes before a drain")
+    p.add_argument("--recover-root", default=None,
+                   help="trial recover dir: persists membership epochs "
+                        "through RecoverInfo.fleet_state")
+    args = p.parse_args(argv)
+
+    from areal_tpu.apps.metrics_report import parse_slo_rule
+
+    rule_texts = list(args.slo)
+    if args.slo_file:
+        with open(args.slo_file) as f:
+            rule_texts += [
+                ln.strip() for ln in f
+                if ln.strip() and not ln.lstrip().startswith("#")
+            ]
+    rules = [parse_slo_rule(t) for t in rule_texts]
+
+    procs = None
+    spawn = drain = None
+    if args.spawn_cmd:
+        procs = LocalProcessFleet(
+            shlex.split(args.spawn_cmd),
+            experiment=args.experiment,
+            trial=args.trial,
+            base_port=args.base_port,
+        )
+        spawn, drain = procs.spawn, procs.drain
+
+    sup = FleetSupervisor(
+        experiment=args.experiment,
+        trial=args.trial,
+        rules=rules,
+        spawn=spawn,
+        drain=drain,
+        min_servers=args.min_servers,
+        max_servers=args.max_servers,
+        action_cooldown_s=args.action_cooldown,
+        idle_rounds=args.idle_rounds,
+        recover_root=args.recover_root,
+    )
+    logger.info(
+        f"fleet supervisor: {len(rules)} SLO rule(s), "
+        f"servers in [{args.min_servers}, {args.max_servers}], "
+        f"epoch {sup.membership_epoch}"
+    )
+    try:
+        actions = sup.run(count=args.count, interval=args.interval)
+    except KeyboardInterrupt:
+        actions = []
+    finally:
+        if procs is not None:
+            procs.shutdown()
+    for a in actions:
+        logger.info(f"action taken: {a.action} {a.victim} ({a.reason})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -283,6 +283,16 @@ def fleet_signals(
     inf = _series_sum(all_samples, "areal_rollout_in_flight")
     if inf is not None:
         signals["in_flight"] = inf
+    # Elastic-fleet health: total prompt re-dispatches (all failure
+    # reasons) and currently-open circuit breakers — a rising redispatch
+    # rate or any stuck-open breaker is a capacity/SLO signal the fleet
+    # supervisor and watchdog can alert or scale on.
+    rd = _series_sum(all_samples, "areal_rollout_redispatch_total")
+    if rd is not None:
+        signals["redispatch"] = rd
+    bo = _series_sum(all_samples, "areal_rollout_breaker_open")
+    if bo is not None:
+        signals["breaker_open"] = bo
     # Raw unlabeled series become rule-addressable too (last wins on
     # duplicates; labeled series need the computed signals above).
     for n, labels, v in all_samples:

@@ -59,6 +59,17 @@ def worker_keepalive(experiment_name: str, trial_name: str, worker_name: str) ->
     return f"{trial_root(experiment_name, trial_name)}/keepalive/{worker_name}"
 
 
+def gen_servers(experiment_name: str, trial_name: str) -> str:
+    """Fleet-membership subtree: every live generation server announces
+    itself here (with a keepalive TTL) and the rollout controller /
+    fleet supervisor discover joins and leaves by listing it."""
+    return f"{trial_root(experiment_name, trial_name)}/gen_servers"
+
+
+def gen_server(experiment_name: str, trial_name: str, server_id: str) -> str:
+    return f"{gen_servers(experiment_name, trial_name)}/{server_id}"
+
+
 def metrics_root(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/metrics"
 

@@ -51,6 +51,11 @@ class RecoverInfo:
     # the data stream where the crashed one stopped.
     replay_watermarks: Dict[str, int] = dataclasses.field(default_factory=dict)
     rollout_state: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Elastic fleet: membership epoch + the announced gen-server set at
+    # the supervisor's last action (FleetSupervisor.persist()) — a
+    # recovered supervisor resumes epochs monotonically instead of
+    # restarting at 0 and re-counting scale actions.
+    fleet_state: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 def recover_root(fileroot: str, experiment_name: str, trial_name: str) -> str:

@@ -1,0 +1,408 @@
+"""Elastic rollout fleet: circuit breaking, membership discovery, and
+the SLO-driven supervisor.
+
+The async subsystem (system/rollout.py) load-balances version-stamped
+dispatches and the metrics plane (apps/metrics_report.py) distills the
+fleet into declarative SLO signals; this module closes the control loop
+in the RLAX / Podracer mold (PAPERS.md: arxiv 2512.06392, 2104.06272):
+decoupled actor pools that survive preemption.
+
+Three pieces:
+
+- :class:`CircuitBreaker` — the per-server dispatch gate the rollout
+  controller consults.  ``threshold`` consecutive failures (dispatch
+  errors, deadline expiries, or failed health polls) open it; after
+  ``cooldown_s`` a half-open probe (the next health poll) is allowed
+  through; a successful probe closes it, a failed one re-opens it with
+  a fresh cooldown.  Pure state machine — no clocks faked, no metrics
+  registered here, so it stays importable from anywhere.
+
+- :func:`fleet_discovery` — membership as a callable: gen servers
+  announce under ``names.gen_servers`` with a keepalive TTL
+  (``GenerationServer.announce``), and the returned closure lists the
+  live subtree into ``{server_id: url}``.  The rollout controller calls
+  it at health-refresh time and diffs against its client set — joins
+  get a client and start receiving dispatches within one refresh
+  interval; leaves are *drained* (no new dispatches, in-flight work
+  runs to completion) instead of errored.
+
+- :class:`FleetSupervisor` — evaluates the metrics plane's SLO rules
+  against live fleet scrapes and spawns or drains gen servers: a CRIT
+  violation on a capacity signal (staleness p99, queue depth,
+  backpressure) adds a server, a sustained idle window (goodput ~0 and
+  the fleet idle) shrinks by one.  Membership epochs persist through
+  ``RecoverInfo.fleet_state`` so a recovered supervisor resumes its
+  epoch counter.  The spawn/drain actions are injectable;
+  :class:`LocalProcessFleet` is the local-process implementation the
+  ``apps/fleet`` entrypoint wires in.
+"""
+
+import dataclasses
+import shlex
+import subprocess
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from areal_tpu.base import logging, name_resolve, names, recover
+
+logger = logging.getLogger("fleet")
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker for one gen server.
+
+    closed --(threshold consecutive failures)--> open
+    open   --(cooldown elapsed; next probe)----> half_open
+    half_open --success--> closed;  --failure--> open (fresh cooldown)
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(
+        self,
+        threshold: int = 3,
+        cooldown_s: float = 5.0,
+        on_transition: Optional[Callable[[str], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if threshold < 1:
+            raise ValueError(f"breaker threshold must be >= 1, got {threshold}")
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.on_transition = on_transition
+        self._clock = clock
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.opens = 0  # times the breaker tripped open
+        self.closes = 0  # times a probe re-closed it
+        self._opened_at = 0.0
+
+    def _to(self, state: str) -> None:
+        if state == self.state:
+            return
+        self.state = state
+        if state == self.OPEN:
+            self.opens += 1
+            self._opened_at = self._clock()
+        elif state == self.CLOSED:
+            self.closes += 1
+        if self.on_transition is not None:
+            self.on_transition(state)
+
+    def allow_dispatch(self) -> bool:
+        """Only a closed breaker takes regular dispatches; half-open
+        admits exactly the probe, which rides the health poll."""
+        return self.state == self.CLOSED
+
+    def probe_due(self) -> bool:
+        return (
+            self.state == self.OPEN
+            and self._clock() - self._opened_at >= self.cooldown_s
+        )
+
+    def begin_probe(self) -> None:
+        self._to(self.HALF_OPEN)
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        self._to(self.CLOSED)
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if self.state == self.HALF_OPEN or (
+            self.state == self.CLOSED
+            and self.consecutive_failures >= self.threshold
+        ):
+            self._to(self.OPEN)
+        elif self.state == self.OPEN:
+            # Failures while already open (e.g. a straggler dispatch
+            # completing with an error) re-arm the cooldown so probes
+            # wait for actual quiet.
+            self._opened_at = self._clock()
+
+
+def fleet_discovery(
+    experiment: str, trial: str
+) -> Callable[[], Dict[str, str]]:
+    """``{server_id: url}`` of currently-announced gen servers, as a
+    closure the rollout controller polls at health-refresh time.
+    Expired keepalives (dead servers) drop out of the listing via the
+    name_resolve TTL reaper, so a preempted server leaves the fleet
+    without anyone deregistering it."""
+    root = names.gen_servers(experiment, trial)
+
+    def discover() -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for key in name_resolve.find_subtree(root):
+            sid = key[len(root) + 1:]
+            try:
+                out[sid] = name_resolve.get(key)
+            except Exception:  # noqa: BLE001 — expired between list and get
+                continue
+        return out
+
+    return discover
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+
+
+@dataclasses.dataclass
+class FleetDecision:
+    action: str  # "spawn" | "drain" | "hold"
+    reason: str = ""
+    victim: str = ""  # server_id being drained (drain only)
+
+
+class LocalProcessFleet:
+    """Spawn/drain gen-server *processes* on this host.
+
+    ``command`` is an argv template; ``{port}``, ``{experiment}`` and
+    ``{trial}`` are substituted at spawn time.  Drain deletes the
+    server's fleet announcement first (the controller stops dispatching
+    to it and finishes in-flight work), then terminates the process
+    after a grace period — preemption with manners.
+    """
+
+    def __init__(
+        self,
+        command: Sequence[str],
+        experiment: str,
+        trial: str,
+        base_port: int = 8101,
+        drain_grace_s: float = 10.0,
+    ):
+        self.command = list(command)
+        self.experiment = experiment
+        self.trial = trial
+        self._next_port = base_port
+        self.drain_grace_s = drain_grace_s
+        self.procs: Dict[str, subprocess.Popen] = {}
+
+    def spawn(self) -> str:
+        port = self._next_port
+        self._next_port += 1
+        argv = [
+            a.format(port=port, experiment=self.experiment, trial=self.trial)
+            for a in self.command
+        ]
+        logger.info(f"fleet spawn: {shlex.join(argv)}")
+        proc = subprocess.Popen(argv)
+        sid = f"port{port}"
+        self.procs[sid] = proc
+        return sid
+
+    def drain(self, server_id: str) -> None:
+        try:
+            name_resolve.delete(
+                names.gen_server(self.experiment, self.trial, server_id)
+            )
+        except Exception:  # noqa: BLE001 — already gone is fine
+            pass
+        proc = self.procs.pop(server_id, None)
+        if proc is None:
+            return
+        deadline = time.monotonic() + self.drain_grace_s
+        while time.monotonic() < deadline and proc.poll() is None:
+            time.sleep(0.2)
+        if proc.poll() is None:
+            proc.terminate()
+
+    def shutdown(self) -> None:
+        for sid in list(self.procs):
+            self.drain(sid)
+
+
+class FleetSupervisor:
+    """SLO-rule-driven autoscaler over the announced gen-server fleet.
+
+    Scale-up: any CRIT violation of a rule whose signal is in
+    ``scale_up_signals`` (capacity pressure) spawns one server.
+    Scale-down: ``idle_rounds`` consecutive evaluations with goodput at
+    ~0 and the fleet idle drain one.  Both respect ``[min_servers,
+    max_servers]`` and an action cooldown so the loop cannot flap.
+
+    ``spawn``/``drain`` are callables (``LocalProcessFleet`` methods, or
+    fakes in tests); the supervisor itself never forks.
+    """
+
+    def __init__(
+        self,
+        experiment: str,
+        trial: str,
+        rules: Sequence[Any] = (),  # metrics_report.SLORule
+        spawn: Optional[Callable[[], Any]] = None,
+        drain: Optional[Callable[[str], Any]] = None,
+        min_servers: int = 1,
+        max_servers: int = 8,
+        action_cooldown_s: float = 30.0,
+        idle_rounds: int = 3,
+        idle_goodput: float = 1e-6,
+        idle_frac: float = 0.95,
+        scale_up_signals: Sequence[str] = (
+            "staleness_p99", "queue_depth", "backpressure",
+        ),
+        recover_root: Optional[str] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.experiment = experiment
+        self.trial = trial
+        self.rules = list(rules)
+        self.spawn = spawn
+        self.drain = drain
+        self.min_servers = min_servers
+        self.max_servers = max_servers
+        self.action_cooldown_s = action_cooldown_s
+        self.idle_rounds = idle_rounds
+        self.idle_goodput = idle_goodput
+        self.idle_frac = idle_frac
+        self.scale_up_signals = set(scale_up_signals)
+        self.recover_root = recover_root
+        self._clock = clock
+        self.history: List[Dict[str, float]] = []
+        self.membership_epoch = 0
+        self._idle_streak = 0
+        self._last_action_t: Optional[float] = None
+        self._restore()
+
+    # ---------------- membership / persistence ----------------
+
+    def list_servers(self) -> List[str]:
+        root = names.gen_servers(self.experiment, self.trial)
+        return [
+            key[len(root) + 1:] for key in name_resolve.find_subtree(root)
+        ]
+
+    def _restore(self) -> None:
+        if not self.recover_root:
+            return
+        info = recover.load(self.recover_root)
+        if info is not None and info.fleet_state:
+            self.membership_epoch = int(
+                info.fleet_state.get("membership_epoch", 0)
+            )
+            logger.info(
+                f"fleet supervisor recovered at membership epoch "
+                f"{self.membership_epoch}"
+            )
+
+    def persist(self) -> None:
+        """Write the membership epoch + server set into the trial's
+        RecoverInfo (merging with whatever the master already dumped)."""
+        if not self.recover_root:
+            return
+        info = recover.load(self.recover_root) or recover.RecoverInfo()
+        info.fleet_state = {
+            "membership_epoch": self.membership_epoch,
+            "servers": sorted(self.list_servers()),
+        }
+        recover.dump(info, self.recover_root)
+
+    # ---------------- decisions ----------------
+
+    def _cooled_down(self) -> bool:
+        return (
+            self._last_action_t is None
+            or self._clock() - self._last_action_t >= self.action_cooldown_s
+        )
+
+    def evaluate(self, signals: Dict[str, float]) -> FleetDecision:
+        """One control-loop step: append the scrape to history, evaluate
+        the SLO rules, return a decision (without executing it)."""
+        self.history.append(signals)
+        n = len(self.list_servers())
+        for rule in self.rules:
+            msg = rule.evaluate(self.history)
+            if (
+                msg is not None
+                and rule.severity == "crit"
+                and rule.signal in self.scale_up_signals
+            ):
+                self._idle_streak = 0
+                if n >= self.max_servers:
+                    return FleetDecision(
+                        "hold", f"CRIT but at max_servers={self.max_servers}: {msg}"
+                    )
+                if not self._cooled_down():
+                    return FleetDecision("hold", f"CRIT but cooling down: {msg}")
+                return FleetDecision("spawn", msg)
+        idle = (
+            signals.get("goodput", 0.0) <= self.idle_goodput
+            and signals.get("idle_frac", 0.0) >= self.idle_frac
+            and signals.get("in_flight", 0.0) <= 0.0
+        )
+        self._idle_streak = self._idle_streak + 1 if idle else 0
+        if (
+            self._idle_streak >= self.idle_rounds
+            and n > self.min_servers
+            and self._cooled_down()
+        ):
+            servers = sorted(self.list_servers())
+            self._idle_streak = 0
+            return FleetDecision(
+                "drain",
+                f"idle for {self.idle_rounds} consecutive scrapes "
+                f"(goodput<={self.idle_goodput:g}, "
+                f"idle_frac>={self.idle_frac:g})",
+                victim=servers[-1],
+            )
+        return FleetDecision("hold", "")
+
+    def apply(self, decision: FleetDecision) -> None:
+        if decision.action == "hold":
+            return
+        if decision.action == "spawn":
+            if self.spawn is None:
+                logger.warning(
+                    f"fleet would spawn ({decision.reason}) but no spawn "
+                    "action is configured"
+                )
+                return
+            self.spawn()
+        elif decision.action == "drain":
+            if self.drain is None:
+                logger.warning(
+                    f"fleet would drain {decision.victim} "
+                    f"({decision.reason}) but no drain action is configured"
+                )
+                return
+            self.drain(decision.victim)
+        self._last_action_t = self._clock()
+        self.membership_epoch += 1
+        logger.info(
+            f"fleet {decision.action} (epoch {self.membership_epoch}): "
+            f"{decision.reason}"
+        )
+        self.persist()
+
+    # ---------------- the control loop ----------------
+
+    def run(
+        self,
+        count: Optional[int] = None,
+        interval: float = 2.0,
+    ) -> List[FleetDecision]:
+        """Scrape → evaluate → act, ``count`` times (None = forever).
+        Reuses the metrics plane's scrape/signal machinery so the
+        supervisor and the watchdog see the SAME numbers."""
+        from areal_tpu.apps import metrics_report as mr
+
+        actions: List[FleetDecision] = []
+        prev = None
+        i = 0
+        while count is None or i < count:
+            if i > 0:
+                time.sleep(interval)
+            endpoints = mr.discover(self.experiment, self.trial)
+            roles = mr.scrape_fleet(endpoints)
+            signals, _ = mr.fleet_signals(roles, prev)
+            prev = {r.role: r for r in roles}
+            decision = self.evaluate(signals)
+            if decision.action != "hold":
+                self.apply(decision)
+                actions.append(decision)
+            i += 1
+        return actions

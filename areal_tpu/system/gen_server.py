@@ -55,6 +55,7 @@ from areal_tpu.api.model_api import (
     register_backend,
 )
 from areal_tpu.base import logging, metrics, tracer
+from areal_tpu.base.faults import FaultInjector
 
 logger = logging.getLogger("gen_server")
 
@@ -91,6 +92,11 @@ _M_CAPACITY = _REG.gauge(
 )
 _M_PAUSED = _REG.gauge(
     "areal_gen_paused", "1 while paused for a weight swap"
+)
+_M_FAULTS = _REG.counter(
+    "areal_gen_faults_total",
+    "injected chaos faults fired (AREAL_FAULTS), by kind",
+    ("kind",),
 )
 
 
@@ -133,9 +139,16 @@ class GenerationServer:
         token: str = "",
         ckpt_root: str = "",
         zmq_port: Optional[int] = 0,  # 0 = random; None = HTTP only
+        # Chaos (base/faults.py): defaults to the env-gated AREAL_FAULTS
+        # spec, so a chaos harness breaks the REAL server binary.
+        faults: Optional[FaultInjector] = None,
+        # Starting weight version — a restarted fleet member rejoins at
+        # the trainer's current version instead of 0 (which would make
+        # every response it serves look maximally stale).
+        version: int = 0,
     ):
         self.engine = engine
-        self.version = 0
+        self.version = int(version)
         # /update_weights loads an arbitrary path and hot-swaps serving
         # weights: restrict it to a checkpoint root when configured.
         self.ckpt_root = ckpt_root or os.environ.get(
@@ -163,6 +176,15 @@ class GenerationServer:
         # new version with stale pause state (or vice versa).
         self._health_lock = threading.Lock()
         _M_CAPACITY.set(int(getattr(engine, "max_decode_batch", 0) or 0))
+        self._faults = faults if faults is not None else FaultInjector.from_env()
+        if self._faults is not None and self._faults.on_fire is None:
+            self._faults.on_fire = lambda kind: _M_FAULTS.labels(kind).inc()
+        # Fleet membership (announce()): the keepalive key + beat thread.
+        self._announce_key: Optional[str] = None
+        self._announce_thread: Optional[threading.Thread] = None
+        # A kill fault tears down WITHOUT deregistering (a preempted node
+        # runs no graceful teardown; its announcement expires by TTL).
+        self._crashed = False
 
         srv = self
 
@@ -251,10 +273,30 @@ class GenerationServer:
         self.zmq_url: Optional[str] = None
         if zmq_port is not None:
             self._start_zmq(host, zmq_port)
+        if self._faults is not None and self._faults.kill_spec is not None:
+            threading.Thread(target=self._kill_loop, daemon=True).start()
         logger.info(
             f"generation server at {self.url}"
             + (f" + {self.zmq_url}" if self.zmq_url else "")
         )
+
+    # ---------------- chaos (base/faults.py) ----------------
+
+    def _fire_fault(self, point: str) -> None:
+        if self._faults is not None:
+            self._faults.fire(point)
+
+    def _kill_loop(self) -> None:
+        """Arm the injector's `kill` fault: once due, tear the server
+        down as a CRASH — no deregistration, no draining — exactly like
+        a preempted node.  The fleet announcement expires by TTL."""
+        while not self._stop.is_set():
+            if self._faults.kill_due():
+                logger.warning("FAULT kill: crashing the generation server")
+                self._crashed = True
+                self.close()
+                return
+            self._stop.wait(0.05)
 
     # ---------------- ZMQ transport ----------------
 
@@ -389,6 +431,50 @@ class GenerationServer:
                 pass
         router.close(linger=200)
 
+    # ---------------- fleet membership ----------------
+
+    def announce(
+        self,
+        experiment: str,
+        trial: str,
+        server_id: Optional[str] = None,
+        ttl: float = 10.0,
+    ) -> str:
+        """Join the elastic fleet: register this server's URL under the
+        `names.gen_servers` subtree with a keepalive TTL, and start a
+        heartbeat thread touching the key at ttl/3.  A server that stops
+        beating (crash, preemption) expires out of the listing and the
+        rollout controller drains it; a graceful close() deregisters
+        immediately.  Returns the server id (default: port-stable
+        `s<port>`, so a restart on the same port resumes the same fleet
+        identity)."""
+        from areal_tpu.base import name_resolve, names
+
+        sid = server_id or f"s{self.port}"
+        key = names.gen_server(experiment, trial, sid)
+        name_resolve.add(
+            key,
+            self.zmq_url or self.url,
+            keepalive_ttl=ttl,
+            replace=True,
+            delete_on_exit=True,
+        )
+        self._announce_key = key
+        beat_s = max(ttl / 3.0, 0.05)
+
+        def beat():
+            repo = name_resolve.default()
+            while not self._stop.wait(beat_s):
+                try:
+                    repo.touch(key)
+                except Exception:  # noqa: BLE001 — key deleted: stop beating
+                    return
+
+        self._announce_thread = threading.Thread(target=beat, daemon=True)
+        self._announce_thread.start()
+        logger.info(f"announced fleet member {sid} (ttl {ttl}s)")
+        return sid
+
     # ---------------- pause / resume / in-memory weight sync ----------------
 
     def health_info(self) -> Dict:
@@ -402,6 +488,7 @@ class GenerationServer:
         different chunk boundaries; queue depth is one qsize() call.
         The same snapshot feeds the /metrics gauges, so /health and the
         metrics plane agree."""
+        self._fire_fault("health")
         eng = self.engine
         with self._health_lock:
             version = self.version
@@ -480,6 +567,9 @@ class GenerationServer:
     # ---------------- request handling ----------------
 
     def _handle_generate(self, req: Dict) -> Dict:
+        # Chaos: may sleep (`slow`), wedge this request thread (`hang`),
+        # or raise (`error` -> HTTP 500 like any handler failure).
+        self._fire_fault("generate")
         g = GenerationHyperparameters(
             n=int(req.get("n", 1)),
             max_new_tokens=int(req.get("max_new_tokens", 256)),
@@ -739,6 +829,20 @@ class GenerationServer:
 
     def close(self):
         self._stop.set()
+        if self._faults is not None:
+            # Unblock wedged `hang` request threads so they fail fast.
+            self._faults.release()
+        if self._announce_key and not self._crashed:
+            # Graceful leave: deregister now so the controller drains us
+            # within one refresh.  A crash skips this — the announcement
+            # expires by TTL, exactly like a preempted node.
+            from areal_tpu.base import name_resolve
+
+            try:
+                name_resolve.delete(self._announce_key)
+            except Exception:  # noqa: BLE001 — already expired/deleted
+                pass
+            self._announce_key = None
         self._http.shutdown()
         self._http.server_close()
         tracer.flush()
@@ -1175,8 +1279,13 @@ def main():
     p.add_argument("--experiment", default="",
                    help="announce this server's /metrics endpoint into "
                         "name_resolve under the experiment/trial metrics "
-                        "subtree (see apps/metrics_report.py)")
+                        "subtree (see apps/metrics_report.py) AND join "
+                        "the elastic fleet under names.gen_servers")
     p.add_argument("--trial", default="trial")
+    p.add_argument("--keepalive-ttl", type=float, default=10.0,
+                   help="fleet-membership keepalive TTL in seconds; a "
+                        "server that stops heartbeating expires out of "
+                        "the fleet after this long")
     args = p.parse_args()
 
     tracer.configure(role="gen_server", rank=args.port)
@@ -1218,6 +1327,11 @@ def main():
                 args.experiment, args.trial, f"gen_server/{server.port}"
             ),
             server.url, replace=True, delete_on_exit=True,
+        )
+        # Elastic fleet: a controller running with fleet_discovery()
+        # starts dispatching here within one health-refresh interval.
+        server.announce(
+            args.experiment, args.trial, ttl=args.keepalive_ttl
         )
     logger.info(
         f"serving {args.path} at {server.url}"

@@ -5,12 +5,13 @@ generation servers (reference: AReaL's rollout worker +
 `GenerationServer` pairing, realhf/system/rollout_worker.py; the
 Podracer "actor plane", arxiv 2104.06272):
 
-- **Queue-depth-aware load balancing**: each dispatch picks the client
-  whose server reports the least load (collector queue depth + live
-  decode slots from the enriched ``/health``) plus the controller's own
+- **Queue-depth-aware load balancing**: each dispatch picks the server
+  whose enriched ``/health`` reports the least load (collector queue
+  depth + live decode slots) plus the controller's own
   not-yet-acknowledged dispatches to it — the cached health signal is
   refreshed at a bounded rate so balancing never becomes a health-poll
-  storm.
+  storm, and the fleet is polled *concurrently* with a per-server
+  timeout so one wedged server cannot stall everyone's refresh.
 - **Version stamping**: every trajectory records the weight version it
   STARTED sampling under (``version_start``, the head version) and the
   one it finished under — bounded-staleness admission in the
@@ -21,18 +22,53 @@ Podracer "actor plane", arxiv 2104.06272):
 - **Bounded fan-out**: a controller-level semaphore caps in-flight
   dispatches, on top of each client's per-loop ``agenerate`` bound.
 
+Elastic-fleet hardening (the RLAX / Podracer preemptible-pool posture,
+PAPERS.md arxiv 2512.06392 / 2104.06272):
+
+- **Dynamic membership**: with a ``discovery`` callable (normally
+  :func:`areal_tpu.system.fleet.fleet_discovery` over the
+  ``names.gen_servers`` keepalive subtree) the controller diffs the
+  announced fleet at every health refresh — joins get a client and
+  start taking dispatches within one refresh interval; leaves are
+  *drained* (no new dispatches; in-flight work runs to completion)
+  and reaped once idle.  Statically-passed clients are never drained
+  by discovery.
+- **Hardened dispatch**: each ``agenerate`` runs under an optional
+  deadline (``dispatch_timeout_s``); a failed or timed-out dispatch is
+  re-sent — with exponential backoff — to a *different* server
+  (excluding every server observed failing this prompt), up to
+  ``max_dispatch_retries`` times before the prompt is counted
+  ``failed``.  No prompt is ever silently dropped.
+- **Circuit breaking**: each server carries a
+  :class:`~areal_tpu.system.fleet.CircuitBreaker`; dispatch failures
+  AND failed health polls count toward opening it, the half-open probe
+  rides the next health poll, and only closed breakers take regular
+  dispatches.
+
 The ``cursor`` (prompts consumed from the stream) is persisted in
 ``RecoverInfo`` so a recovered trial resumes the stream where it
-stopped instead of re-sampling consumed prompts.
+stopped instead of re-sampling consumed prompts; ``membership_epoch``
+rides along so fleet churn is observable across restarts.
 """
 
 import asyncio
 import dataclasses
 import time
-from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    FrozenSet,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+)
 
 from areal_tpu.api.model_api import APIGenerateInput, GenerationHyperparameters
 from areal_tpu.base import logging, metrics, tracer
+from areal_tpu.system.fleet import CircuitBreaker
 from areal_tpu.system.replay import ReplayBuffer, Trajectory
 
 logger = logging.getLogger("rollout")
@@ -47,11 +83,34 @@ class RolloutStat:
     accepted: int = 0
     rejected: int = 0
     failed: int = 0
+    redispatched: int = 0
     in_flight: int = 0
     backpressure_waits: int = 0
 
     def as_dict(self) -> Dict[str, int]:
         return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class ServerState:
+    """One fleet member as the controller sees it."""
+
+    sid: str
+    client: Any  # LLMAPIClient / ZMQGenClient-compatible
+    breaker: CircuitBreaker
+    # False for clients passed at construction (never drained by
+    # discovery); True for discovery-announced members.
+    dynamic: bool = False
+    health: Dict = dataclasses.field(default_factory=dict)
+    # Explicit flag — NOT a sentinel queue depth — so an unreachable
+    # server can never leak bogus numbers into version_lag or autosize.
+    healthy: bool = False
+    # Dispatches sent but not yet completed — the live correction on
+    # top of the (staler) polled queue depth.
+    local_load: int = 0
+    # Draining: takes no new dispatches; in-flight work completes, then
+    # the membership sync reaps the entry.
+    draining: bool = False
 
 
 def _normalize_prompt(item, cursor: int):
@@ -71,22 +130,34 @@ def _normalize_prompt(item, cursor: int):
 
 
 class RolloutController:
-    """Pumps a prompt stream through gen servers into a ReplayBuffer."""
+    """Pumps a prompt stream through an elastic gen-server fleet into a
+    ReplayBuffer."""
 
     def __init__(
         self,
-        clients: Sequence[Any],  # LLMAPIClient / ZMQGenClient-compatible
-        replay: ReplayBuffer,
-        gconfig: GenerationHyperparameters,
+        clients: Sequence[Any] = (),  # static members (never drained)
+        replay: ReplayBuffer = None,
+        gconfig: GenerationHyperparameters = None,
         seed: Optional[int] = None,
         max_concurrency: int = 0,  # 0 = sum of client capacities
         health_refresh_s: float = 0.5,
         backpressure_poll_s: float = 0.05,
         autosize_inflight: bool = True,
+        discovery: Optional[Callable[[], Dict[str, Any]]] = None,
+        dispatch_timeout_s: float = 0.0,  # 0 = no per-dispatch deadline
+        max_dispatch_retries: int = 2,
+        retry_backoff_s: float = 0.05,  # doubles per retry, capped at 2s
+        health_poll_timeout_s: float = 2.0,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 5.0,
     ):
-        if not clients:
-            raise ValueError("rollout controller needs at least one client")
-        self.clients = list(clients)
+        if not clients and discovery is None:
+            raise ValueError(
+                "rollout controller needs at least one client or a "
+                "fleet-discovery callable"
+            )
+        if replay is None or gconfig is None:
+            raise ValueError("rollout controller needs replay and gconfig")
         self.replay = replay
         self.gconfig = gconfig
         self.seed = seed
@@ -97,20 +168,31 @@ class RolloutController:
         # client's own max_inflight (e.g. to oversubscribe the collector
         # queue on purpose).
         self.autosize_inflight = autosize_inflight
+        self.discovery = discovery
+        self.dispatch_timeout_s = dispatch_timeout_s
+        self.max_dispatch_retries = max_dispatch_retries
+        self.retry_backoff_s = retry_backoff_s
+        self.health_poll_timeout_s = health_poll_timeout_s
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown_s = breaker_cooldown_s
         self.stat = RolloutStat()
         # Prompts consumed from the data stream since trial start
         # (persisted via state_dict -> RecoverInfo).
         self.cursor = 0
+        # Bumps on every membership change (join/leave/reap) — persisted
+        # so fleet churn is observable across recoveries.
+        self.membership_epoch = 0
         self._skip_on_run = 0
         self._stop = False
-        self._health: List[Dict] = [{} for _ in self.clients]
+        self._servers: List[ServerState] = []
+        self._by_sid: Dict[str, ServerState] = {}
+        for i, c in enumerate(clients):
+            self._add_server(f"static{i}", c, dynamic=False)
         self._health_ts = 0.0
-        # Dispatches sent but not yet completed, per client — the live
-        # correction on top of the (staler) polled queue depth.
-        self._local_load = [0] * len(self.clients)
+        self._refresh_lock: Optional[asyncio.Lock] = None
         cap = max_concurrency or sum(
-            max(1, int(getattr(c, "max_inflight", 1))) for c in self.clients
-        )
+            max(1, int(getattr(c, "max_inflight", 1))) for c in clients
+        ) or 16
         self._sem = asyncio.Semaphore(cap)
         self.max_concurrency = cap
         reg = metrics.default_registry()
@@ -131,14 +213,132 @@ class RolloutController:
             "trainer weight version minus the dispatched server's "
             "serving version, at dispatch time",
         )
+        self._m_redispatch = reg.counter(
+            "areal_rollout_redispatch_total",
+            "prompts re-sent to a different server after a dispatch "
+            "failure, by failure reason",
+            ("reason",),
+        )
+        self._m_breaker_open = reg.gauge(
+            "areal_rollout_breaker_open",
+            "servers whose circuit breaker is currently open",
+        )
+        self._m_breaker_trans = reg.counter(
+            "areal_rollout_breaker_transitions_total",
+            "circuit-breaker state transitions, by target state",
+            ("state",),
+        )
+        self._m_servers = reg.gauge(
+            "areal_rollout_servers",
+            "non-draining fleet members known to the controller",
+        )
+
+    # ---------------- fleet membership ----------------
+
+    @property
+    def clients(self) -> List[Any]:
+        """All known clients (compat shim for pre-elastic callers)."""
+        return [s.client for s in self._servers]
+
+    @property
+    def servers(self) -> List[ServerState]:
+        return list(self._servers)
+
+    def server(self, sid: str) -> Optional[ServerState]:
+        return self._by_sid.get(sid)
+
+    def _make_breaker(self) -> CircuitBreaker:
+        def on_transition(state: str) -> None:
+            self._m_breaker_trans.labels(state).inc()
+            self._m_breaker_open.set(
+                sum(
+                    1
+                    for s in self._servers
+                    if s.breaker.state == CircuitBreaker.OPEN
+                )
+            )
+
+        return CircuitBreaker(
+            threshold=self.breaker_threshold,
+            cooldown_s=self.breaker_cooldown_s,
+            on_transition=on_transition,
+        )
+
+    def _add_server(self, sid: str, client: Any, dynamic: bool) -> ServerState:
+        st = ServerState(
+            sid=sid, client=client, breaker=self._make_breaker(),
+            dynamic=dynamic,
+        )
+        self._servers.append(st)
+        self._by_sid[sid] = st
+        return st
+
+    def _sync_membership(self, mapping: Dict[str, Any]) -> None:
+        """Diff the announced fleet against the known set: add joins,
+        drain leaves (dynamic members only), reap drained-and-idle."""
+        changed = False
+        for sid, target in mapping.items():
+            st = self._by_sid.get(sid)
+            if st is None:
+                if isinstance(target, str):
+                    from areal_tpu.system.gen_server import make_gen_client
+
+                    client = make_gen_client(target)
+                else:  # tests may announce ready-made client objects
+                    client = target
+                self._add_server(sid, client, dynamic=True)
+                changed = True
+                logger.info(f"fleet join: {sid}")
+            elif st.draining:
+                # Re-announced while draining: welcome back.
+                st.draining = False
+                changed = True
+                logger.info(f"fleet re-join: {sid}")
+        for st in self._servers:
+            if st.dynamic and not st.draining and st.sid not in mapping:
+                st.draining = True
+                changed = True
+                logger.info(
+                    f"fleet leave: {st.sid} draining "
+                    f"({st.local_load} in flight)"
+                )
+        for st in [
+            s for s in self._servers if s.draining and s.local_load == 0
+        ]:
+            self._servers.remove(st)
+            del self._by_sid[st.sid]
+            changed = True
+            logger.info(f"fleet reap: {st.sid}")
+            close = getattr(st.client, "close", None)
+            if st.dynamic and callable(close):
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+        if changed:
+            self.membership_epoch += 1
+        self._m_servers.set(
+            sum(1 for s in self._servers if not s.draining)
+        )
+
+    def drain(self, sid: str) -> None:
+        """Stop dispatching to `sid`; in-flight work completes."""
+        st = self._by_sid.get(sid)
+        if st is not None:
+            st.draining = True
 
     # ---------------- recover ----------------
 
     def state_dict(self) -> Dict[str, Any]:
-        return {"cursor": self.cursor, "stat": self.stat.as_dict()}
+        return {
+            "cursor": self.cursor,
+            "stat": self.stat.as_dict(),
+            "membership_epoch": self.membership_epoch,
+        }
 
     def load_state_dict(self, sd: Dict[str, Any]) -> None:
         self.cursor = int(sd.get("cursor", 0))
+        self.membership_epoch = int(sd.get("membership_epoch", 0))
         st = sd.get("stat", {})
         for k, v in st.items():
             if hasattr(self.stat, k) and k != "in_flight":
@@ -151,37 +351,95 @@ class RolloutController:
     def stop(self) -> None:
         self._stop = True
 
-    # ---------------- load balancing ----------------
+    # ---------------- health / load balancing ----------------
 
-    def _refresh_health(self) -> None:
-        for i, c in enumerate(self.clients):
+    async def _poll_one(self, st: ServerState) -> None:
+        """One server's health poll, breaker-aware.  Open breakers are
+        not polled until their cooldown elapses; the poll that follows
+        IS the half-open probe."""
+        br = st.breaker
+        if br.state == CircuitBreaker.OPEN:
+            if not br.probe_due():
+                st.health = {}
+                st.healthy = False
+                return
+            br.begin_probe()
+        try:
+            h = await asyncio.wait_for(
+                asyncio.to_thread(st.client.health),
+                timeout=self.health_poll_timeout_s,
+            )
+        except Exception as e:  # noqa: BLE001 — deprioritize, don't die
+            logger.warning(f"health poll failed for {st.sid}: {e!r}")
+            st.health = {}
+            st.healthy = False
+            # Failed polls count toward the breaker too, so a server
+            # that dies between dispatches still trips it open.
+            br.record_failure()
+            return
+        st.health = h
+        st.healthy = True
+        br.record_success()
+        cap = int(h.get("capacity", 0))
+        if cap > 0 and self.autosize_inflight:
+            # Size each client's agenerate bound to what its server can
+            # actually co-decode.
+            st.client.max_inflight = max(cap, 1)
+
+    async def _refresh_health(self) -> None:
+        if self.discovery is not None:
             try:
-                self._health[i] = c.health()
-                cap = int(self._health[i].get("capacity", 0))
-                if cap > 0 and self.autosize_inflight:
-                    # Size each client's agenerate bound to what its
-                    # server can actually co-decode.
-                    c.max_inflight = max(cap, 1)
-            except Exception as e:  # noqa: BLE001 — deprioritize, don't die
-                logger.warning(f"health poll failed for client {i}: {e!r}")
-                self._health[i] = {"queue_depth": 1 << 30}
+                mapping = await asyncio.to_thread(self.discovery)
+            except Exception as e:  # noqa: BLE001 — keep the last view
+                logger.warning(f"fleet discovery failed: {e!r}")
+            else:
+                self._sync_membership(dict(mapping))
+        # Concurrent, individually-timed polls: one hung server costs
+        # health_poll_timeout_s, not the whole fleet's refresh.
+        await asyncio.gather(
+            *(self._poll_one(s) for s in self._servers if not s.draining)
+        )
 
-    def _load_score(self, i: int) -> float:
-        h = self._health[i]
+    async def _maybe_refresh(self) -> None:
+        if self._refresh_lock is None:
+            self._refresh_lock = asyncio.Lock()
+        async with self._refresh_lock:
+            if time.monotonic() - self._health_ts < self.health_refresh_s:
+                return
+            await self._refresh_health()
+            self._health_ts = time.monotonic()
+
+    def _load_score(self, st: ServerState) -> float:
+        h = st.health
         return (
             float(h.get("queue_depth", 0))
             + float(h.get("live_slots", 0))
-            + self._local_load[i]
+            + st.local_load
         )
 
-    async def _choose_client(self) -> int:
-        now = time.monotonic()
-        if now - self._health_ts >= self.health_refresh_s or not any(
-            self._health
-        ):
-            self._health_ts = now
-            await asyncio.to_thread(self._refresh_health)
-        return min(range(len(self.clients)), key=self._load_score)
+    def _eligible(self, exclude: FrozenSet[str]) -> List[ServerState]:
+        return [
+            s
+            for s in self._servers
+            if not s.draining
+            and s.healthy
+            and s.breaker.allow_dispatch()
+            and s.sid not in exclude
+        ]
+
+    async def _choose_client(
+        self, exclude: FrozenSet[str] = frozenset()
+    ) -> Optional[ServerState]:
+        """Least-loaded dispatchable server, preferring ones not in
+        `exclude` (servers observed failing THIS prompt); waits through
+        refreshes when nothing is dispatchable.  None only on stop()."""
+        while not self._stop:
+            await self._maybe_refresh()
+            eligible = self._eligible(exclude) or self._eligible(frozenset())
+            if eligible:
+                return min(eligible, key=self._load_score)
+            await asyncio.sleep(min(self.health_refresh_s, 0.1))
+        return None
 
     # ---------------- the pump ----------------
 
@@ -230,29 +488,28 @@ class RolloutController:
             await asyncio.gather(*tasks)
         return self.stat
 
-    async def _dispatch(self, qid: str, prompt_ids: List[int]) -> None:
-        async with self._sem:
-            idx = await self._choose_client()
-            client = self.clients[idx]
-            self._local_load[idx] += 1
-            self.stat.submitted += 1
-            self.stat.in_flight += 1
-            self._m_in_flight.set(self.stat.in_flight)
-            srv_version = self._health[idx].get("version")
+    async def _generate_with_retries(self, qid: str, prompt_ids: List[int]):
+        """Dispatch with deadline + bounded redispatch.  Each failure
+        excludes the observed-failing server for this prompt, records a
+        breaker failure, and backs off exponentially; returns the output
+        or None once every attempt is exhausted (or on stop())."""
+        exclude: set = set()
+        backoff = self.retry_backoff_s
+        attempts = 1 + max(0, self.max_dispatch_retries)
+        for attempt in range(attempts):
+            srv = await self._choose_client(frozenset(exclude))
+            if srv is None:  # stopped while waiting for a server
+                return None
+            srv.local_load += 1
+            srv_version = srv.health.get("version")
             if srv_version is not None:
                 # Dispatch-time lag between the trainer head and the
                 # chosen server's serving weights — a persistently
                 # positive gauge means weight sync is falling behind.
-                self._m_version_lag.set(
-                    self.replay.version - int(srv_version)
-                )
-            tracer.counter(
-                "rollout_controller",
-                in_flight=self.stat.in_flight,
-                backpressured=0,
-            )
+                self._m_version_lag.set(self.replay.version - int(srv_version))
+            err = reason = None
             try:
-                out = await client.agenerate(
+                coro = srv.client.agenerate(
                     APIGenerateInput(
                         qid=qid,
                         prompt_ids=prompt_ids,
@@ -260,16 +517,60 @@ class RolloutController:
                         seed=self.seed,
                     )
                 )
+                if self.dispatch_timeout_s > 0:
+                    out = await asyncio.wait_for(
+                        coro, timeout=self.dispatch_timeout_s
+                    )
+                else:
+                    out = await coro
+            except asyncio.TimeoutError:
+                err, reason = (
+                    f"deadline ({self.dispatch_timeout_s}s) expired",
+                    "timeout",
+                )
             except Exception as e:  # noqa: BLE001 — one prompt, not the pump
+                err, reason = repr(e), "error"
+            finally:
+                srv.local_load -= 1
+            if err is None:
+                srv.breaker.record_success()
+                return out
+            srv.breaker.record_failure()
+            exclude.add(srv.sid)
+            last = attempt == attempts - 1
+            logger.warning(
+                f"dispatch {qid} -> {srv.sid} failed ({err}); "
+                + ("giving up" if last else "re-dispatching")
+            )
+            if not last:
+                self.stat.redispatched += 1
+                self._m_redispatch.labels(reason).inc()
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+        return None
+
+    async def _dispatch(self, qid: str, prompt_ids: List[int]) -> None:
+        async with self._sem:
+            self.stat.submitted += 1
+            self.stat.in_flight += 1
+            self._m_in_flight.set(self.stat.in_flight)
+            tracer.counter(
+                "rollout_controller",
+                in_flight=self.stat.in_flight,
+                backpressured=0,
+            )
+            try:
+                out = await self._generate_with_retries(qid, prompt_ids)
+            finally:
+                self.stat.in_flight -= 1
+                self._m_in_flight.set(self.stat.in_flight)
+            if out is None:
+                # Exhausted every retry: the prompt is explicitly failed
+                # — visible in stat/metrics — never silently dropped.
                 self.stat.failed += 1
                 self._m_dispatched.labels("failed").inc()
-                logger.warning(f"rollout {qid} failed: {e!r}")
                 return
-            finally:
-                self._local_load[idx] -= 1
-                self.stat.in_flight -= 1
-                self.stat.completed += 1
-                self._m_in_flight.set(self.stat.in_flight)
+            self.stat.completed += 1
         # Lossless backpressure on the put side too: a completed response
         # holds until the trainer drains a slot rather than evicting an
         # unconsumed sample.  Too-stale responses fall through to put()

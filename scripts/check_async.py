@@ -20,8 +20,16 @@ stats must appear in the step stats), then the degradation check —
 max_head_offpolicyness=0 must reproduce the synchronous trial's stats
 and final weights bit for bit.
 
+Part 3 (`--chaos`, also runnable standalone) is the elastic-fleet chaos
+leg: THREE gen servers join via fleet discovery, one is killed
+mid-decode by an injected `AREAL_FAULTS=kill@t=...` fault, and the leg
+asserts ZERO lost prompts (every prompt accepted, rejected-as-stale, or
+explicitly failed — and none failed), the staleness bound holding, the
+dead server's circuit breaker opening then re-closing after a restart
+on the same port, and at least one redispatched prompt.
+
 Exit 0 iff every check passes.  CI-friendly: CPU-only, tiny random
-model, under a minute end to end.
+model, a few minutes end to end.
 """
 
 import argparse
@@ -300,6 +308,257 @@ def check_trainer_plane(fileroot: str) -> int:
     return len(failures)
 
 
+def check_chaos(n_prompts: int = 40, kill_after_s: float = 2.5) -> int:
+    """Elastic-fleet chaos leg: 3 discovered servers, one killed
+    mid-decode via AREAL_FAULTS, zero lost prompts."""
+    import jax
+    import numpy as np
+
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.base import name_resolve
+    from areal_tpu.base.name_resolve import MemoryNameResolveRepository
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.engines.generator import GeneratorEngine
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.system.fleet import CircuitBreaker, fleet_discovery
+    from areal_tpu.system.gen_server import GenerationServer
+    from areal_tpu.system.replay import ReplayBuffer
+    from areal_tpu.system.rollout import RolloutController
+
+    # The fleet subtree lives in an in-process repository: the whole
+    # chaos drama — joins, the TTL'd dead window, the re-join — plays
+    # out through the same name_resolve API a real deployment uses.
+    name_resolve.set_default(MemoryNameResolveRepository())
+    exp, trial = "chaos", "t0"
+    failures = []
+
+    cfg = tiny_config()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+
+    def make_engine():
+        # Unreachable EOS keeps every decode running its full window, so
+        # the kill reliably lands while requests are in flight.
+        return GeneratorEngine(
+            cfg, params, mesh, eos_token_id=cfg.vocab_size + 7,
+            max_decode_batch=2,
+        )
+
+    servers = []
+    victim = None
+    for i in range(3):
+        if i == 0:
+            # The victim reads its fault spec from the environment —
+            # exactly how a chaos run breaks a real server binary.
+            os.environ["AREAL_FAULTS"] = f"kill@t={kill_after_s}s"
+            try:
+                srv = GenerationServer(
+                    make_engine(), max_wait_ms=20.0, zmq_port=None
+                )
+            finally:
+                del os.environ["AREAL_FAULTS"]
+            victim = srv
+        else:
+            srv = GenerationServer(
+                make_engine(), max_wait_ms=20.0, zmq_port=None
+            )
+        # Long TTL on purpose: a crashed server's announcement must
+        # outlive the dead window so the controller keeps its breaker
+        # state (same identity) instead of reaping + re-adding it.
+        srv.announce(exp, trial, ttl=30.0)
+        servers.append(srv)
+    victim_sid = f"s{victim.port}"
+    victim_port = victim.port
+    victim_engine = victim.engine
+
+    cap = 2
+    replay = ReplayBuffer(capacity=4, max_head_offpolicyness=cap)
+    ctl = RolloutController(
+        replay=replay,
+        gconfig=GenerationHyperparameters(n=1, max_new_tokens=64),
+        discovery=fleet_discovery(exp, trial),
+        max_concurrency=6,
+        health_refresh_s=0.3,
+        backpressure_poll_s=0.01,
+        autosize_inflight=False,
+        dispatch_timeout_s=60.0,
+        max_dispatch_retries=4,
+        retry_backoff_s=0.05,
+        health_poll_timeout_s=1.0,
+        breaker_threshold=2,
+        breaker_cooldown_s=1.0,
+    )
+    push_params = jax.block_until_ready(
+        tfm.init_params(cfg, jax.random.PRNGKey(100))
+    )
+    rng = np.random.default_rng(0)
+    prompts = [
+        (f"q{i}", [int(t) for t in rng.integers(8, cfg.vocab_size, size=6)])
+        for i in range(n_prompts)
+    ]
+    consumed = []
+    staleness_seen = []
+    chaos_done = asyncio.Event()
+    restarted = {}
+
+    async def wait_until(cond, timeout, what) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            await asyncio.sleep(0.1)
+        failures.append(f"timeout waiting for {what}")
+        return False
+
+    async def consume(pump: "asyncio.Task"):
+        loop = asyncio.get_running_loop()
+        while not pump.done() or len(replay) > 0:
+            # Throttle the drain while the chaos choreography is still
+            # playing out: backpressure keeps undispatched prompts in
+            # reserve, so the breaker's close probe always has live
+            # dispatch traffic (and prompts) left to ride on.
+            if not chaos_done.is_set() and len(consumed) >= n_prompts // 3:
+                k, pause = 1, 0.3
+            else:
+                k, pause = 2, 0.05
+            if pump.done():
+                # Tail drain: get_batch(k) raises on a partial batch, so
+                # a lone leftover trajectory must be taken one at a time.
+                k = 1
+            try:
+                trajs = await loop.run_in_executor(
+                    None, replay.get_batch, k, 0.2
+                )
+            except TimeoutError:
+                trajs = []
+            for t in trajs:
+                staleness_seen.append(t.staleness(replay.version))
+            consumed.extend(trajs)
+            await asyncio.sleep(pause)
+
+    def restart_victim():
+        # The old collector may still be finishing its last batch; the
+        # engine is single-threaded, so hand it to the new server only
+        # once that thread exits.
+        victim._collector_thread.join(timeout=60)
+        srv = GenerationServer(
+            victim_engine, port=victim_port, max_wait_ms=20.0,
+            zmq_port=None,
+            # Rejoin at the trainer's CURRENT version: starting at 0
+            # would stamp every response maximally stale.
+            version=replay.version,
+        )
+        srv.announce(exp, trial, ttl=30.0)
+        restarted["server"] = srv
+
+    async def drive():
+        pump = asyncio.create_task(ctl.run(prompts))
+        consumer = asyncio.create_task(consume(pump))
+        try:
+            # 1. The victim kills itself mid-decode; failed/timed-out
+            #    dispatches re-route and its breaker trips open.
+            def breaker_open():
+                st = ctl.server(victim_sid)
+                return st is not None and st.breaker.opens >= 1
+
+            if await wait_until(breaker_open, 120, "breaker to open"):
+                # 2. Restart on the SAME port (same fleet identity).
+                await asyncio.to_thread(restart_victim)
+                # 3. The half-open health probe re-closes the breaker.
+                def breaker_closed():
+                    st = ctl.server(victim_sid)
+                    return (
+                        st is not None
+                        and st.breaker.opens >= 1
+                        and st.breaker.state == CircuitBreaker.CLOSED
+                    )
+
+                if await wait_until(
+                    breaker_closed, 120, "breaker to re-close"
+                ):
+                    # 4. A weight push proves the staleness bound still
+                    #    holds across the healed fleet.
+                    alive = [
+                        s for s in servers if s is not victim
+                    ] + [restarted["server"]]
+                    v = 0
+                    for s in alive:
+                        v = await asyncio.to_thread(
+                            s.update_weights_inmem, push_params
+                        )
+                    if v:
+                        replay.set_version(v)
+        finally:
+            chaos_done.set()
+            await pump
+            await consumer
+
+    try:
+        asyncio.run(drive())
+    finally:
+        for s in servers[1:]:
+            s.close()
+        if "server" in restarted:
+            restarted["server"].close()
+        if not victim._crashed:  # kill never fired: don't leak the server
+            victim.close()
+
+    stat = ctl.stat
+    # Zero lost prompts: every dispatched prompt reached a terminal,
+    # ACCOUNTED state — and under this fault none may end up failed.
+    if stat.accepted + stat.rejected != n_prompts or stat.failed != 0:
+        failures.append(
+            f"prompt accounting broken: accepted {stat.accepted} + "
+            f"rejected {stat.rejected} != {n_prompts} dispatched "
+            f"(failed={stat.failed})"
+        )
+    if stat.redispatched < 1:
+        failures.append(
+            "kill produced no redispatch (expected failed dispatches to "
+            "re-route to surviving servers)"
+        )
+    if any(s > cap for s in staleness_seen):
+        failures.append(
+            f"staleness bound violated: {sorted(set(staleness_seen))} "
+            f"vs cap {cap}"
+        )
+    st = ctl.server(victim_sid)
+    if st is None:
+        failures.append(f"victim {victim_sid} lost from the fleet")
+    else:
+        if st.breaker.opens < 1:
+            failures.append("victim breaker never opened")
+        if st.breaker.state != CircuitBreaker.CLOSED:
+            failures.append(
+                f"victim breaker ended {st.breaker.state}, not closed"
+            )
+    if len(ctl.servers) != 3:
+        failures.append(
+            f"expected 3 fleet members, controller knows "
+            f"{[s.sid for s in ctl.servers]}"
+        )
+    if ctl.membership_epoch < 1:
+        failures.append("membership epoch never advanced")
+    if victim._faults is None or victim._faults.fired.get("kill", 0) < 1:
+        failures.append("the AREAL_FAULTS kill fault never fired")
+
+    for f in failures:
+        print(f"FAIL[chaos]: {f}")
+    if not failures:
+        vb = st.breaker
+        print(
+            f"OK[chaos]: {n_prompts} prompts, zero lost "
+            f"(accepted={stat.accepted} rejected={stat.rejected} "
+            f"failed={stat.failed} redispatched={stat.redispatched}); "
+            f"victim {victim_sid} killed at t={kill_after_s}s, breaker "
+            f"opened x{vb.opens} and re-closed x{vb.closes}; staleness "
+            f"seen {sorted(set(staleness_seen))} <= cap {cap}; "
+            f"membership epoch {ctl.membership_epoch}"
+        )
+    return len(failures)
+
+
 def main() -> int:
     p = argparse.ArgumentParser(prog="check_async")
     p.add_argument("--prompts", type=int, default=24)
@@ -307,9 +566,20 @@ def main() -> int:
                    help="in-memory weight pushes in the serving check")
     p.add_argument("--dir", default=None,
                    help="fileroot for the trainer check (default: tempdir)")
+    p.add_argument("--chaos", action="store_true",
+                   help="run ONLY the elastic-fleet chaos leg (3 servers, "
+                        "one killed mid-decode via AREAL_FAULTS)")
     args = p.parse_args()
-    fileroot = args.dir or tempfile.mkdtemp(prefix="areal_tpu_async_check_")
 
+    if args.chaos:
+        n_fail = check_chaos()
+        if n_fail:
+            print(f"FAIL: {n_fail} chaos check(s) failed")
+            return 1
+        print("OK: elastic rollout fleet survived the injected kill")
+        return 0
+
+    fileroot = args.dir or tempfile.mkdtemp(prefix="areal_tpu_async_check_")
     n_fail = check_serving_plane(args.prompts, args.versions)
     n_fail += check_trainer_plane(fileroot)
     if n_fail:

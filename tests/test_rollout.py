@@ -6,6 +6,7 @@ degradation to exactly synchronous numerics."""
 
 import asyncio
 import pickle
+import time
 
 import numpy as np
 import pytest
@@ -298,6 +299,223 @@ class TestRolloutController:
         assert ctl2.cursor == 6
         assert stat.submitted == 6  # counters carried across the restart
         assert stat.in_flight == 0
+
+    def test_membership_epoch_rides_state_dict(self):
+        rb = ReplayBuffer(capacity=4, max_head_offpolicyness=8)
+        ctl = RolloutController([_FakeClient()], rb, self._gconfig())
+        ctl.membership_epoch = 5
+        sd = ctl.state_dict()
+        assert sd["membership_epoch"] == 5
+        ctl2 = RolloutController([_FakeClient()], rb, self._gconfig())
+        ctl2.load_state_dict(sd)
+        assert ctl2.membership_epoch == 5
+
+
+class _FailingClient(_FakeClient):
+    """agenerate fails the first `fail_times` calls, then succeeds."""
+
+    def __init__(self, fail_times=10**9, **kw):
+        super().__init__(**kw)
+        self.fail_times = fail_times
+        self.failures = 0
+
+    async def agenerate(self, inp):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            self.failures += 1
+            raise RuntimeError("boom")
+        return await super().agenerate(inp)
+
+
+class _HungHealthClient(_FakeClient):
+    """health() wedges (blocking) — the serial-poll regression case."""
+
+    def __init__(self, hang_s=1.0, **kw):
+        super().__init__(**kw)
+        self.hang_s = hang_s
+
+    def health(self):
+        time.sleep(self.hang_s)
+        return super().health()
+
+
+class TestElasticFleetDispatch:
+    def _gconfig(self):
+        return GenerationHyperparameters(n=1, max_new_tokens=4)
+
+    def _rb(self, cap=16):
+        return ReplayBuffer(capacity=cap, max_head_offpolicyness=8)
+
+    def test_failed_dispatch_is_not_counted_completed(self):
+        """The pre-elastic `finally` block bumped stat.completed on the
+        exception path too, so failed prompts double-counted and goodput
+        accounting lied under faults."""
+        bad = _FailingClient()
+        ctl = RolloutController(
+            [bad], self._rb(), self._gconfig(),
+            max_dispatch_retries=0, breaker_threshold=10**9,
+        )
+        stat = asyncio.run(ctl.run([[1, 2]] * 3))
+        assert stat.submitted == 3 and stat.failed == 3
+        assert stat.completed == 0 and stat.accepted == 0
+        assert stat.in_flight == 0
+
+    def test_failed_dispatch_redispatches_to_different_server(self):
+        bad = _FailingClient()
+        good = _FakeClient()
+        ctl = RolloutController(
+            [bad, good], self._rb(), self._gconfig(),
+            max_dispatch_retries=2, retry_backoff_s=0.001,
+            breaker_threshold=10**9,
+        )
+        stat = asyncio.run(ctl.run([[1, 2]] * 4))
+        # Every prompt landed despite the bad server: zero lost.
+        assert stat.accepted == 4 and stat.failed == 0
+        assert stat.redispatched >= 1
+        assert bad.failures >= 1 and len(good.calls) == 4
+
+    def test_dispatch_deadline_times_out_and_redispatches(self):
+        slow = _FakeClient(delay=30.0)
+        fast = _FakeClient()
+        ctl = RolloutController(
+            [slow, fast], self._rb(), self._gconfig(),
+            dispatch_timeout_s=0.1, max_dispatch_retries=2,
+            retry_backoff_s=0.001, breaker_threshold=10**9,
+        )
+        t0 = time.monotonic()
+        stat = asyncio.run(ctl.run([[1, 2]] * 2))
+        assert time.monotonic() - t0 < 10.0  # never waited out the hang
+        assert stat.accepted == 2 and stat.failed == 0
+        assert stat.redispatched >= 1
+        assert len(fast.calls) == 2
+
+    def test_breaker_opens_then_probe_recloses(self):
+        """Two consecutive failures open the breaker; the half-open
+        probe (riding the next health poll) re-closes it, and the prompt
+        that waited through the open window still completes."""
+        healing = _FailingClient(fail_times=2)
+        ctl = RolloutController(
+            [healing], self._rb(), self._gconfig(),
+            max_dispatch_retries=3, retry_backoff_s=0.001,
+            breaker_threshold=2, breaker_cooldown_s=0.05,
+            health_refresh_s=0.02,
+        )
+        stat = asyncio.run(ctl.run([[1, 2]]))
+        br = ctl.server("static0").breaker
+        assert br.opens == 1 and br.closes >= 1
+        assert br.state == br.CLOSED
+        assert stat.accepted == 1 and stat.failed == 0
+        assert stat.redispatched == 2
+
+    def test_hung_health_poll_does_not_stall_the_fleet(self):
+        hung = _HungHealthClient(hang_s=1.0)
+        alive = _FakeClient()
+        ctl = RolloutController(
+            [hung, alive], self._rb(), self._gconfig(),
+            health_poll_timeout_s=0.05,
+        )
+        async def go():
+            t0 = time.monotonic()
+            stat = await ctl.run([[1, 2]] * 4)
+            return stat, time.monotonic() - t0
+
+        # Elapsed is measured inside the loop: asyncio.run's shutdown
+        # joins the executor thread still stuck in the hung poll.
+        stat, elapsed = asyncio.run(go())
+        # Concurrent polls with a per-client timeout: the refresh costs
+        # ~health_poll_timeout_s, not hang_s per hung server.
+        assert elapsed < hung.hang_s
+        assert stat.accepted == 4 and len(alive.calls) == 4
+        st = ctl.server("static0")
+        # Explicit unhealthy flag — no 1<<30 sentinel that could leak
+        # into version_lag or autosize math.
+        assert st.healthy is False and st.health == {}
+
+    def test_dynamic_join_gets_dispatches_within_one_refresh(self):
+        """A server announced AFTER the controller is running receives
+        dispatches within one health-refresh interval."""
+        a = _FakeClient(delay=0.02)
+        b = _FakeClient(delay=0.02)
+        fleet = {"a": a}
+
+        ctl = RolloutController(
+            replay=self._rb(cap=4),
+            gconfig=self._gconfig(),
+            discovery=lambda: dict(fleet),
+            max_concurrency=2,
+            health_refresh_s=0.03,
+            backpressure_poll_s=0.005,
+        )
+
+        async def go():
+            pump = asyncio.create_task(ctl.run([[1, 2]] * 20))
+
+            async def consume():
+                drained = 0
+                while drained < 20:
+                    try:
+                        drained += len(ctl.replay.get_batch(1, timeout=0))
+                    except TimeoutError:
+                        pass
+                    await asyncio.sleep(0.005)
+
+            c = asyncio.create_task(consume())
+            while not a.calls:  # fleet is live with only "a"
+                await asyncio.sleep(0.005)
+            fleet["b"] = b  # the late join
+            await pump
+            await c
+            return pump.result()
+
+        stat = asyncio.run(go())
+        assert stat.accepted == 20 and stat.failed == 0
+        assert len(b.calls) > 0  # the joiner took real work
+        assert ctl.membership_epoch >= 2  # join of a, then join of b
+
+    def test_departing_server_drains_without_losing_work(self):
+        """Removing a server from the announced fleet mid-run drains it:
+        no new dispatches, in-flight work completes, every prompt lands."""
+        a = _FakeClient(delay=0.01)
+        b = _FakeClient(delay=0.01)
+        fleet = {"a": a, "b": b}
+
+        ctl = RolloutController(
+            replay=self._rb(cap=4),
+            gconfig=self._gconfig(),
+            discovery=lambda: dict(fleet),
+            max_concurrency=2,
+            health_refresh_s=0.02,
+            backpressure_poll_s=0.005,
+        )
+
+        async def go():
+            pump = asyncio.create_task(ctl.run([[1, 2]] * 24))
+
+            async def consume():
+                drained = 0
+                while drained < 24:
+                    try:
+                        drained += len(ctl.replay.get_batch(1, timeout=0))
+                    except TimeoutError:
+                        pass
+                    await asyncio.sleep(0.005)
+
+            c = asyncio.create_task(consume())
+            while not b.calls:  # b is live and working
+                await asyncio.sleep(0.005)
+            del fleet["b"]  # b leaves the fleet
+            calls_at_leave = len(b.calls)
+            await pump
+            await c
+            return pump.result(), calls_at_leave
+
+        stat, calls_at_leave = asyncio.run(go())
+        assert stat.accepted == 24 and stat.failed == 0
+        # Draining allowed at most the already-in-flight dispatches to
+        # finish on b (max_concurrency=2), never routed new work there.
+        assert len(b.calls) <= calls_at_leave + 2
+        assert ctl.server("b") is None  # drained and reaped
+        assert len(a.calls) + len(b.calls) == 24
 
 
 class TestAsyncRLExperiment:
