@@ -33,8 +33,12 @@ servers, rate of ``areal_gen_tokens_total`` between scrapes),
 ``areal_replay_staleness`` histogram), ``queue_depth``,
 ``kv_utilization``, ``idle_frac``, ``version_skew`` (max-min serving
 weight version across gen servers), ``backpressure`` (rate of
-``areal_rollout_backpressure_total``), ``in_flight``, plus any raw
-unlabeled series name.
+``areal_rollout_backpressure_total``), ``in_flight``,
+``pipeline_fill`` / ``pipeline_bubble`` (pipelined-step occupancy: the
+busiest stage's ``areal_master_pipeline_fill_ratio`` and the summed
+``areal_master_pipeline_bubble_seconds`` over stages — e.g.
+``warn: pipeline_fill >= 0.6`` alerts when the overlapped step leaves
+the dominant stage mostly idle), plus any raw unlabeled series name.
 
 Exit status: 0 if no CRIT fired over the run, 1 otherwise (``--count``
 bounds the run; without it the poller runs until interrupted).
@@ -293,6 +297,23 @@ def fleet_signals(
     bo = _series_sum(all_samples, "areal_rollout_breaker_open")
     if bo is not None:
         signals["breaker_open"] = bo
+    # Pipelined-step occupancy (labeled per-stage gauges -> computed
+    # fleet signals): wall-clock of an overlapped step ~= the busiest
+    # stage, so that stage's fill approaching 1.0 means the pipeline is
+    # tight; the summed per-stage bubble seconds is the idle the
+    # overlap exists to shrink.  Absent when pipeline_overlap is off.
+    fills = [
+        v for n, labels, v in all_samples
+        if n == "areal_master_pipeline_fill_ratio"
+    ]
+    if fills:
+        signals["pipeline_fill"] = max(fills)
+    bubs = [
+        v for n, labels, v in all_samples
+        if n == "areal_master_pipeline_bubble_seconds"
+    ]
+    if bubs:
+        signals["pipeline_bubble"] = sum(bubs)
     # Raw unlabeled series become rule-addressable too (last wins on
     # duplicates; labeled series need the computed signals above).
     for n, labels, v in all_samples:
@@ -333,6 +354,7 @@ def render_table(rows: List[Dict[str, object]],
     keys = (
         "goodput", "staleness_p50", "staleness_p99", "queue_depth",
         "kv_utilization", "idle_frac", "version_skew", "backpressure",
+        "pipeline_fill", "pipeline_bubble",
     )
     fleet = ", ".join(
         f"{k}={signals[k]:.4g}" for k in keys if k in signals

@@ -316,6 +316,9 @@ def cmd_ppo_math(args):
         rollout_ahead=args.rollout_ahead,
         max_head_offpolicyness=args.max_head_offpolicyness,
         replay_capacity=args.replay_capacity,
+        pipeline_overlap=args.pipeline_overlap,
+        overlap_window=args.overlap_window,
+        pipeline_chunk_seqs=args.pipeline_chunk_seqs,
         inmem_weight_sync=args.inmem_weight_sync,
         gen_backend_args=(
             {"kv_cache_dtype": args.kv_cache_dtype}
@@ -470,6 +473,20 @@ def main(argv=None):
                          "around weight pushes (in-flight decodes halt at "
                          "a chunk boundary and resume on their KV pages) "
                          "instead of draining the server")
+    pp.add_argument("--pipeline-overlap", action="store_true",
+                    help="overlap the stages INSIDE a step: slice the "
+                         "batch into rollout-group chunks and stream each "
+                         "through gen -> ref/reward -> train "
+                         "forward-backward while later chunks still "
+                         "decode; one optimizer step per global step "
+                         "(mutually exclusive with --rollout-ahead and "
+                         "--max-head-offpolicyness)")
+    pp.add_argument("--overlap-window", type=int, default=2,
+                    help="pipeline overlap: max chunks in flight at once "
+                         "(1 = serial dispatch, bit-exact vs the barrier "
+                         "scheduler)")
+    pp.add_argument("--pipeline-chunk-seqs", type=int, default=1,
+                    help="pipeline overlap: rollout groups per chunk")
     pp.set_defaults(fn=cmd_ppo_math)
 
     # Install YAML defaults on whichever subcommand was chosen.

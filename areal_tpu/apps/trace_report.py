@@ -19,18 +19,31 @@ buckets every step's wall-clock into compute / comms / host / idle:
 Uncategorized spans (request lifetimes, dispatch waits) shape the
 timeline but never count toward a bucket.
 
+``--pipeline`` switches the human view to the pipelined-step report:
+one row per (step, stage) over the master's ``pipe:<stage>`` dispatch
+spans, with each stage's busy time (interval union of its chunk
+dispatches), fill fraction of the step window, and intra-stage bubble,
+plus a per-step overlap fraction (how much of the stages' summed busy
+time ran concurrently — 0 under the barrier scheduler, > 0 once chunks
+of different stages execute at the same time).
+
 ``--json`` emits the report as one JSON object with a stable schema
 (``json_report``) instead of the human tables, for dashboards and the
 regression tooling:
 
-    {"version": 1,
+    {"version": 2,
      "rows": [{"step", "pid", "process", "window_us", "compute_us",
                "comms_us", "host_us", "idle_us"}, ...],
      "bubbles": [{"process", "step", "start_us", "dur_us",
-                  "after_span", "before_span"}, ...]}
+                  "after_span", "before_span"}, ...],
+     "pipeline": [{"step", "window_us", "overlap_frac",
+                   "stages": [{"stage", "n_chunks", "busy_us", "fill",
+                               "bubble_us"}, ...]}, ...]}
 
 ``version`` bumps on any breaking change; consumers must reject
-versions they don't know.
+versions they don't know.  v2 is additive over v1: every v1 field is
+unchanged, ``pipeline`` is new (empty list when the trace has no
+``pipe:*`` spans, i.e. any non-pipelined run).
 """
 
 import argparse
@@ -216,6 +229,98 @@ def bubbles(trace, top: int = 5) -> List[Dict[str, Any]]:
     return out[:top]
 
 
+def pipeline_rows(trace) -> List[Dict[str, Any]]:
+    """Per-step occupancy of the pipelined executor, from the master's
+    ``pipe:<stage>`` dispatch spans.
+
+    For each step window and each stage (DFG node): ``busy_us`` is the
+    interval union of that stage's chunk dispatches clipped to the
+    window, ``fill`` = busy / window, ``bubble_us`` = idle time strictly
+    inside the stage's own active span (last end - first start - busy).
+    The per-step ``overlap_frac`` = 1 - union(all stages) / sum(stages):
+    0 when stages run strictly one after another (the barrier
+    scheduler), approaching 1 - 1/n_stages as they fully overlap.
+    Steps without pipe spans (non-pipelined runs) produce no rows.
+    """
+    events = [
+        e
+        for e in trace.get("traceEvents", [])
+        if e.get("ph") == "X" and str(e.get("name", "")).startswith("pipe:")
+    ]
+    out: List[Dict[str, Any]] = []
+    for step, lo, hi in _step_windows(trace):
+        window = hi - lo
+        stages: Dict[str, List[Interval]] = {}
+        for e in events:
+            s, ee = int(e["ts"]), int(e["ts"]) + int(e["dur"])
+            if ee <= lo or s >= hi:
+                continue
+            stage = (e.get("args") or {}).get("stage") or e["name"][
+                len("pipe:"):
+            ]
+            stages.setdefault(str(stage), []).append(
+                (max(s, lo), min(ee, hi))
+            )
+        if not stages:
+            continue
+        srows = []
+        busy_all: List[Interval] = []
+        sum_busy = 0
+        for stage, iv in sorted(stages.items()):
+            u = _union(iv)
+            busy = _total(u)
+            srows.append(
+                {
+                    "stage": stage,
+                    "n_chunks": len(iv),
+                    "busy_us": busy,
+                    "fill": busy / max(window, 1),
+                    "bubble_us": max((u[-1][1] - u[0][0]) - busy, 0),
+                }
+            )
+            busy_all.extend(u)
+            sum_busy += busy
+        union_all = _total(_union(busy_all))
+        out.append(
+            {
+                "step": step,
+                "window_us": window,
+                "overlap_frac": (
+                    1.0 - union_all / sum_busy if sum_busy else 0.0
+                ),
+                "stages": srows,
+            }
+        )
+    return out
+
+
+def format_pipeline(trace) -> str:
+    steps = pipeline_rows(trace)
+    if not steps:
+        return (
+            "no pipe:* spans in this trace (pipeline_overlap off, or the "
+            "master was not traced)"
+        )
+    lines = [
+        f"{'step':>5} {'stage':<16} {'chunks':>6} {'busy_ms':>9} "
+        f"{'fill%':>6} {'bubble_ms':>9}"
+    ]
+    for st in steps:
+        step = "-" if st["step"] is None else str(st["step"])
+        for r in st["stages"]:
+            lines.append(
+                f"{step:>5} {r['stage']:<16} {r['n_chunks']:>6} "
+                f"{r['busy_us'] / 1000.0:9.1f} {100.0 * r['fill']:5.1f}% "
+                f"{r['bubble_us'] / 1000.0:9.1f}"
+            )
+        lines.append(
+            f"{step:>5} {'(step)':<16} window "
+            f"{st['window_us'] / 1000.0:.1f} ms, overlap "
+            f"{100.0 * st['overlap_frac']:.1f}%"
+        )
+    return "\n".join(lines)
+
+
 def format_report(trace, top: int = 5) -> str:
     rows = attribute(trace)
     lines = []
@@ -247,11 +352,12 @@ def format_report(trace, top: int = 5) -> str:
     return "\n".join(lines)
 
 
-JSON_VERSION = 1
+# v2 is additive over v1: rows/bubbles unchanged, "pipeline" added.
+JSON_VERSION = 2
 
 
 def json_report(trace, top: int = 5) -> Dict[str, Any]:
-    """Machine-readable report, schema v1 (see module docstring).  The
+    """Machine-readable report, schema v2 (see module docstring).  The
     internal ``_covered`` interval list is stripped from rows — it is an
     implementation detail of the precedence subtraction, not contract."""
     rows = [
@@ -262,6 +368,7 @@ def json_report(trace, top: int = 5) -> Dict[str, Any]:
         "version": JSON_VERSION,
         "rows": rows,
         "bubbles": bubbles(trace, top=top),
+        "pipeline": pipeline_rows(trace),
     }
 
 
@@ -279,7 +386,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     p.add_argument(
         "--json", action="store_true",
-        help="emit the stable v1 JSON report instead of tables",
+        help="emit the stable v2 JSON report instead of tables",
+    )
+    p.add_argument(
+        "--pipeline", action="store_true",
+        help="per-stage fill/overlap of the pipelined step executor "
+        "(from pipe:* spans) instead of the stall tables",
     )
     args = p.parse_args(argv)
     if os.path.isdir(args.path):
@@ -297,6 +409,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
     if args.json:
         print(json.dumps(json_report(trace, top=args.top)))
+    elif args.pipeline:
+        print(format_pipeline(trace))
     else:
         print(format_report(trace, top=args.top))
     return 0

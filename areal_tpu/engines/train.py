@@ -164,6 +164,7 @@ class TrainEngine(HostOffloadMixin, Engine):
         self._grad_fns: Dict[Any, Callable] = {}
         self._fwd_fns: Dict[Any, Callable] = {}
         self._apply_fn = None
+        self._scaled_apply_fn = None
         self._batch_sharding = sharding.named(mesh, sharding.batch_pspec())
         (
             self._use_flash,
@@ -269,6 +270,26 @@ class TrainEngine(HostOffloadMixin, Engine):
         self._apply_fn = apply_fn
         return apply_fn
 
+    def _get_scaled_apply_fn(self):
+        """Optimizer step for the streamed path: the grad sum was
+        accumulated at unit loss_scale (the per-chunk weight is unknown
+        until the stream closes), so scale by 1/total_weight here before
+        clipping/AdamW.  Same donation story as `_get_apply_fn`."""
+        if self._scaled_apply_fn is not None:
+            return self._scaled_apply_fn
+        optimizer = self.optimizer
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def apply_fn(params, opt_state, grads, scale):
+            grads = jax.tree.map(lambda g: g * scale, grads)
+            gnorm = optax.global_norm(grads)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, gnorm
+
+        self._scaled_apply_fn = apply_fn
+        return apply_fn
+
     # ---------------- Engine API ----------------
 
     def train_batch(
@@ -365,6 +386,148 @@ class TrainEngine(HostOffloadMixin, Engine):
                 out[k[: -len("_sum")]] = sum(vals) / total_weight
             else:
                 out[k] = float(np.mean(vals))
+        return out
+
+    # ---------------- streamed accumulation ----------------
+    #
+    # Pipeline-overlapped PPO feeds the trainer one rollout chunk at a
+    # time while later chunks are still decoding; the donated grad-sum
+    # loop above is reused as the accumulator, split across calls:
+    #
+    #   state = engine.train_stream_begin()
+    #   for chunk: engine.train_stream_chunk(state, chunk_sample, ...)
+    #   out = engine.train_stream_end(state)   # one optimizer step
+    #
+    # Chunks accumulate at unit loss_scale (the total token weight is
+    # unknown mid-stream); `train_stream_end` scales the grad sum by
+    # 1/total_weight inside the donated apply.  sum(g_i)/W equals the
+    # barrier path's sum(g_i/W) up to float reassociation — the
+    # bit-exact overlap-off guarantee comes from the master dispatching
+    # window=1 steps through the unchanged `train_batch` path.
+
+    def train_stream_begin(self) -> Dict[str, Any]:
+        """Open a streamed accumulation window; returns mutable state."""
+        self._ensure_loaded()
+        return {
+            "acc": None,
+            "loss_sums": [],
+            "stat_sums": {},
+            "weight": 0.0,
+            "n_micro_batches": 0,
+            "n_chunks": 0,
+            "real_tokens": 0,
+            "grid_tokens": 0,
+        }
+
+    def train_stream_chunk(
+        self,
+        state: Dict[str, Any],
+        sample: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        loss_fn: Callable,
+        loss_weight_fn: Callable[[Dict[str, np.ndarray]], float],
+        token_key: str = "packed_input_ids",
+        extra_keys: Sequence[str] = (),
+        version_steps: int = 0,
+    ) -> Dict[str, float]:
+        """Accumulate one chunk's grads into the stream's donated sum.
+
+        Returns this chunk's raw stat sums (keys keep their `_sum`
+        suffix) plus `chunk_weight` / `chunk_loss_sum` so callers can
+        build `*_denominator`-weighted per-chunk stats.
+        """
+        sharded_mbs = packing.split_sharded(sample, mb_spec)
+        if any(blocks for _, blocks in sharded_mbs):
+            raise ValueError(
+                "streamed accumulation does not compose with shard-exact "
+                "data placement (shard_of metadata); broadcast chunk inputs "
+                "or use the barrier train_batch path"
+            )
+        packs = [
+            packing.pack_sample(
+                mb,
+                token_key,
+                extra_keys=extra_keys,
+                n_rows_multiple=self.batch_shard,
+                max_tokens_per_row=mb_spec.max_tokens_per_mb,
+            )
+            for mb, _ in sharded_mbs
+        ]
+        chunks = [
+            c for pk in packs for c in self._pack_row_chunks(pk.arrays)
+        ]
+        chunk_weight = float(sum(loss_weight_fn(c) for c in chunks))
+
+        grad_fn, grad_acc_fn = self._get_grad_fn(loss_fn)
+        scale = jnp.float32(1.0)  # traced arg: no retrace vs train_batch
+        losses = []
+        all_stats = []
+        for arrays in chunks:
+            batch = self._device_batch(arrays)
+            if state["acc"] is None:
+                state["acc"], loss, stats = grad_fn(self.params, batch, scale)
+            else:
+                state["acc"], loss, stats = grad_acc_fn(
+                    self.params, batch, scale, state["acc"]
+                )
+            losses.append(loss)
+            all_stats.append(stats)
+            state["real_tokens"] += int((arrays["segment_ids"] > 0).sum())
+            state["grid_tokens"] += int(np.prod(arrays["segment_ids"].shape))
+        # Host conversion AFTER the dispatch loop (one sync per chunk,
+        # not per micro-batch); the device-side sum also keeps the
+        # window=1 loss bit-identical to train_batch's.
+        chunk_loss = float(jnp.sum(jnp.stack(losses))) if losses else 0.0
+        chunk_stats: Dict[str, float] = {}
+        for stats in all_stats:
+            for k, v in stats.items():
+                chunk_stats[k] = chunk_stats.get(k, 0.0) + float(v)
+
+        state["weight"] += chunk_weight
+        state["loss_sums"].append(chunk_loss)
+        state["n_micro_batches"] += len(chunks)
+        state["n_chunks"] += 1
+        for k, v in chunk_stats.items():
+            state["stat_sums"][k] = state["stat_sums"].get(k, 0.0) + v
+        return {
+            **chunk_stats,
+            "chunk_weight": chunk_weight,
+            "chunk_loss_sum": chunk_loss,
+            "chunk_micro_batches": float(len(chunks)),
+        }
+
+    def train_stream_end(self, state: Dict[str, Any]) -> Dict[str, float]:
+        """Close the stream: one scaled optimizer step over the grad sum."""
+        if state["acc"] is None:
+            raise ValueError("train_stream_end before any train_stream_chunk")
+        total_weight = max(state["weight"], 1.0)
+        params, opt_state, gnorm = self._get_scaled_apply_fn()(
+            self.params,
+            self.opt_state,
+            state["acc"],
+            jnp.float32(1.0 / total_weight),
+        )
+        self.params, self.opt_state = params, opt_state
+        state["acc"] = None  # donated: drop the dead reference
+
+        self.last_pack_stats = {
+            "real_tokens": state["real_tokens"],
+            "grid_tokens": state["grid_tokens"],
+            "pack_efficiency": state["real_tokens"]
+            / max(state["grid_tokens"], 1),
+            "n_micro_batches": state["n_micro_batches"],
+        }
+        out: Dict[str, float] = {
+            "loss": float(sum(state["loss_sums"])) / total_weight,
+            "grad_norm": float(gnorm),
+            "n_micro_batches": float(state["n_micro_batches"]),
+            "n_stream_chunks": float(state["n_chunks"]),
+        }
+        for k, v in state["stat_sums"].items():
+            if k.endswith("_sum"):
+                out[k[: -len("_sum")]] = v / total_weight
+            else:
+                out[k] = v / max(state["n_micro_batches"], 1)
         return out
 
     def masked_moments(

@@ -132,6 +132,24 @@ def check_ppo_math(cfg) -> None:
             f"replay_capacity must be >= 1, got "
             f"{getattr(cfg, 'replay_capacity', 4)}"
         )
+    if getattr(cfg, "pipeline_overlap", False):
+        if cfg.rollout_ahead > 0 or mho is not None:
+            _fail(
+                "pipeline_overlap is mutually exclusive with "
+                "rollout_ahead / max_head_offpolicyness: those overlap "
+                "generation ACROSS steps, pipeline overlap streams "
+                "chunks WITHIN one on-policy step"
+            )
+        if getattr(cfg, "overlap_window", 2) < 1:
+            _fail(
+                f"overlap_window must be >= 1, got "
+                f"{getattr(cfg, 'overlap_window', 2)}"
+            )
+        if getattr(cfg, "pipeline_chunk_seqs", 1) < 1:
+            _fail(
+                f"pipeline_chunk_seqs must be >= 1, got "
+                f"{getattr(cfg, 'pipeline_chunk_seqs', 1)}"
+            )
     if cfg.gen_server_url and getattr(cfg, "gen_backend_args", None):
         # Decoupled serving builds a weightless remote_generator backend;
         # local GeneratorEngine kwargs would be silently ignored — the
@@ -170,18 +188,23 @@ def check_ppo_math(cfg) -> None:
             "and are ignored under gen_server_url (configure the "
             "standalone gen_server instead)"
         )
-    if (cfg.rollout_ahead > 0 or mho is not None) and getattr(
-        cfg, "gen_backend_args", {}
-    ).get("donation_safe_swap") is False:
+    if (
+        cfg.rollout_ahead > 0
+        or mho is not None
+        or getattr(cfg, "pipeline_overlap", False)
+    ) and getattr(cfg, "gen_backend_args", {}).get(
+        "donation_safe_swap"
+    ) is False:
         # The copy-free hot-swap aliases the train master's buffers; with
-        # one-step-ahead rollout OR async-RL prefetch the generator
-        # DECODES while the optimizer donates those buffers — a
+        # one-step-ahead rollout, async-RL prefetch, OR within-step
+        # pipeline overlap the generator DECODES while the optimizer
+        # donates (or is about to donate) those buffers — a
         # use-after-free, not a memory tradeoff.
         _fail(
-            "donation_safe_swap=False requires synchronous rollout "
-            "(rollout_ahead=0 and no max_head_offpolicyness): async "
-            "generation would decode from buffers the optimizer step "
-            "donates"
+            "donation_safe_swap=False requires fully synchronous rollout "
+            "(rollout_ahead=0, no max_head_offpolicyness, no "
+            "pipeline_overlap): overlapped generation would decode from "
+            "buffers the optimizer step donates"
         )
     if cfg.dataset_filter:
         lo = cfg.dataset_filter.get("min_accuracy", 0.0)

@@ -64,6 +64,12 @@ class ExperimentPlan:
     max_head_offpolicyness: Optional[int] = None
     replay_capacity: int = 4
     buffer_max_age_steps: Optional[int] = None
+    # Pipeline-overlapped PPO: stream the step's batch through the graph
+    # in rollout chunks (see master._execute_step_streamed).  window=1 is
+    # the bit-exact overlap-off degenerate form.
+    pipeline_overlap: bool = False
+    overlap_window: int = 2
+    pipeline_chunk_seqs: int = 1
 
 
 @dataclasses.dataclass
@@ -202,6 +208,19 @@ class PPOMathConfig:
     max_head_offpolicyness: Optional[int] = None
     # Replay capacity in batches for the async-RL pipeline.
     replay_capacity: int = 4
+    # Pipeline-overlapped PPO (ROADMAP item 3; OPPO, arxiv 2509.25762):
+    # stream the step's batch through gen -> ref/reward inference ->
+    # train grad accumulation in chunks of `pipeline_chunk_seqs` prompts
+    # with `overlap_window` chunks in flight, so post-generation stages
+    # run while later chunks still decode and the optimizer step fires
+    # once after the last chunk.  overlap_window=1 = overlap off: the
+    # whole batch flows through the unchanged barrier node path
+    # (bit-exact with pipeline_overlap=False).  Mutually exclusive with
+    # rollout_ahead / max_head_offpolicyness; requires
+    # donation_safe_swap on colocated generators (enforced in check.py).
+    pipeline_overlap: bool = False
+    overlap_window: int = 2
+    pipeline_chunk_seqs: int = 1
     # Importance-weight cap for decoupled PPO; tokens whose behavior
     # weight exceeds it are masked out.  Only applied when
     # max_head_offpolicyness > 0 (at 0 the plain PPO loss keeps exact
@@ -568,11 +587,14 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                 backend=ModelBackendAbstraction(
                     "generator",
                     {
-                        # Both async modes decode while the optimizer step
-                        # donates the train buffers -> the generator MUST
-                        # keep its defensive copy.
+                        # Both async modes — and the within-step pipeline
+                        # overlap, whose later chunks decode while earlier
+                        # chunks accumulate grads — run generation
+                        # concurrently with the donating optimizer step ->
+                        # the generator MUST keep its defensive copy.
                         "donation_safe_swap": cfg.rollout_ahead > 0
-                        or cfg.max_head_offpolicyness is not None,
+                        or cfg.max_head_offpolicyness is not None
+                        or cfg.pipeline_overlap,
                         "kv_paged": cfg.kv_paged,
                         "kv_page_size": cfg.kv_page_size,
                         "kv_pool_pages": cfg.kv_pool_pages,
@@ -665,6 +687,9 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
         rollout_ahead=cfg.rollout_ahead,
         max_head_offpolicyness=cfg.max_head_offpolicyness,
         replay_capacity=cfg.replay_capacity,
+        pipeline_overlap=cfg.pipeline_overlap,
+        overlap_window=cfg.overlap_window,
+        pipeline_chunk_seqs=cfg.pipeline_chunk_seqs,
     )
 
 
@@ -707,6 +732,9 @@ def run_experiment(plan: ExperimentPlan, tokenizer=None):
         max_head_offpolicyness=plan.max_head_offpolicyness,
         replay_capacity=plan.replay_capacity,
         buffer_max_age_steps=plan.buffer_max_age_steps,
+        pipeline_overlap=plan.pipeline_overlap,
+        overlap_window=plan.overlap_window,
+        pipeline_chunk_seqs=plan.pipeline_chunk_seqs,
     )
     master.load_recover_info()
     stats = asyncio.run(master.run())

@@ -29,6 +29,7 @@ from areal_tpu.api.model_api import (
     register_interface,
 )
 from areal_tpu.base import logging
+from areal_tpu.base.stats import merge_stats
 from areal_tpu.ops import functional as F
 from areal_tpu.ops.gae import gae_packed
 
@@ -333,9 +334,22 @@ class PPOActorInterface(ModelInterface):
             si += k
         return _select_group_seqs(sample, keep)
 
-    def train_step(
+    def _prepare_train_sample(
         self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
-    ) -> Dict[str, float]:
+    ):
+        """Everything before the minibatch loop: best-of-k filtering,
+        KL-shaped rewards, GAE or GRPO advantages, advantage
+        normalization, and the packed train sample with aligned keys.
+
+        Shared by the barrier `train_step` (whole batch) and the
+        streamed `train_stream_chunk` (one retired rollout chunk at a
+        time); in the streamed case batch-global statistics (advantage
+        moments for adv_norm, the ref-KL term) are computed over the
+        chunk — the streaming per-micro-batch form of the estimator.
+        GRPO group normalization is group-local either way, so it is
+        exact under streaming as long as chunks respect group bounds.
+
+        Returns (train_sample, extra_keys, aux)."""
         if (
             self.generation_size is not None
             and self.generation_size > self.gconfig.n
@@ -567,6 +581,26 @@ class PPOActorInterface(ModelInterface):
             aligned["prox_logp"] = prox_logp
             extra_keys = extra_keys + ("prox_logp",)
         _add_aligned_keys(train_sample, aligned)
+        aux = {
+            "klv": klv,
+            "n_seqs": len(layout),
+            "loss_mask": loss_mask,
+            "old_logp": old_logp,
+            "ref_logp": ref_logp,
+            "scores": scores,
+            "no_eos": no_eos,
+            "ref_kl": ref_kl,
+        }
+        return train_sample, extra_keys, aux
+
+    def train_step(
+        self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        train_sample, extra_keys, aux = self._prepare_train_sample(
+            model, sample, mb_spec
+        )
+        loss_mask = aux["loss_mask"]
+        old_logp, ref_logp = aux["old_logp"], aux["ref_logp"]
 
         loss_fn = self._get_loss_fn()
         all_stats = []
@@ -614,6 +648,7 @@ class PPOActorInterface(ModelInterface):
         # (exact + identical on every member, so the controller cannot
         # drift across the SPMD group); the host formula here would be
         # understated ~1/n_shards by the zero-filled rows.
+        ref_kl = aux["ref_kl"]
         if ref_kl is None:
             ref_kl = 0.0
             if ref_logp is not None and loss_mask.sum() > 0:
@@ -622,17 +657,152 @@ class PPOActorInterface(ModelInterface):
                     / loss_mask.sum()
                 )
         if ref_logp is not None and loss_mask.sum() > 0:
-            self._kl().update(ref_kl, n_steps=len(layout))
+            self._kl().update(ref_kl, n_steps=aux["n_seqs"])
 
         out.update(
-            task_reward=float(scores.mean()),
-            no_eos_ratio=float(no_eos.mean()),
+            task_reward=float(aux["scores"].mean()),
+            no_eos_ratio=float(aux["no_eos"].mean()),
             # advantage_abs arrives from the jitted loss stats (exact
             # under sharding); out already carries it.
             n_response_tokens=float(loss_mask.sum()),
-            kl_ctl_value=klv,
+            kl_ctl_value=aux["klv"],
             ref_kl=ref_kl,
             n_minibatches_skipped=float(n_skipped),
+        )
+        return out
+
+    # ------------- streamed (pipeline-overlapped) train -------------
+
+    def train_stream_begin(
+        self, model: Model, mb_spec: MicroBatchSpec
+    ) -> Dict:
+        """Open a pipeline-overlapped train stream.
+
+        Chunks arrive via `train_stream_chunk` as their rollout groups
+        retire from generation; advantages (and their normalization
+        moments) are computed chunk-locally and grads accumulate into
+        the engine's donated sum.  The single optimizer step fires in
+        `train_stream_end`.  Overlap-off (in-flight window = 1) never
+        reaches this path — the master dispatches window-1 steps
+        through the unchanged barrier `train_step`, which is the
+        bit-exactness guarantee.
+        """
+        return {
+            "engine": model.engine.train_stream_begin(),
+            "chunk_stats": [],
+            "kl_num": 0.0,
+            "kl_den": 0.0,
+            "n_seqs": 0,
+            "score_sum": 0.0,
+            "score_n": 0,
+            "no_eos_sum": 0.0,
+            "no_eos_n": 0,
+            "resp_tokens": 0.0,
+            "klv": self._kl().value,
+            "stopped": False,
+            "n_chunks_skipped": 0,
+        }
+
+    def train_stream_chunk(
+        self,
+        model: Model,
+        state: Dict,
+        sample: SequenceSample,
+        mb_spec: MicroBatchSpec,
+    ) -> Dict[str, float]:
+        """Advantages + grad accumulation for one retired rollout chunk.
+
+        Returns the chunk's stats in `*_denominator`-weighted form so
+        `merge_stats` recovers the token-weighted step means even when
+        chunks carry uneven token counts."""
+        if state["stopped"]:
+            state["n_chunks_skipped"] += 1
+            return {"n_chunks_skipped": 1.0}
+        if sample.shard_blocks() is not None:
+            raise ValueError(
+                "pipeline overlap does not compose with shard-exact "
+                "dispatch; chunk inputs must be broadcast"
+            )
+        train_sample, extra_keys, aux = self._prepare_train_sample(
+            model, sample, mb_spec
+        )
+        raw = model.engine.train_stream_chunk(
+            state["engine"],
+            train_sample,
+            mb_spec,
+            loss_fn=self._get_loss_fn(),
+            loss_weight_fn=_mask_count,
+            token_key="packed_input_ids",
+            extra_keys=extra_keys,
+            version_steps=model.version,
+        )
+        w = max(raw.pop("chunk_weight"), 1.0)
+        loss_sum = raw.pop("chunk_loss_sum")
+        raw.pop("chunk_micro_batches", None)
+        stats: Dict[str, float] = {
+            "loss": loss_sum / w,
+            "loss_denominator": w,
+        }
+        for k, v in raw.items():
+            base = k[: -len("_sum")] if k.endswith("_sum") else k
+            stats[base] = v / w
+            stats[base + "_denominator"] = w
+
+        loss_mask = aux["loss_mask"]
+        old_logp, ref_logp = aux["old_logp"], aux["ref_logp"]
+        mt = float(loss_mask.sum())
+        if ref_logp is not None and mt > 0:
+            state["kl_num"] += float(
+                ((old_logp - ref_logp) * loss_mask).sum()
+            )
+            state["kl_den"] += mt
+        state["n_seqs"] += aux["n_seqs"]
+        state["score_sum"] += float(aux["scores"].sum())
+        state["score_n"] += len(aux["scores"])
+        state["no_eos_sum"] += float(aux["no_eos"].sum())
+        state["no_eos_n"] += len(aux["no_eos"])
+        state["resp_tokens"] += mt
+        state["chunk_stats"].append(stats)
+
+        imp = stats.get("importance_weight", 1.0)
+        akl = abs(stats.get("approx_kl", 0.0))
+        if (
+            self.early_stop_imp_ratio is not None
+            and imp > self.early_stop_imp_ratio
+        ) or (self.early_stop_kl is not None and akl > self.early_stop_kl):
+            state["stopped"] = True
+            logger.warning(
+                f"early stop after stream chunk "
+                f"{len(state['chunk_stats'])}: importance_weight="
+                f"{imp:.3f} approx_kl={akl:.4f} (thresholds "
+                f"{self.early_stop_imp_ratio}/{self.early_stop_kl}); "
+                f"remaining chunks accumulate no gradient"
+            )
+        return stats
+
+    def train_stream_end(
+        self, model: Model, state: Dict, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        """One optimizer step over the streamed grad sum + merged stats."""
+        eng_out = model.engine.train_stream_end(state["engine"])
+        model.inc_version()
+        out = (
+            merge_stats(state["chunk_stats"]) if state["chunk_stats"] else {}
+        )
+        # The engine's stream totals are authoritative for the keys both
+        # report (they agree up to float reassociation).
+        out.update(eng_out)
+        ref_kl = 0.0
+        if state["kl_den"] > 0:
+            ref_kl = state["kl_num"] / state["kl_den"]
+            self._kl().update(ref_kl, n_steps=state["n_seqs"])
+        out.update(
+            task_reward=state["score_sum"] / max(state["score_n"], 1),
+            no_eos_ratio=state["no_eos_sum"] / max(state["no_eos_n"], 1),
+            n_response_tokens=state["resp_tokens"],
+            kl_ctl_value=state["klv"],
+            ref_kl=ref_kl,
+            n_minibatches_skipped=float(state["n_chunks_skipped"]),
         )
         return out
 
@@ -719,9 +889,14 @@ class PPOCriticInterface(ModelInterface):
             )
         return out
 
-    def train_step(
+    def _prepare_train_sample(
         self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
-    ) -> Dict[str, float]:
+    ) -> SequenceSample:
+        """KL-shaped rewards → GAE returns → (optional) value-norm →
+        packed train sample.  Shared by the barrier `train_step` and the
+        streamed `train_stream_chunk`; under streaming the value-norm
+        running moments advance chunk-by-chunk (the streaming form of
+        the running-statistics update)."""
         layout, _ = _extract_layout(sample)
         total = sum(L for (_, L, _) in layout)
         old_logp = _seq_align_minus1(sample, "packed_logprobs")
@@ -815,6 +990,12 @@ class PPOCriticInterface(ModelInterface):
                 "loss_mask": loss_mask,
             },
         )
+        return train_sample
+
+    def train_step(
+        self, model: Model, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        train_sample = self._prepare_train_sample(model, sample, mb_spec)
         loss_fn = self._get_loss_fn()
         all_stats = []
         for mb in train_sample.split_balanced(
@@ -834,6 +1015,64 @@ class PPOCriticInterface(ModelInterface):
         return {
             k: float(np.mean([s[k] for s in all_stats])) for k in all_stats[0]
         }
+
+    # ------------- streamed (pipeline-overlapped) train -------------
+
+    def train_stream_begin(
+        self, model: Model, mb_spec: MicroBatchSpec
+    ) -> Dict:
+        return {
+            "engine": model.engine.train_stream_begin(),
+            "chunk_stats": [],
+        }
+
+    def train_stream_chunk(
+        self,
+        model: Model,
+        state: Dict,
+        sample: SequenceSample,
+        mb_spec: MicroBatchSpec,
+    ) -> Dict[str, float]:
+        if sample.shard_blocks() is not None:
+            raise ValueError(
+                "pipeline overlap does not compose with shard-exact "
+                "dispatch; chunk inputs must be broadcast"
+            )
+        train_sample = self._prepare_train_sample(model, sample, mb_spec)
+        raw = model.engine.train_stream_chunk(
+            state["engine"],
+            train_sample,
+            mb_spec,
+            loss_fn=self._get_loss_fn(),
+            loss_weight_fn=_mask_count,
+            token_key="packed_input_ids",
+            extra_keys=("old_values", "returns", "loss_mask"),
+            version_steps=model.version,
+        )
+        w = max(raw.pop("chunk_weight"), 1.0)
+        loss_sum = raw.pop("chunk_loss_sum")
+        raw.pop("chunk_micro_batches", None)
+        stats: Dict[str, float] = {
+            "loss": loss_sum / w,
+            "loss_denominator": w,
+        }
+        for k, v in raw.items():
+            base = k[: -len("_sum")] if k.endswith("_sum") else k
+            stats[base] = v / w
+            stats[base + "_denominator"] = w
+        state["chunk_stats"].append(stats)
+        return stats
+
+    def train_stream_end(
+        self, model: Model, state: Dict, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        eng_out = model.engine.train_stream_end(state["engine"])
+        model.inc_version()
+        out = (
+            merge_stats(state["chunk_stats"]) if state["chunk_stats"] else {}
+        )
+        out.update(eng_out)
+        return out
 
     _loss_fn_cache = None
 

@@ -116,6 +116,20 @@ class MasterWorker:
         # Evict SequenceBuffer entries older than this many steps (async
         # stragglers from long-dead batches); None = keep forever.
         buffer_max_age_steps: Optional[int] = None,
+        # Pipeline-overlapped PPO (ROADMAP item 3; OPPO, arxiv
+        # 2509.25762): stream the step's batch through the graph in
+        # rollout chunks so ref/reward inference and train grad
+        # accumulation run on retired chunks WHILE later chunks still
+        # decode.  overlap_window bounds in-flight chunks (1 = overlap
+        # off: the whole batch flows through the unchanged barrier node
+        # path — bit-exact with pipeline_overlap=False);
+        # pipeline_chunk_seqs sets prompts per chunk.  Mutually
+        # exclusive with rollout_ahead / max_head_offpolicyness (those
+        # overlap ACROSS steps; this overlaps WITHIN one on-policy
+        # step).
+        pipeline_overlap: bool = False,
+        overlap_window: int = 2,
+        pipeline_chunk_seqs: int = 1,
     ):
         self.dfg = dfg
         self.pool = pool
@@ -173,6 +187,23 @@ class MasterWorker:
             "last step's achieved TFLOP/s, per MFC",
             ("mfc",),
         )
+        # Pipeline-overlap attribution: per-stage busy fraction of the
+        # streamed step window and the idle gap between a stage's first
+        # and last chunk (the bubble the overlap is meant to shrink).
+        self._m_pipe_fill = reg.gauge(
+            "areal_master_pipeline_fill_ratio",
+            "last streamed step: stage busy seconds / step window",
+            ("stage",),
+        )
+        self._m_pipe_bubble = reg.gauge(
+            "areal_master_pipeline_bubble_seconds",
+            "last streamed step: stage idle seconds inside its active span",
+            ("stage",),
+        )
+        self._m_pipe_chunks = reg.counter(
+            "areal_master_pipeline_chunks_total",
+            "rollout chunks streamed through the pipelined step path",
+        )
         # Span tracing (AREAL_TRACE): resolve the trial's shared shard dir
         # before claiming this process's identity so in-process workers
         # and the master write one coherent shard set.
@@ -206,6 +237,26 @@ class MasterWorker:
                 raise ValueError(
                     "max_head_offpolicyness must be >= 0, got "
                     f"{self.max_head_offpolicyness}"
+                )
+        self.pipeline_overlap = bool(pipeline_overlap)
+        self.overlap_window = int(overlap_window)
+        self.pipeline_chunk_seqs = int(pipeline_chunk_seqs)
+        if self.pipeline_overlap:
+            if self.overlap_window < 1:
+                raise ValueError(
+                    f"overlap_window must be >= 1, got {overlap_window}"
+                )
+            if self.pipeline_chunk_seqs < 1:
+                raise ValueError(
+                    "pipeline_chunk_seqs must be >= 1, got "
+                    f"{pipeline_chunk_seqs}"
+                )
+            if rollout_ahead or self._async_rl:
+                raise ValueError(
+                    "pipeline_overlap is mutually exclusive with "
+                    "rollout_ahead / max_head_offpolicyness: those overlap "
+                    "generation ACROSS steps, pipeline overlap streams "
+                    "WITHIN one on-policy step"
                 )
         self._async_K = self.max_head_offpolicyness + 1
         self._replay_dropped: List[Trajectory] = []
@@ -357,6 +408,8 @@ class MasterWorker:
             await self._execute_step_async_rl(results)
         elif self.rollout_ahead > 0 and self._source_nodes:
             await self._execute_step_async(results)
+        elif self.pipeline_overlap and self._source_nodes:
+            await self._execute_step_streamed(results)
         else:
             coros = [self._load_data()]
             for node in self.dfg.nodes:
@@ -538,6 +591,226 @@ class MasterWorker:
             "dropped_stale": float(wm["dropped_stale"]),
             "evicted": float(wm["evicted"]),
         }
+
+    # ---------------- pipeline-overlapped step (streamed) ----------------
+
+    async def _execute_step_streamed(self, results: Dict) -> None:
+        """One step as a group-granular dataflow (ROADMAP item 3; OPPO,
+        arxiv 2509.25762; Podracer's streamed actor→learner handoff,
+        arxiv 2104.06272).
+
+        The batch is split into chunks of `pipeline_chunk_seqs` prompts.
+        Each chunk flows through the graph in topological order — gen,
+        then ref/reward inference, then TRAIN grad accumulation — as one
+        asyncio task, with `overlap_window` chunks in flight: chunk i's
+        ref/reward/train stages run while chunk i+1 is still decoding.
+        Per-node asyncio locks serialize same-engine calls (the
+        in-process workers have no internal locking), so the pipeline is
+        a classic stage pipeline: stages overlap ACROSS chunks, never
+        within one engine.  TRAIN nodes use the worker's
+        mfc_stream_begin/chunk/end protocol: grads accumulate into the
+        engine's donated sum per chunk and the single optimizer step
+        fires after the last chunk (engines/train.py streamed entry
+        point).
+
+        overlap_window=1 is the bit-exactness gate: the whole batch runs
+        through the UNCHANGED per-node `_run_mfc` path (the same code the
+        barrier executor gathers), sequentially in topological order —
+        identical payloads, identical numerics, while still emitting the
+        `pipe:*` spans and `pipeline/*` stats for A/B attribution.
+
+        Requires donation_safe_swap on colocated generators (validated
+        in experiments/check.py): later chunks decode while earlier
+        chunks accumulate grads, so the generator must not alias buffers
+        the optimizer step donates.  DP replica splitting and
+        shard-exact shipping fall back to primary-group broadcast here
+        (chunks are small; shard planning needs whole-batch metadata).
+        """
+        t_step0 = time.monotonic()
+        ids = await self._load_data()
+        order = [n for lvl in self.dfg.topological_order() for n in lvl]
+        spans: Dict[str, List[Tuple[float, float]]] = {
+            n.name: [] for n in order
+        }
+
+        if self.overlap_window <= 1:
+            for node in order:
+                t0 = time.monotonic()
+                with tracer.span(
+                    f"pipe:{node.name}", stage=node.name, chunk=0,
+                    n=len(ids),
+                ):
+                    await self._run_mfc(node, results)
+                spans[node.name].append((t0, time.monotonic()))
+            self._m_pipe_chunks.inc()
+            self._emit_pipeline_stats(results, spans, t_step0, 1)
+            return
+
+        k = self.pipeline_chunk_seqs
+        chunks = [ids[i : i + k] for i in range(0, len(ids), k)]
+        sem = asyncio.Semaphore(self.overlap_window)
+        locks: Dict[str, asyncio.Lock] = {
+            n.name: asyncio.Lock() for n in order
+        }
+        started: set = set()
+        node_stats: Dict[str, List[Dict]] = {n.name: [] for n in order}
+
+        async def run_chunk(ci: int, chunk_ids: List[str]) -> None:
+            async with sem:
+                for node in order:
+                    group = self._group(str(node.model_name))
+                    is_train = (
+                        node.interface_type == ModelInterfaceType.TRAIN_STEP
+                    )
+                    async with locks[node.name]:
+                        if node.name not in started:
+                            started.add(node.name)
+                            for hook in node.pre_hooks:
+                                await self._run_hook(hook, node, group)
+                            if is_train:
+                                await self._release_aliased_generators(node)
+                                await asyncio.gather(
+                                    *[
+                                        self.pool.request(
+                                            w,
+                                            {
+                                                "type": "mfc_stream_begin",
+                                                "model_name": str(
+                                                    node.model_name
+                                                ),
+                                                "mb_spec": node.mb_spec,
+                                            },
+                                        )
+                                        for w in group
+                                    ]
+                                )
+                        t0 = time.monotonic()
+                        with tracer.span(
+                            f"pipe:{node.name}", stage=node.name,
+                            chunk=ci, n=len(chunk_ids),
+                        ):
+                            if is_train:
+                                await asyncio.gather(
+                                    *[
+                                        self._ensure_data(node, chunk_ids, w)
+                                        for w in group
+                                    ]
+                                )
+                                payload = {
+                                    "type": "mfc_stream_chunk",
+                                    "model_name": str(node.model_name),
+                                    "ids": chunk_ids,
+                                    "input_keys": list(node.input_keys),
+                                    "input_key_remap": dict(
+                                        node.input_key_remap
+                                    ),
+                                    "mb_spec": node.mb_spec,
+                                }
+                                resps = await asyncio.gather(
+                                    *[
+                                        self.pool.request(w, payload)
+                                        for w in group
+                                    ]
+                                )
+                                node_stats[node.name].append(
+                                    resps[0].get("stats") or {}
+                                )
+                            else:
+                                resp = await self._dispatch_mfc(
+                                    node, chunk_ids, group
+                                )
+                                node_stats[node.name].append(
+                                    resp.get("stats") or {}
+                                )
+                        spans[node.name].append((t0, time.monotonic()))
+            self._m_pipe_chunks.inc()
+
+        await asyncio.gather(
+            *[run_chunk(ci, c) for ci, c in enumerate(chunks)]
+        )
+
+        # Close the train streams (the one scaled optimizer step each),
+        # then post-hooks in graph order — weight syncs fire exactly once
+        # per step, after the full grad sum, as on the barrier path.
+        for node in order:
+            group = self._group(str(node.model_name))
+            if node.interface_type == ModelInterfaceType.TRAIN_STEP:
+                t0 = time.monotonic()
+                with tracer.span(
+                    f"pipe:{node.name}", stage=node.name, chunk=-1,
+                    apply=True,
+                ):
+                    resps = await asyncio.gather(
+                        *[
+                            self.pool.request(
+                                w,
+                                {
+                                    "type": "mfc_stream_end",
+                                    "model_name": str(node.model_name),
+                                    "mb_spec": node.mb_spec,
+                                },
+                            )
+                            for w in group
+                        ]
+                    )
+                spans[node.name].append((t0, time.monotonic()))
+                results[node.name] = resps[0].get("stats") or {}
+                replicas = self.replicas.get(str(node.model_name))
+                if replicas and len(replicas) > 1:
+                    await self._sync_interface_state(
+                        str(node.model_name), group[0], replicas
+                    )
+            else:
+                results[node.name] = merge_stats(node_stats[node.name])
+            for hook in node.post_hooks:
+                await self._run_hook(hook, node, group)
+
+        # Streamed dispatch bypassed get_batch_for_rpc; take each node's
+        # batch now (all keys are resident, so this returns immediately)
+        # purely to mark consumption so the ledger can evict the step's
+        # entries — otherwise the buffer grows without bound.
+        for node in order:
+            await self.buffer.get_batch_for_rpc(node, timeout=60)
+        self._emit_pipeline_stats(results, spans, t_step0, len(chunks))
+
+    def _emit_pipeline_stats(
+        self,
+        results: Dict,
+        spans: Dict[str, List[Tuple[float, float]]],
+        t0: float,
+        n_chunks: int,
+    ) -> None:
+        """Fill/bubble attribution for the streamed step: per stage,
+        busy = union of its chunk dispatch intervals; fill = busy /
+        step window; bubble = idle gaps BETWEEN the stage's first and
+        last chunk (the inter-chunk stall the overlap should shrink)."""
+        window = max(time.monotonic() - t0, 1e-9)
+        pipe: Dict[str, float] = {
+            "n_chunks": float(n_chunks),
+            "window": float(self.overlap_window),
+            "step_window_s": window,
+        }
+        for name, ivals in spans.items():
+            if not ivals:
+                continue
+            ivals = sorted(ivals)
+            busy = 0.0
+            cur_a, cur_b = ivals[0]
+            for a, b in ivals[1:]:
+                if a > cur_b:
+                    busy += cur_b - cur_a
+                    cur_a, cur_b = a, b
+                else:
+                    cur_b = max(cur_b, b)
+            busy += cur_b - cur_a
+            span = ivals[-1][1] - ivals[0][0]
+            fill = busy / window
+            bubble = max(span - busy, 0.0)
+            pipe[f"fill_{name}"] = fill
+            pipe[f"bubble_s_{name}"] = bubble
+            self._m_pipe_fill.labels(name).set(fill)
+            self._m_pipe_bubble.labels(name).set(bubble)
+        results["pipeline"] = pipe
 
     async def _flush_replay_drops(self) -> None:
         """Purge the ledger entries of batches the replay buffer discarded

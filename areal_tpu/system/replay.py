@@ -68,6 +68,12 @@ class Trajectory:
     version_start: int = 0  # weight version when sampling STARTED (head)
     version_end: int = 0  # weight version when sampling finished
     birth_time: float = 0.0
+    # Trainer weight version at the moment the buffer handed this group
+    # to the trainer (stamped by get_batch / stream).  -1 = not yet
+    # retired.  retired_version - version_start is the staleness the
+    # trainer actually trained on — per-group, so a pipelined step that
+    # retires groups one at a time still gets exact attribution.
+    retired_version: int = -1
     # Arbitrary payload (e.g. the reward row, or a prebuilt
     # SequenceSample) — the buffer never inspects it.
     data: Any = None
@@ -154,8 +160,10 @@ class ReplayBuffer:
                     self.consumed += n
                     _M_EVENTS.labels("consumed").inc(n)
                     for t in out:
-                        # Staleness the trainer actually trains on — the
-                        # distribution the staleness_p99 SLO watches.
+                        # Per-group retirement stamp + the staleness the
+                        # trainer actually trains on — the distribution
+                        # the staleness_p99 SLO watches.
+                        t.retired_version = self._version
                         _M_STALENESS.observe(t.staleness(self._version))
                     self._emit_gauges_locked()
                     return out
@@ -169,6 +177,37 @@ class ReplayBuffer:
                     self._cond.wait(timeout=remaining)
                 else:
                     self._cond.wait(timeout=1.0)
+
+    def get_group(self, timeout: Optional[float] = None) -> Trajectory:
+        """Retire the single oldest admissible group (one accepted
+        Trajectory IS one GRPO group — group sampling happens server-side
+        via ``gconfig.n``).  The group-granular complement of
+        :meth:`get_batch`: the pipelined trainer pulls groups one at a
+        time and starts ref/reward inference on each while later groups
+        are still decoding, instead of blocking for a whole batch.  The
+        returned trajectory carries ``retired_version`` so per-group
+        staleness is exact even when the trainer version advances
+        mid-step."""
+        return self.get_batch(1, timeout=timeout)[0]
+
+    def stream(
+        self,
+        n_groups: Optional[int] = None,
+        timeout_per_group: Optional[float] = None,
+    ):
+        """Generator of retired groups in FIFO retirement order.
+
+        Yields ``n_groups`` trajectories (or forever when None), each
+        stamped with ``retired_version`` at the moment it left the
+        buffer.  Blocking happens per group — the caller overlaps work
+        on yielded groups with the rollout plane still filling the
+        buffer.  Raises TimeoutError if any single group takes longer
+        than ``timeout_per_group`` to become admissible.
+        """
+        yielded = 0
+        while n_groups is None or yielded < n_groups:
+            yield self.get_group(timeout=timeout_per_group)
+            yielded += 1
 
     # ---------------- rollout side ----------------
 

@@ -488,6 +488,58 @@ class RolloutController:
             await asyncio.gather(*tasks)
         return self.stat
 
+    async def completed_groups(
+        self,
+        n_groups: Optional[int] = None,
+        timeout_per_group: Optional[float] = None,
+        poll_s: float = 0.2,
+    ):
+        """Async iterator over retired GRPO groups, in retirement order.
+
+        The streaming complement of ``replay.get_batch(batch_size)``:
+        instead of parking until a whole stamped batch is resident, the
+        consumer receives each finished group (one accepted Trajectory =
+        one prompt's ``gconfig.n`` responses) as soon as the buffer
+        retires it, stamped with ``retired_version`` for per-group
+        staleness attribution.  This is the handoff the
+        pipeline-overlapped trainer builds on: ref/reward inference for
+        group *k* proceeds while groups *k+1..* are still decoding.
+
+        Blocking waits run in a worker thread in short ``poll_s`` slices
+        so ``stop()`` is honored promptly (the iterator then ends);
+        ``timeout_per_group`` bounds how long any single group may take
+        to retire (TimeoutError).  Yields forever when ``n_groups`` is
+        None — pair with ``stop()`` or an explicit count.
+        """
+        yielded = 0
+        while not self._stop and (n_groups is None or yielded < n_groups):
+            deadline = (
+                None
+                if timeout_per_group is None
+                else time.monotonic() + timeout_per_group
+            )
+            while True:
+                if self._stop:
+                    return
+                wait = poll_s
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"completed_groups: waited {timeout_per_group}s "
+                            "for the next admissible group"
+                        )
+                    wait = min(wait, remaining)
+                try:
+                    batch = await asyncio.to_thread(
+                        self.replay.get_batch, 1, wait
+                    )
+                except TimeoutError:
+                    continue  # poll slice expired; re-check stop/deadline
+                break
+            yield batch[0]
+            yielded += 1
+
     async def _generate_with_retries(self, qid: str, prompt_ids: List[int]):
         """Dispatch with deadline + bounded redispatch.  Each failure
         excludes the observed-failing server for this prompt, records a

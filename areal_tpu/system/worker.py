@@ -175,6 +175,9 @@ class ModelWorker:
         self.models: Dict[str, Model] = {}
         self.interfaces: Dict[str, Any] = {}
         self.data_cache: Dict[str, SequenceSample] = {}
+        # Open pipeline-overlapped train streams, keyed by model name
+        # (mfc_stream_begin -> N x mfc_stream_chunk -> mfc_stream_end).
+        self._streams: Dict[str, Dict[str, Any]] = {}
         self.datasets = []
         self.dataloaders = []
         # Per-phase wall-clock marks, drained into each MFC's stats reply
@@ -342,23 +345,12 @@ class ModelWorker:
         rank, n = engine.data_shard_info()
         return {"rank": int(rank), "n": int(n)}
 
-    def _handle_mfc(self, req):
-        """Execute one model function call on cached data."""
-        model_key: str = req["model_name"]
-        itype = ModelInterfaceType(req["interface_type"])
-        ids: List[str] = req["ids"]
-        input_keys = set(req["input_keys"])
-        remap_in: Dict[str, str] = req.get("input_key_remap", {})
-        remap_out: Dict[str, str] = req.get("output_key_remap", {})
-        mb_spec: MicroBatchSpec = req.get("mb_spec") or MicroBatchSpec()
-        # Sharded dispatch: heavy keys arrived only for this member's own
-        # rows; other rows' arrays are zero-filled from metadata (their
-        # real values live on the processes whose devices consume them —
-        # identical PACK layout everywhere, local VALUES only where they
-        # land; see api/dfg.py MFCDef.shard_keys).
-        shard_of: Dict[str, list] = req.get("shard_of") or {}
-        shard_meta = req.get("shard_meta")
-
+    def _assemble_sample(
+        self, ids, input_keys, shard_of, shard_meta, remap_in
+    ) -> SequenceSample:
+        """Gather the per-id cache entries for an MFC into one packed
+        sample (zero-filling other members' rows under sharded
+        dispatch), tag shard_of metadata, and apply the input remap."""
         parts = []
         for idx, sid in enumerate(ids):
             entry = self.data_cache.get(sid)
@@ -398,6 +390,27 @@ class ModelWorker:
                 list(shard_of[sid]) for sid in ids
             ]
         sample.remap_keys_(remap_in)
+        return sample
+
+    def _handle_mfc(self, req):
+        """Execute one model function call on cached data."""
+        model_key: str = req["model_name"]
+        itype = ModelInterfaceType(req["interface_type"])
+        ids: List[str] = req["ids"]
+        remap_out: Dict[str, str] = req.get("output_key_remap", {})
+        mb_spec: MicroBatchSpec = req.get("mb_spec") or MicroBatchSpec()
+        # Sharded dispatch: heavy keys arrived only for this member's own
+        # rows; other rows' arrays are zero-filled from metadata (their
+        # real values live on the processes whose devices consume them —
+        # identical PACK layout everywhere, local VALUES only where they
+        # land; see api/dfg.py MFCDef.shard_keys).
+        sample = self._assemble_sample(
+            ids,
+            set(req["input_keys"]),
+            req.get("shard_of") or {},
+            req.get("shard_meta"),
+            req.get("input_key_remap", {}),
+        )
 
         model = self.models[model_key]
         interface = self.interfaces[model_key]
@@ -462,6 +475,119 @@ class ModelWorker:
                 else:
                     self.data_cache[sid] = one
             return {"meta": out_sample.meta(), "stats": perf}
+        return {"meta": None, "stats": {**dict(result or {}), **perf}}
+
+    # ------------- pipeline-overlapped train stream -------------
+    #
+    # The master's streamed executor feeds TRAIN nodes one retired
+    # rollout chunk at a time: mfc_stream_begin opens interface+engine
+    # stream state, each mfc_stream_chunk computes that chunk's
+    # advantages and accumulates grads (no optimizer step), and
+    # mfc_stream_end fires the single scaled optimizer step and returns
+    # the merged step stats.  Perf accounting sums the chunks' active
+    # seconds (not begin→end wall, which includes master-paced gaps
+    # while later chunks decode).
+
+    def _handle_mfc_stream_begin(self, req):
+        model_key: str = req["model_name"]
+        if model_key in self._streams:
+            raise RuntimeError(
+                f"worker {self.config.worker_index}: train stream for "
+                f"{model_key!r} already open"
+            )
+        model = self.models[model_key]
+        interface = self.interfaces[model_key]
+        mb_spec: MicroBatchSpec = req.get("mb_spec") or MicroBatchSpec()
+        self._streams[model_key] = {
+            "state": interface.train_stream_begin(model, mb_spec),
+            "busy_s": 0.0,
+            "tokens": 0,
+            "sum_sq": 0.0,
+            "n_chunks": 0,
+        }
+        return {"meta": None, "stats": {}}
+
+    def _handle_mfc_stream_chunk(self, req):
+        model_key: str = req["model_name"]
+        st = self._streams[model_key]
+        model = self.models[model_key]
+        interface = self.interfaces[model_key]
+        mb_spec: MicroBatchSpec = req.get("mb_spec") or MicroBatchSpec()
+        sample = self._assemble_sample(
+            req["ids"],
+            set(req["input_keys"]),
+            req.get("shard_of") or {},
+            req.get("shard_meta"),
+            req.get("input_key_remap", {}),
+        )
+        with tracer.span(
+            f"mfc:{model_key}:train_chunk", cat="compute"
+        ) as targs:
+            with self.timers.record("mfc_train_chunk"):
+                t0 = time.monotonic()
+                stats = interface.train_stream_chunk(
+                    model, st["state"], sample, mb_spec
+                )
+                seconds = time.monotonic() - t0
+        st["busy_s"] += seconds
+        st["n_chunks"] += 1
+        key0 = next(iter(sample.keys))
+        lens = [sum(s) for s in sample.seqlens[key0]]
+        st["tokens"] += int(sum(lens))
+        st["sum_sq"] += float(sum(l * l for l in lens))
+        if tracer.enabled():
+            targs["mfc"] = f"{model_key}:train_chunk"
+            targs["tokens"] = int(sum(lens))
+            targs["chunk"] = st["n_chunks"] - 1
+        self._m_mfc_tokens.labels(f"{model_key}:train_chunk").inc(
+            int(sum(lens))
+        )
+        return {"meta": None, "stats": dict(stats)}
+
+    def _handle_mfc_stream_end(self, req):
+        from areal_tpu.base import monitor
+
+        model_key: str = req["model_name"]
+        st = self._streams.pop(model_key)
+        model = self.models[model_key]
+        interface = self.interfaces[model_key]
+        mb_spec: MicroBatchSpec = req.get("mb_spec") or MicroBatchSpec()
+        with tracer.span(
+            f"mfc:{model_key}:train_step", cat="compute"
+        ) as targs:
+            with self.timers.record("mfc_train_step"):
+                t0 = time.monotonic()
+                result = interface.train_stream_end(
+                    model, st["state"], mb_spec
+                )
+                seconds = time.monotonic() - t0
+        busy = st["busy_s"] + seconds
+        perf = {"perf/time_s": busy}
+        try:
+            cfg = model.config
+            if cfg is not None and st["tokens"]:
+                flops = monitor.flops_train(cfg, st["tokens"], st["sum_sq"])
+                perf["perf/tflops"] = flops / 1e12
+                n_dev = (
+                    model.engine.mesh.devices.size
+                    if getattr(model.engine, "mesh", None) is not None
+                    else 0
+                )
+                u = monitor.mfu(flops, busy, n_dev)
+                if u is not None:
+                    perf["perf/mfu"] = u
+        except Exception as e:  # perf accounting must never fail the MFC
+            logger.warning(f"perf accounting failed: {e!r}")
+        perf.update(self.timers.drain())
+        mfc_label = f"{model_key}:train_step"
+        self._m_mfc_seconds.labels(mfc_label).observe(busy)
+        if "perf/mfu" in perf:
+            self._m_mfc_mfu.labels(mfc_label).set(perf["perf/mfu"])
+        if tracer.enabled():
+            targs["mfc"] = mfc_label
+            targs["stream_chunks"] = st["n_chunks"]
+            if "perf/mfu" in perf:
+                targs["mfu"] = perf["perf/mfu"]
         return {"meta": None, "stats": {**dict(result or {}), **perf}}
 
     def _mfc_perf(

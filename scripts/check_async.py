@@ -28,6 +28,22 @@ explicitly failed — and none failed), the staleness bound holding, the
 dead server's circuit breaker opening then re-closing after a restart
 on the same port, and at least one redispatched prompt.
 
+Part 4 (`--overlap`) is the pipeline-overlapped PPO leg: the same tiny
+PPO trial run four ways — barrier, `pipeline_overlap` with
+overlap_window=1 (serial streamed semantics, traced), overlap_window=3
+with 2-seq chunks (traced), and a short overlapped run for compile
+accounting.  The reward interface carries a small per-call latency
+(modeling a remote verifier RPC) so the pipeline has real idle to
+hide.  Asserted: window=1 reproduces the barrier scheduler's stats and
+final weights bit for bit; the overlapped steady-state step is faster
+than the barrier's; the per-stage idle (window - busy, from the merged
+trace via trace_report.pipeline_rows) shrinks; overlap_frac is zero
+serial and positive overlapped; and jit trace/compile counters are
+identical between the 2-step and 4-step overlapped runs (no per-step
+retrace churn from streaming).  `--bench-out` additionally writes the
+bench JSONL consumed by scripts/check_regression.py
+(bench_overlap_cpu8_*.json).
+
 Exit 0 iff every check passes.  CI-friendly: CPU-only, tiny random
 model, a few minutes end to end.
 """
@@ -559,6 +575,349 @@ def check_chaos(n_prompts: int = 40, kill_after_s: float = 2.5) -> int:
     return len(failures)
 
 
+def check_overlap(fileroot: str, bench_out: str = None) -> int:
+    """Pipeline-overlapped PPO leg: barrier vs streamed executor A/B
+    with a latency-bearing reward, trace-level stall attribution, and
+    compile-flatness accounting (see module docstring, Part 4)."""
+    import dataclasses
+    import json
+
+    import jax
+    import numpy as np
+
+    from areal_tpu.api.config import (
+        ModelAbstraction,
+        ModelInterfaceAbstraction,
+    )
+    from areal_tpu.api.data_api import DatasetAbstraction
+    from areal_tpu.api.model_api import (
+        GenerationHyperparameters,
+        OptimizerConfig,
+        register_interface,
+    )
+    from areal_tpu.apps import trace_report
+    from areal_tpu.base import tracer
+    from areal_tpu.experiments.common import (
+        PPOMathConfig,
+        build_ppo_math,
+        run_experiment,
+    )
+    from areal_tpu.interfaces.reward import MultiTaskRewardInterface
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.system.master import ExperimentSaveEvalControl
+    from tests import fixtures
+
+    REWARD_LATENCY_S_PER_SEQ = 0.03
+    GROUP_N = 2
+    MAX_NEW_TOKENS = 64
+
+    @dataclasses.dataclass
+    class OverlapCheckReward(MultiTaskRewardInterface):
+        """Rewards that vary within a group (a tiny random actor gets
+        every answer wrong, and GRPO's group normalization would zero
+        all-equal scores — making every numerics assertion vacuous) and
+        carry a per-sequence latency modeling a remote verifier: the
+        serial idle the overlapped executor exists to hide.  Per
+        sequence, not per call, so the barrier (one call for the whole
+        batch) and the pipeline (one call per chunk) pay the same total
+        — the A/B measures scheduling, not a penalty for chunking."""
+
+        latency_s: float = 0.0
+
+        def inference(self, model, sample, mb_spec):
+            lens = [
+                l
+                for row in sample.seqlens["packed_input_ids"]
+                for l in row
+            ]
+            if self.latency_s:
+                time.sleep(self.latency_s * len(lens))
+            out = super().inference(model, sample, mb_spec)
+            data = np.asarray(sample.data["packed_input_ids"])
+            scores, off = [], 0
+            for L in lens:
+                scores.append(
+                    float(int(np.sum(data[off:off + L])) % 7) - 3.0
+                )
+                off += L
+            out.data["rewards"] = np.asarray(scores, np.float32)
+            return out
+
+    try:
+        register_interface("overlap-check-rw", OverlapCheckReward)
+    except ValueError:
+        pass  # second in-process invocation
+
+    tok = fixtures.make_tokenizer()
+    rows_long = fixtures.build_math_rows(48, seed=7)  # 6 steps
+    rows_short = fixtures.build_math_rows(16, seed=7)  # 2 steps
+
+    def make(sub, rows, **kw):
+        return PPOMathConfig(
+            actor=ModelAbstraction("random", {"config": tiny_config()}),
+            dataset=DatasetAbstraction(
+                "math_code_prompt",
+                {"dataset_builder": lambda: rows, "max_length": 64},
+            ),
+            reward_interface=ModelInterfaceAbstraction(
+                "overlap-check-rw",
+                {
+                    "id2info": {r["query_id"]: r for r in rows},
+                    "latency_s": REWARD_LATENCY_S_PER_SEQ,
+                },
+            ),
+            gconfig=GenerationHyperparameters(
+                n=GROUP_N, max_new_tokens=MAX_NEW_TOKENS
+            ),
+            ppo_kwargs={"n_minibatches": 1, "kl_ctl": 0.0},
+            optimizer=OptimizerConfig(
+                lr=5e-3, warmup_steps_proportion=0.0
+            ),
+            batch_size=8,
+            total_train_epochs=1,
+            seed=1,
+            ctrl=ExperimentSaveEvalControl(),
+            fileroot=os.path.join(fileroot, sub),
+            **kw,
+        )
+
+    def run(tag, rows, trace_dir=None, **kw):
+        # Force-reconfigure the process-global tracer per leg so each
+        # leg's pipe/step spans land in their own shard dir (the
+        # master's own non-force configure then no-ops).
+        tracer.configure(
+            role="overlap_check",
+            rank=0,
+            dir=trace_dir,
+            enabled=trace_dir is not None,
+            force=True,
+        )
+        m, stats = run_experiment(
+            build_ppo_math(make(tag, rows, **kw), tok), tokenizer=tok
+        )
+        trace = None
+        if trace_dir is not None:
+            tracer.flush()
+            trace = tracer.merge_shards(
+                trace_dir, out_path=os.path.join(trace_dir, "trace.json")
+            )
+        os.environ.pop("AREAL_TRACE_DIR", None)
+        return m, stats, trace
+
+    def compile_counts(m):
+        """Jit-trace surface of a finished trial: generator decode
+        compiles plus the train engine's traced-variant count (grad,
+        grad-acc, apply, scaled-apply caches).  Equal counts between a
+        2-step and a 4-step overlapped run == no per-step retrace."""
+        out = {}
+        for key, model in m.pool.workers[0].models.items():
+            eng = model.engine
+            if hasattr(eng, "decode_compiles"):
+                out["decode_compiles"] = eng.decode_compiles
+            if hasattr(eng, "_grad_fns"):
+                n = 0
+                for gf, gaf in eng._grad_fns.values():
+                    n += gf._cache_size() + gaf._cache_size()
+                for fn in (eng._apply_fn, eng._scaled_apply_fn):
+                    if fn is not None:
+                        n += fn._cache_size()
+                out["train_traces"] = n
+        return out
+
+    failures = []
+
+    m_bar, s_bar, _ = run("barrier", rows_long)
+    m_ser, s_ser, tr_ser = run(
+        "serial",
+        rows_long,
+        trace_dir=os.path.join(fileroot, "trace_serial"),
+        pipeline_overlap=True,
+        overlap_window=1,
+    )
+    m_ovl, s_ovl, tr_ovl = run(
+        "overlap",
+        rows_long,
+        trace_dir=os.path.join(fileroot, "trace_overlap"),
+        pipeline_overlap=True,
+        overlap_window=3,
+        pipeline_chunk_seqs=2,
+    )
+    m_short, s_short, _ = run(
+        "overlap_short",
+        rows_short,
+        pipeline_overlap=True,
+        overlap_window=3,
+        pipeline_chunk_seqs=2,
+    )
+
+    # --- window=1 must reproduce the barrier scheduler bit for bit ---
+    keys = (
+        "actor_train/loss", "actor_train/actor_loss",
+        "actor_train/approx_kl", "actor_train/importance_weight",
+        "actor_train/grad_norm", "actor_train/task_reward",
+    )
+    for t, (a, b) in enumerate(zip(s_bar, s_ser)):
+        for k in keys:
+            if a[k] != b[k]:
+                failures.append(
+                    f"window=1 diverged from barrier at step {t}: {k} "
+                    f"{a[k]} != {b[k]}"
+                )
+    if not any(s["actor_train/grad_norm"] > 0 for s in s_bar):
+        failures.append(
+            "degenerate check: every barrier grad_norm is zero"
+        )
+    pa = m_bar.pool.workers[0].models["actor@0"].engine.get_params()
+    pb = m_ser.pool.workers[0].models["actor@0"].engine.get_params()
+    diff = max(
+        float(
+            np.abs(
+                np.asarray(x, np.float32) - np.asarray(y, np.float32)
+            ).max()
+        )
+        for x, y in zip(jax.tree.leaves(pa), jax.tree.leaves(pb))
+    )
+    if diff != 0.0:
+        failures.append(
+            f"window=1 final weights differ from barrier by {diff}"
+        )
+    bit_exact = diff == 0.0 and not any(
+        "diverged" in f for f in failures
+    )
+
+    # --- steady-state wall-clock: overlap must beat the barrier ---
+    # Median, not mean: a single straggler step (a late retrace, a GC
+    # pause) must not flip the gate in either direction.
+    wall_bar = float(np.median([s["time/step_s"] for s in s_bar[2:]]))
+    wall_ovl = float(np.median([s["time/step_s"] for s in s_ovl[2:]]))
+    # The hidden verifier latency alone is worth ~25% of the barrier
+    # step here, so demand a >= 5% win — far above CI timer noise.
+    wall_improved = wall_ovl < 0.95 * wall_bar
+    if not wall_improved:
+        failures.append(
+            f"overlapped steady step ({wall_ovl:.3f}s) is not faster "
+            f"than the barrier's ({wall_bar:.3f}s)"
+        )
+    for s in s_ovl:
+        if not np.isfinite(s["actor_train/loss"]) or not np.isfinite(
+            s["actor_train/grad_norm"]
+        ):
+            failures.append("non-finite stats in the overlapped leg")
+            break
+
+    # --- trace-level stall attribution (the before/after A/B) ---
+    def steady(rows):
+        rows = [r for r in rows if r["step"] is not None]
+        return [r for r in rows if r["step"] >= 3] or rows
+
+    def idle_s(row):
+        # Engine idle during the step: what the overlap exists to
+        # shrink.  Sum over stages of (step window - stage busy).
+        return sum(
+            (row["window_us"] - st["busy_us"]) / 1e6
+            for st in row["stages"]
+        )
+
+    rows_ser = steady(trace_report.pipeline_rows(tr_ser))
+    rows_ovl = steady(trace_report.pipeline_rows(tr_ovl))
+    idle_ser = idle_ovl = ofrac_ser = ofrac_ovl = fill_max = float("nan")
+    if not rows_ser or not rows_ovl:
+        failures.append(
+            "pipe:* spans missing from a traced leg "
+            f"(serial rows={len(rows_ser)}, overlap rows={len(rows_ovl)})"
+        )
+    else:
+        idle_ser = float(np.median([idle_s(r) for r in rows_ser]))
+        idle_ovl = float(np.median([idle_s(r) for r in rows_ovl]))
+        ofrac_ser = float(
+            np.median([r["overlap_frac"] for r in rows_ser])
+        )
+        ofrac_ovl = float(
+            np.median([r["overlap_frac"] for r in rows_ovl])
+        )
+        fill_max = max(
+            st["fill"] for r in rows_ovl for st in r["stages"]
+        )
+        if idle_ovl >= idle_ser:
+            failures.append(
+                f"per-stage idle did not shrink: serial {idle_ser:.3f}s "
+                f"-> overlapped {idle_ovl:.3f}s"
+            )
+        if ofrac_ser > 0.02:
+            failures.append(
+                f"serial leg reports overlap_frac {ofrac_ser:.3f} > 0"
+            )
+        if ofrac_ovl < 0.05:
+            failures.append(
+                f"overlapped leg shows no overlap "
+                f"(overlap_frac {ofrac_ovl:.3f})"
+            )
+
+    # --- compile flatness: 4 overlapped steps trace exactly what 2 do ---
+    cc_long = compile_counts(m_ovl)
+    cc_short = compile_counts(m_short)
+    compiles_flat = cc_long == cc_short
+    if not compiles_flat:
+        failures.append(
+            f"per-step retrace churn under overlap: 4-step counters "
+            f"{cc_long} != 2-step counters {cc_short}"
+        )
+
+    for f in failures:
+        print(f"FAIL[overlap]: {f}")
+    if not failures:
+        print(
+            f"OK[overlap]: window=1 == barrier exactly over "
+            f"{len(s_bar)} steps (max param diff {diff}); steady step "
+            f"{wall_bar:.3f}s -> {wall_ovl:.3f}s "
+            f"({100 * (1 - wall_ovl / wall_bar):.0f}% faster); stage "
+            f"idle {idle_ser:.3f}s -> {idle_ovl:.3f}s; overlap_frac "
+            f"{ofrac_ser:.3f} -> {ofrac_ovl:.3f} (max fill "
+            f"{fill_max:.2f}); compile counters flat {cc_long}"
+        )
+        print()
+        print("--- trace_report --pipeline, window=1 (before) ---")
+        print(trace_report.format_pipeline(tr_ser))
+        print("--- trace_report --pipeline, window=3 (after) ---")
+        print(trace_report.format_pipeline(tr_ovl))
+
+    if bench_out:
+        base = {
+            "devices": len(jax.devices()),
+            "prompts": len(rows_long),
+            "group_n": GROUP_N,
+            "max_new_tokens": MAX_NEW_TOKENS,
+            "reward_latency_s_per_seq": REWARD_LATENCY_S_PER_SEQ,
+            "steps": len(s_bar),
+        }
+        legs = [
+            dict(base, leg="overlap_off", wall_seconds=round(wall_bar, 4)),
+            dict(
+                base,
+                leg="overlap_on",
+                wall_seconds=round(wall_ovl, 4),
+                pipeline_fill_max=round(fill_max, 4),
+                pipeline_idle_seconds=round(idle_ovl, 4),
+                overlap_frac=round(ofrac_ovl, 4),
+                **cc_long,
+            ),
+            {
+                "leg": "overlap_compare",
+                "bit_exact_w1": bool(bit_exact),
+                "wall_improved": bool(wall_improved),
+                "idle_shrunk": bool(idle_ovl < idle_ser),
+                "overlap_frac_positive": bool(ofrac_ovl >= 0.05),
+                "compiles_flat": bool(compiles_flat),
+            },
+        ]
+        with open(bench_out, "w") as f:
+            for row in legs:
+                f.write(json.dumps(row) + "\n")
+        print(f"bench rows -> {bench_out}")
+
+    return len(failures)
+
+
 def main() -> int:
     p = argparse.ArgumentParser(prog="check_async")
     p.add_argument("--prompts", type=int, default=24)
@@ -569,6 +928,13 @@ def main() -> int:
     p.add_argument("--chaos", action="store_true",
                    help="run ONLY the elastic-fleet chaos leg (3 servers, "
                         "one killed mid-decode via AREAL_FAULTS)")
+    p.add_argument("--overlap", action="store_true",
+                   help="run ONLY the pipeline-overlapped PPO leg "
+                        "(barrier vs streamed executor A/B)")
+    p.add_argument("--bench-out", default=None,
+                   help="with --overlap: also write the bench JSONL "
+                        "(bench_overlap_cpu8_<UTC>.json) for "
+                        "check_regression.py")
     args = p.parse_args()
 
     if args.chaos:
@@ -577,6 +943,17 @@ def main() -> int:
             print(f"FAIL: {n_fail} chaos check(s) failed")
             return 1
         print("OK: elastic rollout fleet survived the injected kill")
+        return 0
+
+    if args.overlap:
+        fileroot = args.dir or tempfile.mkdtemp(
+            prefix="areal_tpu_overlap_check_"
+        )
+        n_fail = check_overlap(fileroot, bench_out=args.bench_out)
+        if n_fail:
+            print(f"FAIL: {n_fail} overlap check(s) failed")
+            return 1
+        print("OK: pipeline-overlapped PPO verified against the barrier")
         return 0
 
     fileroot = args.dir or tempfile.mkdtemp(prefix="areal_tpu_async_check_")

@@ -39,7 +39,7 @@ DISCRIMINATORS = ("group_n", "kv_share_prefix", "prompt_len")
 
 # Legs carrying boolean invariants, not perf metrics — every boolean that
 # was true in the baseline must stay true.
-INVARIANT_LEGS = ("compare", "stall_compare")
+INVARIANT_LEGS = ("compare", "stall_compare", "overlap_compare")
 
 
 @dataclasses.dataclass
@@ -60,6 +60,15 @@ RULES: Dict[str, MetricRule] = {
     "prefix_hits": MetricRule("higher", rel_tol=0.0),
     "cow_copies": MetricRule("max", abs_tol=0),
     "admission_prefill_ms": MetricRule("lower", rel_tol=0.50),
+    # Pipeline-overlapped PPO legs (scripts/check_async.py --overlap):
+    # fill and overlap_frac are structural (they move only if the
+    # streamed executor stops overlapping), idle is wall-clock-noisy,
+    # and train_traces growing means a new retrace crept into the
+    # steady-state step.
+    "pipeline_fill_max": MetricRule("higher", rel_tol=0.15),
+    "pipeline_idle_seconds": MetricRule("lower", rel_tol=0.50),
+    "overlap_frac": MetricRule("higher", rel_tol=0.30),
+    "train_traces": MetricRule("max", abs_tol=0),
 }
 
 
@@ -155,7 +164,11 @@ def compare_benches(
 
 
 def default_baselines() -> List[str]:
-    pats = ("bench_paged_cpu8_*.json", "bench_serving_cpu8_*.json")
+    pats = (
+        "bench_paged_cpu8_*.json",
+        "bench_serving_cpu8_*.json",
+        "bench_overlap_cpu8_*.json",
+    )
     out: List[str] = []
     for pat in pats:
         hits = sorted(glob.glob(os.path.join(REPO_ROOT, pat)))
@@ -168,7 +181,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="check_regression")
     p.add_argument("--baseline", action="append", default=[],
                    help="baseline bench JSONL (repeatable; default: newest "
-                        "committed bench_paged/bench_serving files)")
+                        "committed bench_paged/bench_serving/bench_overlap "
+                        "files)")
     p.add_argument("--fresh", action="append", default=[],
                    help="fresh bench JSONL to gate (repeatable)")
     p.add_argument("--self-check", action="store_true",

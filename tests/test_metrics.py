@@ -330,7 +330,7 @@ class TestCheckRegression:
 
 
 class TestTraceReportJSON:
-    def test_v1_schema(self):
+    def test_v2_schema_additive_over_v1(self):
         trace = {
             "traceEvents": [
                 {"ph": "M", "name": "process_name", "pid": 1,
@@ -342,8 +342,9 @@ class TestTraceReportJSON:
             ]
         }
         rep = json_report(trace)
-        assert rep["version"] == 1
-        assert set(rep) == {"version", "rows", "bubbles"}
+        assert rep["version"] == 2
+        assert set(rep) == {"version", "rows", "bubbles", "pipeline"}
+        assert rep["pipeline"] == []  # no pipe:* spans in this trace
         row = rep["rows"][0]
         assert set(row) == {"step", "pid", "process", "window_us",
                             "compute_us", "comms_us", "host_us", "idle_us"}
