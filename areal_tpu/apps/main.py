@@ -45,6 +45,7 @@ def _make_master(plan: ExperimentPlan, pool) -> MasterWorker:
         model_replicas=plan.model_replicas,
         difficulty_filter=plan.difficulty_filter,
         rollout_ahead=plan.rollout_ahead,
+        max_recoveries=plan.max_recoveries,
     )
 
 
@@ -74,7 +75,13 @@ async def _watch_jobs(sched):
 
 
 async def _run_master_zmq(plan: ExperimentPlan, n_workers: int, sched):
-    pool = ZMQWorkerPool(plan.experiment_name, plan.trial_name, n_workers)
+    pool = ZMQWorkerPool(
+        plan.experiment_name,
+        plan.trial_name,
+        n_workers,
+        mfc_timeout_s=plan.mfc_timeout_s,
+        worker_heartbeat_s=plan.worker_heartbeat_s,
+    )
     watchdog = asyncio.get_running_loop().create_task(_watch_jobs(sched))
     try:
         master_task = asyncio.get_running_loop().create_task(
@@ -148,6 +155,10 @@ def run_experiment(
             "PYTHONPATH": pythonpath,
             "AREAL_NAME_RESOLVE": "file",
             "AREAL_NAME_RESOLVE_ROOT": root,
+            # Liveness lane: workers beat this often so the master's MFC
+            # deadline distinguishes slow (alive, still beating) from
+            # dead (no beats past the grace window).
+            "AREAL_WORKER_HEARTBEAT_S": str(plan.worker_heartbeat_s),
         }
         # Trace shards from every process must land in ONE dir; the
         # explicit env dict ships it to schedulers that don't inherit

@@ -38,7 +38,10 @@ weight version across gen servers), ``backpressure`` (rate of
 busiest stage's ``areal_master_pipeline_fill_ratio`` and the summed
 ``areal_master_pipeline_bubble_seconds`` over stages — e.g.
 ``warn: pipeline_fill >= 0.6`` alerts when the overlapped step leaves
-the dominant stage mostly idle), plus any raw unlabeled series name.
+the dominant stage mostly idle), ``ckpt_age`` (seconds since the last
+committed recover checkpoint — ``crit: ckpt_age < 900`` requires a
+crash to lose at most 15 minutes of work), plus any raw unlabeled
+series name.
 
 Exit status: 0 if no CRIT fired over the run, 1 otherwise (``--count``
 bounds the run; without it the poller runs until interrupted).
@@ -314,6 +317,17 @@ def fleet_signals(
     ]
     if bubs:
         signals["pipeline_bubble"] = sum(bubs)
+    # Checkpoint freshness: seconds since the master last committed a
+    # recover checkpoint (the atomic flip stamps
+    # areal_ckpt_last_success_timestamp_seconds).  A rule like
+    # ``crit: ckpt_age < 900`` requires recoverability to stay under 15
+    # minutes of lost work.  Absent until the first flip.
+    ts = [
+        v for n, labels, v in all_samples
+        if n == "areal_ckpt_last_success_timestamp_seconds" and v > 0
+    ]
+    if ts:
+        signals["ckpt_age"] = max(0.0, time.time() - max(ts))
     # Raw unlabeled series become rule-addressable too (last wins on
     # duplicates; labeled series need the computed signals above).
     for n, labels, v in all_samples:

@@ -95,6 +95,18 @@ def _add_common(p: argparse.ArgumentParser):
                    help="spawn workers as subprocesses over ZMQ (default: "
                         "in-process)")
     p.add_argument("--recover-retries", type=int, default=0)
+    p.add_argument("--mfc-timeout-s", type=float, default=None,
+                   help="per-MFC deadline; a worker that misses it AND "
+                        "stops heartbeating is declared dead and the "
+                        "master rolls back to the recover checkpoint "
+                        "(default: no deadline)")
+    p.add_argument("--worker-heartbeat-s", type=float, default=5.0,
+                   help="worker liveness beat period (ZMQ runtime); long "
+                        "MFCs stay alive by beating, so --mfc-timeout-s "
+                        "distinguishes slow from dead")
+    p.add_argument("--max-recoveries", type=int, default=3,
+                   help="worker deaths the master absorbs by restoring "
+                        "the recover checkpoint before exiting non-zero")
     p.add_argument("--eval-data", default=None,
                    help="held-out jsonl; after the trial, every saved "
                         "checkpoint is graded (pass@1) by the automatic "
@@ -219,6 +231,9 @@ def cmd_sft(args):
         experiment_name=args.experiment_name or "sft",
         trial_name=args.trial_name,
         fileroot=args.fileroot,
+        mfc_timeout_s=args.mfc_timeout_s,
+        worker_heartbeat_s=args.worker_heartbeat_s,
+        max_recoveries=args.max_recoveries,
     )
     plan = exps.build_sft(cfg)
     for wc in plan.worker_configs:
@@ -369,6 +384,9 @@ def cmd_ppo_math(args):
         experiment_name=args.experiment_name or "ppo-math",
         trial_name=args.trial_name,
         fileroot=args.fileroot,
+        mfc_timeout_s=args.mfc_timeout_s,
+        worker_heartbeat_s=args.worker_heartbeat_s,
+        max_recoveries=args.max_recoveries,
     )
     plan = exps.build_ppo_math(cfg)
     for wc in plan.worker_configs:

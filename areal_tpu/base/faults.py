@@ -20,7 +20,11 @@ Grammar (commas or whitespace separate faults; ``&`` separates params)::
 Params: ``t`` (arm delay; plain seconds, or with an ``s``/``ms``
 suffix), ``p`` (per-call probability, default 1), ``ms`` (added latency
 for ``slow``), ``point`` (restrict to one injection point, e.g.
-``generate`` or ``health``; default all points).
+``generate`` or ``health``; default all points), ``skip`` (ignore the
+first N matching calls — call-count scoping that, unlike ``t=``, is
+deterministic regardless of timing), ``times`` (fire at most N times,
+0 = unlimited).  ``hang@point=mfc_train_step&skip=2&times=1`` hangs
+exactly the third train MFC, once.
 
 Semantics at a ``fire(point)`` call site:
 
@@ -29,9 +33,13 @@ Semantics at a ``fire(point)`` call site:
   surfaces to the client as an ordinary request failure;
 - ``hang``  — block (p-gated) until :meth:`FaultInjector.release` or the
   ``hang_max_s`` safety cap, simulating a wedged server;
-- ``kill``  — never fires inline; the host process polls
-  :meth:`kill_due` (the gen server arms a timer thread that calls its
-  own ``close()``), simulating preemption of the whole server.
+- ``kill``  — a POINT-SCOPED kill fires inline via
+  :meth:`kill_point` (the host checks it at a named spot — e.g. between
+  a checkpoint stage and its flip — and exits itself, simulating a
+  crash at exactly that boundary); a point-less kill never fires inline
+  — the host polls :meth:`kill_due` (the gen server arms a timer thread
+  that calls its own ``close()``), simulating preemption of the whole
+  server.
 
 Deterministic by default: the probability stream is seeded from
 ``AREAL_FAULTS_SEED`` (default 0) so a chaos leg replays identically.
@@ -79,6 +87,8 @@ class FaultSpec:
     prob: float = 1.0  # p= — per-call firing probability
     latency_s: float = 0.0  # ms= — added latency for `slow`
     point: str = ""  # restrict to one injection point ("" = all)
+    skip: int = 0  # skip= — ignore the first N matching calls
+    times: int = 0  # times= — fire at most N times (0 = unlimited)
 
     def matches(self, point: str, elapsed_s: float) -> bool:
         if elapsed_s < self.arm_after_s:
@@ -113,6 +123,10 @@ def parse_faults(text: str) -> List[FaultSpec]:
                 kw["latency_s"] = float(val) / 1000.0
             elif key == "point":
                 kw["point"] = val
+            elif key in ("skip", "times"):
+                kw[key] = int(val)
+                if kw[key] < 0:
+                    raise ValueError(f"{key} must be >= 0: {raw!r}")
             else:
                 raise ValueError(f"unknown fault param {key!r} in {raw!r}")
         specs.append(FaultSpec(**kw))
@@ -148,6 +162,10 @@ class FaultInjector:
         self._t0 = time.monotonic()
         self.fired = {k: 0 for k in KINDS}
         self._kill_reported = False
+        # spec index -> how many calls have matched it (skip/times
+        # scoping); guarded by _rng_lock (both sit on the same
+        # per-injection-point slow path).
+        self._match_counts = {}
 
     @classmethod
     def parse(cls, text: str, **kw) -> "FaultInjector":
@@ -175,12 +193,25 @@ class FaultInjector:
         if self.on_fire is not None:
             self.on_fire(kind)
 
+    def _count_gate(self, idx: int, spec: FaultSpec) -> bool:
+        """Advance the spec's matching-call counter and apply skip/times:
+        the spec is eligible on call numbers (skip, skip + times]."""
+        with self._rng_lock:
+            n = self._match_counts[idx] = self._match_counts.get(idx, 0) + 1
+        if n <= spec.skip:
+            return False
+        if spec.times and n > spec.skip + spec.times:
+            return False
+        return True
+
     # ---------------- the injection points ----------------
 
     @property
     def kill_spec(self) -> Optional[FaultSpec]:
+        # Point-scoped kills fire inline via kill_point, never from the
+        # host's poll/timer path.
         for s in self.specs:
-            if s.kind == "kill":
+            if s.kind == "kill" and not s.point:
                 return s
         return None
 
@@ -200,8 +231,10 @@ class FaultInjector:
         (``slow``), block (``hang``), or raise :class:`FaultError`
         (``error``); returns normally when nothing fires."""
         elapsed = self.elapsed_s()
-        for s in self.specs:
+        for i, s in enumerate(self.specs):
             if s.kind == "kill" or not s.matches(point, elapsed):
+                continue
+            if not self._count_gate(i, s):
                 continue
             if not self._chance(s.prob):
                 continue
@@ -220,6 +253,26 @@ class FaultInjector:
             elif s.kind == "error":
                 self._record("error")
                 raise FaultError(f"injected error at {point!r}")
+
+    def kill_point(self, point: str) -> bool:
+        """True when a point-scoped ``kill`` fault matches this call
+        (skip/times accounted).  The HOST exits itself on True (e.g.
+        ``os._exit``) — the injector only renders the verdict, so a test
+        harness can also call this to assert the trigger."""
+        elapsed = self.elapsed_s()
+        for i, s in enumerate(self.specs):
+            if s.kind != "kill" or not s.point:
+                continue
+            if not s.matches(point, elapsed):
+                continue
+            if not self._count_gate(i, s):
+                continue
+            if not self._chance(s.prob):
+                continue
+            self._record("kill")
+            logger.warning(f"FAULT kill at point {point!r}")
+            return True
+        return False
 
     def release(self) -> None:
         """Unblock every in-flight ``hang`` (host teardown calls this so

@@ -3,11 +3,24 @@
 `RecoverInfo` captures everything the master needs to resume a trial:
 step/epoch counters, frequency-control states, and hashes of already-consumed
 data (so restarted trials skip samples they already trained on).
+
+Atomic recover checkpoints: a recover-save stages into
+``recover_checkpoint.tmp.<step>``, writes + fsyncs a ``MANIFEST.json``
+(file list with sizes, step, model versions, and a checksum of the
+manifest itself), then flips directories — the old checkpoint rotates to
+``recover_checkpoint.prev`` (keep last-2) and the staged dir renames
+into place.  A crash at ANY point leaves either the old intact
+checkpoint, or old+staged, or new+prev — never a half-written current.
+``latest_valid_checkpoint`` validates the manifest before a restore ever
+trusts a directory, falling back to ``.prev`` on mismatch.
 """
 
 import dataclasses
+import hashlib
+import json
 import os
 import pickle
+import shutil
 from typing import Any, Dict, List, Optional
 
 from areal_tpu.base import logging
@@ -15,6 +28,9 @@ from areal_tpu.base import logging
 logger = logging.getLogger("recover")
 
 RECOVER_FILE = "recover_info.pkl"
+MANIFEST_FILE = "MANIFEST.json"
+PREV_SUFFIX = ".prev"
+STAGE_PREFIX = ".tmp."
 
 
 @dataclasses.dataclass
@@ -100,3 +116,139 @@ def discover_ckpt(ckpt_root: str) -> Optional[str]:
     if os.path.isdir(link):
         return os.path.realpath(link)
     return None
+
+
+# ---------------------------------------------------------------------------
+# Atomic, validated checkpoint directories
+
+
+def stage_dir(base: str, step: int) -> str:
+    """Staging dir a recover-save writes into before the atomic flip."""
+    return f"{base}{STAGE_PREFIX}{step}"
+
+
+def _manifest_checksum(manifest: Dict[str, Any]) -> str:
+    body = {k: v for k, v in manifest.items() if k != "checksum"}
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def write_manifest(
+    d: str,
+    step: int,
+    model_versions: Optional[Dict[str, int]] = None,
+) -> Dict[str, Any]:
+    """Inventory every file under ``d`` into MANIFEST.json and fsync it
+    (file AND directory entry) so the manifest — the flip's validity
+    witness — is durable before the rename makes the dir current."""
+    files = []
+    for root, _dirs, names_ in os.walk(d):
+        for name in sorted(names_):
+            if root == d and name == MANIFEST_FILE:
+                continue
+            p = os.path.join(root, name)
+            files.append(
+                {
+                    "name": os.path.relpath(p, d),
+                    "size": os.path.getsize(p),
+                }
+            )
+    manifest: Dict[str, Any] = {
+        "step": int(step),
+        "model_versions": dict(model_versions or {}),
+        "files": files,
+    }
+    manifest["checksum"] = _manifest_checksum(manifest)
+    path = os.path.join(d, MANIFEST_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    dfd = os.open(d, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+    return manifest
+
+
+def validate_manifest(d: str) -> Optional[Dict[str, Any]]:
+    """Return the manifest iff the directory matches it exactly
+    (manifest present + self-checksum good + every listed file present
+    at its recorded size); None on ANY mismatch — a torn dir must look
+    indistinguishable from no dir."""
+    path = os.path.join(d, MANIFEST_FILE)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(manifest, dict) or "checksum" not in manifest:
+        return None
+    if manifest["checksum"] != _manifest_checksum(manifest):
+        logger.warning(f"manifest checksum mismatch in {d}")
+        return None
+    for entry in manifest.get("files", []):
+        p = os.path.join(d, entry["name"])
+        try:
+            if os.path.getsize(p) != entry["size"]:
+                logger.warning(
+                    f"size mismatch for {entry['name']} in {d}"
+                )
+                return None
+        except OSError:
+            logger.warning(f"missing file {entry['name']} in {d}")
+            return None
+    return manifest
+
+
+def commit_checkpoint(staged: str, base: str) -> str:
+    """Atomically flip a staged (manifest-validated) dir into place:
+    current rotates to ``<base>.prev`` (keep last-2), staged renames to
+    current, parent dir fsynced.  Returns the committed path."""
+    if validate_manifest(staged) is None:
+        raise RuntimeError(
+            f"refusing to commit {staged}: manifest missing or invalid"
+        )
+    prev = base + PREV_SUFFIX
+    if os.path.isdir(base):
+        if os.path.isdir(prev):
+            shutil.rmtree(prev)
+        os.replace(base, prev)
+    os.replace(staged, base)
+    parent = os.path.dirname(base) or "."
+    dfd = os.open(parent, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+    return base
+
+
+def latest_valid_checkpoint(base: str) -> Optional[str]:
+    """The newest manifest-valid checkpoint: current if intact, else the
+    kept previous, else None.  A seed-era dir without a manifest is NOT
+    valid — a restore must never trust an unvalidated tree."""
+    for d in (base, base + PREV_SUFFIX):
+        if os.path.isdir(d) and validate_manifest(d) is not None:
+            return d
+    return None
+
+
+def clean_stale_stages(base: str) -> List[str]:
+    """Remove leftover ``<base>.tmp.<step>`` dirs from saves that died
+    before their flip; returns the removed paths."""
+    parent = os.path.dirname(base) or "."
+    prefix = os.path.basename(base) + STAGE_PREFIX
+    removed = []
+    if not os.path.isdir(parent):
+        return removed
+    for name in os.listdir(parent):
+        if name.startswith(prefix):
+            p = os.path.join(parent, name)
+            shutil.rmtree(p, ignore_errors=True)
+            removed.append(p)
+    return removed

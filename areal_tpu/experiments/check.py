@@ -74,10 +74,38 @@ def check_batch_vs_parallel(
         )
 
 
+def check_liveness(cfg) -> None:
+    """Crash-safe trainer plane knobs: a deadline shorter than the
+    heartbeat grace window (3x the beat period) would declare live
+    workers dead on their first slow MFC."""
+    timeout = getattr(cfg, "mfc_timeout_s", None)
+    beat = getattr(cfg, "worker_heartbeat_s", 5.0)
+    if beat <= 0:
+        _fail(f"worker_heartbeat_s must be > 0, got {beat}")
+    if timeout is not None:
+        if timeout <= 0:
+            _fail(
+                f"mfc_timeout_s must be > 0 (omit it for no deadline), "
+                f"got {timeout}"
+            )
+        if timeout <= beat:
+            _fail(
+                f"mfc_timeout_s ({timeout}) must exceed "
+                f"worker_heartbeat_s ({beat}) — at least one beat must "
+                "fit inside the deadline to tell slow from dead"
+            )
+    if getattr(cfg, "max_recoveries", 3) < 0:
+        _fail(
+            f"max_recoveries must be >= 0, got "
+            f"{getattr(cfg, 'max_recoveries', 3)}"
+        )
+
+
 def check_ppo_math(cfg) -> None:
     """Cross-field checks for PPOMathConfig (cheap, no jax import)."""
     check_optimizer(cfg.optimizer)
     check_gconfig(cfg.gconfig)
+    check_liveness(cfg)
     for role, spec in (
         ("actor", cfg.actor), ("ref", cfg.ref), ("critic", cfg.critic),
     ):
@@ -237,6 +265,7 @@ def check_ppo_math(cfg) -> None:
 
 def check_sft(cfg) -> None:
     check_optimizer(cfg.optimizer)
+    check_liveness(cfg)
     check_model_path("model", cfg.model)
     check_batch_vs_parallel(
         "train", cfg.batch_size, cfg.parallel, cfg.mb_spec.n_mbs

@@ -70,6 +70,14 @@ class ExperimentPlan:
     pipeline_overlap: bool = False
     overlap_window: int = 2
     pipeline_chunk_seqs: int = 1
+    # Crash-safe trainer plane: per-MFC deadline (None = no deadline) and
+    # worker heartbeat period (ZMQ runtime; beats keep long MFCs alive so
+    # the deadline distinguishes slow from dead).  max_recoveries bounds
+    # how many worker deaths the master absorbs by rolling back to the
+    # recover checkpoint before exiting non-zero.
+    mfc_timeout_s: Optional[float] = None
+    worker_heartbeat_s: float = 5.0
+    max_recoveries: int = 3
 
 
 @dataclasses.dataclass
@@ -92,6 +100,10 @@ class SFTConfig:
     experiment_name: str = "sft"
     trial_name: str = "trial"
     fileroot: str = "/tmp/areal_tpu/trial"
+    # Crash-safe trainer plane knobs (see ExperimentPlan).
+    mfc_timeout_s: Optional[float] = None
+    worker_heartbeat_s: float = 5.0
+    max_recoveries: int = 3
 
 
 def build_sft(cfg: SFTConfig, tokenizer=None) -> ExperimentPlan:
@@ -152,6 +164,9 @@ def build_sft(cfg: SFTConfig, tokenizer=None) -> ExperimentPlan:
             if cfg.n_hosts > 1
             else None
         ),
+        mfc_timeout_s=cfg.mfc_timeout_s,
+        worker_heartbeat_s=cfg.worker_heartbeat_s,
+        max_recoveries=cfg.max_recoveries,
     )
 
 
@@ -301,6 +316,10 @@ class PPOMathConfig:
     experiment_name: str = "ppo-math"
     trial_name: str = "trial"
     fileroot: str = "/tmp/areal_tpu/trial"
+    # Crash-safe trainer plane knobs (see ExperimentPlan).
+    mfc_timeout_s: Optional[float] = None
+    worker_heartbeat_s: float = 5.0
+    max_recoveries: int = 3
 
 
 def _remote_gen_shard(cfg: "PPOMathConfig", actor_gen, actor_if):
@@ -690,6 +709,9 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
         pipeline_overlap=cfg.pipeline_overlap,
         overlap_window=cfg.overlap_window,
         pipeline_chunk_seqs=cfg.pipeline_chunk_seqs,
+        mfc_timeout_s=cfg.mfc_timeout_s,
+        worker_heartbeat_s=cfg.worker_heartbeat_s,
+        max_recoveries=cfg.max_recoveries,
     )
 
 
@@ -715,7 +737,7 @@ def run_experiment(plan: ExperimentPlan, tokenizer=None):
         ModelWorker(wc, tokenizer=tokenizer, transfer=planes[i])
         for i, wc in enumerate(plan.worker_configs)
     ]
-    pool = InProcessPool(workers)
+    pool = InProcessPool(workers, mfc_timeout_s=plan.mfc_timeout_s)
     master = MasterWorker(
         dfg=plan.dfg,
         pool=pool,
@@ -735,6 +757,7 @@ def run_experiment(plan: ExperimentPlan, tokenizer=None):
         pipeline_overlap=plan.pipeline_overlap,
         overlap_window=plan.overlap_window,
         pipeline_chunk_seqs=plan.pipeline_chunk_seqs,
+        max_recoveries=plan.max_recoveries,
     )
     master.load_recover_info()
     stats = asyncio.run(master.run())

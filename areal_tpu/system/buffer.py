@@ -102,6 +102,12 @@ class SequenceBuffer:
                     self._entries[sid].sample.update_(one)
             self._cond.notify_all()
 
+    def clear(self) -> None:
+        """Drop every resident entry.  Master step-abort path: after a
+        worker death the data these entries describe died with the step,
+        and a retried step must repopulate from scratch."""
+        self._entries.clear()
+
     async def drop_ids(self, ids: Sequence[str]) -> None:
         """Remove entries outright — async-RL batches rejected or aged out
         by the replay buffer's staleness rule must vanish from the ledger

@@ -11,13 +11,15 @@ import asyncio
 import collections
 import contextvars
 import dataclasses
+import inspect
+import json
 import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from areal_tpu.api.config import ModelInterfaceType
 from areal_tpu.api.dfg import DFG, MFCDef, OffloadHook, ParamReallocHook
-from areal_tpu.base import logging, metrics, recover, timeutil, tracer
+from areal_tpu.base import faults, logging, metrics, recover, timeutil, tracer
 from areal_tpu.base.monitor import StatsLogger
 from areal_tpu.base.stats import merge_stats
 from areal_tpu.system.buffer import SequenceBuffer
@@ -32,28 +34,122 @@ _IN_PREFETCH: contextvars.ContextVar[bool] = contextvars.ContextVar(
 )
 
 
+class WorkerDeadError(RuntimeError):
+    """A worker missed its MFC deadline with a dead heartbeat: its
+    in-flight requests are failed with this so the master can abort the
+    step and recover instead of hanging (see ZMQWorkerPool.request)."""
+
+    def __init__(self, worker_id: int, reason: str):
+        super().__init__(f"worker {worker_id} dead: {reason}")
+        self.worker_id = worker_id
+        self.reason = reason
+
+
+class PoolClosedError(RuntimeError):
+    """The pool was closed with requests still in flight; awaiters get
+    this instead of hanging on futures nobody will ever resolve."""
+
+
+def pool_metrics():
+    """The worker-liveness counters, shared by every WorkerPool
+    implementation (one registration site; the registry is get-or-create
+    so repeated calls return the same metrics)."""
+    reg = metrics.default_registry()
+    return (
+        reg.counter(
+            "areal_master_worker_dead_total",
+            "workers declared dead (deadline expired, heartbeat stale)",
+        ),
+        reg.counter(
+            "areal_master_mfc_timeout_total",
+            "MFC requests whose deadline expired (slow or dead)",
+        ),
+        reg.counter(
+            "areal_master_orphan_replies_total",
+            "late/unmatched worker replies dropped by the master",
+            ("reason",),
+        ),
+    )
+
+
+_TIMEOUT_UNSET = object()
+
+
 class WorkerPool:
     """Transport abstraction: request(worker_id, payload) -> response."""
 
-    async def request(self, worker_id: int, payload: Dict[str, Any]) -> Dict:
+    # Per-request deadline default; None = wait forever (seed behavior).
+    mfc_timeout_s: Optional[float] = None
+
+    async def request(
+        self,
+        worker_id: int,
+        payload: Dict[str, Any],
+        timeout: Any = _TIMEOUT_UNSET,
+    ) -> Dict:
         raise NotImplementedError
 
     @property
     def n_workers(self) -> int:
         raise NotImplementedError
 
+    @property
+    def dead_workers(self) -> set:
+        return set()
+
+    async def wait_workers(self, timeout: float = 300.0):
+        """Block until every worker is reachable (no-op in-process)."""
+
 
 class InProcessPool(WorkerPool):
     """All workers live in this process (single-host trials and the
     reference-style in-process system tests, tests/experiments/utils.py)."""
 
-    def __init__(self, workers):
+    def __init__(self, workers, mfc_timeout_s: Optional[float] = None):
         self.workers = list(workers)
+        self.mfc_timeout_s = mfc_timeout_s
+        self._dead: set = set()
+        self._m_worker_dead, self._m_mfc_timeout, _ = pool_metrics()
 
-    async def request(self, worker_id: int, payload: Dict[str, Any]) -> Dict:
-        return await asyncio.to_thread(
+    async def request(
+        self,
+        worker_id: int,
+        payload: Dict[str, Any],
+        timeout: Any = _TIMEOUT_UNSET,
+    ) -> Dict:
+        if timeout is _TIMEOUT_UNSET:
+            timeout = self.mfc_timeout_s
+        if worker_id in self._dead:
+            raise WorkerDeadError(
+                worker_id, "worker previously declared dead"
+            )
+        coro = asyncio.to_thread(
             self.workers[worker_id].handle_request, payload
         )
+        if timeout is None:
+            return await coro
+        # No heartbeat lane in-process (a handler thread cannot beat for
+        # itself), so deadline expiry alone is the death verdict.  The
+        # expired to_thread keeps running in the default executor — the
+        # caller (or a chaos harness) must release any injected hang.
+        try:
+            return await asyncio.wait_for(coro, timeout)
+        except asyncio.TimeoutError:
+            self._m_mfc_timeout.inc()
+            self._m_worker_dead.inc()
+            self._dead.add(worker_id)
+            raise WorkerDeadError(
+                worker_id,
+                f"no reply to {payload.get('type')} within {timeout}s",
+            ) from None
+
+    def revive(self, worker_id: int):
+        """Un-declare a death (the in-process analogue of a relaunch)."""
+        self._dead.discard(worker_id)
+
+    @property
+    def dead_workers(self) -> set:
+        return set(self._dead)
 
     @property
     def n_workers(self) -> int:
@@ -130,6 +226,15 @@ class MasterWorker:
         pipeline_overlap: bool = False,
         overlap_window: int = 2,
         pipeline_chunk_seqs: int = 1,
+        # Crash-safe trainer plane: how many worker deaths the run loop
+        # absorbs (abort step -> restore recover checkpoint -> resume)
+        # before giving up with a structured fault report.
+        max_recoveries: int = 3,
+        # Optional hook called with the sorted dead worker ids before the
+        # master re-waits for hellos; a launcher uses it to respawn the
+        # processes (may be sync or async).  Without one the master still
+        # re-waits — an externally relaunched worker re-joins by itself.
+        worker_relauncher: Optional[Any] = None,
     ):
         self.dfg = dfg
         self.pool = pool
@@ -204,6 +309,28 @@ class MasterWorker:
             "areal_master_pipeline_chunks_total",
             "rollout chunks streamed through the pipelined step path",
         )
+        # Crash-safe trainer plane: recoveries absorbed by the run loop,
+        # committed checkpoint flips, and the freshness signal the SLO
+        # watchdog derives ckpt_age from.
+        self._m_recoveries = reg.counter(
+            "areal_master_recoveries_total",
+            "worker-death recoveries absorbed by the master run loop",
+        )
+        self._m_ckpt_flips = reg.counter(
+            "areal_ckpt_flips_total",
+            "recover checkpoints atomically committed (staged dir flipped)",
+        )
+        self._m_ckpt_last_success = reg.gauge(
+            "areal_ckpt_last_success_timestamp_seconds",
+            "unix time of the last committed recover checkpoint",
+        )
+        self.max_recoveries = int(max_recoveries)
+        self.worker_relauncher = worker_relauncher
+        self._recoveries = 0
+        # Master-side chaos points (AREAL_FAULTS): recover_stage /
+        # recover_flip kill the master between a checkpoint stage and its
+        # flip, proving a torn save never loses recoverability.
+        self._faults = faults.FaultInjector.from_env()
         # Span tracing (AREAL_TRACE): resolve the trial's shared shard dir
         # before claiming this process's identity so in-process workers
         # and the master write one coherent shard set.
@@ -345,10 +472,14 @@ class MasterWorker:
                 t0 = time.monotonic()
                 # The "step" span marks the attribution window every other
                 # track is bucketed against (apps/trace_report.py).
-                with tracer.span(
-                    "step", step=self.step_info.global_step + 1
-                ):
-                    stats = await self.execute_step()
+                try:
+                    with tracer.span(
+                        "step", step=self.step_info.global_step + 1
+                    ):
+                        stats = await self.execute_step()
+                except WorkerDeadError as e:
+                    await self._recover_from_worker_death(e)
+                    continue
                 dt = time.monotonic() - t0
                 stats["time/step_s"] = dt
                 self._export_step_metrics(stats, dt)
@@ -394,6 +525,87 @@ class MasterWorker:
             await self.save(kind="recover")
         # (eval hook: evaluation jobs are launched by the AutomaticEvaluator
         # watching the checkpoint dir; see areal_tpu/scheduler/evaluator.py)
+
+    # ---------------- worker-death recovery ----------------
+
+    async def _recover_from_worker_death(self, err: WorkerDeadError) -> None:
+        """Absorb a WorkerDeadError surfaced by the pool: emit a
+        structured fault report, abort the half-finished step (streamed
+        train chunks included), wait for the worker to be relaunched, and
+        roll every worker back to the last recover checkpoint.  Raises —
+        so run() exits non-zero — when the recovery budget is exhausted
+        or there is no checkpoint to roll back to."""
+        self._recoveries += 1
+        self._m_recoveries.inc()
+        report = {
+            "event": "worker_dead",
+            "worker_id": err.worker_id,
+            "reason": err.reason,
+            "step": self.step_info.global_step,
+            "dead_workers": sorted(self.pool.dead_workers),
+            "recovery": self._recoveries,
+            "max_recoveries": self.max_recoveries,
+        }
+        logger.error(f"FAULT_REPORT {json.dumps(report, sort_keys=True)}")
+        if self._recoveries > self.max_recoveries:
+            raise RuntimeError(
+                f"recovery budget exhausted ({self.max_recoveries}): "
+                f"worker {err.worker_id} dead: {err.reason}"
+            )
+        await self._abort_step()
+        if self.worker_relauncher is not None:
+            ret = self.worker_relauncher(sorted(self.pool.dead_workers))
+            if inspect.isawaitable(ret):
+                await ret
+        # A relaunched worker re-joins with a fresh hello (ZMQ pool) or a
+        # revive() (in-process pool); block until the fleet is whole again
+        # rather than dispatching into a hole.
+        await self.pool.wait_workers()
+        if not self.load_recover_info():
+            raise RuntimeError(
+                f"worker {err.worker_id} died before the first recover "
+                "checkpoint existed; nothing to roll back to"
+            )
+        await self._restore_worker_state()
+        logger.info(
+            f"recovered from worker {err.worker_id} death; resuming at "
+            f"step {self.step_info.global_step}"
+        )
+
+    async def _abort_step(self) -> None:
+        """Flush the in-flight step after a worker death so the retried
+        step starts from a clean slate: cancel prefetch tasks, drop open
+        train streams on surviving workers (train_stream_* state must not
+        leak into the retry), and reset the master's data-plane maps."""
+        tasks = list(self._ahead_queue)
+        self._ahead_queue.clear()
+        if self._ahead_task is not None:
+            tasks.append(self._ahead_task)
+            self._ahead_task = None
+        for t in tasks:
+            t.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        alive = [
+            w
+            for w in range(self.pool.n_workers)
+            if w not in self.pool.dead_workers
+        ]
+        await asyncio.gather(
+            *[
+                self.pool.request(w, {"type": "train_stream_abort"})
+                for w in alive
+            ],
+            return_exceptions=True,
+        )
+        self.buffer.clear()
+        for fut in self._inflight.values():
+            if not fut.done():
+                fut.cancel()
+        self._inflight.clear()
+        self._owners.clear()
+        self._xfer_acc.clear()
+        self._shard_info_cache.clear()
 
     # ---------------- one step ----------------
 
@@ -1391,11 +1603,12 @@ class MasterWorker:
 
     async def save(self, kind: str = "persistent"):
         step = self.step_info.global_step
-        sub = (
-            f"step_{step}" if kind == "persistent" else "recover_checkpoint"
-        )
+        if kind == "recover":
+            await self._save_recover(step)
+            logger.info(f"saved (recover) at step {step}")
+            return
         for node in self._train_rpcs:
-            d = self._ckpt_dir(node, sub)
+            d = self._ckpt_dir(node, f"step_{step}")
             # All group members join (the host gather of a process-spanning
             # param tree is collective); only the jax process-0 member
             # writes files.
@@ -1412,78 +1625,140 @@ class MasterWorker:
                     for w in self._group(str(node.model_name))
                 ]
             )
-        if kind == "recover":
+        logger.info(f"saved ({kind}) at step {step}")
+
+    async def _save_recover(self, step: int) -> None:
+        """Atomic recover-save.  Every train node's weights + optimizer
+        state stage into ``recover_checkpoint.tmp.<step>``; a fsynced
+        MANIFEST.json (file inventory + model versions + self-checksum)
+        makes the staged dir self-validating; only then do ALL staged
+        dirs flip into place (old current rotates to ``.prev``, keep
+        last-2) and recover_info.pkl is rewritten.  A crash at any point
+        leaves a manifest-valid checkpoint + matching-or-older recover
+        info on disk — never a torn current."""
+        # Version counters for EVERY model on every worker — not just the
+        # train nodes: sampling seeds derive from the generation
+        # replica's counter (e.g. actor_gen@0), which a rollback must
+        # rewind too or the recovered trial redraws different rollouts.
+        model_versions: Dict[str, int] = {}
+        for w in range(self.pool.n_workers):
+            out = await self.pool.request(w, {"type": "model_versions"})
+            for k, v in out["versions"].items():
+                model_versions[k] = int(v)
+        staged_dirs: List[Tuple[str, str]] = []
+        for node in self._train_rpcs:
+            key = str(node.model_name)
+            base = self._ckpt_dir(node, "recover_checkpoint")
+            # Leftover .tmp.<step> dirs from a save that died pre-flip.
+            recover.clean_stale_stages(base)
+            staged = recover.stage_dir(base, step)
+            group = self._group(key)
+            # All group members join (the host gather of a
+            # process-spanning param tree is collective); only the jax
+            # process-0 member writes files.
+            await asyncio.gather(
+                *[
+                    self.pool.request(
+                        w,
+                        {
+                            "type": "save",
+                            "model_name": key,
+                            "save_dir": staged,
+                        },
+                    )
+                    for w in group
+                ]
+            )
             # Optimizer state next to the weights (Adam moments + schedule
             # position; reference: megatron.py:687-736).
-            for node in self._train_rpcs:
-                d = self._ckpt_dir(node, sub)
-                await asyncio.gather(
-                    *[
-                        self.pool.request(
-                            w,
-                            {
-                                "type": "save_optimizer",
-                                "model_name": str(node.model_name),
-                                "path": os.path.join(
-                                    d, "optimizer_state.pkl"
-                                ),
-                            },
-                        )
-                        for w in self._group(str(node.model_name))
-                    ]
-                )
-            # Data stream position per data worker.
-            states = await asyncio.gather(
+            await asyncio.gather(
                 *[
-                    self.pool.request(w, {"type": "data_state"})
-                    for w in self.data_worker_ids
+                    self.pool.request(
+                        w,
+                        {
+                            "type": "save_optimizer",
+                            "model_name": key,
+                            "path": os.path.join(
+                                staged, "optimizer_state.pkl"
+                            ),
+                        },
+                    )
+                    for w in group
                 ]
             )
-            # Algorithm state (e.g. value-norm moments) from every worker.
-            iface_states = await asyncio.gather(
-                *[
-                    self.pool.request(w, {"type": "interface_state"})
-                    for w in range(self.pool.n_workers)
-                ]
+            recover.write_manifest(
+                staged, step, {key: model_versions.get(key, 0)}
             )
-            info = recover.RecoverInfo(
-                last_step_info=self.step_info,
-                save_ctl_states={
-                    "save": self.save_ctl.state_dict(),
-                    "ckpt": self.ckpt_ctl.state_dict(),
-                    "eval": self.eval_ctl.state_dict(),
-                },
-                data_states={
-                    w: s["states"]
-                    for w, s in zip(self.data_worker_ids, states)
-                },
-                interface_states={
-                    w: s["states"]
-                    for w, s in enumerate(iface_states)
-                    if s["states"]
-                },
-                used_data_ids=list(self._filtered_ids),
-                replay_watermarks=(
-                    self.replay.watermarks()
-                    if self.replay is not None
-                    else {}
-                ),
-                rollout_state=(
-                    {
-                        "trainer_version": self._trainer_version,
-                        "batch_seq": self._batch_seq,
-                    }
-                    if self._async_rl
-                    else {}
-                ),
-            )
-            recover.dump(
-                info,
-                recover.recover_root(
-                    self.fileroot, self.experiment_name, self.trial_name
-                ),
-            )
-        logger.info(f"saved ({kind}) at step {step}")
+            staged_dirs.append((staged, base))
+        # Chaos point: a kill here (everything staged, nothing flipped)
+        # must leave the previous current checkpoint untouched.
+        if self._faults is not None and self._faults.kill_point(
+            "recover_stage"
+        ):
+            os._exit(42)
+        for staged, base in staged_dirs:
+            recover.commit_checkpoint(staged, base)
+            self._m_ckpt_flips.inc()
+        self._m_ckpt_last_success.set(time.time())
+        # Chaos point: a kill here (flipped, recover info still old)
+        # restores older counters against newer weights — detectable via
+        # the manifest step, and strictly recoverable.
+        if self._faults is not None and self._faults.kill_point(
+            "recover_flip"
+        ):
+            os._exit(42)
+        # Data stream position per data worker.
+        states = await asyncio.gather(
+            *[
+                self.pool.request(w, {"type": "data_state"})
+                for w in self.data_worker_ids
+            ]
+        )
+        # Algorithm state (e.g. value-norm moments) from every worker.
+        iface_states = await asyncio.gather(
+            *[
+                self.pool.request(w, {"type": "interface_state"})
+                for w in range(self.pool.n_workers)
+            ]
+        )
+        info = recover.RecoverInfo(
+            last_step_info=self.step_info,
+            save_ctl_states={
+                "save": self.save_ctl.state_dict(),
+                "ckpt": self.ckpt_ctl.state_dict(),
+                "eval": self.eval_ctl.state_dict(),
+            },
+            data_states={
+                w: s["states"]
+                for w, s in zip(self.data_worker_ids, states)
+            },
+            interface_states={
+                w: s["states"]
+                for w, s in enumerate(iface_states)
+                if s["states"]
+            },
+            used_data_ids=list(self._filtered_ids),
+            model_versions=model_versions,
+            replay_watermarks=(
+                self.replay.watermarks()
+                if self.replay is not None
+                else {}
+            ),
+            rollout_state=(
+                {
+                    "trainer_version": self._trainer_version,
+                    "batch_seq": self._batch_seq,
+                }
+                if self._async_rl
+                else {}
+            ),
+        )
+        recover.dump(
+            info,
+            recover.recover_root(
+                self.fileroot, self.experiment_name, self.trial_name
+            ),
+        )
 
     def _ckpt_dir(self, node: MFCDef, sub: str) -> str:
         return os.path.join(
@@ -1524,18 +1799,39 @@ class MasterWorker:
         # here would silently mis-ship rows — refresh is one round-trip
         # per model per trial.
         self._shard_info_cache.clear()
+        versions = getattr(info, "model_versions", None) or {}
         for node in self._train_rpcs:
-            d = self._ckpt_dir(node, "recover_checkpoint")
-            if not os.path.isdir(d):
+            key = str(node.model_name)
+            base = self._ckpt_dir(node, "recover_checkpoint")
+            # Trust only a manifest-valid dir (current, else the kept
+            # .prev) — a torn half-written tree must never be loaded.
+            d = recover.latest_valid_checkpoint(base)
+            if d is None:
+                if os.path.isdir(base) or os.path.isdir(
+                    base + recover.PREV_SUFFIX
+                ):
+                    raise RuntimeError(
+                        f"recover checkpoint for {key!r} at {base} failed "
+                        "manifest validation (and no intact .prev exists) "
+                        "— refusing to restore from a torn checkpoint"
+                    )
                 continue
-            group = self._group(str(node.model_name))
+            manifest = recover.validate_manifest(d)
+            if manifest["step"] != self.step_info.global_step:
+                logger.warning(
+                    f"checkpoint step {manifest['step']} != recover-info "
+                    f"step {self.step_info.global_step} for {key!r} (crash "
+                    "between flip and recover-info rewrite); restoring "
+                    "anyway"
+                )
+            group = self._group(key)
             await asyncio.gather(
                 *[
                     self.pool.request(
                         w,
                         {
                             "type": "load_model",
-                            "model_name": str(node.model_name),
+                            "model_name": key,
                             "ckpt_dir": d,
                             "optimizer_path": os.path.join(
                                 d, "optimizer_state.pkl"
@@ -1548,6 +1844,24 @@ class MasterWorker:
             for hook in node.post_hooks:
                 await self._run_hook(hook, node, group)
             logger.info(f"restored {node.model_name} from {d}")
+        if versions:
+            # Rewind EVERY model's version counter fleet-wide (after the
+            # post-hook replay, which must not re-advance them): sampling
+            # seeds derive from the generation replica's counter, so a
+            # recovered trial redraws the same rollouts only if this is
+            # exact.  Workers ignore keys they don't host.
+            await asyncio.gather(
+                *[
+                    self.pool.request(
+                        w,
+                        {
+                            "type": "set_model_versions",
+                            "versions": versions,
+                        },
+                    )
+                    for w in range(self.pool.n_workers)
+                ]
+            )
         # Re-apply difficulty filtering BEFORE rewinding cursors so the
         # dataset the replay walks matches the pre-crash one.
         filtered = getattr(info, "used_data_ids", None) or []

@@ -37,7 +37,7 @@ from areal_tpu.api.model_api import (
     OptimizerConfig,
     make_interface,
 )
-from areal_tpu.base import logging, metrics, tracer
+from areal_tpu.base import faults, logging, metrics, tracer
 from areal_tpu.base.monitor import Timers
 from areal_tpu.base.topology import ParallelConfig, make_mesh
 from areal_tpu.models.config import ModelConfig
@@ -201,6 +201,12 @@ class ModelWorker:
             "tokens processed, per MFC",
             ("mfc",),
         )
+        # Chaos hooks (env-gated, AREAL_FAULTS): kill/hang/slow/error on
+        # MFC execution at points "mfc_<itype>" / "mfc_stream_*", so the
+        # trainer chaos leg breaks a REAL worker with no test-only code
+        # path.  None when unset — the fault-free hot path pays one
+        # attribute check per request.
+        self._faults = faults.FaultInjector.from_env()
         self._setup()
 
     # ---------------- setup ----------------
@@ -296,7 +302,27 @@ class ModelWorker:
         handler = getattr(self, f"_handle_{req['type']}", None)
         if handler is None:
             raise ValueError(f"unknown request type {req['type']!r}")
+        if self._faults is not None:
+            self._fire_faults(req)
         return handler(req)
+
+    def _fire_faults(self, req: Dict[str, Any]) -> None:
+        """Chaos injection on MFC execution.  Points: ``mfc_<itype>``
+        (mfc_train_step / mfc_generate / mfc_inference) for plain MFCs,
+        and the raw request type for streamed ones (mfc_stream_begin /
+        mfc_stream_chunk / mfc_stream_end).  A matching point-scoped
+        kill exits the process hard — from the master's view the worker
+        simply stops beating, exactly like a preempted pod."""
+        rtype = req["type"]
+        if not rtype.startswith("mfc"):
+            return
+        if rtype == "mfc":
+            point = f"mfc_{ModelInterfaceType(req['interface_type']).value}"
+        else:
+            point = rtype
+        if self._faults.kill_point(point):
+            os._exit(43)
+        self._faults.fire(point)
 
     def _handle_spec(self, req):
         sizes = [len(ds) for ds in self.datasets]
@@ -543,6 +569,20 @@ class ModelWorker:
             int(sum(lens))
         )
         return {"meta": None, "stats": dict(stats)}
+
+    def _handle_train_stream_abort(self, req):
+        """Drop every open train stream (accumulated grads and all) so a
+        master recovering from a worker death can restart the step from a
+        clean slate — a leaked stream would make the next
+        mfc_stream_begin raise "already open"."""
+        dropped = sorted(self._streams)
+        self._streams.clear()
+        if dropped:
+            logger.warning(
+                f"worker {self.config.worker_index}: aborted open train "
+                f"stream(s) {dropped}"
+            )
+        return {"dropped": dropped}
 
     def _handle_mfc_stream_end(self, req):
         from areal_tpu.base import monitor
@@ -902,6 +942,19 @@ class ModelWorker:
         for ds in self.datasets:
             removed += int(ds.filter(req["ids"]) or 0)
         return {"removed": removed}
+
+    def _handle_model_versions(self, req):
+        """Per-model weight-version counters — inventoried into the
+        recover checkpoint's MANIFEST.json and RecoverInfo."""
+        return {
+            "versions": {k: int(m.version) for k, m in self.models.items()}
+        }
+
+    def _handle_set_model_versions(self, req):
+        for k, v in (req.get("versions") or {}).items():
+            if k in self.models:
+                self.models[k].version = int(v)
+        return {}
 
     def _handle_ping(self, req):
         return {"pong": self.config.worker_index}

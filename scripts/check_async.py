@@ -44,6 +44,24 @@ retrace churn from streaming).  `--bench-out` additionally writes the
 bench JSONL consumed by scripts/check_regression.py
 (bench_overlap_cpu8_*.json).
 
+Part 5 (`--trainer-chaos`) is the crash-safe trainer plane leg, three
+sub-legs over the same deterministic 4-step tiny-PPO trial with a
+recover checkpoint every step: (a) an injected `AREAL_FAULTS` hang on
+the third train MFC — the master's `mfc_timeout_s` deadline declares
+the worker dead, aborts the step, invokes the relauncher hook, rolls
+back to the last recover checkpoint, and resumes; asserted: exactly one
+recovery, the `areal_master_worker_dead_total` /
+`areal_master_mfc_timeout_total` / `areal_master_recoveries_total`
+counters each move by one, and the resumed run's per-step stats AND
+final weights are bit-identical to a fault-free baseline.  (b) a
+subprocess victim killed (`kill@point=recover_stage`, exit 42) between
+staging and flipping its second recover-save — the step-1 checkpoint
+must stay manifest-valid, and a faultless restart must resume from it
+and finish at step 4 with no stale stage dirs.  (c) the committed
+checkpoint is torn (a manifest-listed file overwritten) —
+`latest_valid_checkpoint` must fall back to `.prev` and a third restart
+must restore from it and exit 0.
+
 Exit 0 iff every check passes.  CI-friendly: CPU-only, tiny random
 model, a few minutes end to end.
 """
@@ -918,6 +936,338 @@ def check_overlap(fileroot: str, bench_out: str = None) -> int:
     return len(failures)
 
 
+def _tiny_ppo_cfg(fileroot: str, rows, mfc_timeout_s=None):
+    """Deterministic 4-step tiny-PPO config (16 rows / batch 4) with a
+    recover save every step — shared by the trainer-chaos legs."""
+    from areal_tpu.api.config import ModelAbstraction
+    from areal_tpu.api.data_api import DatasetAbstraction
+    from areal_tpu.api.model_api import (
+        GenerationHyperparameters,
+        OptimizerConfig,
+    )
+    from areal_tpu.experiments.common import PPOMathConfig
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.system.master import ExperimentSaveEvalControl
+
+    return PPOMathConfig(
+        actor=ModelAbstraction("random", {"config": tiny_config()}),
+        dataset=DatasetAbstraction(
+            "math_code_prompt",
+            {"dataset_builder": lambda: rows, "max_length": 64},
+        ),
+        reward_interface_args={"id2info": {r["query_id"]: r for r in rows}},
+        gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+        ppo_kwargs={"n_minibatches": 1, "kl_ctl": 0.0},
+        optimizer=OptimizerConfig(lr=5e-3, warmup_steps_proportion=0.0),
+        batch_size=4,
+        total_train_epochs=1,
+        seed=1,
+        mfc_timeout_s=mfc_timeout_s,
+        worker_heartbeat_s=1.0,
+        ctrl=ExperimentSaveEvalControl(ckpt_freq_steps=1),
+        fileroot=fileroot,
+    )
+
+
+def _trainer_chaos_victim(fileroot: str) -> int:
+    """Hidden helper behind --trainer-chaos-victim: run the tiny PPO
+    trial to completion (resuming from any recover checkpoint).  The
+    parent process injects AREAL_FAULTS (kill@point=recover_stage) into
+    run 1 and asserts on the checkpoint directories each run leaves."""
+    from areal_tpu.experiments.common import build_ppo_math, run_experiment
+    from tests import fixtures
+
+    tok = fixtures.make_tokenizer()
+    rows = fixtures.build_math_rows(16, seed=7)
+    _, stats = run_experiment(
+        build_ppo_math(_tiny_ppo_cfg(fileroot, rows), tok), tokenizer=tok
+    )
+    print(f"VICTIM_OK steps={len(stats)}")
+    return 0
+
+
+def check_trainer_chaos(fileroot: str) -> int:
+    """Crash-safe trainer plane leg (see module docstring, Part 5):
+    worker hang mid-train-MFC -> deadline recovery -> bit-exact resume;
+    master killed mid-recover-save -> restart from the intact
+    checkpoint; torn current -> manifest fallback to .prev."""
+    import glob
+    import subprocess
+
+    import jax
+    import numpy as np
+
+    from areal_tpu.base import faults, metrics, recover, tracer
+    from areal_tpu.experiments.common import build_ppo_math, run_experiment
+    from areal_tpu.system.master import InProcessPool, MasterWorker
+    from areal_tpu.system.transfer import InProcTransfer
+    from areal_tpu.system.worker import ModelWorker
+    from tests import fixtures
+
+    failures = []
+    tok = fixtures.make_tokenizer()
+    rows = fixtures.build_math_rows(16, seed=7)
+
+    def metric_value(name):
+        total = 0.0
+        for line in metrics.default_registry().expose().splitlines():
+            if line.startswith(f"{name} ") or line.startswith(f"{name}{{"):
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    # ---- Leg 1: worker hangs mid-train-MFC --------------------------
+    # Baseline for the A/B: the identical trial with no faults.
+    m_base, s_base = run_experiment(
+        build_ppo_math(
+            _tiny_ppo_cfg(os.path.join(fileroot, "baseline"), rows), tok
+        ),
+        tokenizer=tok,
+    )
+
+    # The in-process pool has no heartbeat lane (a handler thread cannot
+    # beat for itself), so the deadline must clear the slowest honest
+    # MFC — step 1's cold-compile train step runs several seconds.
+    plan = build_ppo_math(
+        _tiny_ppo_cfg(
+            os.path.join(fileroot, "chaos"), rows, mfc_timeout_s=30.0
+        ),
+        tok,
+    )
+    tracer.default_dir(
+        plan.fileroot, plan.experiment_name, plan.trial_name
+    )
+    planes = InProcTransfer.make_group(len(plan.worker_configs))
+    # Env-gate the injector around worker construction ONLY: the third
+    # train MFC hangs (a stuck host, not a crash), so the master's
+    # deadline — not a process exit — must produce the death verdict,
+    # and the master's own injector must stay empty.
+    os.environ["AREAL_FAULTS"] = "hang@point=mfc_train_step&skip=2&times=1"
+    try:
+        workers = [
+            ModelWorker(wc, tokenizer=tok, transfer=planes[i])
+            for i, wc in enumerate(plan.worker_configs)
+        ]
+    finally:
+        del os.environ["AREAL_FAULTS"]
+    injectors = [w._faults for w in workers if w._faults is not None]
+    pool = InProcessPool(workers, mfc_timeout_s=plan.mfc_timeout_s)
+    relaunches = []
+
+    def relauncher(dead):
+        # Stand-in for a scheduler relaunch: release the hung injector
+        # thread (the stranded to_thread) and revive the pool slot.
+        for inj in injectors:
+            inj.release()
+        for wid in dead:
+            pool.revive(wid)
+        relaunches.append(sorted(dead))
+
+    before = {
+        n: metric_value(n)
+        for n in (
+            "areal_master_worker_dead_total",
+            "areal_master_mfc_timeout_total",
+            "areal_master_recoveries_total",
+            "areal_ckpt_flips_total",
+        )
+    }
+    master = MasterWorker(
+        dfg=plan.dfg,
+        pool=pool,
+        model_placement=plan.model_placement,
+        data_worker_ids=plan.data_worker_ids,
+        ctrl=plan.ctrl,
+        fileroot=plan.fileroot,
+        experiment_name=plan.experiment_name,
+        trial_name=plan.trial_name,
+        model_groups=plan.model_groups,
+        model_replicas=plan.model_replicas,
+        difficulty_filter=plan.difficulty_filter,
+        rollout_ahead=plan.rollout_ahead,
+        max_recoveries=plan.max_recoveries,
+        worker_relauncher=relauncher,
+    )
+    master.load_recover_info()
+    t0 = time.monotonic()
+    stats = asyncio.run(master.run())
+    detect_wall = time.monotonic() - t0
+
+    hangs = sum(i.fired.get("hang", 0) for i in injectors)
+    if hangs != 1:
+        failures.append(f"expected exactly 1 injected hang, got {hangs}")
+    if relaunches != [[0]]:
+        failures.append(
+            f"expected one relaunch of worker 0, got {relaunches}"
+        )
+    if master._recoveries != 1:
+        failures.append(
+            f"expected 1 recovery, got {master._recoveries}"
+        )
+    for name, want in (
+        ("areal_master_worker_dead_total", 1),
+        ("areal_master_mfc_timeout_total", 1),
+        ("areal_master_recoveries_total", 1),
+    ):
+        delta = metric_value(name) - before[name]
+        if delta != want:
+            failures.append(f"{name} moved by {delta}, expected {want}")
+    flips = metric_value("areal_ckpt_flips_total") - before[
+        "areal_ckpt_flips_total"
+    ]
+    if flips < 4:
+        failures.append(
+            f"expected >= 4 checkpoint flips (one per step), got {flips}"
+        )
+    if len(stats) != len(s_base):
+        failures.append(
+            f"chaos run produced {len(stats)} steps, baseline "
+            f"{len(s_base)}"
+        )
+    if master.step_info.global_step != len(s_base):
+        failures.append(
+            f"final global_step {master.step_info.global_step} != "
+            f"{len(s_base)}"
+        )
+    # Bit-exact resume: rollback restores weights, optimizer, model
+    # versions (sampling seeds derive from them), and data cursors from
+    # the end-of-step-2 checkpoint, so the replayed steps 3-4 — and the
+    # final weights — must match the fault-free trial exactly.
+    keys = (
+        "actor_train/loss", "actor_train/actor_loss",
+        "actor_train/approx_kl", "actor_train/importance_weight",
+        "actor_train/grad_norm", "actor_train/task_reward",
+    )
+    for t, (a, b) in enumerate(zip(s_base, stats)):
+        for k in keys:
+            if a[k] != b[k]:
+                failures.append(
+                    f"chaos run diverged from baseline at step {t}: "
+                    f"{k} {b[k]} != {a[k]}"
+                )
+    pa = m_base.pool.workers[0].models["actor@0"].engine.get_params()
+    pb = pool.workers[0].models["actor@0"].engine.get_params()
+    diff = max(
+        float(
+            np.abs(
+                np.asarray(x, np.float32) - np.asarray(y, np.float32)
+            ).max()
+        )
+        for x, y in zip(jax.tree.leaves(pa), jax.tree.leaves(pb))
+    )
+    if diff != 0.0:
+        failures.append(
+            f"post-recovery final weights differ from baseline by {diff}"
+        )
+
+    # ---- Leg 2: master killed mid-recover-save ----------------------
+    vic_root = os.path.join(fileroot, "victim")
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--trainer-chaos-victim", vic_root,
+    ]
+    env = dict(os.environ)
+    # First recover-save commits; the second is killed after staging,
+    # before the flip.
+    env["AREAL_FAULTS"] = "kill@point=recover_stage&skip=1&times=1"
+    r1 = subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=600
+    )
+    if r1.returncode != 42:
+        failures.append(
+            f"victim run 1: expected exit 42 (kill at recover_stage), "
+            f"got {r1.returncode}; stderr tail: {r1.stderr[-800:]}"
+        )
+    bases = sorted(
+        glob.glob(
+            os.path.join(
+                vic_root, "checkpoints", "*", "*", "*",
+                "recover_checkpoint",
+            )
+        )
+    )
+    if not bases:
+        failures.append("victim run 1 left no committed recover_checkpoint")
+    for base in bases:
+        m = recover.validate_manifest(base)
+        if m is None or m["step"] != 1:
+            failures.append(
+                f"{base}: expected intact manifest at step 1 after the "
+                f"mid-save kill, got {m and m['step']}"
+            )
+        staged = recover.stage_dir(base, 2)
+        if not os.path.isdir(staged):
+            failures.append(
+                f"kill at recover_stage left no staged dir {staged}"
+            )
+
+    r2 = subprocess.run(
+        cmd, env=dict(os.environ), capture_output=True, text=True,
+        timeout=600,
+    )
+    if r2.returncode != 0:
+        failures.append(
+            f"victim run 2 (restart after kill): expected exit 0, got "
+            f"{r2.returncode}; stderr tail: {r2.stderr[-800:]}"
+        )
+    roots = glob.glob(os.path.join(vic_root, "recover", "*", "*"))
+    infos = [recover.load(r) for r in roots]
+    if not infos or infos[0].last_step_info.global_step != 4:
+        failures.append(
+            f"victim run 2: expected recover_info at step 4, got "
+            f"{[i.last_step_info.global_step for i in infos]}"
+        )
+    for base in bases:
+        m = recover.validate_manifest(base)
+        if m is None or m["step"] != 4:
+            failures.append(
+                f"{base}: expected manifest at step 4 after the resumed "
+                f"run, got {m and m['step']}"
+            )
+        stale = glob.glob(base + recover.STAGE_PREFIX + "*")
+        if stale:
+            failures.append(f"stale stages left behind: {stale}")
+
+    # ---- Leg 3: torn current checkpoint -> .prev fallback -----------
+    for base in bases:
+        m = recover.validate_manifest(base)
+        if not m:
+            continue
+        torn = os.path.join(base, m["files"][0]["name"])
+        with open(torn, "wb") as f:
+            f.write(b"torn")
+        if recover.validate_manifest(base) is not None:
+            failures.append(f"{base}: torn file passed validation")
+        if recover.latest_valid_checkpoint(base) != (
+            base + recover.PREV_SUFFIX
+        ):
+            failures.append(
+                f"{base}: torn current did not fall back to .prev"
+            )
+    r3 = subprocess.run(
+        cmd, env=dict(os.environ), capture_output=True, text=True,
+        timeout=600,
+    )
+    if r3.returncode != 0:
+        failures.append(
+            f"victim run 3 (torn current): expected exit 0 restoring "
+            f"from .prev, got {r3.returncode}; stderr tail: "
+            f"{r3.stderr[-800:]}"
+        )
+
+    for f in failures:
+        print(f"FAIL[trainer-chaos]: {f}")
+    if not failures:
+        print(
+            f"OK[trainer-chaos]: hang detected and recovered in-run "
+            f"(1 recovery, wall {detect_wall:.1f}s, {flips:.0f} ckpt "
+            f"flips), resumed bit-exact vs baseline over {len(stats)} "
+            f"steps (max param diff {diff}); mid-save kill (exit 42) "
+            f"left step-1 checkpoint intact and the restart finished at "
+            f"step 4; torn current fell back to .prev and restored"
+        )
+    return len(failures)
+
+
 def main() -> int:
     p = argparse.ArgumentParser(prog="check_async")
     p.add_argument("--prompts", type=int, default=24)
@@ -935,7 +1285,28 @@ def main() -> int:
                    help="with --overlap: also write the bench JSONL "
                         "(bench_overlap_cpu8_<UTC>.json) for "
                         "check_regression.py")
+    p.add_argument("--trainer-chaos", action="store_true",
+                   help="run ONLY the crash-safe trainer plane leg "
+                        "(worker hang mid-MFC -> deadline recovery; "
+                        "master killed mid-recover-save -> manifest "
+                        "fallback)")
+    p.add_argument("--trainer-chaos-victim", metavar="DIR", default=None,
+                   help=argparse.SUPPRESS)
     args = p.parse_args()
+
+    if args.trainer_chaos_victim:
+        return _trainer_chaos_victim(args.trainer_chaos_victim)
+
+    if args.trainer_chaos:
+        fileroot = args.dir or tempfile.mkdtemp(
+            prefix="areal_tpu_trainer_chaos_"
+        )
+        n_fail = check_trainer_chaos(fileroot)
+        if n_fail:
+            print(f"FAIL: {n_fail} trainer-chaos check(s) failed")
+            return 1
+        print("OK: crash-safe trainer plane survived the injected faults")
+        return 0
 
     if args.chaos:
         n_fail = check_chaos()

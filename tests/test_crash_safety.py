@@ -1,0 +1,527 @@
+"""Crash-safe trainer plane: MFC deadlines, worker-death detection,
+atomic manifest-validated recover checkpoints, and fault-spec scoping.
+
+End-to-end chaos proof (real worker hang -> recovery -> resume, and a
+master killed mid-recover-save) lives in
+``scripts/check_async.py --trainer-chaos``; these tests pin the unit
+semantics each layer of that proof relies on.
+"""
+
+import asyncio
+import dataclasses
+import json
+import os
+import pickle
+
+import pytest
+
+from areal_tpu.base import faults, recover
+from areal_tpu.system.master import (
+    InProcessPool,
+    PoolClosedError,
+    WorkerDeadError,
+    WorkerPool,
+)
+
+
+# ---------------------------------------------------------------------------
+# Fault-spec scoping (skip/times call-count gating + point-scoped kills)
+
+
+class TestFaultScoping:
+    def test_parse_skip_times(self):
+        (s,) = faults.parse_faults("hang@point=mfc_train_step&skip=2&times=1")
+        assert (s.kind, s.point, s.skip, s.times) == (
+            "hang", "mfc_train_step", 2, 1,
+        )
+
+    def test_parse_rejects_negative(self):
+        with pytest.raises(ValueError):
+            faults.parse_faults("hang@skip=-1")
+        with pytest.raises(ValueError):
+            faults.parse_faults("error@times=-2")
+
+    def test_skip_times_window(self):
+        """skip=2&times=1 fires on exactly the third matching call."""
+        inj = faults.FaultInjector.parse("error@point=p&skip=2&times=1")
+        inj.fire("p")  # call 1: skipped
+        inj.fire("other")  # non-matching point: not counted
+        inj.fire("p")  # call 2: skipped
+        with pytest.raises(faults.FaultError):
+            inj.fire("p")  # call 3: fires
+        inj.fire("p")  # call 4: past the times window
+        assert inj.fired["error"] == 1
+
+    def test_kill_point_scoped(self):
+        inj = faults.FaultInjector.parse("kill@point=recover_stage&skip=1")
+        # Point-scoped kills never leak into the host's poll/timer path.
+        assert inj.kill_spec is None
+        assert not inj.kill_due()
+        assert not inj.kill_point("recover_flip")  # wrong point
+        assert not inj.kill_point("recover_stage")  # call 1: skipped
+        assert inj.kill_point("recover_stage")  # call 2: fires
+        assert inj.fired["kill"] == 1
+
+    def test_pointless_kill_stays_on_timer_path(self):
+        inj = faults.FaultInjector.parse("kill@t=0")
+        assert inj.kill_spec is not None
+        assert not inj.kill_point("recover_stage")
+
+
+# ---------------------------------------------------------------------------
+# Atomic, validated checkpoint directories
+
+
+def _make_ckpt(d, files=(("model.safetensors", b"w" * 64),)):
+    os.makedirs(d, exist_ok=True)
+    for name, data in files:
+        with open(os.path.join(d, name), "wb") as f:
+            f.write(data)
+
+
+class TestAtomicCheckpoints:
+    def test_manifest_round_trip(self, tmp_path):
+        d = str(tmp_path / "ck")
+        _make_ckpt(d, (("model.safetensors", b"x" * 10), ("config.json", b"{}")))
+        m = recover.write_manifest(d, step=3, model_versions={"actor": 7})
+        assert recover.validate_manifest(d) == m
+        assert m["step"] == 3 and m["model_versions"] == {"actor": 7}
+        assert sorted(e["name"] for e in m["files"]) == [
+            "config.json", "model.safetensors",
+        ]
+
+    def test_validate_rejects_tampering(self, tmp_path):
+        d = str(tmp_path / "ck")
+        _make_ckpt(d)
+        recover.write_manifest(d, step=1)
+        # Torn file (size mismatch).
+        with open(os.path.join(d, "model.safetensors"), "wb") as f:
+            f.write(b"torn")
+        assert recover.validate_manifest(d) is None
+        # Missing file.
+        _make_ckpt(d)
+        recover.write_manifest(d, step=1)
+        os.unlink(os.path.join(d, "model.safetensors"))
+        assert recover.validate_manifest(d) is None
+        # Corrupt manifest checksum.
+        _make_ckpt(d)
+        recover.write_manifest(d, step=1)
+        p = os.path.join(d, recover.MANIFEST_FILE)
+        with open(p) as f:
+            m = json.load(f)
+        m["step"] = 999  # body no longer matches the checksum
+        with open(p, "w") as f:
+            json.dump(m, f)
+        assert recover.validate_manifest(d) is None
+
+    def test_manifest_less_dir_is_invalid(self, tmp_path):
+        d = str(tmp_path / "seed_era")
+        _make_ckpt(d)
+        assert recover.validate_manifest(d) is None
+        assert recover.latest_valid_checkpoint(d) is None
+
+    def test_commit_rotates_keep_last_2(self, tmp_path):
+        base = str(tmp_path / "recover_checkpoint")
+        for step, blob in ((1, b"a" * 8), (2, b"b" * 16), (3, b"c" * 24)):
+            staged = recover.stage_dir(base, step)
+            _make_ckpt(staged, (("model.safetensors", blob),))
+            recover.write_manifest(staged, step)
+            assert recover.commit_checkpoint(staged, base) == base
+            assert not os.path.exists(staged)
+        assert recover.validate_manifest(base)["step"] == 3
+        prev = base + recover.PREV_SUFFIX
+        assert recover.validate_manifest(prev)["step"] == 2
+        # Only last-2 are kept.
+        assert recover.latest_valid_checkpoint(base) == base
+
+    def test_commit_refuses_invalid_stage(self, tmp_path):
+        base = str(tmp_path / "recover_checkpoint")
+        staged = recover.stage_dir(base, 1)
+        _make_ckpt(staged)  # no manifest written
+        with pytest.raises(RuntimeError, match="manifest"):
+            recover.commit_checkpoint(staged, base)
+
+    def test_torn_current_falls_back_to_prev(self, tmp_path):
+        """A kill mid-save (or a torn flip) never loses recoverability."""
+        base = str(tmp_path / "recover_checkpoint")
+        for step in (1, 2):
+            staged = recover.stage_dir(base, step)
+            _make_ckpt(staged, (("model.safetensors", bytes(8 * step)),))
+            recover.write_manifest(staged, step)
+            recover.commit_checkpoint(staged, base)
+        # Tear the current checkpoint mid-file.
+        with open(os.path.join(base, "model.safetensors"), "wb") as f:
+            f.write(b"x")
+        assert recover.latest_valid_checkpoint(base) == (
+            base + recover.PREV_SUFFIX
+        )
+
+    def test_clean_stale_stages(self, tmp_path):
+        base = str(tmp_path / "recover_checkpoint")
+        _make_ckpt(recover.stage_dir(base, 1))
+        _make_ckpt(recover.stage_dir(base, 2))
+        _make_ckpt(base)
+        removed = recover.clean_stale_stages(base)
+        assert len(removed) == 2
+        assert os.path.isdir(base)
+        assert not os.path.exists(recover.stage_dir(base, 1))
+
+    def test_old_pickle_backfills_new_fields(self, tmp_path):
+        """RecoverInfo pickles from before a field existed keep loading
+        (pickle replays __dict__, not __init__)."""
+        info = recover.RecoverInfo(
+            last_step_info=recover.StepInfo(global_step=5)
+        )
+        for fld in ("model_versions", "fleet_state", "replay_watermarks"):
+            del info.__dict__[fld]
+        root = str(tmp_path)
+        with open(os.path.join(root, recover.RECOVER_FILE), "wb") as f:
+            pickle.dump(info, f)
+        loaded = recover.load(root)
+        assert loaded.last_step_info.global_step == 5
+        assert loaded.model_versions == {}
+        assert loaded.fleet_state == {}
+        assert loaded.replay_watermarks == {}
+
+
+# ---------------------------------------------------------------------------
+# In-process pool deadline
+
+
+class _SlowWorker:
+    def __init__(self, delay_s=0.0):
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def handle_request(self, req):
+        self.calls += 1
+        if self.delay_s:
+            import time
+
+            time.sleep(self.delay_s)
+        return {"ok": req["type"]}
+
+
+class TestInProcessPoolDeadline:
+    def test_no_timeout_is_plain_await(self):
+        pool = InProcessPool([_SlowWorker()])
+        out = asyncio.run(pool.request(0, {"type": "ping"}))
+        assert out == {"ok": "ping"}
+
+    def test_deadline_declares_dead_then_revive(self):
+        pool = InProcessPool([_SlowWorker(delay_s=5.0)], mfc_timeout_s=0.2)
+
+        async def go():
+            with pytest.raises(WorkerDeadError) as ei:
+                await pool.request(0, {"type": "mfc"})
+            assert ei.value.worker_id == 0
+            assert pool.dead_workers == {0}
+            # Requests to a declared-dead worker fail fast.
+            with pytest.raises(WorkerDeadError):
+                await pool.request(0, {"type": "ping"})
+            pool.revive(0)
+            assert pool.dead_workers == set()
+            # Per-request override beats the pool deadline.
+            return await pool.request(0, {"type": "ping"}, timeout=None)
+
+        # The revived request runs to completion despite the pool default.
+        out = asyncio.run(go())
+        assert out == {"ok": "ping"}
+
+
+# ---------------------------------------------------------------------------
+# ZMQ pool: close() regression, orphan accounting, slow-vs-dead
+
+
+def _fake_worker_socket(addr, worker_index):
+    import zmq as _zmq
+
+    ctx = _zmq.Context()
+    sock = ctx.socket(_zmq.DEALER)
+    sock.connect(addr)
+    sock.send(
+        pickle.dumps({"type": "hello", "worker_index": worker_index})
+    )
+    return ctx, sock
+
+
+def _orphan_counts():
+    """Read the orphan counter per label from the default registry."""
+    from areal_tpu.base import metrics
+
+    out = {"timed_out": 0.0, "unknown": 0.0}
+    for line in metrics.default_registry().expose().splitlines():
+        if line.startswith("areal_master_orphan_replies_total{"):
+            name_part, val = line.rsplit(" ", 1)
+            for reason in out:
+                if f'reason="{reason}"' in name_part:
+                    out[reason] = float(val)
+    return out
+
+
+@pytest.fixture
+def zmq_pool():
+    from areal_tpu.system.stream import ZMQWorkerPool
+
+    made = []
+
+    def make(**kw):
+        pool = ZMQWorkerPool("crash-test", f"t{len(made)}", 1, **kw)
+        made.append(pool)
+        return pool
+
+    yield make
+    for pool in made:
+        pool.close()
+
+
+class TestZMQPoolLiveness:
+    def test_close_fails_pending_with_pool_closed(self, zmq_pool):
+        """Regression: close() used to cancel the recv loop without
+        failing _pending, stranding awaiting requests forever."""
+
+        async def go():
+            pool = zmq_pool()
+            ctx, sock = _fake_worker_socket(pool._addr, 0)
+            try:
+                await pool.wait_workers(timeout=10)
+                task = asyncio.ensure_future(
+                    pool.request(0, {"type": "ping"})
+                )
+                await asyncio.sleep(0.2)  # request sent, reply never comes
+                pool.close()
+                with pytest.raises(PoolClosedError):
+                    await asyncio.wait_for(task, timeout=5)
+            finally:
+                sock.close(linger=0)
+                ctx.term()
+
+        asyncio.run(go())
+
+    def test_orphan_replies_accounted(self, zmq_pool):
+        async def go():
+            pool = zmq_pool(mfc_timeout_s=0.3, worker_heartbeat_s=0.05)
+            ctx, sock = _fake_worker_socket(pool._addr, 0)
+            try:
+                await pool.wait_workers(timeout=10)
+                before = _orphan_counts()
+                # Beats stop after hello -> deadline expiry kills worker 0.
+                with pytest.raises(WorkerDeadError):
+                    await pool.request(0, {"type": "mfc"})
+                # Late reply to the timed-out req_id: accounted, no alarm.
+                sock.send(pickle.dumps({"req_id": 0, "result": {}}))
+                # Reply to a req_id that never existed: unknown orphan.
+                sock.send(pickle.dumps({"req_id": 999, "result": {}}))
+                await asyncio.sleep(0.3)
+                after = _orphan_counts()
+                assert after["timed_out"] == before["timed_out"] + 1
+                assert after["unknown"] == before["unknown"] + 1
+            finally:
+                sock.close(linger=0)
+                ctx.term()
+
+        asyncio.run(go())
+
+    def test_beating_worker_is_slow_not_dead(self, zmq_pool):
+        """A worker that keeps heartbeating past the deadline stays
+        alive (deadline re-arms); one that stops beating is declared
+        dead and its future fails with WorkerDeadError."""
+
+        async def go():
+            pool = zmq_pool(mfc_timeout_s=0.3, worker_heartbeat_s=0.05)
+            ctx, sock = _fake_worker_socket(pool._addr, 0)
+            try:
+                await pool.wait_workers(timeout=10)
+                beat = pickle.dumps({"type": "beat", "worker_index": 0})
+                task = asyncio.ensure_future(
+                    pool.request(0, {"type": "mfc"})
+                )
+                # Beat through ~3 deadline windows: slow, not dead.
+                for _ in range(18):
+                    sock.send(beat)
+                    await asyncio.sleep(0.05)
+                assert not task.done()
+                assert pool.dead_workers == set()
+                # Reply arrives late but the request still succeeds.
+                sock.send(pickle.dumps({"req_id": 0, "result": {"ok": 1}}))
+                assert await asyncio.wait_for(task, timeout=5) == {"ok": 1}
+                # Now a request with no beats at all: declared dead, and
+                # the hello slot re-arms for a relaunched worker.
+                with pytest.raises(WorkerDeadError):
+                    await pool.request(0, {"type": "mfc"})
+                assert pool.dead_workers == {0}
+                sock.send(
+                    pickle.dumps({"type": "hello", "worker_index": 0})
+                )
+                await pool.wait_workers(timeout=10)
+                assert pool.dead_workers == set()
+            finally:
+                sock.close(linger=0)
+                ctx.term()
+
+        asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# Master recover round-trip (stub pool: no jax, no model build)
+
+
+class _StubPool(WorkerPool):
+    """Serves the master's save/restore request vocabulary from memory,
+    writing small real files for weight/optimizer saves so manifests
+    have something to inventory."""
+
+    def __init__(self):
+        self.calls = []
+        self.versions = {"default@0": 7}
+
+    @property
+    def n_workers(self):
+        return 1
+
+    async def request(self, worker_id, payload, timeout=None):
+        t = payload["type"]
+        self.calls.append(payload)
+        if t == "save":
+            os.makedirs(payload["save_dir"], exist_ok=True)
+            with open(
+                os.path.join(payload["save_dir"], "model.safetensors"), "wb"
+            ) as f:
+                f.write(b"w" * 32)
+            return {"path": payload["save_dir"]}
+        if t == "save_optimizer":
+            os.makedirs(os.path.dirname(payload["path"]), exist_ok=True)
+            with open(payload["path"], "wb") as f:
+                f.write(b"o" * 16)
+            return {}
+        if t == "model_versions":
+            return {"versions": dict(self.versions)}
+        if t == "data_state":
+            return {"states": [{"epoch": 1, "cursor": 3}]}
+        if t == "interface_state":
+            return {"states": {"default@0": {"mean": 0.5}}}
+        return {}
+
+
+def _make_master(fileroot, pool=None):
+    from areal_tpu.api.config import (
+        ModelInterfaceAbstraction,
+        ModelInterfaceType,
+        ModelName,
+    )
+    from areal_tpu.api.data_api import MicroBatchSpec
+    from areal_tpu.api.dfg import MFCDef, build_graph
+    from areal_tpu.system.master import (
+        ExperimentSaveEvalControl,
+        MasterWorker,
+    )
+
+    node = MFCDef(
+        name="train",
+        model_name=ModelName("default", 0),
+        interface_type=ModelInterfaceType.TRAIN_STEP,
+        interface_impl=ModelInterfaceAbstraction("sft"),
+        input_keys=("packed_input_ids",),
+        n_seqs=2,
+        mb_spec=MicroBatchSpec(),
+    )
+    pool = pool or _StubPool()
+    master = MasterWorker(
+        dfg=build_graph([node]),
+        pool=pool,
+        model_placement={"default@0": 0},
+        data_worker_ids=[0],
+        ctrl=ExperimentSaveEvalControl(ckpt_freq_steps=1),
+        fileroot=fileroot,
+        experiment_name="crash",
+        trial_name="t0",
+    )
+    return master, pool
+
+
+class TestRecoverRoundTrip:
+    def test_recover_save_commits_manifest_and_info(self, tmp_path):
+        fileroot = str(tmp_path)
+        master, pool = _make_master(fileroot)
+        master.step_info = recover.StepInfo(
+            epoch=0, epoch_step=2, global_step=2
+        )
+        asyncio.run(master.save(kind="recover"))
+        base = master._ckpt_dir(master._train_rpcs[0], "recover_checkpoint")
+        m = recover.validate_manifest(base)
+        assert m is not None and m["step"] == 2
+        assert m["model_versions"] == {"default@0": 7}
+        assert sorted(e["name"] for e in m["files"]) == [
+            "model.safetensors", "optimizer_state.pkl",
+        ]
+        # No stale stage left behind.
+        assert recover.stage_dir(base, 2) not in (
+            os.path.join(os.path.dirname(base), n)
+            for n in os.listdir(os.path.dirname(base))
+        )
+        info = recover.load(
+            recover.recover_root(fileroot, "crash", "t0")
+        )
+        assert info.model_versions == {"default@0": 7}
+        assert info.last_step_info == master.step_info
+
+    def test_round_trip_bit_identical(self, tmp_path):
+        """save recover -> new master (a 'restarted' process) -> reload:
+        counters, versions, data cursors, and watermarks identical."""
+        fileroot = str(tmp_path)
+        master, _ = _make_master(fileroot)
+        master.step_info = recover.StepInfo(
+            epoch=1, epoch_step=0, global_step=4
+        )
+        asyncio.run(master.save(kind="recover"))
+        saved = recover.load(recover.recover_root(fileroot, "crash", "t0"))
+
+        master2, pool2 = _make_master(fileroot)
+        assert master2.load_recover_info()
+        assert master2.step_info == master.step_info
+        info = master2._restore_pending
+        assert dataclasses.asdict(info) == dataclasses.asdict(saved)
+        asyncio.run(master2._restore_worker_state())
+        loads = [c for c in pool2.calls if c["type"] == "load_model"]
+        assert len(loads) == 1
+        base = master2._ckpt_dir(
+            master2._train_rpcs[0], "recover_checkpoint"
+        )
+        assert loads[0]["ckpt_dir"] == base
+        sets = [
+            c for c in pool2.calls if c["type"] == "set_model_versions"
+        ]
+        assert sets and sets[0]["versions"] == {"default@0": 7}
+        data_loads = [
+            c for c in pool2.calls if c["type"] == "load_data_state"
+        ]
+        assert data_loads[0]["states"] == [{"epoch": 1, "cursor": 3}]
+
+    def test_restore_falls_back_to_prev_on_torn_current(self, tmp_path):
+        fileroot = str(tmp_path)
+        master, _ = _make_master(fileroot)
+        master.step_info = recover.StepInfo(global_step=1)
+        asyncio.run(master.save(kind="recover"))
+        master.step_info = recover.StepInfo(global_step=2)
+        asyncio.run(master.save(kind="recover"))
+        base = master._ckpt_dir(master._train_rpcs[0], "recover_checkpoint")
+        # Tear the current checkpoint.
+        with open(os.path.join(base, "model.safetensors"), "wb") as f:
+            f.write(b"t")
+        master2, pool2 = _make_master(fileroot)
+        assert master2.load_recover_info()
+        asyncio.run(master2._restore_worker_state())
+        loads = [c for c in pool2.calls if c["type"] == "load_model"]
+        assert loads[0]["ckpt_dir"] == base + recover.PREV_SUFFIX
+
+    def test_restore_refuses_when_both_torn(self, tmp_path):
+        fileroot = str(tmp_path)
+        master, _ = _make_master(fileroot)
+        master.step_info = recover.StepInfo(global_step=1)
+        asyncio.run(master.save(kind="recover"))
+        base = master._ckpt_dir(master._train_rpcs[0], "recover_checkpoint")
+        os.unlink(os.path.join(base, recover.MANIFEST_FILE))
+        master2, _ = _make_master(fileroot)
+        assert master2.load_recover_info()
+        with pytest.raises(RuntimeError, match="torn checkpoint"):
+            asyncio.run(master2._restore_worker_state())
