@@ -40,8 +40,13 @@ busiest stage's ``areal_master_pipeline_fill_ratio`` and the summed
 ``warn: pipeline_fill >= 0.6`` alerts when the overlapped step leaves
 the dominant stage mostly idle), ``ckpt_age`` (seconds since the last
 committed recover checkpoint — ``crit: ckpt_age < 900`` requires a
-crash to lose at most 15 minutes of work), plus any raw unlabeled
-series name.
+crash to lose at most 15 minutes of work), ``anomalies`` /
+``quarantine_streak`` / ``push_rejected`` (numerical-integrity guard
+plane: sentinel trips summed over kinds, the master's live run of
+consecutive quarantined steps, and checksum-rejected weight pushes —
+e.g. ``crit: quarantine_streak <= 2`` pages one step before the
+escalation ladder rolls the trial back to the last good checkpoint),
+plus any raw unlabeled series name.
 
 Exit status: 0 if no CRIT fired over the run, 1 otherwise (``--count``
 bounds the run; without it the poller runs until interrupted).
@@ -328,6 +333,22 @@ def fleet_signals(
     ]
     if ts:
         signals["ckpt_age"] = max(0.0, time.time() - max(ts))
+    # Numerical-integrity guard plane: sentinel trips summed over kinds
+    # (the raw series is labeled, so rules can't address it directly),
+    # the master's live quarantine streak, and checksum-rejected weight
+    # pushes.  ``warn: anomalies <= 0`` surfaces the first quarantined
+    # step; ``crit: quarantine_streak <= 2`` pages one step before the
+    # escalation ladder rolls the trial back; ``crit: push_rejected == 0``
+    # means a generation server saw a corrupt weight payload.
+    an = _series_sum(all_samples, "areal_train_anomaly_total")
+    if an is not None:
+        signals["anomalies"] = an
+    qs = _series_sum(all_samples, "areal_master_consecutive_quarantines")
+    if qs is not None:
+        signals["quarantine_streak"] = qs
+    pr = _series_sum(all_samples, "areal_gen_weight_push_rejected_total")
+    if pr is not None:
+        signals["push_rejected"] = pr
     # Raw unlabeled series become rule-addressable too (last wins on
     # duplicates; labeled series need the computed signals above).
     for n, labels, v in all_samples:
@@ -368,7 +389,8 @@ def render_table(rows: List[Dict[str, object]],
     keys = (
         "goodput", "staleness_p50", "staleness_p99", "queue_depth",
         "kv_utilization", "idle_frac", "version_skew", "backpressure",
-        "pipeline_fill", "pipeline_bubble",
+        "pipeline_fill", "pipeline_bubble", "anomalies",
+        "quarantine_streak", "push_rejected",
     )
     fleet = ", ".join(
         f"{k}={signals[k]:.4g}" for k in keys if k in signals

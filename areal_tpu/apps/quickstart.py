@@ -107,6 +107,21 @@ def _add_common(p: argparse.ArgumentParser):
     p.add_argument("--max-recoveries", type=int, default=3,
                    help="worker deaths the master absorbs by restoring "
                         "the recover checkpoint before exiting non-zero")
+    p.add_argument("--anomaly-grad-norm-mult", type=float, default=0.0,
+                   help="quarantine a train step whose grad norm exceeds "
+                        "this multiple of the engine's running EWMA "
+                        "(must be > 1; 0 = sentinel off; non-finite "
+                        "loss/grads always quarantine)")
+    p.add_argument("--anomaly-update-norm-max", type=float, default=0.0,
+                   help="quarantine a train step whose optimizer update "
+                        "norm exceeds this absolute ceiling (0 = off)")
+    p.add_argument("--max-consecutive-quarantines", type=int, default=3,
+                   help="consecutive quarantined steps before the master "
+                        "rolls the fleet back to the last recover "
+                        "checkpoint (0 = never escalate)")
+    p.add_argument("--no-weight-push-checksum", action="store_true",
+                   help="skip the per-leaf-norm content checksum "
+                        "receivers verify on cross-worker weight pushes")
     p.add_argument("--eval-data", default=None,
                    help="held-out jsonl; after the trial, every saved "
                         "checkpoint is graded (pass@1) by the automatic "
@@ -234,6 +249,10 @@ def cmd_sft(args):
         mfc_timeout_s=args.mfc_timeout_s,
         worker_heartbeat_s=args.worker_heartbeat_s,
         max_recoveries=args.max_recoveries,
+        anomaly_grad_norm_mult=args.anomaly_grad_norm_mult,
+        anomaly_update_norm_max=args.anomaly_update_norm_max,
+        max_consecutive_quarantines=args.max_consecutive_quarantines,
+        weight_push_checksum=not args.no_weight_push_checksum,
     )
     plan = exps.build_sft(cfg)
     for wc in plan.worker_configs:
@@ -387,6 +406,11 @@ def cmd_ppo_math(args):
         mfc_timeout_s=args.mfc_timeout_s,
         worker_heartbeat_s=args.worker_heartbeat_s,
         max_recoveries=args.max_recoveries,
+        anomaly_grad_norm_mult=args.anomaly_grad_norm_mult,
+        anomaly_update_norm_max=args.anomaly_update_norm_max,
+        anomaly_kl_max=args.anomaly_kl_max,
+        max_consecutive_quarantines=args.max_consecutive_quarantines,
+        weight_push_checksum=not args.no_weight_push_checksum,
     )
     plan = exps.build_ppo_math(cfg)
     for wc in plan.worker_configs:
@@ -505,6 +529,10 @@ def main(argv=None):
                          "scheduler)")
     pp.add_argument("--pipeline-chunk-seqs", type=int, default=1,
                     help="pipeline overlap: rollout groups per chunk")
+    pp.add_argument("--anomaly-kl-max", type=float, default=None,
+                    help="quarantine a batch whose mean |policy-ref KL| "
+                         "exceeds this before it ever reaches the train "
+                         "engine (needs --ref-path; omit to disable)")
     pp.set_defaults(fn=cmd_ppo_math)
 
     # Install YAML defaults on whichever subcommand was chosen.

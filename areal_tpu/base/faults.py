@@ -15,7 +15,7 @@ Grammar (commas or whitespace separate faults; ``&`` separates params)::
     SPEC  := FAULT ((","|WS) FAULT)*
     FAULT := KIND ["@" PARAM ("&" PARAM)*]
     PARAM := KEY "=" VALUE
-    KIND  := kill | hang | slow | error
+    KIND  := kill | hang | slow | error | nan | corrupt_push
 
 Params: ``t`` (arm delay; plain seconds, or with an ``s``/``ms``
 suffix), ``p`` (per-call probability, default 1), ``ms`` (added latency
@@ -33,6 +33,13 @@ Semantics at a ``fire(point)`` call site:
   surfaces to the client as an ordinary request failure;
 - ``hang``  — block (p-gated) until :meth:`FaultInjector.release` or the
   ``hang_max_s`` safety cap, simulating a wedged server;
+- ``nan`` / ``corrupt_push`` — PASSIVE numerical-corruption kinds for
+  the integrity guard plane: ``fire`` never applies them; the host asks
+  :meth:`FaultInjector.poison` at a named data boundary (the train
+  engine at ``train_grads``, the gen server at ``weight_push``) and
+  poisons its own payload when a spec is due —
+  ``nan@point=train_grads&skip=2&times=1`` NaN-poisons exactly the
+  third accumulated gradient;
 - ``kill``  — a POINT-SCOPED kill fires inline via
   :meth:`kill_point` (the host checks it at a named spot — e.g. between
   a checkpoint stage and its flip — and exits itself, simulating a
@@ -58,7 +65,11 @@ from areal_tpu.base import logging
 
 logger = logging.getLogger("faults")
 
-KINDS = ("kill", "hang", "slow", "error")
+KINDS = ("kill", "hang", "slow", "error", "nan", "corrupt_push")
+# Kinds `fire` never applies: kills are polled/point-checked by the host;
+# poison kinds are fetched via `poison` at data boundaries.
+PASSIVE_KINDS = ("kill", "nan", "corrupt_push")
+POISON_KINDS = ("nan", "corrupt_push")
 
 ENV_SPEC = "AREAL_FAULTS"
 ENV_SEED = "AREAL_FAULTS_SEED"
@@ -97,8 +108,10 @@ class FaultSpec:
 
 
 def parse_faults(text: str) -> List[FaultSpec]:
-    """Parse a fault-spec string; raises ``ValueError`` on bad grammar so
-    a typo'd chaos run fails loudly instead of silently injecting nothing."""
+    """Parse a fault-spec string, validating the FULL grammar eagerly —
+    every error names the offending clause, so a typo'd chaos run fails
+    loudly at configure time (``from_env``) instead of silently
+    injecting nothing or blowing up at injection time in a hot path."""
     specs: List[FaultSpec] = []
     for raw in re.split(r"[,\s]+", text.strip()):
         if not raw:
@@ -106,29 +119,53 @@ def parse_faults(text: str) -> List[FaultSpec]:
         kind, _, params = raw.partition("@")
         if kind not in KINDS:
             raise ValueError(
-                f"unknown fault kind {kind!r} in {raw!r} (one of {KINDS})"
+                f"bad fault clause {raw!r}: unknown kind {kind!r} "
+                f"(one of {KINDS})"
             )
         kw = dict(kind=kind)
         for param in params.split("&") if params else ():
             key, sep, val = param.partition("=")
             if not sep:
-                raise ValueError(f"malformed fault param {param!r} in {raw!r}")
-            if key == "t":
-                kw["arm_after_s"] = _parse_duration_s(val)
-            elif key == "p":
-                kw["prob"] = float(val)
-                if not 0.0 <= kw["prob"] <= 1.0:
-                    raise ValueError(f"fault probability out of [0,1]: {raw!r}")
-            elif key == "ms":
-                kw["latency_s"] = float(val) / 1000.0
-            elif key == "point":
-                kw["point"] = val
-            elif key in ("skip", "times"):
-                kw[key] = int(val)
-                if kw[key] < 0:
-                    raise ValueError(f"{key} must be >= 0: {raw!r}")
-            else:
-                raise ValueError(f"unknown fault param {key!r} in {raw!r}")
+                raise ValueError(
+                    f"bad fault clause {raw!r}: malformed param {param!r} "
+                    "(want KEY=VALUE)"
+                )
+            try:
+                if key == "t":
+                    kw["arm_after_s"] = _parse_duration_s(val)
+                elif key == "p":
+                    kw["prob"] = float(val)
+                    if not 0.0 <= kw["prob"] <= 1.0:
+                        raise ValueError(
+                            f"probability {val!r} out of [0, 1]"
+                        )
+                elif key == "ms":
+                    kw["latency_s"] = float(val) / 1000.0
+                elif key == "point":
+                    kw["point"] = val
+                elif key in ("skip", "times"):
+                    kw[key] = int(val)
+                    if kw[key] < 0:
+                        raise ValueError(f"{key} must be >= 0, got {val!r}")
+                else:
+                    raise ValueError(
+                        f"unknown param {key!r} "
+                        "(one of t, p, ms, point, skip, times)"
+                    )
+            except ValueError as e:
+                if raw in str(e):
+                    raise
+                raise ValueError(f"bad fault clause {raw!r}: {e}") from None
+        if kind != "slow" and kw.get("latency_s"):
+            raise ValueError(
+                f"bad fault clause {raw!r}: ms= only applies to slow"
+            )
+        if kind in POISON_KINDS and not kw.get("point"):
+            raise ValueError(
+                f"bad fault clause {raw!r}: {kind} needs point= (a data "
+                "boundary the host polls via poison(), e.g. "
+                "point=train_grads or point=weight_push)"
+            )
         specs.append(FaultSpec(**kw))
     if not specs:
         raise ValueError(f"empty fault spec {text!r}")
@@ -232,7 +269,7 @@ class FaultInjector:
         (``error``); returns normally when nothing fires."""
         elapsed = self.elapsed_s()
         for i, s in enumerate(self.specs):
-            if s.kind == "kill" or not s.matches(point, elapsed):
+            if s.kind in PASSIVE_KINDS or not s.matches(point, elapsed):
                 continue
             if not self._count_gate(i, s):
                 continue
@@ -273,6 +310,28 @@ class FaultInjector:
             logger.warning(f"FAULT kill at point {point!r}")
             return True
         return False
+
+    def poison(self, point: str) -> Optional[str]:
+        """Kind of the first due poison fault (``nan``/``corrupt_push``)
+        at this data boundary, or None.  Like :meth:`kill_point`, the
+        injector only renders the verdict — the HOST corrupts its own
+        payload (NaN-scale the grad sum, perturb the pushed params), so
+        chaos runs exercise the real detection path with no test-only
+        code in it."""
+        elapsed = self.elapsed_s()
+        for i, s in enumerate(self.specs):
+            if s.kind not in POISON_KINDS:
+                continue
+            if not s.matches(point, elapsed):
+                continue
+            if not self._count_gate(i, s):
+                continue
+            if not self._chance(s.prob):
+                continue
+            self._record(s.kind)
+            logger.warning(f"FAULT {s.kind} at point {point!r}")
+            return s.kind
+        return None
 
     def release(self) -> None:
         """Unblock every in-flight ``hang`` (host teardown calls this so

@@ -1,0 +1,190 @@
+"""Numerical-integrity guard plane: shared verdict bits + checksums.
+
+PR 13 made the trainer survive *process* failures; this module is the
+backbone of the *numerical* failure story (ISSUE 14): on-device anomaly
+sentinels in the train engines, batch-level sentinels in the PPO
+interface, step quarantine + rollback in the master, and checksummed
+weight pushes on every path that ships params between processes.
+
+Three things live here so every layer agrees on them:
+
+  - the verdict **bit assignments** (one packed scalar crosses the
+    device->host boundary per train step; the master decodes it back
+    into `areal_train_anomaly_total{kind=...}` increments);
+  - the **weight checksum**: a cheap per-leaf L2-norm vector (plus leaf
+    count and element count) stamped by the pusher and verified by the
+    receiver before any param swap — a corrupted push is rejected, not
+    served;
+  - the guard-plane **metric registrations** (the metrics registry is
+    one-name-one-site; engines, interfaces, and servers import the
+    handles from here).
+
+jax is imported lazily: the checksum helpers accept host numpy pytrees
+too, and arealint's CI job imports modules without jax installed.
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from areal_tpu.base import metrics
+
+# ---------------- verdict bits ----------------
+#
+# The packed verdict is a small integer carried as a float32 through the
+# stats plane (stats dicts are flat float maps).  Engine-level bits are
+# computed inside the jitted apply; interface-level bits are OR'd in by
+# the PPO batch sentinels before dispatch.
+
+NONFINITE = 1        # non-finite loss or grad norm (engine)
+GRAD_SPIKE = 2       # grad norm > mult x running EWMA (engine)
+UPDATE_NORM = 4      # update norm above the configured ceiling (engine)
+KL_BLOWUP = 8        # batch mean |KL(policy, ref)| above anomaly_kl_max
+IMP_RATIO = 16       # behavior/ref importance ratio collapsed or exploded
+DEGENERATE_VAR = 32  # every GRPO group's scores have zero variance
+
+_KIND_BITS = (
+    (NONFINITE, "nonfinite"),
+    (GRAD_SPIKE, "grad_spike"),
+    (UPDATE_NORM, "update_norm"),
+    (KL_BLOWUP, "kl_blowup"),
+    (IMP_RATIO, "imp_ratio"),
+    (DEGENERATE_VAR, "degenerate_variance"),
+)
+
+
+def verdict_kinds(verdict: float) -> List[str]:
+    """Decode a packed verdict scalar into its anomaly kind names."""
+    v = int(verdict)
+    return [name for bit, name in _KIND_BITS if v & bit]
+
+
+def record_anomaly(verdict: float) -> None:
+    """Bump `areal_train_anomaly_total{kind=...}` once per set bit."""
+    for kind in verdict_kinds(verdict):
+        M_ANOMALY.labels(kind).inc()
+
+
+# ---------------- weight checksum ----------------
+
+
+class WeightChecksumError(RuntimeError):
+    """A pushed params pytree failed its content checksum."""
+
+
+def params_checksum(tree: Any) -> np.ndarray:
+    """Cheap content fingerprint of a params pytree.
+
+    float64 vector ``[n_leaves, total_elements, leaf_l2_norms...]`` in
+    ``jax.tree.leaves`` order.  Device leaves are reduced on device and
+    fetched with ONE transfer (a stacked vector of scalars); host numpy
+    leaves are reduced locally — so pusher and receiver can checksum on
+    whichever side of the wire they hold the tree.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree.leaves(tree)
+    n_elems = float(sum(int(np.prod(x.shape)) for x in leaves))
+    head = [float(len(leaves)), n_elems]
+    if not leaves:
+        return np.asarray(head, np.float64)
+    if all(isinstance(x, np.ndarray) for x in leaves):
+        norms = [
+            float(np.linalg.norm(np.asarray(x, np.float32).ravel()))
+            for x in leaves
+        ]
+    else:
+        stacked = jnp.stack(
+            [jnp.linalg.norm(x.astype(jnp.float32).ravel()) for x in leaves]
+        )
+        norms = np.asarray(jax.device_get(stacked), np.float64).tolist()
+    return np.asarray(head + norms, np.float64)
+
+
+def checksum_matches(
+    a: np.ndarray, b: np.ndarray, rtol: float = 1e-4, atol: float = 1e-5
+) -> bool:
+    """True iff two checksums describe the same params content.
+
+    Tolerances absorb reduction-order differences between XLA and numpy
+    norms of the same values; any real corruption the `corrupt_push`
+    fault models (a leaf rescaled/shifted in flight) lands far outside
+    them.
+    """
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    if a.shape != b.shape or a.shape[0] < 2:
+        return False
+    if a[0] != b[0] or a[1] != b[1]:
+        return False
+    return bool(np.allclose(a, b, rtol=rtol, atol=atol))
+
+
+def verify_checksum(tree: Any, expected: np.ndarray) -> None:
+    """Raise WeightChecksumError unless `tree` matches `expected`."""
+    got = params_checksum(tree)
+    if not checksum_matches(got, np.asarray(expected, np.float64)):
+        M_PUSH_REJECTED.inc()
+        raise WeightChecksumError(
+            "weight push rejected: params checksum mismatch "
+            f"(expected {np.asarray(expected)[:4]}..., got {got[:4]}...); "
+            "the payload was corrupted in flight — retry the push"
+        )
+
+
+def corrupt_params(tree: Any) -> Any:
+    """Chaos helper (`corrupt_push@` fault): return a copy of the pytree
+    with its first floating leaf rescaled and shifted — the kind of
+    silent payload corruption the checksum exists to catch."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    for i, x in enumerate(leaves):
+        if np.issubdtype(np.asarray(x).dtype, np.floating):
+            leaves = list(leaves)
+            leaves[i] = np.asarray(x) * 1.5 + 1.0
+            break
+    return jax.tree.unflatten(treedef, leaves)
+
+
+# ---------------- quarantine ledger entries ----------------
+
+
+@dataclasses.dataclass
+class QuarantineEntry:
+    """One quarantined step, persisted inside RecoverInfo's ledger."""
+
+    step: int
+    verdict: int
+    kinds: Tuple[str, ...]
+    ids: Tuple[str, ...] = ()
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def quarantine_entry(
+    step: int, verdict: float, ids: Optional[List[str]] = None
+) -> QuarantineEntry:
+    return QuarantineEntry(
+        step=int(step),
+        verdict=int(verdict),
+        kinds=tuple(verdict_kinds(verdict)),
+        ids=tuple(str(i) for i in (ids or ())),
+    )
+
+
+# ---------------- metrics (one registration site) ----------------
+
+_REG = metrics.default_registry()
+M_ANOMALY = _REG.counter(
+    "areal_train_anomaly_total",
+    "train-step anomaly sentinel trips, by kind",
+    ("kind",),
+)
+M_PUSH_REJECTED = _REG.counter(
+    "areal_gen_weight_push_rejected_total",
+    "weight pushes rejected by the receiver's content checksum",
+)

@@ -72,6 +72,15 @@ class RecoverInfo:
     # recovered supervisor resumes epochs monotonically instead of
     # restarting at 0 and re-counting scale actions.
     fleet_state: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Numerical-integrity guard plane: quarantined steps (anomaly verdict
+    # + offending batch ids, see base/integrity.py quarantine_entry) and
+    # the live consecutive-quarantine count, persisted so a restarted
+    # master neither forgets a streak in progress nor loses the audit
+    # trail of which data poisoned which steps.
+    quarantine_ledger: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
+    consecutive_quarantines: int = 0
 
 
 def recover_root(fileroot: str, experiment_name: str, trial_name: str) -> str:

@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model_api import Engine, FinetuneSpec, OptimizerConfig
-from areal_tpu.base import logging
+from areal_tpu.base import faults, integrity, logging
 from areal_tpu.base.distributed import is_primary, to_host
 from areal_tpu.engines import packing
 from areal_tpu.engines.offload import HostOffloadMixin
@@ -136,6 +136,21 @@ class TrainEngine(HostOffloadMixin, Engine):
         #                intra-schedule interleaving (more bubble ticks —
         #                the memory/throughput trade is the caller's).
         pipe_schedule: str = "gpipe",
+        # Anomaly sentinels (the numerical-integrity guard plane).
+        # Non-finite loss/grad detection is ALWAYS on — a NaN update is
+        # never worth applying.  The tunable sentinels default off:
+        #   anomaly_grad_norm_mult M > 1: quarantine when the grad norm
+        #     exceeds M x a running EWMA of clean-step grad norms (the
+        #     EWMA only starts judging after `anomaly_ewma_warmup` clean
+        #     steps, so early-training norm drift doesn't trip it);
+        #   anomaly_update_norm_max > 0: absolute ceiling on the post-
+        #     optimizer update norm.
+        # All verdicts are computed inside the jitted apply and returned
+        # as ONE packed scalar vector, so the guard costs a single extra
+        # host sync per train step and zero retraces.
+        anomaly_grad_norm_mult: float = 0.0,
+        anomaly_update_norm_max: float = 0.0,
+        anomaly_ewma_warmup: int = 5,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -160,6 +175,40 @@ class TrainEngine(HostOffloadMixin, Engine):
         # Optimizer state mirrors param shapes; jitting init lets the SPMD
         # partitioner give mu/nu the same shardings as the params (ZeRO-1).
         self.opt_state = jax.jit(self.optimizer.init)(self.params)
+        # Commit the state to its shardings (no copy): the apply jits pin
+        # their out_shardings to these, so the params/opt/guard carry run
+        # through train steps with byte-identical cache keys — one compiled
+        # executable per apply fn for the whole trial, checkpoint restores
+        # included.
+        # Leaves the partitioner left off-mesh (scalar step counts land on
+        # a single device) are re-homed as mesh-replicated so the commit
+        # never pins state somewhere the apply jits can't accept it.
+        mesh_devices = set(self.mesh.devices.flat)
+        self.opt_shardings = jax.tree.map(
+            lambda a: (
+                a.sharding
+                if a.sharding.device_set == mesh_devices
+                else sharding.named(self.mesh, P())
+            ),
+            self.opt_state,
+        )
+        self.opt_state = jax.device_put(self.opt_state, self.opt_shardings)
+
+        if 0.0 < anomaly_grad_norm_mult <= 1.0:
+            raise ValueError(
+                "anomaly_grad_norm_mult must be > 1 when set (got "
+                f"{anomaly_grad_norm_mult}); 0 disables the spike sentinel"
+            )
+        self.anomaly_grad_norm_mult = float(anomaly_grad_norm_mult)
+        self.anomaly_update_norm_max = float(anomaly_update_norm_max)
+        self.anomaly_ewma_warmup = int(anomaly_ewma_warmup)
+        # (EWMA of clean-step grad norms, clean-step count) — traced args
+        # of the guarded apply, so their evolution never retraces.
+        self._guard_state = None
+        self._faults = faults.FaultInjector.from_env()
+        # Counts batched device->host stat transfers; chaos legs assert
+        # exactly one per train_batch / stream chunk / stream end call.
+        self.host_transfers = 0
 
         self._grad_fns: Dict[Any, Callable] = {}
         self._fwd_fns: Dict[Any, Callable] = {}
@@ -250,45 +299,150 @@ class TrainEngine(HostOffloadMixin, Engine):
         self._grad_fns[loss_fn] = (grad_fn, grad_acc_fn)
         return self._grad_fns[loss_fn]
 
+    def _guarded_step(self, params, opt_state, grads, guard, loss_sum, ext_trip):
+        """In-graph guarded optimizer step (traced inside the apply jits).
+
+        Computes the anomaly verdict, applies the update ONLY when the
+        verdict is clean (per-leaf `jnp.where` select, so the donated
+        buffers stay reusable and a quarantined step returns the original
+        params/opt_state bit-identically), and advances the grad-norm
+        EWMA on clean steps.  Thresholds are Python constants captured at
+        closure build time; everything data-dependent (verdict, guard,
+        ext_trip) is traced — clean and quarantined steps share one trace.
+        """
+        optimizer = self.optimizer
+        mult = self.anomaly_grad_norm_mult
+        unorm_max = self.anomaly_update_norm_max
+        warmup = float(self.anomaly_ewma_warmup)
+
+        gnorm = optax.global_norm(grads)
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        unorm = optax.global_norm(updates)
+
+        ewma, count = guard[0], guard[1]
+        finite = jnp.isfinite(gnorm) & jnp.isfinite(loss_sum)
+        verdict = jnp.where(finite, 0, integrity.NONFINITE).astype(jnp.int32)
+        if mult > 0.0:
+            # NaN gnorm compares False, so a non-finite step never
+            # double-counts as a spike.
+            spike = (count >= warmup) & (gnorm > mult * ewma)
+            verdict = verdict + jnp.where(spike, integrity.GRAD_SPIKE, 0)
+        if unorm_max > 0.0:
+            ceil = finite & (unorm > unorm_max)
+            verdict = verdict + jnp.where(ceil, integrity.UPDATE_NORM, 0)
+
+        ok = (verdict == 0) & (ext_trip == 0)
+        out_params = jax.tree.map(
+            lambda new, old: jnp.where(ok, new, old), new_params, params
+        )
+        out_opt = jax.tree.map(
+            lambda new, old: jnp.where(ok, new, old), new_opt, opt_state
+        )
+        # The EWMA tracks CLEAN grad norms only: a quarantined spike must
+        # not drag the baseline up, or a spike streak would self-absolve.
+        new_ewma = jnp.where(
+            ok,
+            jnp.where(count > 0, 0.9 * ewma + 0.1 * gnorm, gnorm),
+            ewma,
+        )
+        new_count = count + jnp.where(ok, 1.0, 0.0)
+        new_guard = jnp.stack([new_ewma, new_count])
+        packed = jnp.stack(
+            [
+                loss_sum.astype(jnp.float32),
+                gnorm.astype(jnp.float32),
+                unorm.astype(jnp.float32),
+                verdict.astype(jnp.float32),
+            ]
+        )
+        return out_params, out_opt, new_guard, packed
+
     def _get_apply_fn(self):
         if self._apply_fn is not None:
             return self._apply_fn
-        optimizer = self.optimizer
+        step = self._guarded_step
 
         # Donation: params/opt_state/grads buffers are all dead after the
         # step — without it the optimizer step transiently holds 2x params
         # + 2x Adam state, the peak-memory term for large models on one
         # chip.  Grads share the params' shape/dtype set (master dtype), so
-        # their buffers are reusable for the updated params.
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def apply_fn(params, opt_state, grads):
-            gnorm = optax.global_norm(grads)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, gnorm
+        # their buffers are reusable for the updated params.  The guarded
+        # select keeps this safe on quarantined steps: jnp.where's output
+        # may alias either input, and the original values only ever flow
+        # out through the jit's own outputs.
+        @functools.partial(
+            jax.jit,
+            donate_argnums=(0, 1, 2, 3),
+            out_shardings=self._apply_out_shardings(),
+        )
+        def apply_fn(params, opt_state, grads, guard, loss_sum):
+            return step(
+                params, opt_state, grads, guard, loss_sum, jnp.float32(0.0)
+            )
 
         self._apply_fn = apply_fn
         return apply_fn
+
+    def _apply_out_shardings(self):
+        """Output shardings for the guarded apply jits, pinned to the INPUT
+        shardings of the state they round-trip.  Left unpinned, GSPMD is
+        free to hand params back with collapsed specs (e.g. replicated on a
+        1-device mesh), which changes the next call's cache key — the warm
+        path would silently compile a second executable, and a checkpoint
+        restore (device_put back to the canonical shardings) a third."""
+        return (
+            self.param_shardings,
+            self.opt_shardings,
+            sharding.named(self.mesh, P()),
+            sharding.named(self.mesh, P()),
+        )
 
     def _get_scaled_apply_fn(self):
         """Optimizer step for the streamed path: the grad sum was
         accumulated at unit loss_scale (the per-chunk weight is unknown
         until the stream closes), so scale by 1/total_weight here before
-        clipping/AdamW.  Same donation story as `_get_apply_fn`."""
+        clipping/AdamW.  Same donation story as `_get_apply_fn`; the
+        extra `ext_trip` traced scalar lets the interface force a
+        quarantine (batch-level sentinel tripped mid-stream) so the
+        accumulated partial grads are discarded without a retrace."""
         if self._scaled_apply_fn is not None:
             return self._scaled_apply_fn
-        optimizer = self.optimizer
+        step = self._guarded_step
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def apply_fn(params, opt_state, grads, scale):
+        @functools.partial(
+            jax.jit,
+            donate_argnums=(0, 1, 2, 3),
+            out_shardings=self._apply_out_shardings(),
+        )
+        def apply_fn(params, opt_state, grads, guard, loss_sum, scale, ext_trip):
             grads = jax.tree.map(lambda g: g * scale, grads)
-            gnorm = optax.global_norm(grads)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, gnorm
+            return step(params, opt_state, grads, guard, loss_sum, ext_trip)
 
         self._scaled_apply_fn = apply_fn
         return apply_fn
+
+    def _guard(self):
+        if self._guard_state is None:
+            # Committed replicated placement, matching the apply jits'
+            # pinned guard out_sharding — a fresh guard (first step, or a
+            # post-rollback reset) keys identically to an evolved one.
+            self._guard_state = jax.device_put(
+                jnp.zeros(2, jnp.float32), sharding.named(self.mesh, P())
+            )
+        return self._guard_state
+
+    def _poison_grads(self, acc):
+        """`nan@point=train_grads` chaos hook: poison the accumulated
+        grad sum in eager ops, outside every counted jit cache, so the
+        injection itself cannot perturb trace-flatness accounting."""
+        kind = self._faults.poison("train_grads") if self._faults else None
+        if kind == "nan":
+            logger.warning(
+                "fault injection: NaN-poisoning grad sum (train_grads)"
+            )
+            return jax.tree.map(lambda g: g * np.float32("nan"), acc)
+        return acc
 
     # ---------------- Engine API ----------------
 
@@ -367,25 +521,50 @@ class TrainEngine(HostOffloadMixin, Engine):
             losses.append(loss)
             all_stats.append(stats)
 
-        params, opt_state, gnorm = self._get_apply_fn()(
-            self.params, self.opt_state, acc
+        acc = self._poison_grads(acc)
+        loss_sum = jnp.sum(jnp.stack(losses))
+        params, opt_state, self._guard_state, packed = self._get_apply_fn()(
+            self.params, self.opt_state, acc, self._guard(), loss_sum
         )
         self.params, self.opt_state = params, opt_state
 
-        out: Dict[str, float] = {
-            "loss": float(jnp.sum(jnp.stack(losses))),
-            "grad_norm": float(gnorm),
-            "n_micro_batches": float(len(chunks)),
-        }
         # Stats from loss_fn are summed across micro-batches then divided by
         # total weight where keys end in '_sum'; plain keys are averaged.
-        keys = all_stats[0].keys() if all_stats else ()
-        for k in keys:
-            vals = [float(s[k]) for s in all_stats]
+        # Both reductions happen ON DEVICE and ride the packed-verdict
+        # vector, so the whole step pays exactly ONE device->host sync.
+        keys = list(all_stats[0].keys()) if all_stats else []
+        vec = [packed]
+        if keys:
+            vec.append(
+                jnp.stack(
+                    [
+                        jnp.sum(jnp.stack([s[k] for s in all_stats]))
+                        if k.endswith("_sum")
+                        else jnp.mean(jnp.stack([s[k] for s in all_stats]))
+                        for k in keys
+                    ]
+                )
+            )
+        host = np.asarray(jnp.concatenate(vec), np.float64)
+        self.host_transfers += 1
+
+        verdict = float(host[3])
+        if verdict:
+            integrity.record_anomaly(verdict)
+        out: Dict[str, float] = {
+            "loss": float(host[0]),
+            "grad_norm": float(host[1]),
+            "update_norm": float(host[2]),
+            "anomaly_verdict": verdict,
+            "quarantined": 1.0 if verdict else 0.0,
+            "n_micro_batches": float(len(chunks)),
+        }
+        for i, k in enumerate(keys):
+            v = float(host[4 + i])
             if k.endswith("_sum"):
-                out[k[: -len("_sum")]] = sum(vals) / total_weight
+                out[k[: -len("_sum")]] = v / total_weight
             else:
-                out[k] = float(np.mean(vals))
+                out[k] = v
         return out
 
     # ---------------- streamed accumulation ----------------
@@ -474,14 +653,22 @@ class TrainEngine(HostOffloadMixin, Engine):
             all_stats.append(stats)
             state["real_tokens"] += int((arrays["segment_ids"] > 0).sum())
             state["grid_tokens"] += int(np.prod(arrays["segment_ids"].shape))
-        # Host conversion AFTER the dispatch loop (one sync per chunk,
-        # not per micro-batch); the device-side sum also keeps the
-        # window=1 loss bit-identical to train_batch's.
-        chunk_loss = float(jnp.sum(jnp.stack(losses))) if losses else 0.0
+        # Host conversion AFTER the dispatch loop, as ONE batched
+        # transfer (loss sum + every stat sum in a single stacked
+        # vector): one sync per chunk, not per micro-batch or per stat;
+        # the device-side sum also keeps the window=1 loss bit-identical
+        # to train_batch's.
+        chunk_loss = 0.0
         chunk_stats: Dict[str, float] = {}
-        for stats in all_stats:
-            for k, v in stats.items():
-                chunk_stats[k] = chunk_stats.get(k, 0.0) + float(v)
+        if losses:
+            keys = list(all_stats[0].keys())
+            vec = [jnp.sum(jnp.stack(losses))] + [
+                jnp.sum(jnp.stack([s[k] for s in all_stats])) for k in keys
+            ]
+            host = np.asarray(jnp.stack(vec), np.float64)
+            self.host_transfers += 1
+            chunk_loss = float(host[0])
+            chunk_stats = {k: float(host[1 + i]) for i, k in enumerate(keys)}
 
         state["weight"] += chunk_weight
         state["loss_sums"].append(chunk_loss)
@@ -496,16 +683,31 @@ class TrainEngine(HostOffloadMixin, Engine):
             "chunk_micro_batches": float(len(chunks)),
         }
 
-    def train_stream_end(self, state: Dict[str, Any]) -> Dict[str, float]:
-        """Close the stream: one scaled optimizer step over the grad sum."""
+    def train_stream_end(
+        self, state: Dict[str, Any], quarantine: bool = False
+    ) -> Dict[str, float]:
+        """Close the stream: one scaled optimizer step over the grad sum.
+
+        `quarantine=True` (a batch-level sentinel tripped mid-stream)
+        forces the guarded apply to discard the accumulated partial
+        grads: params/opt_state come back bit-identical, via the same
+        traced select as an engine-level verdict — no retrace.
+        """
         if state["acc"] is None:
             raise ValueError("train_stream_end before any train_stream_chunk")
         total_weight = max(state["weight"], 1.0)
-        params, opt_state, gnorm = self._get_scaled_apply_fn()(
-            self.params,
-            self.opt_state,
-            state["acc"],
-            jnp.float32(1.0 / total_weight),
+        acc = self._poison_grads(state["acc"])
+        loss_sum = jnp.float32(sum(state["loss_sums"]))
+        params, opt_state, self._guard_state, packed = (
+            self._get_scaled_apply_fn()(
+                self.params,
+                self.opt_state,
+                acc,
+                self._guard(),
+                loss_sum,
+                jnp.float32(1.0 / total_weight),
+                jnp.float32(1.0 if quarantine else 0.0),
+            )
         )
         self.params, self.opt_state = params, opt_state
         state["acc"] = None  # donated: drop the dead reference
@@ -517,9 +719,17 @@ class TrainEngine(HostOffloadMixin, Engine):
             / max(state["grid_tokens"], 1),
             "n_micro_batches": state["n_micro_batches"],
         }
+        host = np.asarray(packed, np.float64)
+        self.host_transfers += 1
+        verdict = float(host[3])
+        if verdict:
+            integrity.record_anomaly(verdict)
         out: Dict[str, float] = {
             "loss": float(sum(state["loss_sums"])) / total_weight,
-            "grad_norm": float(gnorm),
+            "grad_norm": float(host[1]),
+            "update_norm": float(host[2]),
+            "anomaly_verdict": verdict,
+            "quarantined": 1.0 if (verdict or quarantine) else 0.0,
             "n_micro_batches": float(state["n_micro_batches"]),
             "n_stream_chunks": float(state["n_chunks"]),
         }

@@ -101,11 +101,48 @@ def check_liveness(cfg) -> None:
         )
 
 
+def check_anomaly(cfg) -> None:
+    """Numerical-integrity guard-plane knobs (engines/train.py sentinels,
+    interfaces/ppo.py batch sentinels, master quarantine escalation)."""
+    mult = getattr(cfg, "anomaly_grad_norm_mult", 0.0)
+    if mult < 0:
+        _fail(
+            f"anomaly_grad_norm_mult must be >= 0 (0 disables the "
+            f"grad-spike sentinel), got {mult}"
+        )
+    if 0.0 < mult <= 1.0:
+        # A spike threshold at-or-below the running mean would quarantine
+        # routine steps — the knob is a MULTIPLIER over the EWMA.
+        _fail(
+            f"anomaly_grad_norm_mult must be > 1 when enabled (it "
+            f"multiplies the running grad-norm EWMA), got {mult}"
+        )
+    unorm = getattr(cfg, "anomaly_update_norm_max", 0.0)
+    if unorm < 0:
+        _fail(
+            f"anomaly_update_norm_max must be >= 0 (0 disables the "
+            f"update-norm ceiling), got {unorm}"
+        )
+    kl_max = getattr(cfg, "anomaly_kl_max", None)
+    if kl_max is not None and kl_max <= 0:
+        _fail(
+            f"anomaly_kl_max must be > 0 (omit it to disable the KL "
+            f"sentinel), got {kl_max}"
+        )
+    mcq = getattr(cfg, "max_consecutive_quarantines", 3)
+    if mcq < 0:
+        _fail(
+            f"max_consecutive_quarantines must be >= 0 (0 disables "
+            f"rollback escalation), got {mcq}"
+        )
+
+
 def check_ppo_math(cfg) -> None:
     """Cross-field checks for PPOMathConfig (cheap, no jax import)."""
     check_optimizer(cfg.optimizer)
     check_gconfig(cfg.gconfig)
     check_liveness(cfg)
+    check_anomaly(cfg)
     for role, spec in (
         ("actor", cfg.actor), ("ref", cfg.ref), ("critic", cfg.critic),
     ):
@@ -266,6 +303,7 @@ def check_ppo_math(cfg) -> None:
 def check_sft(cfg) -> None:
     check_optimizer(cfg.optimizer)
     check_liveness(cfg)
+    check_anomaly(cfg)
     check_model_path("model", cfg.model)
     check_batch_vs_parallel(
         "train", cfg.batch_size, cfg.parallel, cfg.mb_spec.n_mbs

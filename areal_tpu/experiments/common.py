@@ -78,6 +78,11 @@ class ExperimentPlan:
     mfc_timeout_s: Optional[float] = None
     worker_heartbeat_s: float = 5.0
     max_recoveries: int = 3
+    # Numerical-integrity guard plane (see system/master.py): quarantine
+    # streak length that escalates to a checkpoint rollback (0 = count
+    # only), and content checksums on cross-set weight pushes.
+    max_consecutive_quarantines: int = 3
+    weight_push_checksum: bool = True
 
 
 @dataclasses.dataclass
@@ -104,6 +109,14 @@ class SFTConfig:
     mfc_timeout_s: Optional[float] = None
     worker_heartbeat_s: float = 5.0
     max_recoveries: int = 3
+    # Numerical-integrity guard plane: grad-norm-spike multiplier vs the
+    # engine's running EWMA (0 = sentinel off; must be > 1 when set),
+    # absolute update-norm ceiling (0 = off), quarantine-streak rollback
+    # threshold, and checksummed weight pushes (see ExperimentPlan).
+    anomaly_grad_norm_mult: float = 0.0
+    anomaly_update_norm_max: float = 0.0
+    max_consecutive_quarantines: int = 3
+    weight_push_checksum: bool = True
 
 
 def build_sft(cfg: SFTConfig, tokenizer=None) -> ExperimentPlan:
@@ -127,7 +140,9 @@ def build_sft(cfg: SFTConfig, tokenizer=None) -> ExperimentPlan:
     shard = ModelShardSpec(
         name=model_name,
         model=cfg.model,
-        backend=ModelBackendAbstraction("train"),
+        backend=ModelBackendAbstraction(
+            "train", _anomaly_backend_args(cfg)
+        ),
         interface=ModelInterfaceAbstraction("sft"),
         parallel=cfg.parallel,
         optimizer=cfg.optimizer,
@@ -167,7 +182,24 @@ def build_sft(cfg: SFTConfig, tokenizer=None) -> ExperimentPlan:
         mfc_timeout_s=cfg.mfc_timeout_s,
         worker_heartbeat_s=cfg.worker_heartbeat_s,
         max_recoveries=cfg.max_recoveries,
+        max_consecutive_quarantines=cfg.max_consecutive_quarantines,
+        weight_push_checksum=cfg.weight_push_checksum,
     )
+
+
+def _anomaly_backend_args(cfg, base: Optional[Dict[str, Any]] = None):
+    """Fold the config's engine-level anomaly knobs into a train-backend
+    args dict (explicit train_backend_args entries win)."""
+    args: Dict[str, Any] = dict(base or {})
+    if cfg.anomaly_grad_norm_mult:
+        args.setdefault(
+            "anomaly_grad_norm_mult", cfg.anomaly_grad_norm_mult
+        )
+    if cfg.anomaly_update_norm_max:
+        args.setdefault(
+            "anomaly_update_norm_max", cfg.anomaly_update_norm_max
+        )
+    return args
 
 
 @dataclasses.dataclass
@@ -320,6 +352,16 @@ class PPOMathConfig:
     mfc_timeout_s: Optional[float] = None
     worker_heartbeat_s: float = 5.0
     max_recoveries: int = 3
+    # Numerical-integrity guard plane: engine-level grad-spike multiplier
+    # vs running EWMA and absolute update-norm ceiling (0 = off; folded
+    # into train_backend_args, explicit entries win); batch-level KL
+    # sentinel for the actor interface (None = off; ppo_kwargs wins);
+    # quarantine-streak rollback threshold; checksummed weight pushes.
+    anomaly_grad_norm_mult: float = 0.0
+    anomaly_update_norm_max: float = 0.0
+    anomaly_kl_max: Optional[float] = None
+    max_consecutive_quarantines: int = 3
+    weight_push_checksum: bool = True
 
 
 def _remote_gen_shard(cfg: "PPOMathConfig", actor_gen, actor_if):
@@ -378,6 +420,11 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
 
     ppo_kwargs = dict(cfg.ppo_kwargs)
     ppo_kwargs.setdefault("disable_value", disable_value)
+    if cfg.anomaly_kl_max is not None:
+        ppo_kwargs.setdefault("anomaly_kl_max", cfg.anomaly_kl_max)
+    train_backend_args = _anomaly_backend_args(
+        cfg, cfg.train_backend_args
+    )
     if (cfg.max_head_offpolicyness or 0) > 0:
         # Off-policy samples are admissible -> decoupled PPO corrects for
         # them.  At cap 0 the plain loss keeps exact synchronous numerics.
@@ -580,7 +627,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
             name=actor,
             model=cfg.actor,
             backend=ModelBackendAbstraction(
-                "train", dict(cfg.train_backend_args)
+                "train", dict(train_backend_args)
             ),
             interface=actor_if,
             parallel=cfg.actor_parallel,
@@ -657,7 +704,7 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
                 name=critic,
                 model=cfg.critic,
                 backend=ModelBackendAbstraction(
-                    "train", dict(cfg.train_backend_args)
+                    "train", dict(train_backend_args)
                 ),
                 interface=critic_if,
                 parallel=cfg.critic_parallel,
@@ -712,6 +759,8 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
         mfc_timeout_s=cfg.mfc_timeout_s,
         worker_heartbeat_s=cfg.worker_heartbeat_s,
         max_recoveries=cfg.max_recoveries,
+        max_consecutive_quarantines=cfg.max_consecutive_quarantines,
+        weight_push_checksum=cfg.weight_push_checksum,
     )
 
 
@@ -758,6 +807,8 @@ def run_experiment(plan: ExperimentPlan, tokenizer=None):
         overlap_window=plan.overlap_window,
         pipeline_chunk_seqs=plan.pipeline_chunk_seqs,
         max_recoveries=plan.max_recoveries,
+        max_consecutive_quarantines=plan.max_consecutive_quarantines,
+        weight_push_checksum=plan.weight_push_checksum,
     )
     master.load_recover_info()
     stats = asyncio.run(master.run())

@@ -28,7 +28,7 @@ from areal_tpu.api.model_api import (
     ModelInterface,
     register_interface,
 )
-from areal_tpu.base import logging
+from areal_tpu.base import integrity, logging
 from areal_tpu.base.stats import merge_stats
 from areal_tpu.ops import functional as F
 from areal_tpu.ops.gae import gae_packed
@@ -262,6 +262,53 @@ class PPOActorInterface(ModelInterface):
     # the cap are masked out).  None = standard PPO — exactly today's
     # numerics, which is what `max_head_offpolicyness=0` configures.
     behav_imp_weight_cap: Optional[float] = None
+    # Batch-level anomaly sentinels (numerical-integrity guard plane),
+    # evaluated on host statistics BEFORE any gradient work is
+    # dispatched — unlike early_stop_*, which reacts to per-minibatch
+    # training stats, these reject the whole batch as unsound input:
+    #   anomaly_kl_max: mean |logp - ref_logp| over response tokens
+    #     above this -> KL blowup, quarantine the step;
+    #   anomaly_imp_ratio_max R > 1: mean behavior importance weight
+    #     exp(prox_logp - old_logp) outside [1/R, R] -> the behavior
+    #     policy is too stale for clipped updates (decoupled PPO only);
+    #   anomaly_degenerate_variance: every GRPO group's scores have
+    #     zero variance -> all advantages are 0/eps noise (a poisoned or
+    #     saturated reward).  Off by default: tiny eval trials with
+    #     constant rewards are routine.
+    # A tripped sentinel quarantines the step: the barrier path skips
+    # all minibatches; the streamed path stops accumulating and forces
+    # the engine to discard partial grads at train_stream_end.
+    anomaly_kl_max: Optional[float] = None
+    anomaly_imp_ratio_max: Optional[float] = None
+    anomaly_degenerate_variance: bool = False
+
+    def _batch_verdict(self, aux) -> int:
+        """OR of interface-level verdict bits for this batch (0 = clean).
+
+        Host-side means under sharded dispatch are computed over this
+        member's own rows only — every member sees the same broadcast
+        per-seq keys, and per-token anomalies large enough to matter
+        dominate any single shard's mean, so the verdict stays
+        SPMD-consistent in practice for the blowup thresholds it guards.
+        """
+        v = 0
+        if (
+            self.anomaly_kl_max is not None
+            and aux.get("kl_abs_mean") is not None
+            and aux["kl_abs_mean"] > self.anomaly_kl_max
+        ):
+            v |= integrity.KL_BLOWUP
+        if (
+            self.anomaly_imp_ratio_max is not None
+            and aux.get("behav_imp_mean") is not None
+        ):
+            r = aux["behav_imp_mean"]
+            cap = self.anomaly_imp_ratio_max
+            if not (1.0 / cap <= r <= cap):
+                v |= integrity.IMP_RATIO
+        if self.anomaly_degenerate_variance and aux.get("degenerate_var"):
+            v |= integrity.DEGENERATE_VAR
+        return v
 
     def _kl(self):
         if getattr(self, "_kl_inst", None) is None:
@@ -462,16 +509,20 @@ class PPOActorInterface(ModelInterface):
             seq_slices.append((lo, hi))
         rewards *= loss_mask
 
+        degenerate_var = None
         if self.disable_value:
             # GRPO: group-normalized terminal score broadcast over response.
             adv_seq = np.zeros(len(layout), np.float32)
             groups: Dict[int, list] = {}
             for si in range(len(layout)):
                 groups.setdefault(group_of[si], []).append(si)
+            degenerate_var = len(groups) > 0
             for gi, sis in groups.items():
                 g_scores = scores[sis]
                 mean = g_scores.mean()
                 std = g_scores.std()
+                if std > 0:
+                    degenerate_var = False
                 adv_seq[sis] = (g_scores - mean) / (std + 1e-5)
             for si, (lo, hi) in enumerate(seq_slices):
                 adv_full[lo:hi] = adv_seq[si]
@@ -581,6 +632,19 @@ class PPOActorInterface(ModelInterface):
             aligned["prox_logp"] = prox_logp
             extra_keys = extra_keys + ("prox_logp",)
         _add_aligned_keys(train_sample, aligned)
+        # Sentinel inputs (host means over this member's rows).
+        mt = float(loss_mask.sum())
+        kl_abs_mean = None
+        if ref_logp is not None and mt > 0:
+            kl_abs_mean = float(
+                (np.abs(old_logp - ref_logp) * loss_mask).sum() / mt
+            )
+        behav_imp_mean = None
+        if prox_logp is not None and mt > 0:
+            behav_imp_mean = float(
+                (np.exp((prox_logp - old_logp) * loss_mask) * loss_mask).sum()
+                / mt
+            )
         aux = {
             "klv": klv,
             "n_seqs": len(layout),
@@ -590,6 +654,9 @@ class PPOActorInterface(ModelInterface):
             "scores": scores,
             "no_eos": no_eos,
             "ref_kl": ref_kl,
+            "kl_abs_mean": kl_abs_mean,
+            "behav_imp_mean": behav_imp_mean,
+            "degenerate_var": degenerate_var,
         }
         return train_sample, extra_keys, aux
 
@@ -601,6 +668,32 @@ class PPOActorInterface(ModelInterface):
         )
         loss_mask = aux["loss_mask"]
         old_logp, ref_logp = aux["old_logp"], aux["ref_logp"]
+
+        verdict = self._batch_verdict(aux)
+        if verdict:
+            # Quarantine BEFORE any gradient dispatch: no minibatch of
+            # this batch touches the optimizer; the master records a
+            # skipped step (and escalates to rollback on a streak).
+            integrity.record_anomaly(verdict)
+            logger.warning(
+                "batch sentinel quarantined train step: "
+                f"{integrity.verdict_kinds(verdict)} "
+                f"(kl_abs_mean={aux['kl_abs_mean']} "
+                f"behav_imp_mean={aux['behav_imp_mean']} "
+                f"degenerate_var={aux['degenerate_var']})"
+            )
+            model.inc_version()
+            return {
+                "anomaly_verdict": float(verdict),
+                "quarantined": 1.0,
+                "task_reward": float(aux["scores"].mean()),
+                "no_eos_ratio": float(aux["no_eos"].mean()),
+                "n_response_tokens": float(loss_mask.sum()),
+                "kl_ctl_value": aux["klv"],
+                "n_minibatches_skipped": float(
+                    min(self.n_minibatches, train_sample.bs)
+                ),
+            }
 
         loss_fn = self._get_loss_fn()
         all_stats = []
@@ -701,6 +794,9 @@ class PPOActorInterface(ModelInterface):
             "klv": self._kl().value,
             "stopped": False,
             "n_chunks_skipped": 0,
+            # Batch-sentinel trip: stop accumulating AND force the
+            # engine to discard the partial grad sum at stream end.
+            "quarantine_verdict": 0,
         }
 
     def train_stream_chunk(
@@ -726,6 +822,25 @@ class PPOActorInterface(ModelInterface):
         train_sample, extra_keys, aux = self._prepare_train_sample(
             model, sample, mb_spec
         )
+        verdict = self._batch_verdict(aux)
+        if verdict:
+            # Sentinel tripped mid-stream: this chunk never reaches the
+            # engine, later chunks short-circuit via `stopped`, and the
+            # whole step's partial grad sum is discarded at stream end.
+            state["stopped"] = True
+            state["quarantine_verdict"] |= verdict
+            state["n_chunks_skipped"] += 1
+            integrity.record_anomaly(verdict)
+            logger.warning(
+                "batch sentinel quarantined stream chunk "
+                f"{len(state['chunk_stats']) + 1}: "
+                f"{integrity.verdict_kinds(verdict)}; the step's "
+                "accumulated gradient will be discarded"
+            )
+            return {
+                "n_chunks_skipped": 1.0,
+                "anomaly_verdict": float(verdict),
+            }
         raw = model.engine.train_stream_chunk(
             state["engine"],
             train_sample,
@@ -784,7 +899,20 @@ class PPOActorInterface(ModelInterface):
         self, model: Model, state: Dict, mb_spec: MicroBatchSpec
     ) -> Dict[str, float]:
         """One optimizer step over the streamed grad sum + merged stats."""
-        eng_out = model.engine.train_stream_end(state["engine"])
+        verdict = int(state["quarantine_verdict"])
+        if verdict and state["engine"]["acc"] is None:
+            # Sentinel tripped before any chunk reached the engine:
+            # there is no grad sum to discard and no optimizer step.
+            eng_out: Dict[str, float] = {
+                "grad_norm": 0.0,
+                "update_norm": 0.0,
+                "n_micro_batches": 0.0,
+                "n_stream_chunks": 0.0,
+            }
+        else:
+            eng_out = model.engine.train_stream_end(
+                state["engine"], quarantine=bool(verdict)
+            )
         model.inc_version()
         out = (
             merge_stats(state["chunk_stats"]) if state["chunk_stats"] else {}
@@ -792,6 +920,11 @@ class PPOActorInterface(ModelInterface):
         # The engine's stream totals are authoritative for the keys both
         # report (they agree up to float reassociation).
         out.update(eng_out)
+        if verdict:
+            out["anomaly_verdict"] = float(
+                int(out.get("anomaly_verdict", 0.0)) | verdict
+            )
+            out["quarantined"] = 1.0
         ref_kl = 0.0
         if state["kl_den"] > 0:
             ref_kl = state["kl_num"] / state["kl_den"]

@@ -17,14 +17,46 @@ import dataclasses
 import json
 import os
 import threading
+import time
+import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
-from areal_tpu.base import logging, tracer
+from areal_tpu.base import logging, metrics, tracer
 
 logger = logging.getLogger("reward_service")
+
+# Remote round-trips that failed, by failure class — the signal that
+# separates "the FaaS is down" (network/timeout) from "we disagree about
+# the wire format" (http/protocol) on the fleet dashboard.
+_M_REMOTE_ERRORS = metrics.default_registry().counter(
+    "areal_reward_remote_errors_total",
+    "remote reward verification failures, by reason",
+    ("reason",),
+)
+
+# The failure classes verify_batch retries on.  Everything else (a
+# programming error) propagates — a typo must not silently degrade every
+# batch to local grading forever.
+_RETRYABLE = (urllib.error.URLError, TimeoutError, OSError, ValueError,
+              KeyError)
+
+
+def _error_reason(e: BaseException) -> str:
+    """Map a transport/protocol failure onto its counter label."""
+    if isinstance(e, urllib.error.HTTPError):
+        return "http"
+    if isinstance(e, TimeoutError):
+        return "timeout"
+    if isinstance(e, urllib.error.URLError):
+        if isinstance(getattr(e, "reason", None), TimeoutError):
+            return "timeout"
+        return "network"
+    if isinstance(e, OSError):
+        return "network"
+    return "protocol"
 
 
 def _grade_one(item: Dict[str, Any]) -> bool:
@@ -127,35 +159,83 @@ class RemoteVerifier:
     """Client for the reward service with local fallback.
 
     The reference tolerates FaaS flakiness by retrying then falling back;
-    here a failed round-trip falls back to in-process grading so a dead
-    service degrades throughput, never correctness."""
+    here each batch gets `attempts` tries (per-attempt `timeout_s`,
+    linear `backoff_s` between tries) over the TYPED failure set —
+    transport errors, timeouts, and malformed replies — before falling
+    back to in-process grading, so a dead service degrades throughput,
+    never correctness.  Every failed round-trip bumps
+    `areal_reward_remote_errors_total{reason}`; the degradation itself is
+    logged at warning once per client, then demoted to debug so a
+    long-dead service doesn't flood the trial log once per batch."""
 
     url: str
     timeout_s: float = 600.0
     token: str = ""
+    attempts: int = 3
+    backoff_s: float = 0.5
+    _degraded: bool = dataclasses.field(
+        default=False, init=False, repr=False
+    )
+
+    def __post_init__(self):
+        if self.attempts < 1:
+            raise ValueError(
+                f"RemoteVerifier.attempts must be >= 1, got {self.attempts}"
+            )
+        if self.backoff_s < 0:
+            raise ValueError(
+                f"RemoteVerifier.backoff_s must be >= 0, got "
+                f"{self.backoff_s}"
+            )
+
+    def _round_trip(self, items: List[Dict[str, Any]]) -> List[bool]:
+        headers = {"Content-Type": "application/json"}
+        tok = self.token or os.environ.get("AREAL_REWARD_TOKEN", "")
+        if tok:
+            headers["X-Areal-Token"] = tok
+        req = urllib.request.Request(
+            self.url.rstrip("/") + "/verify",
+            data=json.dumps({"items": items}).encode(),
+            headers=headers,
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            out = json.loads(r.read())
+        results = [bool(x) for x in out["results"]]
+        if len(results) != len(items):
+            raise ValueError(
+                f"result length mismatch: sent {len(items)} items, got "
+                f"{len(results)} results"
+            )
+        return results
 
     def verify_batch(self, items: List[Dict[str, Any]]) -> List[bool]:
-        try:
-            headers = {"Content-Type": "application/json"}
-            tok = self.token or os.environ.get("AREAL_REWARD_TOKEN", "")
-            if tok:
-                headers["X-Areal-Token"] = tok
-            req = urllib.request.Request(
-                self.url.rstrip("/") + "/verify",
-                data=json.dumps({"items": items}).encode(),
-                headers=headers,
-            )
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-                out = json.loads(r.read())
-            results = [bool(x) for x in out["results"]]
-            if len(results) != len(items):
-                raise ValueError("result length mismatch")
-            return results
-        except Exception as e:  # noqa: BLE001 — degrade to local grading
-            logger.warning(
-                f"remote verification failed ({e!r}); grading locally"
-            )
-            return [_grade_one(it) for it in items]
+        for attempt in range(1, self.attempts + 1):
+            try:
+                results = self._round_trip(items)
+                if self._degraded:
+                    self._degraded = False
+                    logger.info(
+                        f"remote verification at {self.url} recovered"
+                    )
+                return results
+            except _RETRYABLE as e:
+                reason = _error_reason(e)
+                _M_REMOTE_ERRORS.labels(reason).inc()
+                if attempt < self.attempts:
+                    logger.debug(
+                        f"remote verification attempt {attempt}/"
+                        f"{self.attempts} failed ({reason}: {e!r}); "
+                        f"retrying in {self.backoff_s * attempt:.1f}s"
+                    )
+                    time.sleep(self.backoff_s * attempt)
+                    continue
+                log = logger.debug if self._degraded else logger.warning
+                log(
+                    f"remote verification failed after {self.attempts} "
+                    f"attempts (last: {reason}: {e!r}); grading locally"
+                )
+                self._degraded = True
+        return [_grade_one(it) for it in items]
 
 
 def main():

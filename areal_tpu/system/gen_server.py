@@ -54,7 +54,7 @@ from areal_tpu.api.model_api import (
     LLMAPIClient,
     register_backend,
 )
-from areal_tpu.base import logging, metrics, tracer
+from areal_tpu.base import integrity, logging, metrics, tracer
 from areal_tpu.base.faults import FaultInjector
 
 logger = logging.getLogger("gen_server")
@@ -532,14 +532,30 @@ class GenerationServer:
         with self._resume_cond:
             self._resume_cond.notify_all()
 
-    def update_weights_inmem(self, params) -> int:
+    def update_weights_inmem(self, params, checksum=None) -> int:
         """Interruptible in-memory weight push (async RL): pause at a
         chunk boundary, hot-swap the given params pytree directly into
         the engine (no disk checkpoint), bump the version, resume —
         interrupted requests continue on their existing KV pages, so the
         push costs one chunk of replay instead of a full drain.  Python
-        API only: a params pytree cannot ship over the JSON transports."""
+        API only: a params pytree cannot ship over the JSON transports.
+
+        `checksum` (from ``integrity.params_checksum`` at the pusher) is
+        verified BEFORE the swap; a mismatch raises
+        :class:`~areal_tpu.base.integrity.WeightChecksumError`, bumps
+        ``areal_gen_weight_push_rejected_total``, and leaves the server
+        decoding on its previous healthy weights — the pusher retries.
+        The ``corrupt_push@point=weight_push`` chaos kind corrupts the
+        incoming payload here, modeling in-flight corruption against the
+        real verification path."""
+        if (
+            self._faults is not None
+            and self._faults.poison("weight_push") == "corrupt_push"
+        ):
+            params = integrity.corrupt_params(params)
         with self._update_mutex:
+            if checksum is not None:
+                integrity.verify_checksum(params, checksum)
             self.pause()
             try:
                 with self._engine_lock:

@@ -19,7 +19,15 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from areal_tpu.api.config import ModelInterfaceType
 from areal_tpu.api.dfg import DFG, MFCDef, OffloadHook, ParamReallocHook
-from areal_tpu.base import faults, logging, metrics, recover, timeutil, tracer
+from areal_tpu.base import (
+    faults,
+    integrity,
+    logging,
+    metrics,
+    recover,
+    timeutil,
+    tracer,
+)
 from areal_tpu.base.monitor import StatsLogger
 from areal_tpu.base.stats import merge_stats
 from areal_tpu.system.buffer import SequenceBuffer
@@ -235,6 +243,18 @@ class MasterWorker:
         # processes (may be sync or async).  Without one the master still
         # re-waits — an externally relaunched worker re-joins by itself.
         worker_relauncher: Optional[Any] = None,
+        # Numerical-integrity guard plane: a step whose merged stats carry
+        # a `quarantined` flag (engine/interface anomaly sentinels tripped
+        # and the weight update was discarded) extends a consecutive
+        # streak; after this many in a row the master escalates to a
+        # rollback onto the last manifest-valid recover checkpoint,
+        # sharing the worker-death recovery budget (max_recoveries).
+        # 0 disables escalation (quarantined steps are only counted).
+        max_consecutive_quarantines: int = 3,
+        # Stamp a per-leaf-norm content checksum on cross-set weight
+        # pushes (param_send) so the receiver verifies the payload before
+        # swapping; a corrupted push is rejected and retried once.
+        weight_push_checksum: bool = True,
     ):
         self.dfg = dfg
         self.pool = pool
@@ -324,9 +344,33 @@ class MasterWorker:
             "areal_ckpt_last_success_timestamp_seconds",
             "unix time of the last committed recover checkpoint",
         )
+        # Numerical-integrity guard plane: quarantined steps (update
+        # discarded, data consumed), the live streak the escalation
+        # ladder watches, and the rollbacks it triggered.
+        self._m_quarantined = reg.counter(
+            "areal_master_quarantined_steps_total",
+            "train steps quarantined by the anomaly sentinels",
+        )
+        self._m_consec_quar = reg.gauge(
+            "areal_master_consecutive_quarantines",
+            "current run of consecutive quarantined steps",
+        )
+        self._m_quar_rollbacks = reg.counter(
+            "areal_master_quarantine_rollbacks_total",
+            "checkpoint rollbacks triggered by quarantine streaks",
+        )
         self.max_recoveries = int(max_recoveries)
         self.worker_relauncher = worker_relauncher
         self._recoveries = 0
+        self.max_consecutive_quarantines = int(max_consecutive_quarantines)
+        self.weight_push_checksum = bool(weight_push_checksum)
+        self._consecutive_quarantines = 0
+        self._quarantine_ledger: List[Dict[str, Any]] = []
+        # Data ids of the most recent _load_data batch — the ledger's
+        # best-effort attribution of WHICH samples poisoned a quarantined
+        # step (exact on the barrier/streamed paths; the async paths may
+        # be one prefetch ahead).
+        self._last_data_ids: List[str] = []
         # Master-side chaos points (AREAL_FAULTS): recover_stage /
         # recover_flip kill the master between a checkpoint stage and its
         # flip, proving a torn save never loses recoverability.
@@ -483,6 +527,7 @@ class MasterWorker:
                 dt = time.monotonic() - t0
                 stats["time/step_s"] = dt
                 self._export_step_metrics(stats, dt)
+                quarantined = self._note_quarantine(stats)
                 self.stats_history.append(stats)
                 logger.info(
                     f"step {self.step_info.global_step + 1}/{total_steps} "
@@ -491,7 +536,17 @@ class MasterWorker:
                 )
                 self.stats_logger.log(self.step_info.global_step + 1, stats)
                 self.step_info = self.step_info.next(self._steps_per_epoch)
-                await self._post_step()
+                if not quarantined:
+                    await self._post_step()
+                elif (
+                    self.max_consecutive_quarantines > 0
+                    and self._consecutive_quarantines
+                    >= self.max_consecutive_quarantines
+                ):
+                    # A quarantined step never checkpoints (the rollback
+                    # target must stay pre-anomaly); a streak at the
+                    # threshold escalates to a fleet-wide rollback.
+                    await self._quarantine_rollback()
                 tracer.flush()
         finally:
             self.stats_logger.close()
@@ -570,6 +625,96 @@ class MasterWorker:
         logger.info(
             f"recovered from worker {err.worker_id} death; resuming at "
             f"step {self.step_info.global_step}"
+        )
+
+    # ---------------- step quarantine + escalation ----------------
+
+    def _note_quarantine(self, stats: Dict[str, float]) -> bool:
+        """Fold the step's sentinel outcome into the escalation state.
+
+        Any MFC reporting a positive `quarantined` stat means the anomaly
+        sentinels tripped and the weight update was discarded on-device
+        (engines/train.py guarded apply) or never dispatched
+        (interfaces/ppo.py batch sentinels): bump the streak, record the
+        step + decoded verdict + offending data ids in the ledger.  A
+        clean step resets the streak."""
+        quarantined = any(
+            k.rsplit("/", 1)[-1] == "quarantined" and v > 0
+            for k, v in stats.items()
+        )
+        if not quarantined:
+            if self._consecutive_quarantines:
+                self._consecutive_quarantines = 0
+                self._m_consec_quar.set(0.0)
+            return False
+        verdict = 0
+        for k, v in stats.items():
+            if k.rsplit("/", 1)[-1] == "anomaly_verdict":
+                verdict |= int(v)
+        self._consecutive_quarantines += 1
+        self._m_quarantined.inc()
+        self._m_consec_quar.set(float(self._consecutive_quarantines))
+        entry = integrity.quarantine_entry(
+            self.step_info.global_step, verdict, self._last_data_ids
+        )
+        self._quarantine_ledger.append(entry.as_dict())
+        logger.warning(
+            "QUARANTINE "
+            + json.dumps(
+                {
+                    "event": "step_quarantined",
+                    "step": self.step_info.global_step,
+                    "verdict": verdict,
+                    "kinds": list(entry.kinds),
+                    "consecutive": self._consecutive_quarantines,
+                    "threshold": self.max_consecutive_quarantines,
+                },
+                sort_keys=True,
+            )
+        )
+        return True
+
+    async def _quarantine_rollback(self) -> None:
+        """Escalate a quarantine streak: abort any residual step state and
+        roll every worker back to the last manifest-valid recover
+        checkpoint — quarantined steps never checkpoint, so that target
+        predates the first anomaly of the streak.  Shares (and is bounded
+        by) the worker-death recovery budget."""
+        self._recoveries += 1
+        self._m_recoveries.inc()
+        self._m_quar_rollbacks.inc()
+        report = {
+            "event": "quarantine_rollback",
+            "step": self.step_info.global_step,
+            "consecutive_quarantines": self._consecutive_quarantines,
+            "ledger_tail": self._quarantine_ledger[
+                -self._consecutive_quarantines:
+            ],
+            "recovery": self._recoveries,
+            "max_recoveries": self.max_recoveries,
+        }
+        logger.error(f"FAULT_REPORT {json.dumps(report, sort_keys=True)}")
+        if self._recoveries > self.max_recoveries:
+            raise RuntimeError(
+                f"recovery budget exhausted ({self.max_recoveries}): "
+                f"{self._consecutive_quarantines} consecutive quarantined "
+                "steps"
+            )
+        await self._abort_step()
+        if not self.load_recover_info():
+            raise RuntimeError(
+                "quarantine streak hit before the first recover checkpoint "
+                "existed; nothing to roll back to"
+            )
+        await self._restore_worker_state()
+        # The streak is resolved by the rollback (the replayed steps get a
+        # fresh verdict); load_recover_info restored the persisted count,
+        # which described the saved state, not the post-rollback one.
+        self._consecutive_quarantines = 0
+        self._m_consec_quar.set(0.0)
+        logger.info(
+            "quarantine rollback complete; resuming at step "
+            f"{self.step_info.global_step}"
         )
 
     async def _abort_step(self) -> None:
@@ -1061,6 +1206,7 @@ class MasterWorker:
                         meta, step=self.step_info.global_step
                     )
                     ids.extend(meta.ids)
+            self._last_data_ids = list(ids)
         return ids
 
     def _record_owner(self, meta, worker: int, replace: bool = False):
@@ -1487,45 +1633,70 @@ class MasterWorker:
                 # one copy to each target member; sends and recvs are
                 # dispatched concurrently so no side waits on the other's
                 # request ordering.
-                xfer_ids = list(
-                    range(self._xfer_id, self._xfer_id + len(target_group))
-                )
-                self._xfer_id += len(target_group)
-                with tracer.span(
-                    f"param_realloc:{hook.target}", cat="comms",
-                    n_dst=len(target_group),
-                ) as realloc_args:
-                    resps = await asyncio.gather(
-                        *[
-                            self.pool.request(
-                                w,
-                                {
-                                    "type": "param_send",
-                                    "model_name": str(node.model_name),
-                                    "dsts": target_group,
-                                    "xfer_ids": xfer_ids,
-                                    "sender": i == 0,
-                                },
-                            )
-                            for i, w in enumerate(group)
-                        ],
-                        *[
-                            self.pool.request(
-                                w,
-                                {
-                                    "type": "param_recv",
-                                    "model_name": str(hook.target),
-                                    "xfer_id": xid,
-                                    "eta": hook.eta,
-                                },
-                            )
-                            for w, xid in zip(target_group, xfer_ids)
-                        ],
+                # Checksummed push with one retry: the receiver verifies
+                # the per-leaf-norm checksum the sender stamped before
+                # swapping; a payload corrupted in flight raises
+                # WeightChecksumError (and bumps the rejection counter)
+                # instead of serving poisoned weights, and the push is
+                # re-dispatched once with fresh transfer ids.
+                for attempt in (1, 2):
+                    xfer_ids = list(
+                        range(
+                            self._xfer_id, self._xfer_id + len(target_group)
+                        )
                     )
-                    realloc_args["bytes"] = sum(
-                        int(r.get("bytes", 0) or 0)
-                        for r in resps[: len(group)]
-                    )
+                    self._xfer_id += len(target_group)
+                    try:
+                        with tracer.span(
+                            f"param_realloc:{hook.target}", cat="comms",
+                            n_dst=len(target_group),
+                        ) as realloc_args:
+                            resps = await asyncio.gather(
+                                *[
+                                    self.pool.request(
+                                        w,
+                                        {
+                                            "type": "param_send",
+                                            "model_name": str(
+                                                node.model_name
+                                            ),
+                                            "dsts": target_group,
+                                            "xfer_ids": xfer_ids,
+                                            "sender": i == 0,
+                                            "checksum": (
+                                                self.weight_push_checksum
+                                            ),
+                                        },
+                                    )
+                                    for i, w in enumerate(group)
+                                ],
+                                *[
+                                    self.pool.request(
+                                        w,
+                                        {
+                                            "type": "param_recv",
+                                            "model_name": str(hook.target),
+                                            "xfer_id": xid,
+                                            "eta": hook.eta,
+                                        },
+                                    )
+                                    for w, xid in zip(
+                                        target_group, xfer_ids
+                                    )
+                                ],
+                            )
+                            realloc_args["bytes"] = sum(
+                                int(r.get("bytes", 0) or 0)
+                                for r in resps[: len(group)]
+                            )
+                        break
+                    except integrity.WeightChecksumError as e:
+                        if attempt >= 2:
+                            raise
+                        logger.warning(
+                            f"weight push to {hook.target} rejected by "
+                            f"receiver checksum ({e}); retrying once"
+                        )
                 for i, send_r in enumerate(resps[: len(group)]):
                     # Only member 0 actually sends (sender=i==0); the
                     # rest reply bytes=0 and must not bump the transfer
@@ -1752,6 +1923,8 @@ class MasterWorker:
                 if self._async_rl
                 else {}
             ),
+            quarantine_ledger=list(self._quarantine_ledger),
+            consecutive_quarantines=self._consecutive_quarantines,
         )
         recover.dump(
             info,
@@ -1781,6 +1954,18 @@ class MasterWorker:
             self.ckpt_ctl.load_state_dict(info.save_ctl_states["ckpt"])
         if "eval" in info.save_ctl_states:
             self.eval_ctl.load_state_dict(info.save_ctl_states["eval"])
+        # Quarantine audit trail: keep whichever ledger is longer — a
+        # fresh restart adopts the persisted one; a live rollback keeps
+        # the in-memory entries of the streak that triggered it (those
+        # steps never checkpointed, so the persisted ledger predates
+        # them).
+        ledger = list(getattr(info, "quarantine_ledger", None) or [])
+        if len(ledger) > len(self._quarantine_ledger):
+            self._quarantine_ledger = ledger
+        self._consecutive_quarantines = int(
+            getattr(info, "consecutive_quarantines", 0) or 0
+        )
+        self._m_consec_quar.set(float(self._consecutive_quarantines))
         # Worker-side state (weights, optimizer, data cursors) is restored
         # at run() start, once the pool is serving.
         self._restore_pending = info

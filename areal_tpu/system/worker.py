@@ -779,9 +779,16 @@ class ModelWorker:
         """Ship a model's host-side param pytree to other workers (the
         cross-worker half of param realloc; reference model_worker.py:1009).
         Every member of a process-spanning src mesh calls this — the host
-        gather is a collective — but only the designated sender pushes."""
+        gather is a collective — but only the designated sender pushes.
+
+        With ``checksum`` set (the default, master-gated by
+        ``weight_push_checksum``), the payload carries a content
+        checksum stamped BEFORE the wire so the receiver can reject a
+        push corrupted in flight instead of swapping poisoned weights in
+        (see base/integrity.py)."""
         import jax
 
+        from areal_tpu.base import integrity
         from areal_tpu.base.distributed import to_host
 
         t0 = time.monotonic()
@@ -789,20 +796,40 @@ class ModelWorker:
         host = jax.tree.map(to_host, params)
         nbytes = 0
         if req.get("sender", True):
+            checksum = (
+                integrity.params_checksum(host)
+                if req.get("checksum", True)
+                else None
+            )
+            if (
+                self._faults is not None
+                and self._faults.poison("weight_push") == "corrupt_push"
+            ):
+                host = integrity.corrupt_params(host)
             dsts = req.get("dsts") or [req["dst"]]
             xids = req.get("xfer_ids") or [req["xfer_id"]]
             for dst, xid in zip(dsts, xids):
-                nbytes += self.transfer.send(dst, xid, ("params", host))
+                nbytes += self.transfer.send(
+                    dst, xid, ("params", host, checksum)
+                )
         return {"bytes": nbytes, "seconds": time.monotonic() - t0}
 
     def _handle_param_recv(self, req):
         import jax
 
+        from areal_tpu.base import integrity
         from areal_tpu.base.distributed import to_host
 
         t0 = time.monotonic()
-        kind, host = self._recv_xfer(req["xfer_id"])
+        payload = self._recv_xfer(req["xfer_id"])
+        kind, host, checksum = (
+            payload if len(payload) == 3 else (*payload, None)
+        )
         assert kind == "params", kind
+        if checksum is not None:
+            # Fail fast BEFORE set_params: a rejected push leaves the
+            # receiver serving its previous (healthy) weights.
+            integrity.verify_checksum(host, checksum)
         eng = self.models[req["model_name"]].engine
         eta = float(req.get("eta", 1.0))
         if eta >= 1.0:

@@ -62,6 +62,24 @@ checkpoint is torn (a manifest-listed file overwritten) —
 `latest_valid_checkpoint` must fall back to `.prev` and a third restart
 must restore from it and exit 0.
 
+Part 6 (`--nan-chaos`) is the numerical-integrity guard plane leg,
+three proofs: (a) an injected `nan@point=train_grads` fault poisons a
+train step's accumulated grads — the in-jit sentinel quarantines the
+step with ZERO weight/optimizer change (bit-identical params), exactly
+one batched host sync per train call, and no extra jit trace; (b) a
+two-step NaN streak inside the tiny-PPO trial trips the master's
+`max_consecutive_quarantines` escalation — it rolls back to the last
+manifest-valid recover checkpoint and replays; asserted: exactly 2
+quarantined steps, 1 quarantine rollback, and the replayed steps AND
+final weights bit-identical to a fault-free baseline with flat jit
+trace counters; (c) a `corrupt_push@point=weight_push` fault corrupts
+an in-memory weight push in flight — the gen server's checksum rejects
+it (`areal_gen_weight_push_rejected_total` moves, the serving version
+stays put), the retry lands, and greedy decode is token-identical to a
+control server that received the same weights cleanly.  `--bench-out`
+writes the bench JSONL consumed by check_regression.py
+(bench_nanchaos_cpu8_*.json).
+
 Exit 0 iff every check passes.  CI-friendly: CPU-only, tiny random
 model, a few minutes end to end.
 """
@@ -1268,6 +1286,379 @@ def check_trainer_chaos(fileroot: str) -> int:
     return len(failures)
 
 
+def check_nan_chaos(fileroot: str, bench_out: str = None) -> int:
+    """Numerical-integrity guard plane leg (module docstring, Part 6):
+    NaN grads -> quarantine with zero weight change; a quarantine
+    streak -> checkpoint rollback + bit-exact replay; a corrupted
+    weight push -> checksum rejection, retry, token-identical decode."""
+    import jax
+    import numpy as np
+
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model_api import (
+        FinetuneSpec,
+        GenerationHyperparameters,
+        OptimizerConfig,
+    )
+    from areal_tpu.base import integrity, metrics, tracer
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.engines.generator import GeneratorEngine
+    from areal_tpu.engines.train import TrainEngine
+    from areal_tpu.experiments.common import build_ppo_math, run_experiment
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.system.gen_server import GenerationServer
+    from areal_tpu.system.master import InProcessPool, MasterWorker
+    from areal_tpu.system.transfer import InProcTransfer
+    from areal_tpu.system.worker import ModelWorker
+    from tests import fixtures
+
+    failures = []
+
+    def metric_value(name):
+        total = 0.0
+        for line in metrics.default_registry().expose().splitlines():
+            if line.startswith(f"{name} ") or line.startswith(f"{name}{{"):
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    def host_leaves(tree):
+        # copy=True: the guarded apply donates and in-place reuses its
+        # input buffers; a zero-copy view captured "before" a step would
+        # silently show the "after" values.
+        return [np.array(x, copy=True) for x in jax.tree.leaves(tree)]
+
+    def max_diff(a, b):
+        return max(
+            float(
+                np.abs(
+                    np.asarray(x, np.float32) - np.asarray(y, np.float32)
+                ).max()
+            )
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+        )
+
+    # ---- Proof 1: NaN grads -> quarantine, zero weight change -------
+    from areal_tpu.ops import functional as F
+
+    cfg = tiny_config()
+    mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+    os.environ["AREAL_FAULTS"] = "nan@point=train_grads&times=1"
+    try:
+        eng = TrainEngine(
+            cfg, params=tfm.init_params(cfg, jax.random.PRNGKey(0)),
+            mesh=mesh,
+            optimizer_config=OptimizerConfig(
+                lr=1e-2, warmup_steps_proportion=0.0
+            ),
+            ftspec=FinetuneSpec(1, 8, 8),
+        )
+    finally:
+        del os.environ["AREAL_FAULTS"]
+    rng = np.random.default_rng(0)
+    sample = fixtures.random_sample(
+        rng, ids=[f"s{i}" for i in range(6)], keys=("packed_input_ids",),
+        max_len=20,
+    )
+    masks = []
+    for sl in sample.seqlens["packed_input_ids"]:
+        m = np.zeros(sl[0], dtype=bool)
+        m[:2] = True
+        masks.append(m)
+    sample.update_(
+        SequenceSample(
+            keys={"prompt_mask"},
+            ids=sample.ids,
+            seqlens={
+                "prompt_mask": [
+                    list(s) for s in sample.seqlens["packed_input_ids"]
+                ]
+            },
+            data={"prompt_mask": np.concatenate(masks)},
+        )
+    )
+    sft_kw = dict(
+        loss_fn=F.sft_loss, loss_weight_fn=F.sft_label_count,
+        token_key="packed_input_ids", extra_keys=("prompt_mask",),
+    )
+    before_p = host_leaves(eng.get_params())
+    m_anom0 = metric_value("areal_train_anomaly_total")
+    out = eng.train_batch(sample, MicroBatchSpec(), **sft_kw)
+    quarantine_zero_weight_change = (
+        out["quarantined"] == 1.0
+        and int(out["anomaly_verdict"]) & integrity.NONFINITE
+        and all(
+            np.array_equal(a, b)
+            for a, b in zip(before_p, host_leaves(eng.get_params()))
+        )
+    )
+    if not quarantine_zero_weight_change:
+        failures.append(
+            f"NaN step not quarantined with zero weight change: {out}"
+        )
+    if metric_value("areal_train_anomaly_total") - m_anom0 != 1:
+        failures.append("anomaly counter did not move by 1 on the NaN step")
+    # Fault exhausted (times=1): the next step must train normally...
+    out2 = eng.train_batch(sample, MicroBatchSpec(), **sft_kw)
+    if out2["quarantined"] != 0.0 or not any(
+        not np.array_equal(a, b)
+        for a, b in zip(before_p, host_leaves(eng.get_params()))
+    ):
+        failures.append("clean step after the NaN fault did not train")
+    # ...through the SAME guarded-apply trace, with exactly one batched
+    # host sync per train call.
+    if eng._apply_fn._cache_size() != 1:
+        failures.append(
+            f"guarded apply retraced: cache size "
+            f"{eng._apply_fn._cache_size()} != 1"
+        )
+    if eng.host_transfers != 2:
+        failures.append(
+            f"expected 1 host sync per train call (2 total), got "
+            f"{eng.host_transfers}"
+        )
+
+    # ---- Proof 2: quarantine streak -> rollback, bit-exact replay ---
+    tok = fixtures.make_tokenizer()
+    rows = fixtures.build_math_rows(16, seed=7)
+    m_base, s_base = run_experiment(
+        build_ppo_math(
+            _tiny_ppo_cfg(os.path.join(fileroot, "baseline"), rows), tok
+        ),
+        tokenizer=tok,
+    )
+
+    plan = build_ppo_math(
+        _tiny_ppo_cfg(os.path.join(fileroot, "chaos"), rows), tok
+    )
+    tracer.default_dir(
+        plan.fileroot, plan.experiment_name, plan.trial_name
+    )
+    planes = InProcTransfer.make_group(len(plan.worker_configs))
+    # Env-gate the injector around worker construction ONLY: the actor
+    # train engine NaN-poisons its 3rd and 4th accumulated grad sums
+    # (steps 3-4), tripping the 2-step quarantine streak.
+    os.environ["AREAL_FAULTS"] = "nan@point=train_grads&skip=2&times=2"
+    try:
+        workers = [
+            ModelWorker(wc, tokenizer=tok, transfer=planes[i])
+            for i, wc in enumerate(plan.worker_configs)
+        ]
+    finally:
+        del os.environ["AREAL_FAULTS"]
+    pool = InProcessPool(workers)
+    before = {
+        n: metric_value(n)
+        for n in (
+            "areal_master_quarantined_steps_total",
+            "areal_master_quarantine_rollbacks_total",
+            "areal_master_recoveries_total",
+        )
+    }
+    master = MasterWorker(
+        dfg=plan.dfg,
+        pool=pool,
+        model_placement=plan.model_placement,
+        data_worker_ids=plan.data_worker_ids,
+        ctrl=plan.ctrl,
+        fileroot=plan.fileroot,
+        experiment_name=plan.experiment_name,
+        trial_name=plan.trial_name,
+        model_groups=plan.model_groups,
+        model_replicas=plan.model_replicas,
+        difficulty_filter=plan.difficulty_filter,
+        rollout_ahead=plan.rollout_ahead,
+        max_recoveries=plan.max_recoveries,
+        max_consecutive_quarantines=2,
+    )
+    master.load_recover_info()
+    stats = asyncio.run(master.run())
+
+    def is_quarantined(s):
+        return any(
+            k.rsplit("/", 1)[-1] == "quarantined" and v > 0
+            for k, v in s.items()
+        )
+
+    quarantined = [s for s in stats if is_quarantined(s)]
+    clean = [s for s in stats if not is_quarantined(s)]
+    if len(quarantined) != 2:
+        failures.append(
+            f"expected exactly 2 quarantined steps, got {len(quarantined)}"
+        )
+    for name, want in (
+        ("areal_master_quarantined_steps_total", 2),
+        ("areal_master_quarantine_rollbacks_total", 1),
+        ("areal_master_recoveries_total", 1),
+    ):
+        delta = metric_value(name) - before[name]
+        if delta != want:
+            failures.append(f"{name} moved by {delta}, expected {want}")
+    if len(master._quarantine_ledger) < 2:
+        failures.append(
+            f"quarantine ledger holds {len(master._quarantine_ledger)} "
+            "entries, expected >= 2"
+        )
+    if master.step_info.global_step != len(s_base):
+        failures.append(
+            f"final global_step {master.step_info.global_step} != "
+            f"{len(s_base)}"
+        )
+    # The rollback restores the end-of-step-2 checkpoint (quarantined
+    # steps never checkpoint), so the replayed steps 3-4 — and the
+    # final weights — must match the fault-free trial bit for bit.
+    rollback_bit_exact = len(clean) == len(s_base)
+    keys = (
+        "actor_train/loss", "actor_train/actor_loss",
+        "actor_train/approx_kl", "actor_train/importance_weight",
+        "actor_train/grad_norm", "actor_train/task_reward",
+    )
+    for t, (a, b) in enumerate(zip(s_base, clean)):
+        for k in keys:
+            if a[k] != b[k]:
+                rollback_bit_exact = False
+                failures.append(
+                    f"replay diverged from baseline at step {t}: "
+                    f"{k} {b[k]} != {a[k]}"
+                )
+    diff = max_diff(
+        m_base.pool.workers[0].models["actor@0"].engine.get_params(),
+        pool.workers[0].models["actor@0"].engine.get_params(),
+    )
+    if diff != 0.0:
+        rollback_bit_exact = False
+        failures.append(
+            f"post-rollback final weights differ from baseline by {diff}"
+        )
+    if not rollback_bit_exact and len(clean) != len(s_base):
+        failures.append(
+            f"chaos run produced {len(clean)} clean steps, baseline "
+            f"{len(s_base)}"
+        )
+    # Guarded apply adds no retrace: quarantine + rollback must leave
+    # the trial's jit trace surface identical to the clean baseline's.
+    def train_traces(m):
+        n = 0
+        for model in m.pool.workers[0].models.values():
+            e = model.engine
+            if hasattr(e, "_grad_fns"):
+                for gf, gaf in e._grad_fns.values():
+                    n += gf._cache_size() + gaf._cache_size()
+                for fn in (e._apply_fn, e._scaled_apply_fn):
+                    if fn is not None:
+                        n += fn._cache_size()
+        return n
+
+    tr_base, tr_chaos = train_traces(m_base), train_traces(master)
+    compiles_flat = tr_base == tr_chaos
+    if not compiles_flat:
+        failures.append(
+            f"quarantine/rollback changed the jit trace surface: "
+            f"{tr_chaos} traces vs baseline {tr_base}"
+        )
+
+    # ---- Proof 3: corrupted weight push -> rejected, retried --------
+    gen_params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    os.environ["AREAL_FAULTS"] = "corrupt_push@point=weight_push&times=1"
+    try:
+        victim = GenerationServer(
+            GeneratorEngine(
+                cfg, gen_params, mesh, eos_token_id=cfg.vocab_size + 7
+            )
+        )
+    finally:
+        del os.environ["AREAL_FAULTS"]
+    control = GenerationServer(
+        GeneratorEngine(
+            cfg, gen_params, mesh, eos_token_id=cfg.vocab_size + 7
+        )
+    )
+    try:
+        new_params = tfm.init_params(cfg, jax.random.PRNGKey(42))
+        cs = integrity.params_checksum(new_params)
+        m_rej0 = metric_value("areal_gen_weight_push_rejected_total")
+        v0 = victim.version
+        corrupt_push_rejected = False
+        try:
+            victim.update_weights_inmem(new_params, checksum=cs)
+        except integrity.WeightChecksumError:
+            corrupt_push_rejected = True
+        if not corrupt_push_rejected:
+            failures.append("corrupted push was NOT rejected by checksum")
+        if metric_value("areal_gen_weight_push_rejected_total") - m_rej0 != 1:
+            failures.append("push-rejected counter did not move by 1")
+        if victim.version != v0:
+            failures.append(
+                "rejected push still bumped the serving version"
+            )
+        # The pusher retries; the fault is exhausted, the push lands.
+        victim.update_weights_inmem(new_params, checksum=cs)
+        control.update_weights_inmem(new_params, checksum=cs)
+        prompts = SequenceSample(
+            keys={"packed_prompts"},
+            ids=["p0", "p1"],
+            seqlens={"packed_prompts": [[6], [9]]},
+            data={
+                "packed_prompts": rng.integers(
+                    8, cfg.vocab_size, size=15
+                ).astype(np.int32)
+            },
+        )
+        g = GenerationHyperparameters(n=1, max_new_tokens=16, greedy=True)
+        out_v = victim.engine.generate(prompts, MicroBatchSpec(), g)
+        out_c = control.engine.generate(prompts, MicroBatchSpec(), g)
+        if not np.array_equal(
+            np.asarray(out_v.data["packed_input_ids"]),
+            np.asarray(out_c.data["packed_input_ids"]),
+        ):
+            failures.append(
+                "post-retry greedy decode differs from the control server"
+            )
+    finally:
+        victim.close()
+        control.close()
+
+    if bench_out:
+        import json
+
+        legs = [
+            {
+                "leg": "nan_chaos",
+                "devices": len(jax.devices()),
+                "steps": len(s_base),
+                "quarantined_steps": len(quarantined),
+                "quarantine_rollbacks": 1,
+                "train_traces": tr_chaos,
+            },
+            {
+                "leg": "nan_chaos_compare",
+                "quarantine_zero_weight_change": bool(
+                    quarantine_zero_weight_change
+                ),
+                "rollback_bit_exact": bool(rollback_bit_exact),
+                "corrupt_push_rejected": bool(corrupt_push_rejected),
+                "compiles_flat": bool(compiles_flat),
+            },
+        ]
+        with open(bench_out, "w") as f:
+            for row in legs:
+                f.write(json.dumps(row) + "\n")
+        print(f"bench rows -> {bench_out}")
+
+    for f in failures:
+        print(f"FAIL[nan-chaos]: {f}")
+    if not failures:
+        print(
+            f"OK[nan-chaos]: NaN grad quarantined with zero weight "
+            f"change (1 host sync/step, 1 apply trace); 2-step NaN "
+            f"streak rolled back and replayed bit-exact vs baseline "
+            f"over {len(clean)} steps (max param diff {diff}, trace "
+            f"surface flat at {tr_chaos}); corrupted push rejected by "
+            f"checksum, retry landed, greedy decode token-identical"
+        )
+    return len(failures)
+
+
 def main() -> int:
     p = argparse.ArgumentParser(prog="check_async")
     p.add_argument("--prompts", type=int, default=24)
@@ -1282,8 +1673,9 @@ def main() -> int:
                    help="run ONLY the pipeline-overlapped PPO leg "
                         "(barrier vs streamed executor A/B)")
     p.add_argument("--bench-out", default=None,
-                   help="with --overlap: also write the bench JSONL "
-                        "(bench_overlap_cpu8_<UTC>.json) for "
+                   help="with --overlap / --nan-chaos: also write the "
+                        "bench JSONL (bench_overlap_cpu8_<UTC>.json / "
+                        "bench_nanchaos_cpu8_<UTC>.json) for "
                         "check_regression.py")
     p.add_argument("--trainer-chaos", action="store_true",
                    help="run ONLY the crash-safe trainer plane leg "
@@ -1292,6 +1684,11 @@ def main() -> int:
                         "fallback)")
     p.add_argument("--trainer-chaos-victim", metavar="DIR", default=None,
                    help=argparse.SUPPRESS)
+    p.add_argument("--nan-chaos", action="store_true",
+                   help="run ONLY the numerical-integrity guard plane "
+                        "leg (NaN grads -> quarantine; streak -> "
+                        "rollback + bit-exact replay; corrupt push -> "
+                        "checksum rejection)")
     args = p.parse_args()
 
     if args.trainer_chaos_victim:
@@ -1306,6 +1703,18 @@ def main() -> int:
             print(f"FAIL: {n_fail} trainer-chaos check(s) failed")
             return 1
         print("OK: crash-safe trainer plane survived the injected faults")
+        return 0
+
+    if args.nan_chaos:
+        fileroot = args.dir or tempfile.mkdtemp(
+            prefix="areal_tpu_nan_chaos_"
+        )
+        n_fail = check_nan_chaos(fileroot, bench_out=args.bench_out)
+        if n_fail:
+            print(f"FAIL: {n_fail} nan-chaos check(s) failed")
+            return 1
+        print("OK: numerical-integrity guard plane survived the "
+              "injected corruption")
         return 0
 
     if args.chaos:

@@ -39,7 +39,12 @@ DISCRIMINATORS = ("group_n", "kv_share_prefix", "prompt_len")
 
 # Legs carrying boolean invariants, not perf metrics — every boolean that
 # was true in the baseline must stay true.
-INVARIANT_LEGS = ("compare", "stall_compare", "overlap_compare")
+INVARIANT_LEGS = (
+    "compare",
+    "stall_compare",
+    "overlap_compare",
+    "nan_chaos_compare",
+)
 
 
 @dataclasses.dataclass
@@ -69,6 +74,12 @@ RULES: Dict[str, MetricRule] = {
     "pipeline_idle_seconds": MetricRule("lower", rel_tol=0.50),
     "overlap_frac": MetricRule("higher", rel_tol=0.30),
     "train_traces": MetricRule("max", abs_tol=0),
+    # Numerical-integrity chaos leg (scripts/check_async.py --nan-chaos):
+    # the fault plan is deterministic, so the guard plane must quarantine
+    # exactly the injected steps and roll back exactly once — any drift
+    # means sentinels or escalation thresholds changed behavior.
+    "quarantined_steps": MetricRule("exact"),
+    "quarantine_rollbacks": MetricRule("exact"),
 }
 
 
@@ -168,6 +179,7 @@ def default_baselines() -> List[str]:
         "bench_paged_cpu8_*.json",
         "bench_serving_cpu8_*.json",
         "bench_overlap_cpu8_*.json",
+        "bench_nanchaos_cpu8_*.json",
     )
     out: List[str] = []
     for pat in pats:
@@ -181,8 +193,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="check_regression")
     p.add_argument("--baseline", action="append", default=[],
                    help="baseline bench JSONL (repeatable; default: newest "
-                        "committed bench_paged/bench_serving/bench_overlap "
-                        "files)")
+                        "committed bench_paged/bench_serving/bench_overlap/"
+                        "bench_nanchaos files)")
     p.add_argument("--fresh", action="append", default=[],
                    help="fresh bench JSONL to gate (repeatable)")
     p.add_argument("--self-check", action="store_true",

@@ -1,0 +1,558 @@
+"""Numerical-integrity guard plane tests (base/integrity.py + the
+engine/interface sentinels).
+
+Covers the packed-verdict semantics, the guarded (donation-safe) apply,
+the quarantine ledger's RecoverInfo round-trip, weight-push checksums,
+the PPO batch sentinels, the fault-spec grammar's eager validation, and
+the reward client's typed bounded retries.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model_api import (
+    FinetuneSpec,
+    GenerationHyperparameters,
+    Model,
+    OptimizerConfig,
+)
+from areal_tpu.base import faults, integrity, recover
+from areal_tpu.base.topology import ParallelConfig, make_mesh
+from areal_tpu.engines.train import TrainEngine
+from areal_tpu.models import transformer as tfm
+from areal_tpu.models.config import tiny_config
+from areal_tpu.ops import functional as F
+from tests import fixtures
+
+
+# ---------------- shared helpers ----------------
+
+
+def _make_engine(seed: int = 0, lr: float = 1e-2, **anomaly_kw) -> TrainEngine:
+    cfg = tiny_config()
+    mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+    params = tfm.init_params(cfg, jax.random.PRNGKey(seed))
+    return TrainEngine(
+        cfg, params, mesh,
+        optimizer_config=OptimizerConfig(
+            lr=lr, warmup_steps_proportion=0.0
+        ),
+        ftspec=FinetuneSpec(1, 32, 32),
+        **anomaly_kw,
+    )
+
+
+def _sft_sample(rng, n: int = 6, max_len: int = 20) -> SequenceSample:
+    sample = fixtures.random_sample(
+        rng, ids=[f"s{i}" for i in range(n)], keys=("packed_input_ids",),
+        max_len=max_len,
+    )
+    masks = []
+    for sl in sample.seqlens["packed_input_ids"]:
+        m = np.zeros(sl[0], dtype=bool)
+        m[:2] = True
+        masks.append(m)
+    sample.update_(
+        SequenceSample(
+            keys={"prompt_mask"},
+            ids=sample.ids,
+            seqlens={
+                "prompt_mask": [
+                    list(s) for s in sample.seqlens["packed_input_ids"]
+                ]
+            },
+            data={"prompt_mask": np.concatenate(masks)},
+        )
+    )
+    return sample
+
+
+_SFT_KW = dict(
+    loss_fn=F.sft_loss,
+    loss_weight_fn=F.sft_label_count,
+    token_key="packed_input_ids",
+    extra_keys=("prompt_mask",),
+)
+
+
+def _host_leaves(tree):
+    # copy=True: np.asarray of a CPU jax.Array can be a zero-copy view,
+    # and the guarded apply donates (and now in-place reuses) its input
+    # buffers — a view captured "before" would silently show "after".
+    return [np.array(x, copy=True) for x in jax.tree.leaves(tree)]
+
+
+def _assert_trees_identical(a, b):
+    la, lb = _host_leaves(a), _host_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+
+
+# ---------------- verdict bits ----------------
+
+
+class TestVerdictBits:
+    def test_bits_are_distinct_powers_of_two(self):
+        bits = [
+            integrity.NONFINITE, integrity.GRAD_SPIKE,
+            integrity.UPDATE_NORM, integrity.KL_BLOWUP,
+            integrity.IMP_RATIO, integrity.DEGENERATE_VAR,
+        ]
+        assert len(set(bits)) == len(bits)
+        for b in bits:
+            assert b > 0 and (b & (b - 1)) == 0
+
+    def test_kind_decode(self):
+        assert integrity.verdict_kinds(0.0) == []
+        assert integrity.verdict_kinds(integrity.NONFINITE) == ["nonfinite"]
+        got = integrity.verdict_kinds(
+            float(integrity.GRAD_SPIKE | integrity.KL_BLOWUP)
+        )
+        assert got == ["grad_spike", "kl_blowup"]
+
+    def test_record_anomaly_bumps_per_kind(self):
+        before = integrity.M_ANOMALY.labels("update_norm").get()
+        integrity.record_anomaly(
+            float(integrity.UPDATE_NORM | integrity.DEGENERATE_VAR)
+        )
+        assert integrity.M_ANOMALY.labels("update_norm").get() == before + 1
+
+    def test_quarantine_entry(self):
+        e = integrity.quarantine_entry(
+            7, float(integrity.NONFINITE | integrity.DEGENERATE_VAR),
+            ids=["a", "b"],
+        )
+        assert e.step == 7
+        assert e.kinds == ("nonfinite", "degenerate_variance")
+        assert e.ids == ("a", "b")
+        d = e.as_dict()
+        assert d["step"] == 7 and list(d["kinds"]) == list(e.kinds)
+
+
+# ---------------- engine sentinels + guarded apply ----------------
+
+
+class TestEngineSentinels:
+    def test_constructor_rejects_mult_at_most_one(self):
+        with pytest.raises(ValueError, match="anomaly_grad_norm_mult"):
+            _make_engine(anomaly_grad_norm_mult=0.5)
+        with pytest.raises(ValueError, match="anomaly_grad_norm_mult"):
+            _make_engine(anomaly_grad_norm_mult=1.0)
+
+    def test_clean_step_applies_and_reports_zero_verdict(self, rng):
+        eng = _make_engine()
+        sample = _sft_sample(rng)
+        before = _host_leaves(eng.get_params())
+        out = eng.train_batch(sample, MicroBatchSpec(), **_SFT_KW)
+        assert out["anomaly_verdict"] == 0.0
+        assert out["quarantined"] == 0.0
+        assert np.isfinite(out["grad_norm"]) and out["grad_norm"] > 0
+        assert np.isfinite(out["update_norm"]) and out["update_norm"] > 0
+        after = _host_leaves(eng.get_params())
+        assert any(
+            not np.array_equal(a, b) for a, b in zip(before, after)
+        ), "clean step must actually update the params"
+        # One batched device->host transfer per train call.
+        assert eng.host_transfers == 1
+        eng.train_batch(sample, MicroBatchSpec(), **_SFT_KW)
+        assert eng.host_transfers == 2
+
+    def test_nan_grads_quarantine_with_zero_weight_change(
+        self, rng, monkeypatch
+    ):
+        monkeypatch.setenv("AREAL_FAULTS", "nan@point=train_grads")
+        eng = _make_engine()
+        sample = _sft_sample(rng)
+        before_p = _host_leaves(eng.get_params())
+        before_o = _host_leaves(eng.opt_state)
+        before_m = integrity.M_ANOMALY.labels("nonfinite").get()
+        out = eng.train_batch(sample, MicroBatchSpec(), **_SFT_KW)
+        assert out["quarantined"] == 1.0
+        assert int(out["anomaly_verdict"]) & integrity.NONFINITE
+        _assert_trees_identical(before_p, eng.get_params())
+        _assert_trees_identical(before_o, eng.opt_state)
+        assert integrity.M_ANOMALY.labels("nonfinite").get() == before_m + 1
+        # Clean and quarantined steps share ONE trace of the guarded
+        # apply: the verdict select is traced, not a retrace trigger.
+        assert eng._apply_fn._cache_size() == 1
+        assert eng.host_transfers == 1
+
+    def test_grad_spike_trips_after_ewma_warmup(self, rng):
+        eng = _make_engine(
+            lr=1e-4, anomaly_grad_norm_mult=2.0, anomaly_ewma_warmup=2
+        )
+        sample = _sft_sample(rng)
+        for _ in range(2):  # warm the EWMA with clean steps
+            out = eng.train_batch(sample, MicroBatchSpec(), **_SFT_KW)
+            assert out["quarantined"] == 0.0
+        # Spike the accumulated grads via the poison hook seam (eager
+        # ops outside every counted jit cache, like the chaos leg).
+        orig = eng._poison_grads
+        eng._poison_grads = lambda acc: jax.tree.map(
+            lambda g: g * np.float32(100.0), acc
+        )
+        before = _host_leaves(eng.get_params())
+        out = eng.train_batch(sample, MicroBatchSpec(), **_SFT_KW)
+        assert int(out["anomaly_verdict"]) & integrity.GRAD_SPIKE
+        assert out["quarantined"] == 1.0
+        _assert_trees_identical(before, eng.get_params())
+        # The EWMA only tracks CLEAN norms: the spike must not have
+        # dragged the baseline up, so an unpoisoned step is clean again.
+        eng._poison_grads = orig
+        out = eng.train_batch(sample, MicroBatchSpec(), **_SFT_KW)
+        assert out["quarantined"] == 0.0
+
+    def test_update_norm_ceiling(self, rng):
+        eng = _make_engine(anomaly_update_norm_max=1e-12)
+        sample = _sft_sample(rng)
+        before = _host_leaves(eng.get_params())
+        out = eng.train_batch(sample, MicroBatchSpec(), **_SFT_KW)
+        assert int(out["anomaly_verdict"]) == integrity.UPDATE_NORM
+        assert out["quarantined"] == 1.0
+        _assert_trees_identical(before, eng.get_params())
+
+    def test_stream_external_trip_discards_partial_grads(self, rng):
+        eng = _make_engine()
+        sample = _sft_sample(rng)
+        before = _host_leaves(eng.get_params())
+        state = eng.train_stream_begin()
+        eng.train_stream_chunk(state, sample, MicroBatchSpec(), **_SFT_KW)
+        out = eng.train_stream_end(state, quarantine=True)
+        assert out["quarantined"] == 1.0
+        assert out["anomaly_verdict"] == 0.0  # interface bit, not engine's
+        _assert_trees_identical(before, eng.get_params())
+        # One transfer for the chunk stats, one for the end verdict.
+        assert eng.host_transfers == 2
+
+
+# ---------------- weight checksums ----------------
+
+
+class TestChecksum:
+    def _tree(self, rng):
+        return {
+            "w": rng.normal(size=(4, 3)).astype(np.float32),
+            "b": rng.normal(size=(3,)).astype(np.float32),
+            "step": np.asarray(7, np.int32),
+        }
+
+    def test_numpy_and_device_paths_agree(self, rng):
+        tree = self._tree(rng)
+        cs = integrity.params_checksum(tree)
+        assert cs[0] == 3 and cs[1] == 4 * 3 + 3 + 1
+        dev = jax.tree.map(jnp.asarray, tree)
+        assert integrity.checksum_matches(cs, integrity.params_checksum(dev))
+
+    def test_verify_ok_and_mismatch(self, rng):
+        tree = self._tree(rng)
+        cs = integrity.params_checksum(tree)
+        integrity.verify_checksum(tree, cs)  # must not raise
+        bad = integrity.corrupt_params(tree)
+        assert not integrity.checksum_matches(
+            integrity.params_checksum(bad), cs
+        )
+        before = integrity.M_PUSH_REJECTED.get()
+        with pytest.raises(integrity.WeightChecksumError):
+            integrity.verify_checksum(bad, cs)
+        assert integrity.M_PUSH_REJECTED.get() == before + 1
+
+    def test_structural_mismatch_is_detected(self, rng):
+        tree = self._tree(rng)
+        cs = integrity.params_checksum(tree)
+        fewer = {"w": tree["w"]}
+        assert not integrity.checksum_matches(
+            integrity.params_checksum(fewer), cs
+        )
+        assert not integrity.checksum_matches(cs, np.zeros(1))
+
+
+# ---------------- quarantine ledger persistence ----------------
+
+
+class TestLedgerRecover:
+    def test_roundtrip(self, tmp_path):
+        entry = integrity.quarantine_entry(
+            3, float(integrity.NONFINITE), ids=["q1", "q2"]
+        ).as_dict()
+        info = recover.RecoverInfo(
+            quarantine_ledger=[entry], consecutive_quarantines=2
+        )
+        recover.dump(info, str(tmp_path))
+        got = recover.load(str(tmp_path))
+        assert got.quarantine_ledger == [entry]
+        assert got.consecutive_quarantines == 2
+
+    def test_old_pickle_backfills_defaults(self, tmp_path):
+        info = recover.RecoverInfo()
+        del info.__dict__["quarantine_ledger"]
+        del info.__dict__["consecutive_quarantines"]
+        path = tmp_path / recover.RECOVER_FILE
+        with open(path, "wb") as f:
+            pickle.dump(info, f)
+        got = recover.load(str(tmp_path))
+        assert got.quarantine_ledger == []
+        assert got.consecutive_quarantines == 0
+
+
+# ---------------- fault-spec grammar ----------------
+
+
+class TestFaultGrammar:
+    @pytest.mark.parametrize(
+        "spec,needle",
+        [
+            ("frob@p=1", "unknown kind"),
+            ("slow@ms", "malformed param"),
+            ("slow@zz=1", "unknown param"),
+            ("error@ms=5", "ms= only applies to slow"),
+            ("error@p=1.5", "out of [0, 1]"),
+            ("kill@t=abc", "unparseable duration"),
+            ("hang@skip=-1", "skip must be >= 0"),
+            ("nan", "needs point="),
+            ("corrupt_push@times=1", "needs point="),
+        ],
+    )
+    def test_malformed_specs_name_the_clause(self, spec, needle):
+        with pytest.raises(ValueError) as ei:
+            faults.parse_faults(spec)
+        msg = str(ei.value)
+        assert needle in msg
+        # Every error names the offending clause.
+        assert spec.split("@")[0] in msg
+
+    def test_empty_spec_rejected_but_env_unset_is_none(self):
+        with pytest.raises(ValueError, match="empty fault spec"):
+            faults.parse_faults("   ")
+        assert faults.FaultInjector.from_env({}) is None
+        assert faults.FaultInjector.from_env({"AREAL_FAULTS": ""}) is None
+
+    def test_poison_skip_times_scoping(self):
+        inj = faults.FaultInjector.parse(
+            "nan@point=train_grads&skip=1&times=1"
+        )
+        # Other points never match and never consume the skip budget.
+        assert inj.poison("weight_push") is None
+        assert inj.poison("train_grads") is None  # skipped
+        assert inj.poison("train_grads") == "nan"  # fires once
+        assert inj.poison("train_grads") is None  # exhausted
+        assert inj.fired["nan"] == 1
+
+    def test_fire_never_applies_poison_kinds(self):
+        inj = faults.FaultInjector.parse("corrupt_push@point=weight_push")
+        inj.fire("weight_push")  # must be a no-op, not an error
+        assert inj.fired["corrupt_push"] == 0
+        assert inj.poison("weight_push") == "corrupt_push"
+
+
+# ---------------- PPO batch sentinels ----------------
+
+
+def _ppo_actor():
+    from areal_tpu.engines.generator import GeneratorEngine
+
+    cfg = tiny_config()
+    mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+    params = tfm.init_params(cfg, jax.random.PRNGKey(5))
+    tok = fixtures.make_tokenizer()
+    actor_engine = TrainEngine(
+        cfg, params, mesh,
+        optimizer_config=OptimizerConfig(
+            lr=1e-4, warmup_steps_proportion=0.0
+        ),
+        ftspec=FinetuneSpec(1, 8, 8),
+    )
+    gen_engine = GeneratorEngine(
+        cfg, params, mesh, eos_token_id=tok.eos_token_id
+    )
+    actor = Model("actor", engine=actor_engine, tokenizer=tok, config=cfg)
+    gen = Model("actor_gen", engine=gen_engine, tokenizer=tok, config=cfg)
+    return actor, gen, tok
+
+
+def _reward_sample(rollout, scores_fn):
+    """Rewards mirroring the rollout's group structure (one score per
+    generated sequence), like MultiTaskRewardInterface emits."""
+    groups = rollout.seqlens["packed_input_ids"]
+    n = sum(len(g) for g in groups)
+    return SequenceSample(
+        keys={"rewards"},
+        ids=list(rollout.ids),
+        seqlens={"rewards": [[1] * len(g) for g in groups]},
+        data={"rewards": scores_fn(n)},
+    )
+
+
+def _rollout(actor_if, gen, tok):
+    rows = fixtures.build_math_rows(2, seed=3)
+    ids, toks, seqlens = [], [], []
+    for r in rows:
+        ids.append(r["query_id"])
+        t = tok.encode(r["prompt"])
+        toks.append(np.asarray(t, np.int32))
+        seqlens.append([len(t)])
+    prompts = SequenceSample(
+        keys={"packed_prompts"},
+        ids=ids,
+        seqlens={"packed_prompts": seqlens},
+        data={"packed_prompts": np.concatenate(toks)},
+    )
+    return actor_if.generate(gen, prompts, MicroBatchSpec())
+
+
+class TestPPOSentinels:
+    def _iface(self, **kw):
+        from areal_tpu.interfaces.ppo import PPOActorInterface
+
+        return PPOActorInterface(
+            gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+            n_minibatches=1, disable_value=True, kl_ctl=0.0, **kw,
+        )
+
+    def test_batch_verdict_bits(self):
+        clean = {
+            "kl_abs_mean": 0.01, "behav_imp_mean": 1.0,
+            "degenerate_var": False,
+        }
+        iface = self._iface(
+            anomaly_kl_max=0.1, anomaly_imp_ratio_max=2.0,
+            anomaly_degenerate_variance=True,
+        )
+        assert iface._batch_verdict(clean) == 0
+        assert (
+            iface._batch_verdict({**clean, "kl_abs_mean": 0.5})
+            == integrity.KL_BLOWUP
+        )
+        assert (
+            iface._batch_verdict({**clean, "behav_imp_mean": 3.0})
+            == integrity.IMP_RATIO
+        )
+        assert (  # collapse below 1/R trips too
+            iface._batch_verdict({**clean, "behav_imp_mean": 0.4})
+            == integrity.IMP_RATIO
+        )
+        assert (
+            iface._batch_verdict({**clean, "degenerate_var": True})
+            == integrity.DEGENERATE_VAR
+        )
+        # Sentinels off -> nothing trips even on wild stats.
+        off = self._iface()
+        assert off._batch_verdict(
+            {"kl_abs_mean": 9.0, "behav_imp_mean": 50.0,
+             "degenerate_var": True}
+        ) == 0
+
+    def test_degenerate_variance_quarantines_before_dispatch(self):
+        actor, gen, tok = _ppo_actor()
+        iface = self._iface(anomaly_degenerate_variance=True)
+        rollout = _rollout(iface, gen, tok)
+        # Constant scores -> every GRPO group has zero variance.
+        rollout.update_(
+            _reward_sample(rollout, lambda n: np.zeros(n, np.float32))
+        )
+        before = _host_leaves(actor.engine.get_params())
+        stats = iface.train_step(actor, rollout, MicroBatchSpec())
+        assert stats["quarantined"] == 1.0
+        assert int(stats["anomaly_verdict"]) & integrity.DEGENERATE_VAR
+        assert stats["n_minibatches_skipped"] >= 1
+        # Quarantine happens BEFORE any gradient dispatch.
+        _assert_trees_identical(before, actor.engine.get_params())
+        assert actor.engine.host_transfers == 0
+
+    def test_kl_blowup_quarantines(self):
+        actor, gen, tok = _ppo_actor()
+        iface = self._iface(anomaly_kl_max=0.1)
+        rollout = _rollout(iface, gen, tok)
+        rollout.update_(
+            _reward_sample(
+                rollout, lambda n: np.arange(n, dtype=np.float32)
+            )
+        )
+        # Synthetic ref logprobs offset by -0.5/token -> |KL| mean 0.5.
+        lp = np.asarray(rollout.data["packed_logprobs"], np.float32)
+        rollout.update_(
+            SequenceSample(
+                keys={"packed_ref_logprobs"},
+                ids=list(rollout.ids),
+                seqlens={
+                    "packed_ref_logprobs": [
+                        list(x) for x in rollout.seqlens["packed_logprobs"]
+                    ]
+                },
+                data={"packed_ref_logprobs": lp - 0.5},
+            )
+        )
+        stats = iface.train_step(actor, rollout, MicroBatchSpec())
+        assert stats["quarantined"] == 1.0
+        assert int(stats["anomaly_verdict"]) & integrity.KL_BLOWUP
+
+
+# ---------------- reward client retries ----------------
+
+
+class TestRemoteVerifierRetries:
+    def test_config_validation(self):
+        from areal_tpu.interfaces.reward_service import RemoteVerifier
+
+        with pytest.raises(ValueError, match="attempts"):
+            RemoteVerifier("http://x", attempts=0)
+        with pytest.raises(ValueError, match="backoff_s"):
+            RemoteVerifier("http://x", backoff_s=-1.0)
+
+    def test_typed_retries_then_local_fallback(self, monkeypatch):
+        import urllib.error
+
+        from areal_tpu.interfaces import reward_service
+        from areal_tpu.interfaces.reward_service import RemoteVerifier
+
+        rv = RemoteVerifier("http://localhost:1", attempts=3, backoff_s=0.0)
+        calls = []
+
+        def dead(items):
+            calls.append(len(items))
+            raise urllib.error.URLError("connection refused")
+
+        monkeypatch.setattr(rv, "_round_trip", dead)
+        before = reward_service._M_REMOTE_ERRORS.labels("network").get()
+        items = [{"task": "unknown-task"}]  # local grade -> False
+        assert rv.verify_batch(items) == [False]
+        assert len(calls) == 3  # bounded: attempts, then fallback
+        after = reward_service._M_REMOTE_ERRORS.labels("network").get()
+        assert after == before + 3
+        assert rv._degraded is True
+
+    def test_recovery_resets_degradation(self, monkeypatch):
+        from areal_tpu.interfaces.reward_service import RemoteVerifier
+
+        rv = RemoteVerifier("http://localhost:1", attempts=1, backoff_s=0.0)
+        fail = [True]
+
+        def flaky(items):
+            if fail[0]:
+                raise TimeoutError("slow service")
+            return [True for _ in items]
+
+        monkeypatch.setattr(rv, "_round_trip", flaky)
+        rv.verify_batch([{"task": "unknown-task"}])
+        assert rv._degraded is True
+        fail[0] = False
+        assert rv.verify_batch([{"task": "unknown-task"}]) == [True]
+        assert rv._degraded is False
+
+    def test_programming_errors_propagate(self, monkeypatch):
+        from areal_tpu.interfaces.reward_service import RemoteVerifier
+
+        rv = RemoteVerifier("http://localhost:1", attempts=3, backoff_s=0.0)
+
+        def bug(items):
+            raise ZeroDivisionError("not a transport failure")
+
+        monkeypatch.setattr(rv, "_round_trip", bug)
+        with pytest.raises(ZeroDivisionError):
+            rv.verify_batch([{"task": "unknown-task"}])
