@@ -34,9 +34,34 @@ class GenerationHyperparameters:
     # stream over up to k+1 tokens.  0 = off.
     spec_decode_k: int = 0
     spec_ngram: int = 3  # gram length for the lookup proposal
+    # Stop sequences: tuple of token-id tuples.  A decode row whose tail
+    # matches any sequence finishes at that boundary (the stop tokens are
+    # KEPT in the output — agent controllers parse the tool call out of
+    # them).  Normalized to tuples in __post_init__ so the config stays
+    # hashable (engine compile caches key on it) and survives a JSON
+    # round-trip (lists come back from the wire).
+    stop: tuple = ()
+
+    def __post_init__(self):
+        self.stop = tuple(tuple(int(t) for t in s) for s in self.stop)
 
     def new(self, **kwargs):
         return dataclasses.replace(self, **kwargs)
+
+
+class SlotGoneError(RuntimeError):
+    """An episode continuation targeted a slot the serving side no longer
+    holds (evicted under pool pressure, released, or the server
+    restarted).  Typed — NOT a silent fresh admission — so the episode
+    controller can recover deliberately: it re-admits the full
+    conversation, which the prefix cache turns into a tail re-prefill.
+    Raised by the engine, and reconstructed by API clients from the
+    server's ``{"error_type": "slot_gone"}`` payload."""
+
+    def __init__(self, episode_id: str, reason: str = "unknown"):
+        super().__init__(f"episode {episode_id!r}: slot gone ({reason})")
+        self.episode_id = episode_id
+        self.reason = reason
 
 
 @dataclasses.dataclass
@@ -199,11 +224,17 @@ class LLMAPIClient(BoundedAgenerateMixin):
             # Surface the server's error body (it sends {"error": repr(exc)}
             # with the failure status) instead of a bare status line.
             try:
-                detail = _json.loads(e.read()).get("error", "")
+                body = _json.loads(e.read())
             except Exception:
-                detail = ""
+                body = {}
+            if body.get("error_type") == "slot_gone":
+                raise SlotGoneError(
+                    str(body.get("episode_id", "")),
+                    str(body.get("reason", "unknown")),
+                ) from e
             raise RuntimeError(
-                f"generation server {path} failed: HTTP {e.code} {detail}"
+                f"generation server {path} failed: HTTP {e.code} "
+                f"{body.get('error', '')}"
             ) from e
         if "error" in out:
             raise RuntimeError(f"generation server error: {out['error']}")
@@ -234,6 +265,7 @@ class LLMAPIClient(BoundedAgenerateMixin):
                 "temperature": g.temperature,
                 "spec_decode_k": g.spec_decode_k,
                 "spec_ngram": g.spec_ngram,
+                "stop": [list(s) for s in g.stop],
                 "seed": inp.seed,
             },
         )
@@ -275,6 +307,46 @@ class LLMAPIClient(BoundedAgenerateMixin):
 
     def resume(self) -> Dict:
         return self._post("/resume", {})
+
+    # ---- agent-serving episodes -------------------------------------
+    # Multi-turn tool-use on the server's persistent KV pages.  extend()
+    # raises SlotGoneError when the server reclaimed the episode's slot;
+    # the controller recovers by start()ing the full conversation again.
+
+    def episode_start(
+        self,
+        episode_id: str,
+        prompt_ids,
+        gconfig: GenerationHyperparameters,
+        token_budget: int = 0,
+        seed: int = 0,
+    ) -> Dict:
+        return self._post(
+            "/episode",
+            {
+                "op": "start",
+                "episode_id": episode_id,
+                "prompt_ids": list(map(int, prompt_ids)),
+                "gconfig": dataclasses.asdict(gconfig),
+                "token_budget": int(token_budget),
+                "seed": int(seed),
+            },
+        )
+
+    def episode_extend(self, episode_id: str, obs_ids) -> Dict:
+        return self._post(
+            "/episode",
+            {
+                "op": "extend",
+                "episode_id": episode_id,
+                "obs_ids": list(map(int, obs_ids)),
+            },
+        )
+
+    def episode_release(self, episode_id: str) -> Dict:
+        return self._post(
+            "/episode", {"op": "release", "episode_id": episode_id}
+        )
 
 
 class Engine(abc.ABC):

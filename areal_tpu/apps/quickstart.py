@@ -411,6 +411,10 @@ def cmd_ppo_math(args):
         anomaly_kl_max=args.anomaly_kl_max,
         max_consecutive_quarantines=args.max_consecutive_quarantines,
         weight_push_checksum=not args.no_weight_push_checksum,
+        episode_max_turns=args.episode_max_turns,
+        episode_token_budget=args.episode_token_budget,
+        tool_timeout_s=args.tool_timeout_s,
+        reward_backend=args.reward_backend,
     )
     plan = exps.build_ppo_math(cfg)
     for wc in plan.worker_configs:
@@ -533,6 +537,20 @@ def main(argv=None):
                     help="quarantine a batch whose mean |policy-ref KL| "
                          "exceeds this before it ever reaches the train "
                          "engine (needs --ref-path; omit to disable)")
+    pp.add_argument("--episode-max-turns", type=int, default=0,
+                    help="agent-serving runtime: >0 turns rollout into "
+                         "multi-turn tool-use episodes parked on "
+                         "persistent KV slots (0 = single-shot)")
+    pp.add_argument("--episode-token-budget", type=int, default=0,
+                    help="agent episodes: total transcript token cap per "
+                         "episode (0 = engine default)")
+    pp.add_argument("--tool-timeout-s", type=float, default=10.0,
+                    help="agent episodes: wall-clock bound on each tool "
+                         "call before it degrades to an error observation")
+    pp.add_argument("--reward-backend", default="",
+                    help="force one reward-fabric verifier backend (math, "
+                         "code, judge, or a registered name) for every "
+                         "sample instead of routing by per-row task")
     pp.set_defaults(fn=cmd_ppo_math)
 
     # Install YAML defaults on whichever subcommand was chosen.

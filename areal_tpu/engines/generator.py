@@ -34,7 +34,11 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
-from areal_tpu.api.model_api import Engine, GenerationHyperparameters
+from areal_tpu.api.model_api import (
+    Engine,
+    GenerationHyperparameters,
+    SlotGoneError,
+)
 from areal_tpu.base import logging, metrics, tracer
 from areal_tpu.base.distributed import to_host
 from areal_tpu.base.topology import batch_sharding_degree
@@ -56,6 +60,54 @@ def _cache_nbytes(cache) -> int:
         if a is not None:
             total += a.size * a.dtype.itemsize
     return total
+
+
+# SlotGoneError (typed "your episode's slot was reclaimed" failure) lives
+# in api/model_api.py so HTTP/ZMQ clients can raise the same type without
+# importing the engines layer; re-exported here for engine-side callers.
+
+
+def _find_stop_end(toks, scan_from: int, stop_seqs) -> Optional[int]:
+    """Earliest index just PAST a completed stop sequence whose match
+    ends after `scan_from` — so a sequence straddling two decode chunks
+    is still caught, exactly once.  None when nothing matches."""
+    best = None
+    for seq in stop_seqs:
+        L = len(seq)
+        if L == 0 or len(toks) < L:
+            continue
+        target = list(seq)
+        for i in range(max(0, scan_from - L + 1), len(toks) - L + 1):
+            if toks[i : i + L] == target:
+                end = i + L
+                if best is None or end < best:
+                    best = end
+                break
+    return best
+
+
+@dataclasses.dataclass
+class _EpisodeSlot:
+    """Host bookkeeping for one live episode pinned to a serving slot.
+
+    The transcript itself lives in the shared session (`slot_prompt[s]`
+    holds every forwarded token, the page table holds its KV); this
+    records the episode-level state machine: turn count, per-turn decode
+    budget, the stop-scan low-water mark, and whether an interrupt
+    parked the episode mid-turn."""
+
+    ep_id: str
+    slot: int
+    gconfig: GenerationHyperparameters
+    token_budget: int  # max transcript tokens; 0 = session default
+    turns: int = 0
+    seq: int = 0  # LRU tick (bumped on every touch; eviction takes min)
+    turn_start_len: int = 0  # transcript tokens when this turn began
+    scan_from: int = 0  # stop-scan position within the current turn
+    last_admit_tokens: int = 0  # teacher-forced tokens this call
+    turn_max_new: int = 0  # effective per-turn budget (after clamp)
+    budget_limited: bool = False  # turn_max_new was clamped by budget
+    parked_mid_turn: bool = False  # interrupted inside a turn
 
 
 @dataclasses.dataclass
@@ -116,6 +168,13 @@ class _PagedGenSession:
     # members sharing instead of racing k private prefills).
     inflight_prefix: Any = None  # Dict[bytes, int]
     peak_live: int = 0  # max simultaneously live slots (capacity sweep)
+    # ---- agent-serving episodes (engine-lifetime session only) ----
+    # ep_id -> _EpisodeSlot for every episode currently pinning a slot;
+    # active[s] holds the ep_id string (any non-None marks the slot
+    # live for the shared privatize/reserve helpers).
+    episodes: Any = None  # Dict[str, _EpisodeSlot]
+    ep_seq: int = 0  # monotonic LRU tick source
+    ep_budget: int = 0  # session default per-episode token budget
 
 
 def _spec_emit(
@@ -331,6 +390,16 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         self._interrupt_evt = threading.Event()
         self._session: Optional[_PagedGenSession] = None
         self.resume_replays = 0
+        # Agent-serving episodes: an engine-LIFETIME serving session
+        # (slot pool + page pool) that multi-turn episodes pin slots in;
+        # created lazily by the first episode_start().  Counters are
+        # cumulative (never reset by generate()) — the agents check leg
+        # reads deltas.
+        self._ep_session: Optional[_PagedGenSession] = None
+        self.episodes_started = 0
+        self.episodes_evicted = 0
+        self.episode_prefix_hits = 0
+        self.episode_prefix_misses = 0
         # Load gauges for gen_server /health queue-depth-aware balancing:
         # slots live in the current chunk loop and the last sampled
         # KV-pool utilization.  `load_state` is the atomically replaced
@@ -387,6 +456,13 @@ class GeneratorEngine(HostOffloadMixin, Engine):
     def interrupted(self) -> bool:
         """True iff a parked session is waiting for resume_generate()."""
         return self._session is not None
+
+    @property
+    def interrupt_requested(self) -> bool:
+        """True while an interrupt is pending (set, not yet cleared) —
+        episode drivers poll this before episode_resume() so a resume
+        doesn't immediately re-park."""
+        return self._interrupt_evt.is_set()
 
     @property
     def page_budget_tokens(self) -> Optional[int]:
@@ -549,6 +625,11 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         b_cap = max(self.batch_shard, self.max_decode_batch)
         if gconfig.spec_decode_k > 0:
             inflight = True  # spec decoding lives on the inflight path
+        elif gconfig.stop:
+            # Stop sequences are scanned host-side at chunk boundaries;
+            # the static path is one fused device program with no such
+            # boundary, so stop-bearing requests always go inflight.
+            inflight = True
         elif inflight is None:
             # Static chunks win when every request fits one pool (uniform
             # lengths, no refills, zero per-chunk host round-trips);
@@ -847,15 +928,18 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             self._drain_chunk_outputs(
                 out_toks, out_logps, new_done, active, toks_acc, logps_acc,
                 results, done_host, cache_len, gconfig.max_new_tokens,
+                stop_seqs=gconfig.stop,
             )
 
     def _drain_chunk_outputs(
         self, out_toks, out_logps, new_done, active, toks_acc, logps_acc,
         results, done_host, cache_len, max_new: int, on_retire=None,
+        stop_seqs=(),
     ) -> None:
         """Shared inflight bookkeeping (plain + speculative loops): append
         each live slot's chunk output (rows are contiguous, -1-terminated),
-        finish on EOS or the token budget, retire finished slots (a dead
+        finish on EOS, a matched stop sequence (the stop tokens stay in
+        the output), or the token budget, retire finished slots (a dead
         slot must not drive cache growth).  `on_retire(slot)` fires when a
         slot finishes — the paged loops hook it to recycle the slot's
         pages into the free list."""
@@ -872,10 +956,24 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             # One batched host conversion per slot per chunk — a per-token
             # float()/int() here would be a per-scalar sync if a caller
             # ever passed device arrays (rule host-sync).
+            prev_len = len(toks_acc[s])
             toks_acc[s].extend(row[:limit].tolist())
             logps_acc[s].extend(out_logps[s, :limit].tolist())
+            # Stop sequences are a HOST-side contract (the compiled chunk
+            # keys only on geometry + sampling knobs, so adding a stop
+            # set never recompiles): scan the accumulated tail, truncate
+            # just past the match.
+            cut = (
+                _find_stop_end(toks_acc[s], prev_len, stop_seqs)
+                if stop_seqs
+                else None
+            )
+            if cut is not None:
+                del toks_acc[s][cut:]
+                del logps_acc[s][cut:]
             finished = (
-                len(toks_acc[s]) >= max_new
+                cut is not None
+                or len(toks_acc[s]) >= max_new
                 or (toks_acc[s] and toks_acc[s][-1] == self.eos_token_id)
             )
             if finished:
@@ -1218,6 +1316,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 out_toks, out_logps, to_host(new_done), st.active,
                 st.toks_acc, st.logps_acc, st.results, st.done_host,
                 st.cache_len, gconfig.max_new_tokens, on_retire=_retire,
+                stop_seqs=gconfig.stop,
             )
         self.last_pool_stats.update(
             pool_pages=st.n_pages, page_size=ps,
@@ -1533,6 +1632,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 out_toks, out_logps, to_host(new_done), st.active,
                 st.toks_acc, st.logps_acc, st.results, st.done_host,
                 st.cache_len, gconfig.max_new_tokens, on_retire=_retire,
+                stop_seqs=gconfig.stop,
             )
         self.last_pool_stats.update(
             pool_pages=st.n_pages, page_size=ps,
@@ -1838,6 +1938,565 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         )
         return fn
 
+    # -- agent-serving episodes (multi-turn tool use on persistent KV) --
+
+    def _require_serving_plane(self) -> None:
+        if not (
+            self.kv_paged
+            and self.prefill_chunk_tokens > 0
+            and self.kv_cache_dtype != "int8"
+        ):
+            raise RuntimeError(
+                "episodes require the serving plane: kv_paged=True, "
+                "prefill_chunk_tokens > 0, and a non-int8 KV cache"
+            )
+
+    def _episode_session_get(
+        self, gconfig: GenerationHyperparameters, token_budget: int,
+        seed: int,
+    ) -> "_PagedGenSession":
+        """Lazily create the engine-LIFETIME episode session: one slot
+        pool + one page pool shared by every live episode.  Geometry is
+        fixed at first use, so the serving chunk program compiles ONCE
+        and every later turn of every episode reuses it — the agents
+        check leg asserts decode_compiles stays 1 across a whole
+        multi-episode run."""
+        if self._ep_session is not None:
+            return self._ep_session
+        n_slots = max(self.batch_shard, self.max_decode_batch)
+        while n_slots % self.batch_shard:
+            n_slots += 1
+        ps = self.kv_page_size
+        chunk_t = min(32, gconfig.max_new_tokens)
+        budget = int(token_budget) or 2048
+        # The admission width bounds any single teacher-forced slab; a
+        # conversation re-admitted after SlotGone is the worst case (the
+        # whole budget), so pbw == budget keeps that path recompile-free.
+        pbw = budget
+        max_pages = -(-(budget + chunk_t) // ps)
+        n_pages = self.kv_pool_pages or n_slots * max_pages
+        st = _PagedGenSession(
+            gconfig=gconfig,
+            key=jax.random.PRNGKey(seed),
+            results={},
+            n_slots=n_slots,
+            n_pages=n_pages,
+            max_pages=max_pages,
+            chunk_t=chunk_t,
+            alloc=PageAllocator(n_pages, ps, n_slots, max_pages),
+            pool=tfm.init_paged_kv_cache(
+                self.cfg, n_pages, ps, dtype=self._paged_kv_dtype()
+            ),
+            logits_buf=jnp.zeros(
+                (n_slots, self.cfg.vocab_size), jnp.float32
+            ),
+            cache_len=np.zeros((n_slots,), np.int32),
+            gen_count=np.zeros((n_slots,), np.int32),
+            done_host=np.ones((n_slots,), bool),
+            active=[None] * n_slots,
+            toks_acc={},
+            logps_acc={},
+            pending=[],
+            slot_prompt={},
+            last_emit=np.zeros((n_slots,), np.int32),
+            prefill_chunk=max(1, self.prefill_chunk_tokens),
+            prompt_buf=np.full((n_slots, pbw), self.pad_token_id, np.int32),
+            prefill_rem=np.zeros((n_slots,), np.int32),
+            prompt_off=np.zeros((n_slots,), np.int32),
+            shared_from=np.zeros((n_slots,), np.int32),
+            slot_hash={},
+            inflight_prefix={},
+            episodes={},
+            ep_budget=budget,
+        )
+        self._ep_session = st
+        logger.info(
+            f"episode session: {n_slots} slots, pool {n_pages}x{ps}, "
+            f"chunk={chunk_t}, budget={budget}"
+        )
+        return st
+
+    def episode_start(
+        self,
+        ep_id: str,
+        prompt_ids,
+        gconfig: GenerationHyperparameters,
+        token_budget: int = 0,
+        seed: int = 0,
+    ) -> Optional[Dict[str, Any]]:
+        """Open an episode: pin a serving slot, admit the conversation
+        through the chunked-prefill serving program (the longest
+        page-aligned transcript prefix already published rides the
+        prefix cache — shared system prompts and post-SlotGone
+        re-admissions both land here), decode turn 0 until a stop
+        sequence / EOS / budget, then PARK the slot with its KV pages
+        held.  Returns the turn dict, or None when an interrupt parked
+        the call mid-turn (episode_resume() continues it)."""
+        self._ensure_loaded()
+        self._require_params()
+        self._require_serving_plane()
+        st = self._episode_session_get(gconfig, token_budget, seed)
+        if ep_id in st.episodes:
+            raise ValueError(f"episode {ep_id!r} already live")
+        toks = np.asarray(list(map(int, prompt_ids)), np.int32)
+        budget = int(token_budget) or st.ep_budget
+        if len(toks) == 0:
+            raise ValueError("episode_start needs a non-empty prompt")
+        if len(toks) + 1 > budget:
+            raise ValueError(
+                f"episode prompt ({len(toks)} tokens) leaves no room in "
+                f"the token budget ({budget})"
+            )
+        if len(toks) > st.prompt_buf.shape[1]:
+            raise ValueError(
+                f"episode prompt ({len(toks)} tokens) exceeds the "
+                f"admission width ({st.prompt_buf.shape[1]})"
+            )
+        s = self._episode_free_slot(st)
+        ep = _EpisodeSlot(
+            ep_id=ep_id, slot=s, gconfig=gconfig, token_budget=budget,
+        )
+        st.episodes[ep_id] = ep
+        self.episodes_started += 1
+        self._episode_admit(st, ep, toks, fresh=True)
+        return self._run_episode_turn(st, ep)
+
+    def episode_extend(
+        self, ep_id: str, obs_ids
+    ) -> Optional[Dict[str, Any]]:
+        """Append a tool result / observation onto the episode's SAME
+        slot — a chunked-prefill admission over its existing KV pages,
+        so nothing already in cache is ever re-forwarded — and decode
+        the next turn.  Raises SlotGoneError when the slot was
+        reclaimed; the controller then re-admits the full conversation
+        via episode_start (the prefix cache pays for most of it)."""
+        self._ensure_loaded()
+        self._require_params()
+        st = self._ep_session
+        if st is None or ep_id not in st.episodes:
+            raise SlotGoneError(
+                ep_id,
+                "engine has no episode session" if st is None
+                else "slot reclaimed",
+            )
+        ep = st.episodes[ep_id]
+        if ep.parked_mid_turn:
+            raise RuntimeError(
+                f"episode {ep_id!r} is parked mid-turn; call "
+                "episode_resume() first"
+            )
+        obs = np.asarray(list(map(int, obs_ids)), np.int32)
+        if len(obs) == 0:
+            raise ValueError("episode_extend needs a non-empty observation")
+        if len(obs) > st.prompt_buf.shape[1]:
+            raise ValueError(
+                f"observation ({len(obs)} tokens) exceeds the admission "
+                f"width ({st.prompt_buf.shape[1]})"
+            )
+        if (
+            ep.token_budget
+            and int(st.cache_len[ep.slot]) + len(obs) + 1 > ep.token_budget
+        ):
+            # The observation alone busts the budget: a terminal
+            # zero-token turn, no admission (the slot keeps its pages so
+            # the transcript stays readable until release).
+            ep.turns += 1
+            return {
+                "episode_id": ep.ep_id,
+                "turn_index": ep.turns - 1,
+                "tokens": [],
+                "logprobs": [],
+                "stop_reason": "budget",
+                "transcript_len": int(st.cache_len[ep.slot]),
+                "prefill_tokens": 0,
+                "shared_prefix_tokens": int(st.shared_from[ep.slot]),
+                "slot": ep.slot,
+            }
+        self._episode_admit(st, ep, obs, fresh=False)
+        return self._run_episode_turn(st, ep)
+
+    def episode_resume(self, ep_id: str) -> Optional[Dict[str, Any]]:
+        """Continue a mid-turn-parked episode under the CURRENT weights:
+        replay the slot's last chunk tail through its existing page
+        table (resume_generate mechanics, one row), drop the prefix
+        cache (stale-weight KV must not be shared into new admissions),
+        then re-enter the turn loop."""
+        self._ensure_loaded()
+        self._require_params()
+        st = self._ep_session
+        if st is None or ep_id not in st.episodes:
+            raise SlotGoneError(
+                ep_id,
+                "engine has no episode session" if st is None
+                else "slot reclaimed",
+            )
+        ep = st.episodes[ep_id]
+        if not ep.parked_mid_turn:
+            raise RuntimeError(
+                f"episode {ep_id!r} is not parked mid-turn"
+            )
+        ep.parked_mid_turn = False
+        s = ep.slot
+        Q = st.chunk_t
+        hist = np.concatenate(
+            [st.slot_prompt[s], np.asarray(st.toks_acc[s], np.int32)]
+        )
+        L = int(st.cache_len[s])
+        priv = int(st.shared_from[s])
+        r = int(min(max(int(st.last_emit[s]), 1), Q, L - priv))
+        if r > 0:
+            tokens = np.full((st.n_slots, Q), self.pad_token_id, np.int32)
+            positions = np.zeros((st.n_slots, Q), np.int32)
+            write_pos0 = np.zeros((st.n_slots,), np.int32)
+            take_idx = np.zeros((st.n_slots,), np.int32)
+            live_mask = np.zeros((st.n_slots,), bool)
+            q_lens = np.zeros((st.n_slots,), np.int32)
+            tokens[s, :r] = hist[L - r : L]
+            write_pos0[s] = L - r
+            positions[s] = (L - r) + np.arange(Q)
+            take_idx[s] = r - 1
+            live_mask[s] = True
+            q_lens[s] = r
+            with tracer.span("episode_resume_replay", cat="compute", n=1):
+                st.logits_buf, st.pool = self._get_paged_replay_fn(
+                    st.n_slots, st.n_pages, st.max_pages, Q
+                )(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray(positions), st.pool,
+                    jnp.asarray(st.alloc.table), jnp.asarray(write_pos0),
+                    st.logits_buf, jnp.asarray(take_idx),
+                    jnp.asarray(live_mask), jnp.asarray(q_lens),
+                )
+        self.resume_replays += 1
+        st.alloc.prefix_clear()
+        st.inflight_prefix.clear()
+        st.slot_hash.clear()
+        return self._run_episode_turn(st, ep)
+
+    def episode_release(self, ep_id: str) -> bool:
+        """Retire an episode: release its pages (prefix-cache holds on
+        published transcript prefixes survive) and free the slot.
+        Returns False when the episode is already gone."""
+        st = self._ep_session
+        if st is None or ep_id not in st.episodes:
+            return False
+        self._drop_episode(st, st.episodes[ep_id])
+        return True
+
+    def episode_stats(self) -> Dict[str, Any]:
+        """Episode-plane load snapshot (gen_server /health + checks)."""
+        st = self._ep_session
+        out = {
+            "active": 0,
+            "parked_mid_turn": 0,
+            "started": self.episodes_started,
+            "evicted": self.episodes_evicted,
+            "prefix_hits": self.episode_prefix_hits,
+            "prefix_misses": self.episode_prefix_misses,
+        }
+        if st is not None:
+            out["active"] = len(st.episodes)
+            out["parked_mid_turn"] = sum(
+                1 for e in st.episodes.values() if e.parked_mid_turn
+            )
+            out["pool_pages"] = st.n_pages
+            out["pages_allocated"] = st.alloc.allocated_pages()
+        return out
+
+    def _episode_free_slot(self, st: "_PagedGenSession") -> int:
+        for s in range(st.n_slots):
+            if st.active[s] is None:
+                return s
+        # Every slot is pinned: reclaim the least-recently-touched
+        # parked episode — its controller sees SlotGoneError on the next
+        # continuation and re-admits via the prefix cache.
+        if not self._evict_parked_episode(st):
+            raise RuntimeError(
+                "no free episode slot and nothing parked to evict"
+            )
+        return next(s for s in range(st.n_slots) if st.active[s] is None)
+
+    def _evict_parked_episode(
+        self, st: "_PagedGenSession", exclude: str = ""
+    ) -> bool:
+        """Reclaim the LRU parked episode's slot + pages.  Mid-turn
+        parked episodes are exempt (their resume path owns the slot)."""
+        cands = [
+            ep for ep in st.episodes.values()
+            if not ep.parked_mid_turn and ep.ep_id != exclude
+        ]
+        if not cands:
+            return False
+        victim = min(cands, key=lambda e: e.seq)
+        logger.info(
+            f"evicting parked episode {victim.ep_id!r} "
+            f"(slot {victim.slot}, {victim.turns} turns)"
+        )
+        self._drop_episode(st, victim)
+        self.episodes_evicted += 1
+        return True
+
+    def _drop_episode(self, st: "_PagedGenSession", ep: _EpisodeSlot):
+        s = ep.slot
+        st.alloc.release(s)
+        st.active[s] = None
+        st.done_host[s] = True
+        st.cache_len[s] = 0
+        st.gen_count[s] = 0
+        st.prefill_rem[s] = 0
+        st.prompt_off[s] = 0
+        st.last_emit[s] = 0
+        st.shared_from[s] = 0
+        st.slot_prompt.pop(s, None)
+        st.toks_acc.pop(s, None)
+        st.logps_acc.pop(s, None)
+        st.episodes.pop(ep.ep_id, None)
+
+    def _episode_admit(
+        self, st: "_PagedGenSession", ep: _EpisodeSlot, toks: np.ndarray,
+        fresh: bool,
+    ) -> None:
+        """Admission is pure host bookkeeping (the serving chunk does
+        the forwards).  fresh=True maps a slot for a full conversation:
+        the LONGEST page-aligned transcript prefix published in the
+        prefix cache is mapped copy-on-write (refcount bump, zero
+        copies) and only the tail teacher-forces — this is what makes
+        shared system prompts and post-SlotGone re-admission cheap.
+        fresh=False appends an observation onto the SAME slot's live
+        pages: the new tokens prefill from position cache_len onward,
+        overwriting any tail KV a stop-sequence rewind left behind."""
+        alloc = st.alloc
+        s = ep.slot
+        ps = alloc.page_size
+        g = ep.gconfig
+        chunk_t = st.chunk_t
+        st.ep_seq += 1
+        ep.seq = st.ep_seq
+        if fresh:
+            plen = len(toks)
+            start = 0
+            if self.kv_share_prefix and plen > ps:
+                # Probe longest-first: published keys are page-aligned
+                # transcript prefixes, so the first hit is the best hit.
+                # The tail keeps >= 1 token — the re-forward must
+                # produce this conversation's own end-of-prompt logits.
+                for k in range((plen - 1) // ps, 0, -1):
+                    shared = alloc.prefix_lookup(
+                        b"ep:" + toks[: k * ps].tobytes()
+                    )
+                    if shared is None:
+                        continue
+                    need = alloc.pages_for(plen + chunk_t) - len(shared)
+                    if need > len(alloc.free):
+                        alloc.prefix_evict(need)
+                    if need > len(alloc.free):
+                        break  # pool too tight to extend past the share
+                    alloc.share(s, shared)
+                    start = k * ps
+                    break
+            if start > 0:
+                self.episode_prefix_hits += 1
+            else:
+                self.episode_prefix_misses += 1
+            try:
+                self._reserve_with_evict(alloc, s, plen + chunk_t)
+            except PagePoolExhausted:
+                if not self._evict_parked_episode(st, exclude=ep.ep_id):
+                    raise
+                self._reserve_with_evict(alloc, s, plen + chunk_t)
+            st.active[s] = ep.ep_id
+            st.cache_len[s] = start
+            st.shared_from[s] = start
+            st.slot_prompt[s] = toks
+            rem = plen - start
+        else:
+            st.slot_prompt[s] = np.concatenate([st.slot_prompt[s], toks])
+            start = int(st.cache_len[s])
+            rem = len(toks)
+        st.toks_acc[s] = []
+        st.logps_acc[s] = []
+        st.gen_count[s] = 0
+        st.done_host[s] = False
+        st.prompt_buf[s, :] = self.pad_token_id
+        st.prompt_buf[s, :rem] = toks[len(toks) - rem :]
+        st.prefill_rem[s] = rem
+        st.prompt_off[s] = 0
+        st.last_emit[s] = 0
+        ep.last_admit_tokens = rem
+        ep.turn_start_len = start + rem
+        ep.scan_from = 0
+        # Per-turn decode budget, clamped so the transcript can never
+        # outgrow the episode's token budget (the page reservation and
+        # the admission width both rely on that bound).  Callers
+        # pre-check, so this is >= 1 here.
+        left = (
+            ep.token_budget - ep.turn_start_len
+            if ep.token_budget
+            else g.max_new_tokens
+        )
+        ep.turn_max_new = max(0, min(g.max_new_tokens, left))
+        ep.budget_limited = ep.turn_max_new < g.max_new_tokens
+
+    def _run_episode_turn(
+        self, st: "_PagedGenSession", ep: _EpisodeSlot
+    ) -> Optional[Dict[str, Any]]:
+        """Drive serving chunks until THIS episode's turn ends (stop
+        sequence, EOS, per-turn length, or episode budget).  Other
+        episodes' slots ride along as done rows — dead queries whose
+        writes drop, exactly like retired slots in the batch loop.
+        Checks the interrupt event at every chunk boundary: a weight
+        push parks the turn in place (returns None) and
+        episode_resume() replays the last chunk tail on the same pages
+        before continuing."""
+        g = ep.gconfig
+        s = ep.slot
+        alloc = st.alloc
+        n_slots, chunk_t, W = st.n_slots, st.chunk_t, st.prefill_chunk
+        pbw = st.prompt_buf.shape[1]
+        chunk_fn = self._get_serving_chunk_fn(
+            n_slots, st.n_pages, st.max_pages, chunk_t, W, pbw, g
+        )
+        max_new = ep.turn_max_new
+        stop_seqs = g.stop
+        reason = None
+        while reason is None:
+            if self._interrupt_evt.is_set():
+                ep.parked_mid_turn = True
+                tracer.counter(
+                    "episode_interrupt",
+                    slot=s,
+                    cache_len=int(st.cache_len[s]),
+                )
+                return None
+            rem = int(st.prefill_rem[s])
+            left = max(0, max_new - int(st.gen_count[s]))
+            target = int(st.cache_len[s]) + max(
+                1, min(chunk_t * W, rem + chunk_t, rem + left)
+            )
+            try:
+                self._reserve_with_evict(alloc, s, target)
+            except PagePoolExhausted:
+                if not self._evict_parked_episode(st, exclude=ep.ep_id):
+                    raise
+                self._reserve_with_evict(alloc, s, target)
+            self._privatize_write_windows(st)
+            self._accum_pool_stats(
+                "paged", int(st.cache_len.sum()),
+                alloc.allocated_pages() * alloc.page_size,
+            )
+            st.key, sub = jax.random.split(st.key)
+            prev_gen = st.gen_count.copy()
+            with tracer.span(
+                "episode_chunk", cat="compute", t=chunk_t, w=W
+            ):
+                (
+                    out_toks, out_logps, st.logits_buf, st.pool,
+                    new_cache_len, new_gen_count, new_done, new_rem,
+                    new_off,
+                ) = chunk_fn(
+                    self.params, st.pool, st.logits_buf,
+                    jnp.asarray(alloc.table), jnp.asarray(st.prompt_buf),
+                    jnp.asarray(st.prompt_off),
+                    jnp.asarray(st.prefill_rem),
+                    jnp.asarray(st.cache_len), jnp.asarray(st.gen_count),
+                    jnp.asarray(st.done_host), sub,
+                )
+                out_toks = to_host(out_toks)
+                out_logps = to_host(out_logps)
+            st.cache_len = to_host(new_cache_len).copy()
+            st.gen_count = to_host(new_gen_count).copy()
+            st.prefill_rem = to_host(new_rem).copy()
+            st.prompt_off = to_host(new_off).copy()
+            st.done_host = to_host(new_done).copy()
+            st.last_emit = st.gen_count - prev_gen
+            # Drain THIS slot only (parked rows emit nothing).
+            row = out_toks[s]
+            term = np.flatnonzero(row < 0)
+            limit = int(term[0]) if term.size else row.shape[0]
+            limit = min(limit, max(0, max_new - len(st.toks_acc[s])))
+            eos_at = np.flatnonzero(row[:limit] == self.eos_token_id)
+            if eos_at.size:
+                limit = int(eos_at[0]) + 1
+            prev_len = len(st.toks_acc[s])
+            st.toks_acc[s].extend(row[:limit].tolist())
+            st.logps_acc[s].extend(out_logps[s, :limit].tolist())
+            cut = (
+                _find_stop_end(st.toks_acc[s], prev_len, stop_seqs)
+                if stop_seqs
+                else None
+            )
+            if cut is not None:
+                del st.toks_acc[s][cut:]
+                del st.logps_acc[s][cut:]
+                reason = "stop"
+            elif (
+                st.toks_acc[s]
+                and st.toks_acc[s][-1] == self.eos_token_id
+            ):
+                reason = "eos"
+            elif (
+                int(st.prefill_rem[s]) == 0
+                and len(st.toks_acc[s]) >= max_new
+            ):
+                reason = "budget" if ep.budget_limited else "length"
+        return self._finish_episode_turn(st, ep, reason)
+
+    def _finish_episode_turn(
+        self, st: "_PagedGenSession", ep: _EpisodeSlot, reason: str
+    ) -> Dict[str, Any]:
+        s = ep.slot
+        kept = len(st.toks_acc[s])
+        # Rewind: tokens sampled past the kept boundary (after a stop
+        # sequence, or over the turn budget) left KV at positions the
+        # transcript no longer covers.  Pulling cache_len back is pure
+        # host bookkeeping — attention never reads past a row's write
+        # cursor, and the next admission teacher-forces over those
+        # positions in place.
+        st.cache_len[s] = ep.turn_start_len + kept
+        st.done_host[s] = True
+        st.prefill_rem[s] = 0
+        turn_toks = [int(t) for t in st.toks_acc[s]]
+        turn_lps = [float(x) for x in st.logps_acc[s]]
+        if turn_toks:
+            st.slot_prompt[s] = np.concatenate(
+                [st.slot_prompt[s], np.asarray(turn_toks, np.int32)]
+            )
+        st.toks_acc[s] = []
+        st.logps_acc[s] = []
+        ep.turns += 1
+        self._episode_publish_prefix(st, s)
+        self._set_live_slots(len(st.episodes))
+        return {
+            "episode_id": ep.ep_id,
+            "turn_index": ep.turns - 1,
+            "tokens": turn_toks,
+            "logprobs": turn_lps,
+            "stop_reason": reason,
+            "transcript_len": int(st.cache_len[s]),
+            "prefill_tokens": int(ep.last_admit_tokens),
+            "shared_prefix_tokens": int(st.shared_from[s]),
+            "slot": s,
+        }
+
+    def _episode_publish_prefix(
+        self, st: "_PagedGenSession", s: int
+    ) -> None:
+        """Publish the slot's page-aligned transcript prefix so a future
+        conversation sharing it — another episode with the same system
+        prompt, or a post-SlotGone re-admission of this very transcript
+        — maps the pages instead of re-prefilling.  Keys are the prefix
+        token bytes, page-aligned, so admission probes longest-first."""
+        if not self.kv_share_prefix:
+            return
+        alloc = st.alloc
+        sp = int(st.cache_len[s]) // alloc.page_size
+        if sp <= 0:
+            return
+        alloc.prefix_insert(
+            b"ep:" + st.slot_prompt[s][: sp * alloc.page_size].tobytes(),
+            alloc.table[s, :sp],
+        )
+
     # -- speculative inflight (n-gram drafts + exact verification) --
 
     def _generate_inflight_spec(self, reqs, g, key, results) -> None:
@@ -1942,6 +2601,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             self._drain_chunk_outputs(
                 out_toks, out_logps, to_host(new_done), active, toks_acc,
                 logps_acc, results, done_host, cache_len, g.max_new_tokens,
+                stop_seqs=g.stop,
             )
 
     def _get_spec_admit_fn(self, g):
@@ -2158,7 +2818,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             self._drain_chunk_outputs(
                 out_toks, out_logps, to_host(new_done), active, toks_acc,
                 logps_acc, results, done_host, cache_len, g.max_new_tokens,
-                on_retire=alloc.release,
+                on_retire=alloc.release, stop_seqs=g.stop,
             )
         self.last_pool_stats.update(
             pool_pages=n_pages, page_size=ps,

@@ -362,6 +362,15 @@ class PPOMathConfig:
     anomaly_kl_max: Optional[float] = None
     max_consecutive_quarantines: int = 3
     weight_push_checksum: bool = True
+    # Agent-serving runtime (system/episode.py): >0 max turns switches
+    # rollout into multi-turn tool-use episodes parked on persistent KV
+    # slots; token budget caps the whole transcript (0 = engine default);
+    # tool_timeout_s bounds each ToolExecutor call; reward_backend forces
+    # a verifier backend for every sample ("" = route by per-row task).
+    episode_max_turns: int = 0
+    episode_token_budget: int = 0
+    tool_timeout_s: float = 10.0
+    reward_backend: str = ""
 
 
 def _remote_gen_shard(cfg: "PPOMathConfig", actor_gen, actor_if):
@@ -437,8 +446,11 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
             "use_dense_reward needs a custom reward_interface that emits "
             "'dense_rewards' (the default rw-math-code grades scalars only)"
         )
+    rew_args = dict(cfg.reward_interface_args)
+    if cfg.reward_backend:
+        rew_args.setdefault("reward_backend", cfg.reward_backend)
     rew_if = cfg.reward_interface or ModelInterfaceAbstraction(
-        "rw-math-code", cfg.reward_interface_args
+        "rw-math-code", rew_args
     )
     rew_outputs = (
         ("rewards", "dense_rewards") if use_dense else ("rewards",)

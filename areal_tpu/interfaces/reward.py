@@ -19,7 +19,6 @@ import numpy as np
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.base import logging
 from areal_tpu.api.model_api import Model, ModelInterface, register_interface
-from areal_tpu.interfaces import math_verify
 
 logger = logging.getLogger("reward")
 
@@ -50,6 +49,10 @@ class MultiTaskRewardInterface(ModelInterface):
     remote_url: Optional[str] = None
     # Generous default: code batches can run minutes of sandboxed tests.
     remote_timeout_s: float = 600.0
+    # When set, overrides every row's task key — forces one verifier
+    # backend (e.g. "judge") for the whole run regardless of dataset
+    # metadata.  "" = dispatch per-row.
+    reward_backend: str = ""
 
     def __post_init__(self):
         if self.dataset_path and not self.id2info:
@@ -87,12 +90,18 @@ class MultiTaskRewardInterface(ModelInterface):
                 text = tokenizer.decode(resp_tokens.tolist())
                 todo.append(
                     {
-                        "task": task,
+                        "task": self.reward_backend or task,
                         "text": text,
-                        "solutions": info.get("solutions") or [],
-                        "input_output": info.get("input_output"),
-                        "choices": info.get("choices"),
-                        "timeout_s": self.code_timeout_s,
+                        # Opaque backend payload (reward_service registry
+                        # schema): backends read it verbatim, so adding a
+                        # backend never remaps keys here.
+                        "payload": {
+                            "solutions": info.get("solutions") or [],
+                            "input_output": info.get("input_output"),
+                            "choices": info.get("choices"),
+                            "reference": info.get("reference"),
+                            "timeout_s": self.code_timeout_s,
+                        },
                     }
                 )
                 si += 1
@@ -104,7 +113,8 @@ class MultiTaskRewardInterface(ModelInterface):
             ).verify_batch(todo)
         else:
             oks = [
-                self.verify(it["task"], it["text"], it) for it in todo
+                self.verify(it["task"], it["text"], it["payload"])
+                for it in todo
             ]
         n_correct = sum(map(int, oks))
         rewards = [
@@ -122,18 +132,21 @@ class MultiTaskRewardInterface(ModelInterface):
         )
 
     def verify(self, task: str, text: str, info: Dict[str, Any]) -> bool:
-        """Grade one response for `task` ("math" | "code") — public so the
-        offline evaluator shares the exact training-reward graders."""
-        if task == "math":
-            return math_verify.verify_math(
-                text,
-                info.get("solutions", []),
-                is_choice=_row_is_choice(info),
-            )
-        elif task == "code":
-            return self._verify_code(text, info)
-        logger.warning(f"unknown task {task!r}; reward 0")
-        return False
+        """Grade one response for ``task`` via the verifier-backend
+        registry (reward_service) — public so the offline evaluator
+        shares the exact training-reward graders, and so a backend
+        registered once is available to every grading path."""
+        from areal_tpu.interfaces import reward_service
+
+        payload = dict(info)
+        payload.setdefault("timeout_s", self.code_timeout_s)
+        return reward_service.grade_item(
+            {
+                "task": self.reward_backend or task,
+                "text": text,
+                "payload": payload,
+            }
+        )
 
     # -- code verification: run extracted program against input/output pairs
     # in a SANDBOXED subprocess — rlimits + tmpdir jail + (where available)

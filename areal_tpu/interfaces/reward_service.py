@@ -22,7 +22,7 @@ import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from areal_tpu.base import logging, metrics, tracer
 
@@ -59,32 +59,127 @@ def _error_reason(e: BaseException) -> str:
     return "protocol"
 
 
-def _grade_one(item: Dict[str, Any]) -> bool:
+# ---------------------------------------------------------------------------
+# verifier-backend registry (the pluggable reward fabric)
+# ---------------------------------------------------------------------------
+#
+# Grading dispatches on the item's ``task`` key over an open registry,
+# and items travel in an OPAQUE schema::
+#
+#     {"task": "code", "text": "<response>", "payload": {...backend args}}
+#
+# The server never interprets ``payload`` — it hands it to the backend
+# verbatim — so a new backend round-trips client → FaaS → grader without
+# anyone in between remapping keys.  The pre-registry flat schema (math
+# keys at the top level) is accepted for one release with a log-once
+# warning; new callers must send ``payload``.
+
+_VERIFIERS: Dict[str, Callable[[str, Dict[str, Any]], bool]] = {}
+
+
+def register_verifier(
+    task: str, fn: Callable[[str, Dict[str, Any]], bool]
+) -> None:
+    """Register (or replace) the grader for a ``task`` key.  ``fn`` takes
+    ``(text, payload)`` and returns pass/fail; it runs on the service's
+    grading pool, so sandboxed subprocess work is fine."""
+    _VERIFIERS[task] = fn
+
+
+def verifier_names() -> List[str]:
+    return sorted(_VERIFIERS)
+
+
+def _verify_math_backend(text: str, payload: Dict[str, Any]) -> bool:
     from areal_tpu.interfaces import math_verify
+    from areal_tpu.interfaces.reward import _row_is_choice
+
+    return bool(
+        math_verify.verify_math(
+            text,
+            payload.get("solutions") or [],
+            is_choice=_row_is_choice(payload),
+        )
+    )
+
+
+def _verify_code_backend(text: str, payload: Dict[str, Any]) -> bool:
     from areal_tpu.interfaces.reward import MultiTaskRewardInterface
 
-    task = item.get("task", "math")
-    if task == "math":
-        from areal_tpu.interfaces.reward import _row_is_choice
+    iface = MultiTaskRewardInterface(
+        code_timeout_s=float(payload.get("timeout_s", 8.0))
+    )
+    return bool(
+        iface._verify_code(
+            text, {"input_output": payload.get("input_output")}
+        )
+    )
 
-        return bool(
-            math_verify.verify_math(
-                item.get("text", ""),
-                item.get("solutions") or [],
-                is_choice=_row_is_choice(item),
+
+def _verify_judge_backend(text: str, payload: Dict[str, Any]) -> bool:
+    """Judge-model STUB: case-insensitive reference containment over the
+    response tail (``payload["reference"]``, optional ``tail_chars``).
+    Deterministic placeholder that keeps the wire format and registry
+    seam honest until a real judge-model client lands; absent reference
+    grades False rather than guessing."""
+    ref = str(payload.get("reference", "")).strip()
+    if not ref:
+        return False
+    tail = int(payload.get("tail_chars", 0))
+    hay = text[-tail:] if tail > 0 else text
+    return ref.lower() in hay.lower()
+
+
+register_verifier("math", _verify_math_backend)
+register_verifier("code", _verify_code_backend)
+register_verifier("judge", _verify_judge_backend)
+
+_legacy_schema_warned = False
+_unknown_tasks_warned: set = set()
+
+
+def _normalize_item(item: Dict[str, Any]):
+    """Split an item into (task, text, payload), accepting the legacy
+    flat schema — backend keys at the top level — with a log-once
+    deprecation warning."""
+    global _legacy_schema_warned
+    task = str(item.get("task", "math"))
+    text = str(item.get("text", ""))
+    payload = item.get("payload")
+    if isinstance(payload, dict):
+        return task, text, payload
+    payload = {
+        k: v for k, v in item.items() if k not in ("task", "text")
+    }
+    if payload and not _legacy_schema_warned:
+        _legacy_schema_warned = True
+        logger.warning(
+            "verify item without 'payload' — accepting the legacy flat "
+            "schema for one release; send {'task','text','payload'} "
+            "(warned once)"
+        )
+    return task, text, payload
+
+
+def grade_item(item: Dict[str, Any]) -> bool:
+    """Grade one item via the verifier registry — the single dispatch
+    shared by the FaaS handler, the RemoteVerifier local fallback, and
+    the in-process reward fabric."""
+    task, text, payload = _normalize_item(item)
+    fn = _VERIFIERS.get(task)
+    if fn is None:
+        if task not in _unknown_tasks_warned:
+            _unknown_tasks_warned.add(task)
+            logger.warning(
+                f"no verifier backend for task {task!r} "
+                f"(registered: {verifier_names()}); reward 0"
             )
-        )
-    if task == "code":
-        iface = MultiTaskRewardInterface(
-            code_timeout_s=float(item.get("timeout_s", 8.0))
-        )
-        return bool(
-            iface._verify_code(
-                item.get("text", ""),
-                {"input_output": item.get("input_output")},
-            )
-        )
-    return False
+        return False
+    return bool(fn(text, payload))
+
+
+# Pre-registry name, kept for existing call sites.
+_grade_one = grade_item
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -121,7 +216,7 @@ class _Handler(BaseHTTPRequestHandler):
             # timeouts; grade the batch in parallel.
             with tracer.span("verify", cat="host", n=len(items)):
                 with ThreadPoolExecutor(max_workers=8) as ex:
-                    results = list(ex.map(_grade_one, items))
+                    results = list(ex.map(grade_item, items))
             tracer.flush()
             self._send(200, {"results": results})
         except Exception as e:  # noqa: BLE001 — report to the client
@@ -235,7 +330,7 @@ class RemoteVerifier:
                     f"attempts (last: {reason}: {e!r}); grading locally"
                 )
                 self._degraded = True
-        return [_grade_one(it) for it in items]
+        return [grade_item(it) for it in items]
 
 
 def main():

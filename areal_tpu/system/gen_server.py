@@ -52,6 +52,7 @@ from areal_tpu.api.model_api import (
     Engine,
     GenerationHyperparameters,
     LLMAPIClient,
+    SlotGoneError,
     register_backend,
 )
 from areal_tpu.base import integrity, logging, metrics, tracer
@@ -98,6 +99,13 @@ _M_FAULTS = _REG.counter(
     "injected chaos faults fired (AREAL_FAULTS), by kind",
     ("kind",),
 )
+# Episode continuations rejected because the engine reclaimed the slot
+# (eviction under pool pressure / restart) — each one costs the
+# controller a full-conversation re-admission through the prefix cache.
+_M_EPISODE_SLOT_LOST = _REG.counter(
+    "areal_gen_episode_slot_lost_total",
+    "episode continuations rejected: slot reclaimed",
+)
 
 
 @dataclasses.dataclass
@@ -123,7 +131,8 @@ def _gkey(p: _Pending):
     # arrival timing; exact-replay trainers should use the in-process
     # generator.
     return (g.n, g.max_new_tokens, g.min_new_tokens, g.greedy, g.top_p,
-            g.top_k, g.temperature, g.spec_decode_k, g.spec_ngram, p.seed)
+            g.top_k, g.temperature, g.spec_decode_k, g.spec_ngram, g.stop,
+            p.seed)
 
 
 class GenerationServer:
@@ -227,6 +236,8 @@ class GenerationServer:
                     req = json.loads(self.rfile.read(n))
                     if self.path == "/generate":
                         self._send(200, srv._handle_generate(req))
+                    elif self.path == "/episode":
+                        self._send(200, srv.handle_episode(req))
                     elif self.path == "/update_weights":
                         self._send(200, srv._handle_update(req))
                     elif self.path == "/pause":
@@ -241,6 +252,18 @@ class GenerationServer:
                         )
                     else:
                         self._send(404, {"error": "unknown path"})
+                except SlotGoneError as e:
+                    # Typed rejection, NOT a silent fresh admission: the
+                    # controller decides to re-admit the conversation.
+                    self._send(
+                        409,
+                        {
+                            "error": str(e),
+                            "error_type": "slot_gone",
+                            "episode_id": e.episode_id,
+                            "reason": e.reason,
+                        },
+                    )
                 except Exception as e:  # noqa: BLE001 — report to client
                     self._send(500, {"error": repr(e)})
 
@@ -377,6 +400,33 @@ class GenerationServer:
                         t_enq=time.monotonic_ns(),
                     )
                     self._queue.put(p)
+                    jobs.append((ident, rid, p))
+                elif cmd == "episode":
+                    # Episode turns block for a full decode; spawn like
+                    # update_weights so the ROUTER loop stays responsive.
+                    # slot_gone replies carry error_type WITHOUT "error"
+                    # so the client future resolves and the caller can
+                    # raise the typed SlotGoneError itself.
+                    p = _Pending(
+                        qid="", prompt_ids=[],
+                        gconfig=GenerationHyperparameters(),
+                        done=threading.Event(),
+                    )
+
+                    def _ep(p=p, req=dict(req)):
+                        try:
+                            p.result = self.handle_episode(req)
+                        except SlotGoneError as e:
+                            p.result = {
+                                "error_type": "slot_gone",
+                                "episode_id": e.episode_id,
+                                "reason": e.reason,
+                            }
+                        except Exception as e:  # noqa: BLE001
+                            p.error = repr(e)
+                        p.done.set()
+
+                    threading.Thread(target=_ep, daemon=True).start()
                     jobs.append((ident, rid, p))
                 elif cmd == "update_weights":
                     p = _Pending(
@@ -596,6 +646,7 @@ class GenerationServer:
             temperature=float(req.get("temperature", 1.0)),
             spec_decode_k=int(req.get("spec_decode_k", 0)),
             spec_ngram=int(req.get("spec_ngram", 3)),
+            stop=req.get("stop") or (),
         )
         p = _Pending(
             qid=str(req["qid"]),
@@ -615,6 +666,85 @@ class GenerationServer:
         if p.error:
             raise RuntimeError(p.error)
         return p.result
+
+    def handle_episode(self, req: Dict) -> Dict:
+        """Agent-serving episode ops (start/extend/release) — one turn per
+        request, pinned to the engine slot holding the episode's KV pages.
+
+        Runs on the calling transport thread, NOT through the collector:
+        an episode op needs ITS slot, so batching it with strangers buys
+        nothing, and the engine lock already serializes it against
+        batched generates and weight swaps.  A mid-turn weight push parks
+        the turn at a chunk boundary; the park loop below releases the
+        engine for the swap and resumes the SAME turn on its pages.  An
+        op against a reclaimed slot raises the typed
+        :class:`SlotGoneError` (HTTP 409 / ZMQ ``error_type`` payload)
+        and bumps ``areal_gen_episode_slot_lost_total`` — the controller
+        re-admits the full conversation via the prefix cache."""
+        self._fire_fault("episode")
+        eng = self.engine
+        if not hasattr(eng, "episode_start"):
+            raise RuntimeError(
+                "engine has no episode support (agent episodes need the "
+                "paged serving plane: kv_paged + prefill_chunk_tokens)"
+            )
+        op = str(req.get("op", ""))
+        ep_id = str(req.get("episode_id", ""))
+        if not ep_id:
+            raise ValueError("episode op needs a non-empty episode_id")
+        if op == "release":
+            with self._engine_lock:
+                released = bool(eng.episode_release(ep_id))
+            return {
+                "episode_id": ep_id,
+                "released": released,
+                "version": self.version,
+            }
+        if op == "start":
+            g = GenerationHyperparameters(**req.get("gconfig", {}))
+            prompt_ids = [int(t) for t in req.get("prompt_ids", [])]
+            budget = int(req.get("token_budget", 0))
+            seed = int(req.get("seed", 0))
+
+            def first():
+                return eng.episode_start(
+                    ep_id, prompt_ids, g, token_budget=budget, seed=seed
+                )
+        elif op == "extend":
+            obs = [int(t) for t in req.get("obs_ids", [])]
+
+            def first():
+                return eng.episode_extend(ep_id, obs)
+        else:
+            raise ValueError(f"unknown episode op {op!r}")
+        try:
+            if self._pause_evt.is_set():
+                self._await_resume()
+            self._engine_lock.acquire()
+            locked = True
+            try:
+                version_start = self.version
+                out = first()
+                while out is None:
+                    # Parked by pause(): free the engine for the weight
+                    # swap, then resume THIS turn on its existing pages.
+                    self._engine_lock.release()
+                    locked = False
+                    self._await_resume()
+                    self._engine_lock.acquire()
+                    locked = True
+                    out = eng.episode_resume(ep_id)
+                version = self.version
+            finally:
+                if locked:
+                    self._engine_lock.release()
+        except SlotGoneError:
+            _M_EPISODE_SLOT_LOST.inc()
+            raise
+        out = dict(out)
+        out["version"] = version
+        out["version_start"] = version_start
+        return out
 
     def _handle_update(self, req: Dict) -> Dict:
         from areal_tpu.models.hf import registry as hf
@@ -1119,6 +1249,50 @@ class ZMQGenClient(BoundedAgenerateMixin):
 
     def resume(self) -> Dict:
         return self._call_many([{"cmd": "resume"}])[0]
+
+    # ---- agent-serving episodes (same surface as LLMAPIClient) ----
+
+    def _episode_call(self, req: Dict) -> Dict:
+        out = self._call_many([dict(req, cmd="episode")])[0]
+        if out.get("error_type") == "slot_gone":
+            raise SlotGoneError(
+                str(out.get("episode_id", "")),
+                str(out.get("reason", "unknown")),
+            )
+        return out
+
+    def episode_start(
+        self,
+        episode_id: str,
+        prompt_ids,
+        gconfig: GenerationHyperparameters,
+        token_budget: int = 0,
+        seed: int = 0,
+    ) -> Dict:
+        return self._episode_call(
+            {
+                "op": "start",
+                "episode_id": episode_id,
+                "prompt_ids": list(map(int, prompt_ids)),
+                "gconfig": dataclasses.asdict(gconfig),
+                "token_budget": int(token_budget),
+                "seed": int(seed),
+            }
+        )
+
+    def episode_extend(self, episode_id: str, obs_ids) -> Dict:
+        return self._episode_call(
+            {
+                "op": "extend",
+                "episode_id": episode_id,
+                "obs_ids": list(map(int, obs_ids)),
+            }
+        )
+
+    def episode_release(self, episode_id: str) -> Dict:
+        return self._episode_call(
+            {"op": "release", "episode_id": episode_id}
+        )
 
 
 def make_gen_client(url: str, **kw):

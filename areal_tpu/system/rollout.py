@@ -150,6 +150,16 @@ class RolloutController:
         health_poll_timeout_s: float = 2.0,
         breaker_threshold: int = 3,
         breaker_cooldown_s: float = 5.0,
+        # Agent-serving episodes: when set, each prompt becomes a
+        # multi-turn episode instead of a single generate —
+        # ``episode_runner(client, qid, prompt_ids)`` drives the full
+        # tool-use loop against that server (system/episode.py's
+        # ``make_episode_runner``) and returns an Episode, which lands
+        # in replay as ONE trajectory with version-stamped turns.  The
+        # runner is synchronous (it blocks on each turn); dispatches run
+        # it on a worker thread, so deadline/retry/breaker semantics
+        # apply to the whole episode.
+        episode_runner: Optional[Callable[[Any, str, List[int]], Any]] = None,
     ):
         if not clients and discovery is None:
             raise ValueError(
@@ -175,6 +185,7 @@ class RolloutController:
         self.health_poll_timeout_s = health_poll_timeout_s
         self.breaker_threshold = breaker_threshold
         self.breaker_cooldown_s = breaker_cooldown_s
+        self.episode_runner = episode_runner
         self.stat = RolloutStat()
         # Prompts consumed from the data stream since trial start
         # (persisted via state_dict -> RecoverInfo).
@@ -561,14 +572,19 @@ class RolloutController:
                 self._m_version_lag.set(self.replay.version - int(srv_version))
             err = reason = None
             try:
-                coro = srv.client.agenerate(
-                    APIGenerateInput(
-                        qid=qid,
-                        prompt_ids=prompt_ids,
-                        gconfig=self.gconfig,
-                        seed=self.seed,
+                if self.episode_runner is not None:
+                    coro = asyncio.to_thread(
+                        self.episode_runner, srv.client, qid, prompt_ids
                     )
-                )
+                else:
+                    coro = srv.client.agenerate(
+                        APIGenerateInput(
+                            qid=qid,
+                            prompt_ids=prompt_ids,
+                            gconfig=self.gconfig,
+                            seed=self.seed,
+                        )
+                    )
                 if self.dispatch_timeout_s > 0:
                     out = await asyncio.wait_for(
                         coro, timeout=self.dispatch_timeout_s
@@ -623,6 +639,20 @@ class RolloutController:
                 self._m_dispatched.labels("failed").inc()
                 return
             self.stat.completed += 1
+        if self.episode_runner is not None:
+            # One Episode -> ONE trajectory: version-stamped turns ride
+            # in traj.data["episode"]; tool tokens carry zero logprobs.
+            traj = out.to_trajectory(qid, birth_time=time.time())
+        else:
+            traj = Trajectory(
+                qid=out.qid,
+                prompt_ids=list(out.prompt_ids),
+                output_ids=out.output_ids,
+                output_logprobs=out.output_logprobs,
+                no_eos=out.no_eos,
+                version_start=out.version_start,
+                version_end=out.version,
+            )
         # Lossless backpressure on the put side too: a completed response
         # holds until the trainer drains a slot rather than evicting an
         # unconsumed sample.  Too-stale responses fall through to put()
@@ -630,21 +660,12 @@ class RolloutController:
         while (
             not self._stop
             and len(self.replay) >= self.replay.capacity
-            and self.replay.version - out.version_start
+            and self.replay.version - traj.version_start
             <= self.replay.max_head_offpolicyness
         ):
             self.stat.backpressure_waits += 1
             self._m_backpressure.inc()
             await asyncio.sleep(self.backpressure_poll_s)
-        traj = Trajectory(
-            qid=out.qid,
-            prompt_ids=list(out.prompt_ids),
-            output_ids=out.output_ids,
-            output_logprobs=out.output_logprobs,
-            no_eos=out.no_eos,
-            version_start=out.version_start,
-            version_end=out.version,
-        )
         if self.replay.put(traj):
             self.stat.accepted += 1
             self._m_dispatched.labels("accepted").inc()

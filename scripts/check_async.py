@@ -80,6 +80,20 @@ control server that received the same weights cleanly.  `--bench-out`
 writes the bench JSONL consumed by check_regression.py
 (bench_nanchaos_cpu8_*.json).
 
+Part 7 (`--agents`) is the agent-serving runtime leg: multi-turn
+tool-use episodes on persistent KV slots.  With every even token id a
+single-token stop sequence (the random model's stand-in for a tool-call
+marker), three 3-turn calculator episodes run through the
+EpisodeController — asserted: after turn 1
+every turn prefills ONLY the tool observation (zero full-prompt
+re-prefills), all turns stay on one slot, the decode program compiles
+exactly once, and each assistant turn is token-identical to a
+single-shot replay of its transcript prefix.  A code-RL episode runs
+its tool call through the OS sandbox and is graded end-to-end by the
+reward fabric's sandboxed code backend, and a mid-episode in-memory
+weight push parks the slot at a chunk boundary, swaps weights, and
+resumes the SAME episode to completion.
+
 Exit 0 iff every check passes.  CI-friendly: CPU-only, tiny random
 model, a few minutes end to end.
 """
@@ -1659,6 +1673,312 @@ def check_nan_chaos(fileroot: str, bench_out: str = None) -> int:
     return len(failures)
 
 
+def check_agents(n_episodes: int = 3) -> int:
+    """Agent-serving runtime leg (`--agents`): multi-turn tool-use
+    episodes on persistent KV state, driven end to end on CPU.
+
+    The tiny random model has no chat template, so the tool-call stop
+    sequence is a token-space convention (every even token id stops a
+    turn) — greedy decode then yields deterministic turn boundaries
+    without a trained model.  Verified:
+
+      - N 3-turn calculator episodes: after turn 1, every turn prefills
+        ONLY the tool observation (zero full-prompt re-prefills), all
+        turns stay on one slot, and the engine compiles its decode
+        program exactly once across every episode;
+      - greedy identity: each assistant turn is token-identical to a
+        single-shot replay of its transcript prefix on a fresh engine;
+      - a code-RL episode: the model's tool call runs real Python in the
+        OS sandbox mid-episode, and the episode is then graded
+        end-to-end through the reward fabric's sandboxed code backend;
+      - a mid-episode in-memory weight push: the episode's slot parks at
+        a chunk boundary, the swap lands, and the episode resumes on its
+        KV pages and completes (never lost, never re-admitted);
+      - the episode metrics move and drain (turns counted, active gauge
+        back to zero, tool latency histogram populated).
+    """
+    import threading as _threading
+
+    import jax
+    import numpy as np
+
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.base import metrics
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.engines.generator import GeneratorEngine
+    from areal_tpu.interfaces.reward_service import grade_item
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.system.episode import (
+        EngineEpisodeClient,
+        EpisodeController,
+        ToolCall,
+        ToolExecutor,
+    )
+
+    failures = []
+    cfg = tiny_config()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+    eos = cfg.vocab_size + 7  # unreachable: turns end on stop sequences
+
+    def mk_engine(p):
+        return GeneratorEngine(
+            cfg, p, mesh, eos_token_id=eos, kv_paged=True,
+            kv_page_size=8, prefill_chunk_tokens=4, max_decode_batch=2,
+        )
+
+    def sample_of(toks):
+        arr = np.asarray(toks, np.int32)
+        return SequenceSample(
+            keys={"packed_prompts"}, ids=["p0"],
+            seqlens={"packed_prompts": [[len(arr)]]},
+            data={"packed_prompts": arr},
+        )
+
+    def metric_value(name):
+        total = 0.0
+        for line in metrics.default_registry().expose().splitlines():
+            if line.startswith(f"{name} ") or line.startswith(f"{name}{{"):
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    rng = np.random.default_rng(17)
+    prompt = [int(t) for t in rng.integers(8, cfg.vocab_size, size=12)]
+
+    # The random tiny model has no chat template, so "tool-call stop
+    # sequence" is a token-space convention: every EVEN token is a
+    # single-token stop.  Greedy decode over any transcript then hits a
+    # stop within a couple of tokens — deterministic turn boundaries
+    # without a trained model (later turns are continuations the probe
+    # trick of a fixed pair can't cover).
+    g = GenerationHyperparameters(
+        n=1, max_new_tokens=24, greedy=True,
+        stop=tuple((t,) for t in range(0, cfg.vocab_size, 2)),
+    )
+
+    class RecordingClient(EngineEpisodeClient):
+        """Keeps every raw turn dict so the leg can assert prefill
+        accounting the controller's Turn records don't carry."""
+
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            self.outs = []
+
+        def _drive(self, fn, ep_id):
+            turn = super()._drive(fn, ep_id)
+            self.outs.append(dict(turn))
+            return turn
+
+    # Token-level tool-call convention for the random model: any stop
+    # turn "calls" the calculator on operands read off its last tokens;
+    # observations are digits re-encoded into the vocab.
+    def parse_calc(toks):
+        a, b = (list(toks) * 2)[-2:]  # tolerate 1-token turns
+        return ToolCall("calculator", f"{a % 9} + {b % 9}")
+
+    def encode_obs(call, text, ok):
+        return [8 + (ord(c) % 16) for c in text][:6] or [8]
+
+    tools = ToolExecutor(timeout_s=10.0)
+
+    # ---- Leg 1: calculator episodes + prefill accounting ------------
+    eng = mk_engine(params)
+    turns0 = metric_value("areal_episode_turns_total")
+    done0 = metric_value("areal_episode_completed_total")
+    episodes = []
+    clients = []
+    for i in range(n_episodes):
+        client = RecordingClient(eng, g, token_budget=0, seed=0)
+        ctl = EpisodeController(
+            client, tools, parse_calc, encode_obs, max_turns=3
+        )
+        ep = ctl.run_episode(f"calc-{i}", prompt)
+        episodes.append(ep)
+        clients.append(client)
+
+    for ep, client in zip(episodes, clients):
+        if ep.stop_reason != "max_turns" or ep.assistant_turns != 3:
+            failures.append(
+                f"{ep.episode_id}: expected 3 assistant turns ending "
+                f"max_turns, got {ep.assistant_turns} ({ep.stop_reason})"
+            )
+            continue
+        outs = client.outs
+        # Later episodes share the first one's prompt pages via the
+        # published prefix cache, so turn 1 is shared + tail prefill.
+        covered = (outs[0]["prefill_tokens"]
+                   + outs[0]["shared_prefix_tokens"])
+        if covered != len(prompt):
+            failures.append(
+                f"{ep.episode_id}: turn 1 covered {covered} tokens "
+                f"(prefill {outs[0]['prefill_tokens']} + shared "
+                f"{outs[0]['shared_prefix_tokens']}), want {len(prompt)}"
+            )
+        tool_turns = [t for t in ep.turns if t.role == "tool"]
+        for k, (o, tt) in enumerate(zip(outs[1:], tool_turns)):
+            # The tentpole property: zero full re-prefills after turn 1
+            # — each continuation prefills exactly its observation.
+            if o["prefill_tokens"] != len(tt.tokens):
+                failures.append(
+                    f"{ep.episode_id} turn {k + 2}: prefilled "
+                    f"{o['prefill_tokens']} tokens, want observation "
+                    f"size {len(tt.tokens)}"
+                )
+        if len({o["slot"] for o in outs}) != 1:
+            failures.append(
+                f"{ep.episode_id}: turns hopped slots "
+                f"{[o['slot'] for o in outs]}"
+            )
+    if eng.decode_compiles != 1:
+        failures.append(
+            f"decode compiled {eng.decode_compiles} times across "
+            f"{n_episodes} episodes, want exactly 1"
+        )
+    if eng.episode_prefix_hits < n_episodes - 1:
+        failures.append(
+            f"same-prompt episodes missed the prefix cache "
+            f"(hits={eng.episode_prefix_hits}, want >= {n_episodes - 1})"
+        )
+
+    # ---- Leg 2: greedy identity vs single-shot replay ---------------
+    # Every assistant turn must be token-identical to a fresh engine
+    # decoding the same transcript prefix in one shot: proof the parked
+    # KV pages hold exactly the state a cold prefill would build.
+    ep0 = episodes[0] if episodes else None
+    if ep0 is not None and not failures:
+        prefix = list(ep0.prompt_ids)
+        for t in ep0.turns:
+            if t.role == "assistant":
+                replay_eng = mk_engine(params)
+                r = replay_eng.generate(
+                    sample_of(prefix), MicroBatchSpec(), g, inflight=True
+                )
+                replayed = np.asarray(
+                    r.data["packed_input_ids"]
+                ).tolist()[len(prefix):]
+                if replayed != t.tokens:
+                    failures.append(
+                        f"greedy identity broke at turn {t.index}: "
+                        f"episode {t.tokens} vs replay {replayed}"
+                    )
+                    break
+            prefix.extend(t.tokens)
+
+    # ---- Leg 3: code-RL episode graded in the sandbox ---------------
+    # The "agent" writes one canonical program; the tool executes it in
+    # the OS sandbox mid-episode, and the reward fabric then grades the
+    # same program end-to-end through the sandboxed code backend.
+    code_text = "```python\nprint(int(input()) ** 2)\n```"
+
+    def parse_code(toks):
+        return ToolCall("python_exec", "print(3 ** 2)")
+
+    code_client = RecordingClient(eng, g)
+    code_ep = EpisodeController(
+        code_client, tools, parse_code, encode_obs, max_turns=2
+    ).run_episode("code-0", prompt)
+    code_tool = [t for t in code_ep.turns if t.role == "tool"]
+    if not code_tool or not code_tool[0].tool_ok:
+        failures.append(
+            f"code episode tool run failed: "
+            f"{[(t.tool_name, t.tool_ok) for t in code_tool]}"
+        )
+    code_ep.reward = float(grade_item({
+        "task": "code",
+        "text": code_text,
+        "payload": {
+            "input_output": {"inputs": ["3\n"], "outputs": ["9"]},
+            "timeout_s": 8.0,
+        },
+    }))
+    if code_ep.reward != 1.0:
+        failures.append(
+            "sandboxed code grading rejected a correct solution"
+        )
+    traj = code_ep.to_trajectory(qid="code-0")
+    if len(traj.output_ids[0]) != len(traj.output_logprobs[0]):
+        failures.append("episode trajectory logprob/token length mismatch")
+
+    # ---- Leg 4: mid-episode in-memory weight push -------------------
+    # The pusher waits for the episode to go live, interrupts the
+    # engine (the slot parks at a chunk boundary), swaps the weights,
+    # and clears the interrupt; the client's park loop must resume the
+    # SAME episode to completion — no SlotGone, no re-admission.
+    params2 = jax.block_until_ready(
+        tfm.init_params(cfg, jax.random.PRNGKey(101))
+    )
+    push_state = {"parked": False}
+
+    def pusher():
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if eng.episode_stats()["active"] > 0:
+                break
+            time.sleep(0.002)
+        eng.interrupt()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if eng.episode_stats()["parked_mid_turn"] >= 1:
+                push_state["parked"] = True
+                break
+            time.sleep(0.002)
+        eng.set_params(params2)
+        eng.clear_interrupt()
+
+    push_client = RecordingClient(eng, g)
+    push_ctl = EpisodeController(
+        push_client, tools, parse_calc, encode_obs, max_turns=4
+    )
+    th = _threading.Thread(target=pusher)
+    th.start()
+    push_ep = push_ctl.run_episode("push-0", prompt)
+    th.join(timeout=120)
+    if th.is_alive():
+        failures.append("weight pusher never finished")
+    if not push_state["parked"]:
+        failures.append(
+            "the weight push never parked the episode mid-turn"
+        )
+    if push_ep.status != "done" or push_ep.slot_lost != 0:
+        failures.append(
+            f"pushed-through episode not cleanly finished: "
+            f"status={push_ep.status} slot_lost={push_ep.slot_lost}"
+        )
+    if len({o["slot"] for o in push_client.outs}) != 1:
+        failures.append("weight push moved the episode off its slot")
+
+    # ---- metrics drain ----------------------------------------------
+    n_eps = n_episodes + 2  # calculator + code + push
+    turns_delta = metric_value("areal_episode_turns_total") - turns0
+    if turns_delta < n_episodes * 3 + 2:
+        failures.append(
+            f"areal_episode_turns_total moved by {turns_delta}, want "
+            f">= {n_episodes * 3 + 2}"
+        )
+    if metric_value("areal_episode_completed_total") - done0 != n_eps:
+        failures.append("areal_episode_completed_total did not track")
+    if metric_value("areal_episode_active") != 0:
+        failures.append("areal_episode_active did not drain to zero")
+    if metric_value("areal_episode_tool_seconds_count") <= 0:
+        failures.append("tool latency histogram never observed")
+
+    for f in failures:
+        print(f"FAIL[agents]: {f}")
+    if not failures:
+        stats = eng.episode_stats()
+        print(
+            f"OK[agents]: {n_episodes} calculator episodes (3 turns, "
+            f"observation-only prefills, decode_compiles="
+            f"{eng.decode_compiles}), greedy identity vs single-shot "
+            f"replay, sandboxed code reward graded "
+            f"{code_ep.reward}, mid-episode weight push parked+resumed "
+            f"on one slot; engine episode stats {stats}"
+        )
+    return len(failures)
+
+
 def main() -> int:
     p = argparse.ArgumentParser(prog="check_async")
     p.add_argument("--prompts", type=int, default=24)
@@ -1689,6 +2009,11 @@ def main() -> int:
                         "leg (NaN grads -> quarantine; streak -> "
                         "rollback + bit-exact replay; corrupt push -> "
                         "checksum rejection)")
+    p.add_argument("--agents", action="store_true",
+                   help="run ONLY the agent-serving runtime leg "
+                        "(multi-turn tool-use episodes on persistent "
+                        "KV slots, sandboxed code reward, mid-episode "
+                        "weight push)")
     args = p.parse_args()
 
     if args.trainer_chaos_victim:
@@ -1715,6 +2040,14 @@ def main() -> int:
             return 1
         print("OK: numerical-integrity guard plane survived the "
               "injected corruption")
+        return 0
+
+    if args.agents:
+        n_fail = check_agents()
+        if n_fail:
+            print(f"FAIL: {n_fail} agent check(s) failed")
+            return 1
+        print("OK: agent-serving runtime verified end to end")
         return 0
 
     if args.chaos:

@@ -1,0 +1,369 @@
+"""Agent-serving episode subsystem (system/episode.py): the Turn/Episode
+state-machine records and their replay flattening, the ToolExecutor
+registry (timeouts, fault hooks, the AST-fenced calculator and sandboxed
+python-exec builtins), the controller loop's terminal conditions and
+SlotGone re-admission, and the async reward fabric facade.
+
+Controller tests drive a scripted fake client so they exercise the loop
+logic without compiling an engine; the serving integration lives in
+tests/test_gen_server.py and the --agents check leg.
+"""
+
+import time
+
+import pytest
+
+from areal_tpu.api.model_api import SlotGoneError
+from areal_tpu.base.faults import FaultInjector
+from areal_tpu.system.episode import (
+    Episode,
+    EpisodeController,
+    RewardFabric,
+    ToolCall,
+    ToolError,
+    ToolExecutor,
+    Turn,
+)
+
+
+def _turn(tokens, stop_reason, logprobs=None, version=0):
+    return {
+        "tokens": list(tokens),
+        "logprobs": list(logprobs or [-0.5] * len(tokens)),
+        "stop_reason": stop_reason,
+        "version": version,
+    }
+
+
+class FakeClient:
+    """Scripted episode client: each start/extend pops the next reply
+    (a turn dict, or an exception to raise)."""
+
+    def __init__(self, replies, version=0):
+        self.replies = list(replies)
+        self._v = version
+        self.starts = []
+        self.extends = []
+        self.released = []
+
+    def version(self):
+        return self._v
+
+    def _next(self):
+        item = self.replies.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        self._v = item.get("version", self._v)
+        return dict(item)
+
+    def start(self, ep_id, prompt_ids):
+        self.starts.append((ep_id, list(prompt_ids)))
+        return self._next()
+
+    def extend(self, ep_id, obs_ids):
+        self.extends.append((ep_id, list(obs_ids)))
+        return self._next()
+
+    def release(self, ep_id):
+        self.released.append(ep_id)
+
+
+def _parse_always(name="calculator", args="2+3"):
+    return lambda toks: ToolCall(name, args)
+
+
+def _encode_fixed(tokens):
+    return lambda call, text, ok: list(tokens)
+
+
+# ---------------------------------------------------------------------------
+# records
+# ---------------------------------------------------------------------------
+
+
+class TestRecords:
+    def _episode(self):
+        ep = Episode(episode_id="e0", prompt_ids=[1, 2, 3])
+        ep.turns = [
+            Turn(index=0, role="assistant", tokens=[4, 5],
+                 logprobs=[-0.1, -0.2], stop_reason="stop",
+                 version=3, version_start=3),
+            Turn(index=1, role="tool", tokens=[6],
+                 tool_name="calculator", tool_ok=True, version=3),
+            Turn(index=2, role="assistant", tokens=[7, 8, 9],
+                 logprobs=[-0.3, -0.4, -0.5], stop_reason="eos",
+                 version=5, version_start=4),
+        ]
+        ep.stop_reason = "eos"
+        ep.status = "done"
+        return ep
+
+    def test_transcript_concatenates_prompt_and_turns(self):
+        ep = self._episode()
+        assert ep.transcript() == [1, 2, 3, 4, 5, 6, 7, 8, 9]
+        assert ep.response_text_tokens() == [4, 5, 6, 7, 8, 9]
+        assert ep.assistant_turns == 2
+
+    def test_to_trajectory_single_group_with_spans(self):
+        traj = self._episode().to_trajectory(qid="q7", birth_time=1.5)
+        assert traj.qid == "q7"
+        assert traj.prompt_ids == [1, 2, 3]
+        assert traj.output_ids == [[4, 5, 6, 7, 8, 9]]
+        # Tool tokens were injected, not sampled: zero logprobs.
+        assert traj.output_logprobs == [
+            [-0.1, -0.2, 0.0, -0.3, -0.4, -0.5]
+        ]
+        spans = traj.data["episode"]["turns"]
+        assert [(s["role"], s["start"], s["len"]) for s in spans] == [
+            ("assistant", 0, 2), ("tool", 2, 1), ("assistant", 3, 3),
+        ]
+        assert traj.birth_time == 1.5
+
+    def test_to_trajectory_version_spans_the_episode(self):
+        traj = self._episode().to_trajectory()
+        # First assistant turn started under v3, last finished under v5:
+        # staleness admission must see the episode's full age.
+        assert traj.version_start == 3
+        assert traj.version_end == 5
+
+    def test_to_trajectory_no_eos_tracks_last_assistant_turn(self):
+        ep = self._episode()
+        assert ep.to_trajectory().no_eos == [False]
+        ep.turns[-1].stop_reason = "length"
+        assert ep.to_trajectory().no_eos == [True]
+
+
+# ---------------------------------------------------------------------------
+# tool executor
+# ---------------------------------------------------------------------------
+
+
+class TestCalculator:
+    @pytest.fixture()
+    def tools(self):
+        return ToolExecutor(register_builtins=True)
+
+    def _run(self, tools, expr):
+        return tools.run(ToolCall("calculator", expr))
+
+    def test_arithmetic(self, tools):
+        assert self._run(tools, "2 * (3 + 4)") == "14"
+        assert self._run(tools, "-7 // 2") == "-4"
+        assert self._run(tools, "2 ** 10") == "1024"
+
+    def test_integral_floats_render_as_ints(self, tools):
+        assert self._run(tools, "10 / 4") == "2.5"
+        assert self._run(tools, "8 / 2") == "4"
+
+    def test_names_and_calls_rejected(self, tools):
+        # eval() never sees the string: any name/call/attribute node is a
+        # typed tool error, not an execution.
+        for evil in (
+            "__import__('os').system('true')",
+            "open('/etc/passwd')",
+            "(1).__class__",
+        ):
+            with pytest.raises(ToolError) as ei:
+                self._run(tools, evil)
+            assert ei.value.kind == "error"
+
+
+class TestToolExecutor:
+    def test_unknown_tool_is_typed(self):
+        tools = ToolExecutor(register_builtins=False)
+        with pytest.raises(ToolError) as ei:
+            tools.run(ToolCall("nope", ""))
+        assert ei.value.kind == "unknown_tool"
+
+    def test_custom_registration_and_names(self):
+        tools = ToolExecutor(register_builtins=False)
+        tools.register("echo", lambda a: f"<<{a}>>")
+        assert tools.names() == ["echo"]
+        assert tools.run(ToolCall("echo", "hi")) == "<<hi>>"
+
+    def test_per_tool_timeout(self):
+        tools = ToolExecutor(register_builtins=False)
+        tools.register("sleepy", lambda a: time.sleep(30), timeout_s=0.05)
+        t0 = time.monotonic()
+        with pytest.raises(ToolError) as ei:
+            tools.run(ToolCall("sleepy", ""))
+        assert ei.value.kind == "timeout"
+        assert time.monotonic() - t0 < 10.0
+
+    def test_fault_injection_breaks_exactly_one_call(self):
+        inj = FaultInjector.parse("error@point=tool:flaky&times=1")
+        tools = ToolExecutor(faults=inj, register_builtins=False)
+        tools.register("flaky", lambda a: "ok")
+        with pytest.raises(ToolError) as ei:
+            tools.run(ToolCall("flaky", ""))
+        assert ei.value.kind == "fault"
+        # times=1: the second execution goes through.
+        assert tools.run(ToolCall("flaky", "")) == "ok"
+
+    def test_python_exec_runs_in_sandbox(self):
+        tools = ToolExecutor(timeout_s=15.0)
+        out = tools.run(ToolCall("python_exec", "print(6 * 7)"))
+        assert out.strip() == "42"
+
+    def test_python_exec_nonzero_exit_is_typed(self):
+        tools = ToolExecutor(timeout_s=15.0)
+        with pytest.raises(ToolError) as ei:
+            tools.run(ToolCall("python_exec", "raise SystemExit(3)"))
+        assert ei.value.kind == "error"
+
+
+# ---------------------------------------------------------------------------
+# controller
+# ---------------------------------------------------------------------------
+
+
+class TestEpisodeController:
+    def _tools(self):
+        tools = ToolExecutor(register_builtins=False)
+        tools.register("calculator", lambda a: "5")
+        return tools
+
+    def test_two_turn_episode_terminates_on_eos(self):
+        client = FakeClient([
+            _turn([10, 11], "stop", version=1),
+            _turn([12, 13], "eos", version=1),
+        ])
+        ctl = EpisodeController(
+            client, self._tools(), _parse_always(), _encode_fixed([99]),
+            max_turns=4,
+        )
+        ep = ctl.run_episode("e1", [1, 2])
+        assert ep.status == "done"
+        assert ep.stop_reason == "eos"
+        assert [t.role for t in ep.turns] == ["assistant", "tool",
+                                              "assistant"]
+        assert ep.turns[1].tool_name == "calculator"
+        assert ep.turns[1].tool_ok is True
+        # The observation (not the transcript) went back to the slot...
+        assert client.extends == [("e1", [99])]
+        # ...and the slot was released at the end.
+        assert client.released == ["e1"]
+
+    def test_max_turns_caps_the_loop(self):
+        client = FakeClient([_turn([10], "stop")])
+        ctl = EpisodeController(
+            client, self._tools(), _parse_always(), _encode_fixed([99]),
+            max_turns=1,
+        )
+        ep = ctl.run_episode("e2", [1])
+        assert ep.stop_reason == "max_turns"
+        assert ep.assistant_turns == 1
+        assert client.extends == []  # no tool ran past the cap
+
+    def test_no_tool_call_is_terminal(self):
+        client = FakeClient([_turn([10], "stop")])
+        ctl = EpisodeController(
+            client, self._tools(), lambda toks: None, _encode_fixed([99]),
+        )
+        ep = ctl.run_episode("e3", [1])
+        assert ep.stop_reason == "no_tool_call"
+
+    def test_non_stop_reasons_are_terminal(self):
+        for reason in ("length", "budget"):
+            client = FakeClient([_turn([10], reason)])
+            ctl = EpisodeController(
+                client, self._tools(), _parse_always(),
+                _encode_fixed([99]),
+            )
+            ep = ctl.run_episode("e4", [1])
+            assert ep.stop_reason == reason
+            assert client.extends == []
+
+    def test_tool_failure_becomes_error_observation(self):
+        """A broken tool is a training signal, not a crash: the episode
+        records a tool_ok=False turn and keeps going."""
+        seen = {}
+
+        def encode(call, text, ok):
+            seen["text"], seen["ok"] = text, ok
+            return [77]
+
+        client = FakeClient([
+            _turn([10], "stop"),
+            _turn([11], "eos"),
+        ])
+        ctl = EpisodeController(
+            client, self._tools(), _parse_always(name="missing"), encode,
+        )
+        ep = ctl.run_episode("e5", [1])
+        assert ep.stop_reason == "eos"
+        assert ep.turns[1].tool_ok is False
+        assert seen["ok"] is False
+        assert "unknown_tool" in seen["text"]
+
+    def test_slot_gone_readmits_full_transcript(self):
+        """A reclaimed slot (eviction, server restart) re-admits the whole
+        conversation via start(); the prefix cache turns that into a tail
+        prefill on the serving side."""
+        client = FakeClient([
+            _turn([10, 11], "stop"),
+            SlotGoneError("e6", "evicted"),
+            _turn([12], "eos"),
+        ])
+        ctl = EpisodeController(
+            client, self._tools(), _parse_always(), _encode_fixed([99]),
+        )
+        ep = ctl.run_episode("e6", [1, 2])
+        assert ep.stop_reason == "eos"
+        assert ep.slot_lost == 1
+        # The recovery start carried prompt + turn1 + observation.
+        assert client.starts[-1] == ("e6", [1, 2, 10, 11, 99])
+
+    def test_release_runs_even_when_the_client_blows_up(self):
+        client = FakeClient([RuntimeError("transport died")])
+        ctl = EpisodeController(
+            client, self._tools(), _parse_always(), _encode_fixed([99]),
+        )
+        with pytest.raises(RuntimeError, match="transport died"):
+            ctl.run_episode("e7", [1])
+        assert client.released == ["e7"]
+
+    def test_max_turns_validated(self):
+        with pytest.raises(ValueError, match="max_turns"):
+            EpisodeController(
+                FakeClient([]), self._tools(), _parse_always(),
+                _encode_fixed([9]), max_turns=0,
+            )
+
+
+# ---------------------------------------------------------------------------
+# reward fabric
+# ---------------------------------------------------------------------------
+
+
+class TestRewardFabric:
+    def test_local_grading_via_registry(self):
+        fabric = RewardFabric()
+        assert fabric.grade(
+            "judge", "after some work the answer is 42",
+            {"reference": "42"},
+        ) is True
+        assert fabric.grade(
+            "judge", "no idea", {"reference": "42"}
+        ) is False
+
+    def test_submit_returns_future(self):
+        fut = RewardFabric().submit(
+            "judge", "result: 7", {"reference": "7"}
+        )
+        assert fut.result(timeout=30) is True
+
+    def test_remote_items_travel_in_opaque_schema(self):
+        sent = []
+
+        class Remote:
+            def verify_batch(self, items):
+                sent.extend(items)
+                return [True] * len(items)
+
+        fabric = RewardFabric(remote=Remote())
+        assert fabric.grade("code", "print(1)", {"timeout_s": 2.0}) is True
+        assert sent == [{
+            "task": "code", "text": "print(1)",
+            "payload": {"timeout_s": 2.0},
+        }]

@@ -380,6 +380,105 @@ class TestZMQTransport:
             assert len(o.output_ids[0]) > 0
 
 
+class TestEpisodeServing:
+    """Agent-serving episode surface: start/extend/release over HTTP and
+    ZMQ, observation-only prefills on the parked slot, and the typed
+    SlotGoneError a continuation on a reclaimed slot gets."""
+
+    @pytest.fixture(scope="class")
+    def ep_env(self, cfg):
+        mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+        params = tfm.init_params(cfg, jax.random.PRNGKey(13))
+        # EOS outside the vocab so greedy decode never terminates early;
+        # turns end on the probe-derived stop sequence instead.
+        eng = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=cfg.vocab_size + 7,
+            kv_paged=True, kv_page_size=8, prefill_chunk_tokens=4,
+            max_decode_batch=2,
+        )
+        srv = GenerationServer(eng, max_wait_ms=2.0, zmq_port=0)
+        client = LLMAPIClient(srv.url)
+        rng = np.random.default_rng(7)
+        prompt = [int(x) for x in rng.integers(8, cfg.vocab_size, size=10)]
+        # Probe the greedy continuation, then pick a stop sequence the
+        # model is guaranteed to emit (same trick as the --agents leg).
+        probe = client.generate(APIGenerateInput(
+            qid="probe", prompt_ids=prompt,
+            gconfig=GenerationHyperparameters(
+                n=1, max_new_tokens=8, greedy=True
+            ),
+        ))
+        toks = [int(t) for t in probe.output_ids[0]]
+        g = GenerationHyperparameters(
+            n=1, max_new_tokens=8, greedy=True, stop=(tuple(toks[2:4]),),
+        )
+        yield srv, client, prompt, toks, g
+        srv.close()
+
+    @staticmethod
+    def _metric(name):
+        from areal_tpu.base import metrics
+
+        total = 0.0
+        for line in metrics.default_registry().expose().splitlines():
+            if line.startswith(f"{name} ") or line.startswith(f"{name}{{"):
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    def test_http_episode_lifecycle(self, ep_env):
+        _, client, prompt, toks, g = ep_env
+        t1 = client.episode_start("ep-h", prompt, g, token_budget=64)
+        assert t1["stop_reason"] == "stop"
+        # Stop tokens stay IN the turn: the parser needs the full call.
+        assert t1["tokens"] == toks[:4]
+        obs = [int(x) for x in np.asarray(prompt[:3]) + 1]
+        t2 = client.episode_extend("ep-h", obs)
+        # The tentpole property: turn 2 prefilled ONLY the observation —
+        # the transcript stayed hot on the slot's KV pages.
+        assert t2["prefill_tokens"] == len(obs)
+        assert t2["transcript_len"] == (
+            len(prompt) + len(t1["tokens"]) + len(obs) + len(t2["tokens"])
+        )
+        assert client.episode_release("ep-h")["released"] is True
+
+    def test_http_continuation_on_reclaimed_slot_is_typed(self, ep_env):
+        from areal_tpu.api.model_api import SlotGoneError
+
+        _, client, prompt, _, g = ep_env
+        client.episode_start("ep-gone", prompt, g, token_budget=64)
+        client.episode_release("ep-gone")
+        lost0 = self._metric("areal_gen_episode_slot_lost_total")
+        with pytest.raises(SlotGoneError) as ei:
+            client.episode_extend("ep-gone", [9, 10])
+        assert ei.value.episode_id == "ep-gone"
+        assert ei.value.reason
+        assert self._metric(
+            "areal_gen_episode_slot_lost_total"
+        ) == lost0 + 1
+
+    def test_zmq_episode_matches_http(self, ep_env):
+        from areal_tpu.api.model_api import SlotGoneError
+        from areal_tpu.system.gen_server import ZMQGenClient
+
+        srv, _, prompt, toks, g = ep_env
+        zc = ZMQGenClient(srv.zmq_url)
+        try:
+            t1 = zc.episode_start("ep-z", prompt, g, token_budget=64)
+            assert t1["tokens"] == toks[:4]
+            zc.episode_release("ep-z")
+            with pytest.raises(SlotGoneError):
+                zc.episode_extend("ep-z", [9, 10])
+        finally:
+            zc.close()
+
+    def test_generate_honors_stop_sequences(self, ep_env):
+        _, client, prompt, toks, g = ep_env
+        out = client.generate(APIGenerateInput(
+            qid="stop-q", prompt_ids=prompt, gconfig=g,
+        ))
+        assert out.output_ids[0] == toks[:4]
+
+
 class TestAsyncServing:
     """Async-RL serving surface: enriched /health load signals,
     pause/resume at a chunk boundary, and the interruptible in-memory

@@ -1,5 +1,7 @@
 """Remote reward verification service (functioncall FaaS parity):
-server round-trip, local fallback, and the reward interface's remote path."""
+server round-trip, local fallback, the verifier-backend registry with
+its opaque {task, text, payload} schema, and the reward interface's
+remote path."""
 
 import json
 import urllib.request
@@ -7,7 +9,14 @@ import urllib.request
 import numpy as np
 import pytest
 
-from areal_tpu.interfaces.reward_service import RemoteVerifier, serve
+from areal_tpu.interfaces import reward_service
+from areal_tpu.interfaces.reward_service import (
+    RemoteVerifier,
+    grade_item,
+    register_verifier,
+    serve,
+    verifier_names,
+)
 
 
 @pytest.fixture(scope="module")
@@ -40,6 +49,106 @@ def test_local_fallback_on_dead_service():
         {"task": "math", "text": r"\boxed{7}", "solutions": [r"\boxed{7}"]}
     ]
     assert v.verify_batch(items) == [True]
+
+
+class TestVerifierRegistry:
+    """The pluggable reward fabric: grading dispatches on the item's
+    `task` key over an open registry, payloads travel opaquely, and the
+    pre-registry flat schema stays accepted for one release."""
+
+    def test_builtin_backends_registered(self):
+        names = verifier_names()
+        for task in ("math", "code", "judge"):
+            assert task in names
+
+    def test_opaque_schema_dispatch(self):
+        assert grade_item({
+            "task": "math", "text": r"\boxed{7}",
+            "payload": {"solutions": [r"\boxed{7}"]},
+        }) is True
+        assert grade_item({
+            "task": "judge", "text": "I conclude the answer is Paris.",
+            "payload": {"reference": "paris"},
+        }) is True
+        assert grade_item({
+            "task": "judge", "text": "I conclude the answer is Lyon.",
+            "payload": {"reference": "paris"},
+        }) is False
+
+    def test_judge_tail_window(self):
+        item = {
+            "task": "judge",
+            "text": "paris? no wait. " + "x" * 64 + " the answer: Lyon",
+            "payload": {"reference": "paris", "tail_chars": 32},
+        }
+        assert grade_item(item) is False  # match is outside the tail
+        item["payload"]["tail_chars"] = 0
+        assert grade_item(item) is True
+
+    def test_custom_backend_round_trips_the_service(self, server):
+        """A newly registered backend works end-to-end through the FaaS
+        without any schema change — the server never interprets payload."""
+        seen = {}
+
+        def exact(text, payload):
+            seen[payload.get("expect")] = payload
+            return text == payload.get("expect")
+
+        register_verifier("exact", exact)
+        try:
+            got = RemoteVerifier(server).verify_batch([
+                {"task": "exact", "text": "abc",
+                 "payload": {"expect": "abc", "nested": {"k": [1, 2]}}},
+                {"task": "exact", "text": "abc",
+                 "payload": {"expect": "xyz"}},
+            ])
+            assert got == [True, False]
+            assert seen["abc"]["nested"] == {"k": [1, 2]}
+        finally:
+            reward_service._VERIFIERS.pop("exact", None)
+
+    @pytest.fixture()
+    def service_log(self, caplog):
+        """The repo's logging module sets propagate=False, so caplog only
+        sees records if its handler is attached to the logger directly."""
+        import logging as _logging
+
+        slog = _logging.getLogger("areal_tpu.reward_service")
+        slog.addHandler(caplog.handler)
+        try:
+            with caplog.at_level(
+                _logging.WARNING, logger="areal_tpu.reward_service"
+            ):
+                yield caplog
+        finally:
+            slog.removeHandler(caplog.handler)
+
+    def test_unknown_task_grades_false_and_warns_once(self, service_log):
+        reward_service._unknown_tasks_warned.discard("no-such-task")
+        assert grade_item({"task": "no-such-task", "text": "x",
+                           "payload": {}}) is False
+        assert grade_item({"task": "no-such-task", "text": "x",
+                           "payload": {}}) is False
+        hits = [r for r in service_log.records
+                if "no verifier backend" in r.getMessage()]
+        assert len(hits) == 1
+
+    def test_legacy_flat_schema_accepted_with_one_warning(self, service_log):
+        reward_service._legacy_schema_warned = False
+        try:
+            assert grade_item({
+                "task": "math", "text": r"\boxed{2}",
+                "solutions": [r"\boxed{2}"],
+            }) is True
+            assert grade_item({
+                "task": "math", "text": r"\boxed{2}",
+                "solutions": [r"\boxed{3}"],
+            }) is False
+            hits = [r for r in service_log.records
+                    if "legacy flat" in r.getMessage()]
+            assert len(hits) == 1
+        finally:
+            reward_service._legacy_schema_warned = True
 
 
 def test_reward_interface_remote_path(server):

@@ -6,10 +6,14 @@ grading without harming the trial process.
 """
 
 import os
+import subprocess
 import sys
+import time
+import uuid
 
 import pytest
 
+import areal_tpu.interfaces.sandbox as sandbox
 from areal_tpu.interfaces.reward import MultiTaskRewardInterface
 from areal_tpu.interfaces.sandbox import _unshare_prefix, run_sandboxed
 
@@ -74,6 +78,124 @@ class TestRunSandboxed:
             timeout_s=10.0,
         )
         assert rc != 0
+
+
+@pytest.fixture()
+def fresh_probe():
+    """Reset the cached `unshare -rn` probe so a test can exercise the
+    probe itself, restoring the real result afterwards."""
+    old = sandbox._UNSHARE
+    sandbox._UNSHARE = None
+    yield
+    sandbox._UNSHARE = old
+
+
+class TestUnshareProbe:
+    """Hosts without user+net namespaces (locked-down kernels, nested
+    containers) must degrade to rlimits + jail, not crash grading."""
+
+    def test_no_unshare_binary_falls_back(self, fresh_probe, monkeypatch):
+        monkeypatch.setattr(sandbox.shutil, "which", lambda _: None)
+        assert _unshare_prefix() == []
+        # The sandbox still runs (rlimits + tmpdir jail, no namespace).
+        rc, out = run_sandboxed(
+            [sys.executable, "-c", "print('ok')"], timeout_s=10.0
+        )
+        assert rc == 0 and out.strip() == "ok"
+
+    def test_probe_failure_falls_back(self, fresh_probe, monkeypatch):
+        """`unshare` exists but the kernel refuses -rn (EPERM under
+        seccomp/userns restrictions): probe caches the empty prefix."""
+        monkeypatch.setattr(
+            sandbox.shutil, "which", lambda _: "/usr/bin/unshare"
+        )
+
+        def deny(argv, **kw):
+            return subprocess.CompletedProcess(argv, returncode=1)
+
+        monkeypatch.setattr(sandbox.subprocess, "run", deny)
+        assert _unshare_prefix() == []
+
+    def test_probe_exception_falls_back(self, fresh_probe, monkeypatch):
+        monkeypatch.setattr(
+            sandbox.shutil, "which", lambda _: "/usr/bin/unshare"
+        )
+
+        def boom(argv, **kw):
+            raise subprocess.TimeoutExpired(argv, 5)
+
+        monkeypatch.setattr(sandbox.subprocess, "run", boom)
+        assert _unshare_prefix() == []
+
+    def test_probe_success_cached(self, fresh_probe, monkeypatch):
+        monkeypatch.setattr(
+            sandbox.shutil, "which", lambda _: "/bin/unshare"
+        )
+        calls = []
+
+        def allow(argv, **kw):
+            calls.append(argv)
+            return subprocess.CompletedProcess(argv, returncode=0)
+
+        monkeypatch.setattr(sandbox.subprocess, "run", allow)
+        assert _unshare_prefix() == ["/bin/unshare", "-rn"]
+        assert _unshare_prefix() == ["/bin/unshare", "-rn"]
+        assert len(calls) == 1  # probed once, cached after
+
+
+def _procs_with_marker(marker: str):
+    """PIDs whose cmdline carries the marker (the graded program and any
+    children it forked — fork preserves cmdline)."""
+    found = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                if marker.encode() in f.read():
+                    found.append(pid)
+        except OSError:
+            pass  # raced with process exit
+    return found
+
+
+@pytest.fixture()
+def fork_bomb():
+    """A bounded fork bomb: children park in sleep so any survivor is
+    visible in /proc by its marker.  Teardown asserts the sandbox left
+    no process behind — the rlimit (`ulimit -u`) caps the spawn and the
+    session kill reaps whatever did spawn."""
+    marker = f"AREAL_FORKBOMB_{uuid.uuid4().hex}"
+    prog = (
+        f"# {marker}\n"
+        "import os, time\n"
+        "for _ in range(64):\n"
+        "    try:\n"
+        "        pid = os.fork()\n"
+        "    except OSError:\n"
+        "        break\n"
+        "    if pid == 0:\n"
+        "        time.sleep(300)\n"
+        "        os._exit(0)\n"
+        "time.sleep(300)\n"
+    )
+    yield prog, marker
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and _procs_with_marker(marker):
+        time.sleep(0.2)
+    assert not _procs_with_marker(marker), "fork bomb outlived the sandbox"
+
+
+class TestForkBomb:
+    def test_fork_bomb_contained(self, fork_bomb):
+        prog, _ = fork_bomb
+        rc, _ = run_sandboxed(
+            [sys.executable, "-c", prog], timeout_s=2.0, nproc=64
+        )
+        # EAGAIN'd out (rlimit) or wall-killed with its whole session
+        # (killpg) — either way it grades as a failure...
+        assert rc != 0
+        # ...and the fixture teardown asserts nothing survived.
 
 
 class TestCodeRewardUsesSandbox:
