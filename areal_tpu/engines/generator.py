@@ -168,6 +168,13 @@ class _PagedGenSession:
     # members sharing instead of racing k private prefills).
     inflight_prefix: Any = None  # Dict[bytes, int]
     peak_live: int = 0  # max simultaneously live slots (capacity sweep)
+    # Speculative decoding through the serving chunk (spec_decode_k > 0):
+    # device-resident history buffer (prompt + emitted, read by the
+    # in-chunk n-gram proposer) and the one sampled-but-unverified token
+    # per row.  Always allocated (cheap) — trace-time K>0 branches in the
+    # chunk fn decide whether they are consumed.
+    tokens_buf: Any = None  # device [n_slots, buf_w] int32
+    pending_tok: Any = None  # device [n_slots] int32
     # ---- agent-serving episodes (engine-lifetime session only) ----
     # ep_id -> _EpisodeSlot for every episode currently pinning a slot;
     # active[s] holds the ep_id string (any non-None marks the slot
@@ -179,7 +186,8 @@ class _PagedGenSession:
 
 def _spec_emit(
     cfg, g, eos, rows, logits, drafts, sub, pending, cache_len, gen_count,
-    done, out_toks, out_logps, out_fill, tokens_buf,
+    done, out_toks, out_logps, out_fill, tokens_buf, active=None,
+    n_valid=None,
 ):
     """Shared post-forward bookkeeping for one speculative decode step
     (dense AND paged cache layouts — one implementation so the two can
@@ -187,11 +195,19 @@ def _spec_emit(
     accept/reject (`spec_accept`), first-EOS truncation, appends into
     the chunk output buffers and the device-resident history buffer.
 
+    `active` [B] bool (default: ~done) masks rows that should emit this
+    step — the ragged serving chunk passes (~done) & (~is_pref) & got-
+    lanes so prefilling rows and lane-starved rows carry their state
+    untouched.  `n_valid` [B] int32 forwards to `spec_accept` for lane-
+    truncated verification (row b only forwarded n_valid[b] positions).
+
     Returns (tokens_buf, pending, cache_len, gen_count, done, out_toks,
     out_logps, out_fill) — the post-step carry pieces."""
     from areal_tpu.ops.sampling import spec_accept
 
     K = g.spec_decode_k
+    if active is None:
+        active = ~done
     if g.min_new_tokens > 0:
         not_enough = (
             gen_count[:, None] + jnp.arange(K + 1)[None, :]
@@ -205,15 +221,15 @@ def _spec_emit(
     emitted, logps, n_emit = spec_accept(
         logits, drafts, sub,
         temperature=g.temperature, top_k=g.top_k, top_p=g.top_p,
-        greedy=g.greedy,
+        greedy=g.greedy, n_valid=n_valid,
     )
-    n_emit = jnp.where(done, 0, n_emit)
+    n_emit = jnp.where(active, n_emit, 0)
     # Truncate at the first EOS (inclusive).
     j_idx = jnp.arange(K + 1)[None, :]
     is_eos = (emitted == eos) & (j_idx < n_emit[:, None])
     eos_pos = jnp.min(jnp.where(is_eos, j_idx, K + 1), axis=1)
     n_emit = jnp.minimum(n_emit, eos_pos + 1)
-    new_done = done | jnp.any(is_eos, axis=1)
+    new_done = done | (active & jnp.any(is_eos, axis=1))
     valid = j_idx < n_emit[:, None]
     # Append to the output buffers at per-row fill offsets.
     cols = out_fill[:, None] + j_idx
@@ -259,6 +275,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         kv_pool_pages: int = 0,
         prefill_chunk_tokens: Optional[int] = None,
         kv_share_prefix: Optional[bool] = None,
+        serving_admit_lanes: Optional[int] = None,
     ):
         if cfg.is_critic:
             raise ValueError("cannot generate from a critic model")
@@ -277,7 +294,9 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         self.static_path_max_new = 2048
         # "auto" = compute dtype; "int8" halves KV HBM per token (the
         # long-context capacity bound — see models.transformer.KVCache).
-        # Applies to the inflight paths (plain + speculative; spec stays
+        # Applies to every inflight path, INCLUDING the serving plane:
+        # chunked admission quantizes fresh KV once per chunk and all
+        # query lanes attend the dequantized pool (spec stays
         # distribution-exact because drafts and verification score
         # against the same quantized-cache model).  The static short-
         # decode path keeps full precision (its windows are small).
@@ -340,6 +359,26 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 os.environ.get("AREAL_KV_SHARE_PREFIX", "1") != "0"
             )
         self.kv_share_prefix = bool(kv_share_prefix)
+        # Serving-chunk lane budget headroom A: the packed token stream is
+        # T = min(n_slots + A, n_slots * Wmax) lanes wide (rounded up to a
+        # batch-shard multiple), where Wmax = max(W, K+1).  Every live row
+        # always gets >= 1 lane (T >= n_slots); the A spare lanes are
+        # shared by rows that want more (prefill slices, spec verify).
+        # 0 = auto (4 * Wmax).  Undersizing is graceful: contended rows
+        # progress slower, never wrong.
+        if serving_admit_lanes is None:
+            serving_admit_lanes = int(
+                os.environ.get("AREAL_SERVING_ADMIT_LANES", "0")
+            )
+        if serving_admit_lanes < 0:
+            raise ValueError(
+                f"serving_admit_lanes must be >= 0 (0 = auto), "
+                f"got {serving_admit_lanes}"
+            )
+        self.serving_admit_lanes = int(serving_admit_lanes)
+        # Lane budget of the most recently compiled serving chunk fn
+        # (T above) — bench/regression tooling reads it.
+        self.serving_lane_budget = 0
         # When True (default), set_params COPIES any leaf whose buffers
         # alias the source tree — required when generation can overlap a
         # train step that donates those buffers (rollout_ahead).  In a
@@ -379,6 +418,18 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         self.decode_compiles = 0
         self.cache_copy_bytes = 0
         self.last_pool_stats: Dict[str, Any] = {}
+        # Ragged-stream lane accounting (serving chunk only; reset in
+        # generate()): lanes_dispatched = query lanes launched (chunk
+        # steps x T), lanes_live = lanes carrying a real token,
+        # lanes_slack = budgeted-but-idle lanes (compute eliminated, not
+        # masked — the packed stream simply ends before them), and
+        # dead_live_lanes = lanes that were live but mapped to no row /
+        # an out-of-grant qpos.  The last is structurally zero; the bench
+        # invariant leg asserts it ("dead-lane compute exactly 0").
+        self.lanes_dispatched = 0
+        self.lanes_live = 0
+        self.lanes_slack = 0
+        self.dead_live_lanes = 0
         # Interruptible generation (async RL): interrupt() makes the
         # plain-paged inflight loop park at its next chunk boundary
         # (generate() then returns None); resume_generate() replays each
@@ -606,6 +657,10 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         self.decode_compiles = 0
         self.cache_copy_bytes = 0
         self.last_pool_stats = {}
+        self.lanes_dispatched = 0
+        self.lanes_live = 0
+        self.lanes_slack = 0
+        self.dead_live_lanes = 0
         self._gen_t0 = time.monotonic()
         prompt_lens = sample.seqlens_of(prompt_key)
         bounds = sample.cu_seqlens(prompt_key)
@@ -809,32 +864,26 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         between jitted T-token decode chunks.  kv_paged (the default)
         routes to the paged-pool variants: fixed shapes, one decode
         compilation, zero grow copies."""
-        if gconfig.spec_decode_k > 0:
-            # Speculative decoding keeps its two-program admit (the
-            # draft buffers make admission stateful); the serving plane
-            # covers the plain path only — pinned by
-            # tests/test_paged_kv.py::TestServingPlaneEquivalence.
-            if self.kv_paged:
-                return self._generate_inflight_spec_paged(
-                    reqs, gconfig, key, results
-                )
-            return self._generate_inflight_spec(reqs, gconfig, key, results)
         if self.kv_paged:
-            # int8 KV keeps the two-program admit: chunked prefill
-            # scores later prompt chunks against the QUANTIZED cache of
-            # earlier ones, while the one-shot prefill is full-precision
-            # — routing int8 through serving would break its bit-parity
-            # contract with the dense window (test_plain_greedy_int8).
-            if (
-                self.prefill_chunk_tokens > 0
-                and self.kv_cache_dtype != "int8"
-            ):
+            # ONE ragged serving chunk admits, decodes, and (K>0)
+            # spec-verifies: every row is just a q_len in the packed
+            # token stream, so spec drafts and int8 pools ride the same
+            # program as plain decode — no two-program admit carve-outs.
+            if self.prefill_chunk_tokens > 0:
                 return self._generate_inflight_serving(
                     reqs, gconfig, key, results
+                )
+            if gconfig.spec_decode_k > 0:
+                raise ValueError(
+                    "spec_decode_k > 0 over the paged pool requires the "
+                    "serving plane (prefill_chunk_tokens > 0); the legacy "
+                    "two-program spec admit path was removed"
                 )
             return self._generate_inflight_plain_paged(
                 reqs, gconfig, key, results
             )
+        if gconfig.spec_decode_k > 0:
+            return self._generate_inflight_spec(reqs, gconfig, key, results)
         return self._generate_inflight_plain(reqs, gconfig, key, results)
 
     def _generate_inflight_plain(self, reqs, gconfig, key, results) -> None:
@@ -1230,6 +1279,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             slot_prompt={},
             last_emit=np.zeros((n_slots,), np.int32),
         )
+        st.alloc.page_bytes = _cache_nbytes(st.pool) // n_pages
         self._run_paged_loop(st)
 
     def _run_paged_loop(self, st: "_PagedGenSession") -> bool:
@@ -1322,6 +1372,8 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             pool_pages=st.n_pages, page_size=ps,
             pages_recycled=alloc.pages_recycled,
             peak_pages_used=alloc.peak_pages_used,
+            pool_bytes=alloc.pool_bytes(),
+            peak_allocated_bytes=alloc.peak_pages_used * alloc.page_bytes,
         )
         self._set_live_slots(0)
         return True
@@ -1497,10 +1549,14 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             n_slots += 1
         ps = self.kv_page_size
         chunk_t = min(32, gconfig.max_new_tokens)
+        K = gconfig.spec_decode_k
         max_prompt = max(len(t) for (_, _, t) in reqs)
-        max_pages = -(-(max_prompt + gconfig.max_new_tokens + chunk_t) // ps)
+        max_pages = -(
+            -(max_prompt + gconfig.max_new_tokens + chunk_t + K) // ps
+        )
         n_pages = self.kv_pool_pages or n_slots * max_pages
         pbw = max(max_prompt, 1)
+        buf_w = max_prompt + gconfig.max_new_tokens + K + 2
         st = _PagedGenSession(
             gconfig=gconfig,
             key=key,
@@ -1530,7 +1586,10 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             shared_from=np.zeros((n_slots,), np.int32),
             slot_hash={},
             inflight_prefix={},
+            tokens_buf=jnp.zeros((n_slots, buf_w), jnp.int32),
+            pending_tok=jnp.zeros((n_slots,), jnp.int32),
         )
+        st.alloc.page_bytes = _cache_nbytes(st.pool) // n_pages
         self._run_serving_loop(st)
 
     def _run_serving_loop(self, st: "_PagedGenSession") -> bool:
@@ -1561,21 +1620,30 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 return False
             self._take_admits_serving(st)
             # Map pages covering this chunk's worst-case advance per live
-            # slot: a prefilling row consumes up to chunk_t*W prompt
+            # slot: a prefilling row consumes up to chunk_t*Wmax prompt
             # tokens (but never more than its remainder + the decode
             # steps that may follow); a decoding row advances at most
-            # chunk_t, clamped to its remaining emission budget — tokens
-            # past max_new are drained away anyway, so reserving for
-            # them would make a nearly-finished row hold pages it never
-            # usefully writes (over-budget writes drop via the sentinel,
-            # like done-row rewrites).  Host-side int appends only.
+            # chunk_t (plain) or chunk_t*(K+1) (spec), clamped to its
+            # remaining emission budget + K draft-scratch positions —
+            # tokens past max_new are drained away anyway, so reserving
+            # for them would make a nearly-finished row hold pages it
+            # never usefully writes (over-budget writes drop via the
+            # sentinel; the positions they would have filled are only
+            # ever attended by tokens that are themselves over budget
+            # and discarded at drain).  Host-side int appends only.
             max_new = gconfig.max_new_tokens
+            K = gconfig.spec_decode_k
+            Wmax = max(W, K + 1)
             for s in range(n_slots):
                 if st.active[s] is not None:
                     rem = int(st.prefill_rem[s])
                     left = max(0, max_new - int(st.gen_count[s]))
                     target = int(st.cache_len[s]) + max(
-                        1, min(chunk_t * W, rem + chunk_t, rem + left)
+                        1, min(
+                            chunk_t * Wmax,
+                            rem + chunk_t * (K + 1),
+                            rem + left + K,
+                        )
                     )
                     self._reserve_with_evict(alloc, s, target)
             self._privatize_write_windows(st)
@@ -1592,21 +1660,31 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 (
                     out_toks, out_logps, st.logits_buf, st.pool,
                     new_cache_len, new_gen_count, new_done, new_rem,
-                    new_off,
+                    new_off, st.tokens_buf, st.pending_tok, lane_acc,
                 ) = chunk_fn(
                     self.params, st.pool, st.logits_buf,
                     jnp.asarray(alloc.table), jnp.asarray(st.prompt_buf),
                     jnp.asarray(st.prompt_off), jnp.asarray(st.prefill_rem),
                     jnp.asarray(st.cache_len), jnp.asarray(st.gen_count),
-                    jnp.asarray(st.done_host), sub,
+                    jnp.asarray(st.done_host), st.tokens_buf,
+                    st.pending_tok, sub,
                 )
+                # ONE host-sync block per chunk (the done/eos flags must
+                # be exact before the next admission round) — the lane
+                # counters ride it rather than adding a sync of their
+                # own.
                 out_toks = to_host(out_toks)
                 out_logps = to_host(out_logps)
+                lane_acc = to_host(lane_acc)
             st.cache_len = to_host(new_cache_len).copy()
             st.gen_count = to_host(new_gen_count).copy()
             st.prefill_rem = to_host(new_rem).copy()
             st.prompt_off = to_host(new_off).copy()
             st.last_emit = st.gen_count - prev_gen
+            self.lanes_dispatched += chunk_t * self.serving_lane_budget
+            self.lanes_live += int(lane_acc[0])
+            self.lanes_slack += int(lane_acc[1])
+            self.dead_live_lanes += int(lane_acc[2])
 
             # Register prefixes that FINISHED prefilling this chunk,
             # before any retirement below can release the owner's pages:
@@ -1643,6 +1721,11 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             prefix_hits=alloc.prefix_hits,
             prefix_misses=alloc.prefix_misses,
             peak_live_slots=st.peak_live,
+            # int8-aware: page_bytes is measured off the real device
+            # pool, so an int8 pool reports ~1/2 the bf16 bytes (codes
+            # + per-token scales), not a dtype guess.
+            pool_bytes=alloc.pool_bytes(),
+            peak_allocated_bytes=alloc.peak_pages_used * alloc.page_bytes,
         )
         self._set_live_slots(0)
         return True
@@ -1660,7 +1743,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         the head request still cannot fit (undersized pool)."""
         alloc, gconfig = st.alloc, st.gconfig
         n_slots, ps, chunk_t = st.n_slots, alloc.page_size, st.chunk_t
-        slack = chunk_t
+        slack = chunk_t + gconfig.spec_decode_k
         admitted = 0
         for s in range(n_slots):
             if st.active[s] is not None or not st.pending:
@@ -1816,46 +1899,86 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         self, n_slots: int, n_pages: int, max_pages: int, chunk_t: int,
         W: int, pbw: int, g: GenerationHyperparameters,
     ):
-        """The unified serving chunk: chunk_t inner steps, each ONE
-        ragged W-wide `decode_step_spec_paged` forward in which a
-        prefilling row teacher-forces up to W prompt tokens (emitting
-        nothing), a decoding row samples and forwards 1 token, and a
-        done/parked row contributes 0 live queries.  W > 1 rides the
-        decode step's streamed weights — decode is bandwidth-bound, so
-        the extra query lanes reuse the same weight stream (the spec-
-        decode economics).  Like the legacy decode fn its signature
-        depends only on pool geometry, so it compiles EXACTLY ONCE per
-        generate call even under continuous admission — the admission-
-        shape zoo (`_get_prefill_pages_fn` bucketed shapes) is gone.
+        """The unified serving chunk over a PACKED ragged token stream:
+        chunk_t inner steps, each ONE `decode_step_ragged_paged` forward
+        of a static [T]-lane stream in which every row occupies exactly
+        the q_len it needs this step — a prefilling row teacher-forces up
+        to W prompt tokens, a plain decoding row forwards its 1 sampled
+        token, a speculating row (K > 0) forwards its pending token plus
+        K n-gram drafts for exact verification, and a done/parked row
+        occupies ZERO lanes.  Dead query lanes are ELIMINATED, not
+        masked: the stream simply ends at `total` live lanes, and the
+        slack tail carries sentinel rows whose compute the ragged kernel
+        skips (its flash loop runs zero KV blocks for them).  Extra
+        query lanes ride the decode step's streamed weights — decode is
+        bandwidth-bound, so prefill slices AND spec verification share
+        one weight stream (the spec-decode economics, now one program).
+
+        Lane budget: T = min(n_slots + A, n_slots * Wmax) rounded up to
+        a batch-shard multiple, Wmax = max(W, K+1), A the admit-lane
+        headroom knob.  Every live row is guaranteed >= 1 lane (T >=
+        n_slots); rows wanting more split the spare lanes front-to-back.
+        An undersized budget degrades THROUGHPUT only: a lane-starved
+        prefill row consumes fewer prompt tokens this step, a lane-
+        starved spec row verifies fewer drafts (`spec_accept` n_valid
+        truncation — distribution-exact at any grant).
+
+        Like the legacy decode fn the signature depends only on pool
+        geometry + hyperparameters, so it compiles EXACTLY ONCE per
+        generate call even under continuous admission of mixed
+        prefill/decode/spec rows — the admission-shape zoo AND the
+        separate spec-decode program are gone.
 
         Emission is FILL-INDEXED, not step-indexed: a row's sampled
         tokens pack contiguously from column 0 of its out row whatever
         inner steps it spent prefilling, preserving the -1-termination
         contract `_drain_chunk_outputs` relies on."""
+        K = g.spec_decode_k
+        Wmax = max(W, K + 1)
+        A = self.serving_admit_lanes or 4 * Wmax
+        T = min(n_slots + A, n_slots * Wmax)
+        while T % self.batch_shard:
+            T += 1
+        self.serving_lane_budget = T
         sig = (
             "serving_chunk", n_slots, n_pages, max_pages, chunk_t, W, pbw,
+            K, g.spec_ngram, T,
             g.min_new_tokens, g.greedy, g.top_p, g.top_k, g.temperature,
         )
         if sig in self._gen_fns:
             return self._gen_fns[sig]
         cfg = self.cfg
         eos = self.eos_token_id
+        # A spec row can emit up to K+1 tokens per inner step, plus one
+        # fresh first token the step it leaves prefill.
+        out_w = chunk_t * (K + 1) + 1 if K > 0 else chunk_t
+        if K > 0:
+            from areal_tpu.ops.ngram import propose_ngram
 
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 10))
         def fn(params, pool, logits, page_table, prompt_buf, prompt_off,
-               prefill_rem, cache_len, gen_count, done, key):
-            out_toks = jnp.full((n_slots, chunk_t), -1, jnp.int32)
-            out_logps = jnp.zeros((n_slots, chunk_t), jnp.float32)
+               prefill_rem, cache_len, gen_count, done, tokens_buf,
+               pending, key):
+            out_toks = jnp.full((n_slots, out_w), -1, jnp.int32)
+            out_logps = jnp.zeros((n_slots, out_w), jnp.float32)
             out_fill = jnp.zeros((n_slots,), jnp.int32)
+            # (live lanes, slack lanes, live-but-misassigned lanes) —
+            # the third is structurally zero; the bench invariant leg
+            # asserts it stays so ("dead-lane compute exactly 0").
+            lane_acc = jnp.zeros((3,), jnp.int32)
             rows = jnp.arange(n_slots)
-            lanes = jnp.arange(W)
+            lanes = jnp.arange(Wmax)
+            lane_ids = jnp.arange(T)
+            buf_w = tokens_buf.shape[1]
 
             def body(t, st):
                 (logits, pool, cache_len, gen_count, done, prefill_rem,
-                 prompt_off, out_toks, out_logps, out_fill) = st
+                 prompt_off, tokens_buf, pending, out_toks, out_logps,
+                 out_fill, lane_acc) = st
                 is_pref = prefill_rem > 0
-                c = jnp.where(is_pref, jnp.minimum(prefill_rem, W), 1)
                 sub = jax.random.fold_in(key, t)
+                if K > 0:
+                    sub, sub_v = jax.random.split(sub)
                 lg = logits
                 if g.min_new_tokens > 0:
                     lg = jnp.where(
@@ -1873,7 +1996,13 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                     temperature=g.temperature, top_k=g.top_k, top_p=g.top_p,
                     greedy=g.greedy,
                 )
-                emitting = (~done) & (~is_pref)
+                if K > 0:
+                    # K>0: the carry sample only seeds rows FRESH out of
+                    # prefill (their first pending token, emitted now);
+                    # speculating rows emit via spec_accept below.
+                    emitting = (~done) & (~is_pref) & (gen_count == 0)
+                else:
+                    emitting = (~done) & (~is_pref)
                 out_toks = out_toks.at[rows, out_fill].set(
                     jnp.where(emitting, tok, out_toks[rows, out_fill])
                 )
@@ -1881,52 +2010,142 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                     jnp.where(emitting, logp, out_logps[rows, out_fill])
                 )
                 out_fill = out_fill + emitting.astype(jnp.int32)
-                # W-wide token slab: prompt slice for prefilling rows
-                # (teacher-forced), sampled token in lane 0 for decoding
-                # rows (done rows rewrite their position with EOS, the
-                # legacy convention — the allocator keeps it mapped).
+                if K > 0:
+                    done = done | (emitting & (tok == eos))
+                    gen_count = gen_count + emitting.astype(jnp.int32)
+                    pending = jnp.where(emitting, tok, pending)
+                    # History invariant for speculating rows: cache_len =
+                    # plen + gen_count - 1 and tokens_buf[cache_len] is
+                    # the pending (sampled, not yet forwarded) token.
+                    bp0 = jnp.clip(cache_len, 0, buf_w - 1)
+                    tokens_buf = tokens_buf.at[rows, bp0].set(
+                        jnp.where(emitting, tok, tokens_buf[rows, bp0])
+                    )
+                    drafts = propose_ngram(
+                        tokens_buf, cache_len + 1, K, g.spec_ngram
+                    )  # [n_slots, K]
+                # Per-row lane want: a done/parked row wants ZERO lanes
+                # (its compute is eliminated from the stream, the legacy
+                # EOS-rewrite-in-place is gone), a prefilling row wants
+                # its next W-slice, a decoding row 1 (plain) or K+1
+                # (pending + drafts).  Everybody gets their base lane
+                # (T >= n_slots); the spare splits front-to-back.
+                want = jnp.where(
+                    done, 0,
+                    jnp.where(
+                        is_pref, jnp.minimum(prefill_rem, W), K + 1
+                    ),
+                ).astype(jnp.int32)
+                base = (want > 0).astype(jnp.int32)
+                extra = want - base
+                spare = T - jnp.sum(base)
+                excl = jnp.cumsum(extra) - extra
+                c = base + jnp.clip(spare - excl, 0, extra)
+                c = jnp.where(want > 0, c, 0)
+                # Pack: row r owns stream lanes [starts[r], starts[r]+c[r]).
+                cu = jnp.cumsum(c)
+                starts = cu - c
+                total = cu[-1]
+                row_of = jnp.searchsorted(
+                    cu, lane_ids, side="right"
+                ).astype(jnp.int32)
+                lane_live = lane_ids < total
+                rid = jnp.minimum(row_of, n_slots - 1)
+                qpos = lane_ids - starts[rid]
+                badlane = lane_live & (
+                    (row_of >= n_slots) | (qpos < 0) | (qpos >= c[rid])
+                )
+                lane_acc = lane_acc + jnp.stack([
+                    total, T - total,
+                    jnp.sum(badlane.astype(jnp.int32)),
+                ])
+                # Per-row lane-token slab, gathered into the stream.
                 idx = jnp.minimum(
                     prompt_off[:, None] + lanes[None, :], pbw - 1
                 )
                 pref_toks = jnp.take_along_axis(prompt_buf, idx, axis=1)
-                lane0 = jnp.where(
-                    is_pref, pref_toks[:, 0], jnp.where(done, eos, tok)
+                if K > 0:
+                    dec = jnp.concatenate(
+                        [pending[:, None], drafts], axis=1
+                    )
+                    if Wmax > K + 1:
+                        dec = jnp.pad(dec, [(0, 0), (0, Wmax - (K + 1))])
+                    slab = jnp.where(is_pref[:, None], pref_toks, dec)
+                    # Prefill rows record their granted prompt slice into
+                    # the history buffer (the n-gram proposer reads it).
+                    lv = is_pref[:, None] & (lanes[None, :] < c[:, None])
+                    bcols = jnp.clip(
+                        cache_len[:, None] + lanes[None, :], 0, buf_w - 1
+                    )
+                    cur = tokens_buf[rows[:, None], bcols]
+                    tokens_buf = tokens_buf.at[rows[:, None], bcols].set(
+                        jnp.where(lv, pref_toks, cur)
+                    )
+                else:
+                    slab = jnp.where(is_pref[:, None], pref_toks, 0)
+                    slab = slab.at[:, 0].set(
+                        jnp.where(is_pref, pref_toks[:, 0], tok)
+                    )
+                qv = jnp.clip(qpos, 0, Wmax - 1)
+                stream_tok = jnp.where(lane_live, slab[rid, qv], 0)
+                stream_pos = jnp.where(
+                    lane_live, cache_len[rid] + qv, 0
                 )
-                slab = jnp.where(is_pref[:, None], pref_toks, 0)
-                slab = slab.at[:, 0].set(lane0)
-                positions = cache_len[:, None] + lanes[None, :]
-                logits_all, pool2 = tfm.decode_step_spec_paged(
-                    params, cfg, slab, positions, pool, page_table,
-                    cache_len, q_lens=c,
+                logits_pk, pool2 = tfm.decode_step_ragged_paged(
+                    params, cfg, stream_tok, stream_pos, pool,
+                    page_table, row_of,
+                )  # [T, V]
+                # Next-step carry = each granted row's LAST lane logits
+                # (end-of-slice for prefill, post-token for decode);
+                # zero-lane rows keep their carry untouched.
+                last = jnp.clip(starts + c - 1, 0, T - 1)
+                logits = jnp.where(
+                    (c > 0)[:, None], logits_pk[last], logits
                 )
-                # Next-token logits = each row's LAST live query's output
-                # (query c-1): end-of-slice for prefill, the single lane
-                # for decode — uniform take, no per-mode branch.
-                logits = jnp.take_along_axis(
-                    logits_all, (c - 1)[:, None, None], axis=1
-                )[:, 0]
-                done = jnp.where(is_pref, done, done | (tok == eos))
-                # Decode rows advance by their emission (a row emitting
-                # its EOS still wrote that token); done rows rewrote in
-                # place and stay put — same rule as the legacy chunk.
-                cache_len = cache_len + jnp.where(
-                    is_pref, c, emitting.astype(jnp.int32)
-                )
-                gen_count = gen_count + emitting.astype(jnp.int32)
+                if K > 0:
+                    # Ragged verification: row r's K+1 spec positions are
+                    # lanes starts[r]..starts[r]+K; only the first c[r]
+                    # were forwarded (n_valid truncation in spec_accept).
+                    gidx = jnp.clip(
+                        starts[:, None] + jnp.arange(K + 1)[None, :],
+                        0, T - 1,
+                    )
+                    spec_lg = logits_pk[gidx]  # [n_slots, K+1, V]
+                    active_m = (~done) & (~is_pref) & (c > 0)
+                    (tokens_buf, pending, cache_len_s, gen_count, done,
+                     out_toks, out_logps, out_fill) = _spec_emit(
+                        cfg, g, eos, rows, spec_lg, drafts, sub_v,
+                        pending, cache_len, gen_count, done, out_toks,
+                        out_logps, out_fill, tokens_buf,
+                        active=active_m, n_valid=c,
+                    )
+                    cache_len = jnp.where(
+                        is_pref, cache_len + c, cache_len_s
+                    )
+                else:
+                    done = jnp.where(is_pref, done, done | (tok == eos))
+                    # Decode rows advance by their emission (a row
+                    # emitting its EOS still wrote that token); done rows
+                    # hold zero lanes and stay put.
+                    cache_len = cache_len + c
+                    gen_count = gen_count + emitting.astype(jnp.int32)
                 prompt_off = prompt_off + jnp.where(is_pref, c, 0)
                 prefill_rem = prefill_rem - jnp.where(is_pref, c, 0)
                 return (logits, pool2, cache_len, gen_count, done,
-                        prefill_rem, prompt_off, out_toks, out_logps,
-                        out_fill)
+                        prefill_rem, prompt_off, tokens_buf, pending,
+                        out_toks, out_logps, out_fill, lane_acc)
 
             st = (logits, pool, cache_len, gen_count, done, prefill_rem,
-                  prompt_off, out_toks, out_logps, out_fill)
+                  prompt_off, tokens_buf, pending, out_toks, out_logps,
+                  out_fill, lane_acc)
             st = jax.lax.fori_loop(0, chunk_t, body, st)
             (logits, pool, cache_len, gen_count, done, prefill_rem,
-             prompt_off, out_toks, out_logps, _) = st
+             prompt_off, tokens_buf, pending, out_toks, out_logps, _,
+             lane_acc) = st
             return (
                 out_toks, out_logps, logits, pool, cache_len, gen_count,
-                done, prefill_rem, prompt_off,
+                done, prefill_rem, prompt_off, tokens_buf, pending,
+                lane_acc,
             )
 
         self._gen_fns[sig] = fn
@@ -1934,21 +2153,18 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         self._m_decode_compiles.inc()
         logger.info(
             f"compiled serving chunk n_slots={n_slots} "
-            f"pool={n_pages}x{self.kv_page_size} chunk={chunk_t} W={W}"
+            f"pool={n_pages}x{self.kv_page_size} chunk={chunk_t} W={W} "
+            f"K={K} lanes={T}"
         )
         return fn
 
     # -- agent-serving episodes (multi-turn tool use on persistent KV) --
 
     def _require_serving_plane(self) -> None:
-        if not (
-            self.kv_paged
-            and self.prefill_chunk_tokens > 0
-            and self.kv_cache_dtype != "int8"
-        ):
+        if not (self.kv_paged and self.prefill_chunk_tokens > 0):
             raise RuntimeError(
-                "episodes require the serving plane: kv_paged=True, "
-                "prefill_chunk_tokens > 0, and a non-int8 KV cache"
+                "episodes require the serving plane: kv_paged=True and "
+                "prefill_chunk_tokens > 0"
             )
 
     def _episode_session_get(
@@ -1973,7 +2189,8 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         # conversation re-admitted after SlotGone is the worst case (the
         # whole budget), so pbw == budget keeps that path recompile-free.
         pbw = budget
-        max_pages = -(-(budget + chunk_t) // ps)
+        K = gconfig.spec_decode_k
+        max_pages = -(-(budget + chunk_t + K) // ps)
         n_pages = self.kv_pool_pages or n_slots * max_pages
         st = _PagedGenSession(
             gconfig=gconfig,
@@ -2008,7 +2225,10 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             inflight_prefix={},
             episodes={},
             ep_budget=budget,
+            tokens_buf=jnp.zeros((n_slots, budget + K + 2), jnp.int32),
+            pending_tok=jnp.zeros((n_slots,), jnp.int32),
         )
+        st.alloc.page_bytes = _cache_nbytes(st.pool) // n_pages
         self._ep_session = st
         logger.info(
             f"episode session: {n_slots} slots, pool {n_pages}x{ps}, "
@@ -2269,7 +2489,10 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         s = ep.slot
         ps = alloc.page_size
         g = ep.gconfig
-        chunk_t = st.chunk_t
+        # Chunk-advance slack past the transcript: decode steps plus the
+        # K draft-scratch positions a speculating row writes past its
+        # last accepted token.
+        slack = st.chunk_t + g.spec_decode_k
         st.ep_seq += 1
         ep.seq = st.ep_seq
         if fresh:
@@ -2286,7 +2509,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                     )
                     if shared is None:
                         continue
-                    need = alloc.pages_for(plen + chunk_t) - len(shared)
+                    need = alloc.pages_for(plen + slack) - len(shared)
                     if need > len(alloc.free):
                         alloc.prefix_evict(need)
                     if need > len(alloc.free):
@@ -2299,19 +2522,26 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             else:
                 self.episode_prefix_misses += 1
             try:
-                self._reserve_with_evict(alloc, s, plen + chunk_t)
+                self._reserve_with_evict(alloc, s, plen + slack)
             except PagePoolExhausted:
                 if not self._evict_parked_episode(st, exclude=ep.ep_id):
                     raise
-                self._reserve_with_evict(alloc, s, plen + chunk_t)
+                self._reserve_with_evict(alloc, s, plen + slack)
             st.active[s] = ep.ep_id
             st.cache_len[s] = start
             st.shared_from[s] = start
             st.slot_prompt[s] = toks
             rem = plen - start
         else:
+            # Observation append: teacher-force everything past the KV
+            # cursor.  For K > 0 the cursor parks ONE token short of the
+            # kept transcript (the final kept token was a pending spec
+            # token whose KV was never forwarded — see
+            # _finish_episode_turn), so the tail re-forwards it along
+            # with the observation.
             st.slot_prompt[s] = np.concatenate([st.slot_prompt[s], toks])
             start = int(st.cache_len[s])
+            toks = st.slot_prompt[s][start:]
             rem = len(toks)
         st.toks_acc[s] = []
         st.logps_acc[s] = []
@@ -2370,8 +2600,14 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 return None
             rem = int(st.prefill_rem[s])
             left = max(0, max_new - int(st.gen_count[s]))
+            K = g.spec_decode_k
+            Wmax = max(W, K + 1)
             target = int(st.cache_len[s]) + max(
-                1, min(chunk_t * W, rem + chunk_t, rem + left)
+                1, min(
+                    chunk_t * Wmax,
+                    rem + chunk_t * (K + 1),
+                    rem + left + K,
+                )
             )
             try:
                 self._reserve_with_evict(alloc, s, target)
@@ -2392,17 +2628,23 @@ class GeneratorEngine(HostOffloadMixin, Engine):
                 (
                     out_toks, out_logps, st.logits_buf, st.pool,
                     new_cache_len, new_gen_count, new_done, new_rem,
-                    new_off,
+                    new_off, st.tokens_buf, st.pending_tok, lane_acc,
                 ) = chunk_fn(
                     self.params, st.pool, st.logits_buf,
                     jnp.asarray(alloc.table), jnp.asarray(st.prompt_buf),
                     jnp.asarray(st.prompt_off),
                     jnp.asarray(st.prefill_rem),
                     jnp.asarray(st.cache_len), jnp.asarray(st.gen_count),
-                    jnp.asarray(st.done_host), sub,
+                    jnp.asarray(st.done_host), st.tokens_buf,
+                    st.pending_tok, sub,
                 )
                 out_toks = to_host(out_toks)
                 out_logps = to_host(out_logps)
+                lane_acc = to_host(lane_acc)
+            self.lanes_dispatched += chunk_t * self.serving_lane_budget
+            self.lanes_live += int(lane_acc[0])
+            self.lanes_slack += int(lane_acc[1])
+            self.dead_live_lanes += int(lane_acc[2])
             st.cache_len = to_host(new_cache_len).copy()
             st.gen_count = to_host(new_gen_count).copy()
             st.prefill_rem = to_host(new_rem).copy()
@@ -2451,8 +2693,14 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         # transcript no longer covers.  Pulling cache_len back is pure
         # host bookkeeping — attention never reads past a row's write
         # cursor, and the next admission teacher-forces over those
-        # positions in place.
-        st.cache_len[s] = ep.turn_start_len + kept
+        # positions in place.  With spec decoding the final kept token
+        # may be a still-PENDING token (sampled, never forwarded, so no
+        # KV exists for it) — park one short and let the next
+        # observation admit teacher-force it with the obs tail.
+        if ep.gconfig.spec_decode_k > 0:
+            st.cache_len[s] = ep.turn_start_len + max(0, kept - 1)
+        else:
+            st.cache_len[s] = ep.turn_start_len + kept
         st.done_host[s] = True
         st.prefill_rem[s] = 0
         turn_toks = [int(t) for t in st.toks_acc[s]]
@@ -2472,7 +2720,7 @@ class GeneratorEngine(HostOffloadMixin, Engine):
             "tokens": turn_toks,
             "logprobs": turn_lps,
             "stop_reason": reason,
-            "transcript_len": int(st.cache_len[s]),
+            "transcript_len": int(ep.turn_start_len + kept),
             "prefill_tokens": int(ep.last_admit_tokens),
             "shared_prefix_tokens": int(st.shared_from[s]),
             "slot": s,
@@ -2716,228 +2964,6 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         logger.info(
             f"compiled spec decoder n_slots={n_slots} s_max={s_max} "
             f"steps={n_steps} K={K}"
-        )
-        return fn
-
-    # -- speculative inflight over the paged pool --
-
-    def _generate_inflight_spec_paged(self, reqs, g, key, results) -> None:
-        """`_generate_inflight_spec` over the paged KV pool: same n-gram
-        drafts + exact verification, but the pool, history buffer and
-        decode program keep ONE shape for the whole call — no grow
-        copies, one decode compilation, pages recycled on retirement."""
-        K = g.spec_decode_k
-        n_slots = min(max(self.batch_shard, self.max_decode_batch), len(reqs))
-        while n_slots % self.batch_shard:
-            n_slots += 1
-        ps = self.kv_page_size
-        max_prompt = max(len(t) for (_, _, t) in reqs)
-        n_steps = max(1, min(32, g.max_new_tokens) // (K + 1))
-        step_cap = n_steps * (K + 1)
-        # Chunk slack: a chunk advances up to step_cap positions and
-        # writes K+1 consecutive entries past the last advance.
-        slack = step_cap + K + 1
-        max_pages = -(-(max_prompt + g.max_new_tokens + slack) // ps)
-        n_pages = self.kv_pool_pages or n_slots * max_pages
-        alloc = PageAllocator(n_pages, ps, n_slots, max_pages)
-        pool = tfm.init_paged_kv_cache(
-            self.cfg, n_pages, ps, dtype=self._paged_kv_dtype()
-        )
-        # Fixed-width history buffer: must hold the widest admission
-        # prefill (bucketed + page-aligned) and the worst-case sequence.
-        sp_max = bucket_len(max_prompt)
-        sp_max += (-sp_max) % ps
-        buf_w = max(max_prompt + g.max_new_tokens + slack, sp_max) + K + 2
-        tokens_buf = jnp.zeros((n_slots, buf_w), jnp.int32)
-        pending = jnp.zeros((n_slots,), jnp.int32)
-        decode_fn = self._get_paged_spec_decode_fn(
-            n_slots, n_pages, max_pages, buf_w, n_steps, g
-        )
-        cache_len = np.zeros((n_slots,), np.int32)
-        gen_count = np.zeros((n_slots,), np.int32)
-        done_host = np.ones((n_slots,), bool)
-        active: List[Optional[Tuple[int, int]]] = [None] * n_slots
-        toks_acc: Dict[int, List[int]] = {}
-        logps_acc: Dict[int, List[float]] = {}
-        pending_list = list(reversed(reqs))
-
-        while pending_list or any(a is not None for a in active):
-            admits = self._take_admits_paged(
-                active, pending_list, n_slots, alloc, slack
-            )
-            if admits:
-                rows, plens, slots, page_rows = self._pack_admits_paged(
-                    admits, n_slots, alloc
-                )
-                key, sub = jax.random.split(key)
-                with tracer.span("prefill", cat="compute", n=len(admits)):
-                    toks0, logps0, pool, tokens_buf, pending = (
-                        self._get_spec_admit_pages_fn(g)(
-                            self.params, jnp.asarray(rows),
-                            jnp.asarray(plens), pool, tokens_buf, pending,
-                            jnp.asarray(slots), jnp.asarray(page_rows), sub,
-                        )
-                    )
-                    self.prefill_dispatches += 1
-                    # ONE host sync per refill cycle (mirrors the spec
-                    # path): the per-admit float()/int() below read these
-                    # host arrays, not the device.
-                    toks0 = to_host(toks0)
-                    logps0 = to_host(logps0)
-                for j, (s, i, rep, toks) in enumerate(admits):
-                    t0 = int(toks0[j])
-                    cache_len[s] = len(toks)
-                    gen_count[s] = 1  # the sampled pending token
-                    done_host[s] = t0 == self.eos_token_id
-                    active[s] = (i, rep)
-                    toks_acc[s] = [t0]
-                    logps_acc[s] = [float(logps0[j])]
-
-            for s in range(n_slots):
-                if active[s] is not None:
-                    alloc.reserve(s, int(cache_len[s]) + slack)
-            self._accum_pool_stats(
-                "paged", int(cache_len.sum()), alloc.allocated_pages() * ps
-            )
-
-            key, sub = jax.random.split(key)
-            with tracer.span("decode_chunk", cat="compute", t=step_cap):
-                (
-                    out_toks, out_logps, tokens_buf, pool, pending,
-                    new_cache_len, new_gen_count, new_done,
-                ) = decode_fn(
-                    self.params, pool, tokens_buf, pending,
-                    jnp.asarray(alloc.table), jnp.asarray(cache_len),
-                    jnp.asarray(gen_count), jnp.asarray(done_host), sub,
-                )
-                out_toks = to_host(out_toks)
-                out_logps = to_host(out_logps)
-            cache_len = to_host(new_cache_len).copy()
-            gen_count = to_host(new_gen_count).copy()
-
-            self._drain_chunk_outputs(
-                out_toks, out_logps, to_host(new_done), active, toks_acc,
-                logps_acc, results, done_host, cache_len, g.max_new_tokens,
-                on_retire=alloc.release, stop_seqs=g.stop,
-            )
-        self.last_pool_stats.update(
-            pool_pages=n_pages, page_size=ps,
-            pages_recycled=alloc.pages_recycled,
-            peak_pages_used=alloc.peak_pages_used,
-        )
-
-    def _get_spec_admit_pages_fn(self, g):
-        sig = ("spec_admit_pages", g.greedy, g.top_p, g.top_k,
-               g.temperature, g.min_new_tokens)
-        if sig in self._gen_fns:
-            return self._gen_fns[sig]
-        cfg = self.cfg
-        eos = self.eos_token_id
-        use_flash = (
-            False if isinstance(self._use_flash, Mesh) else self._use_flash
-        )
-
-        # Batched paged admission: prefill into the assigned pool pages,
-        # sample each prompt's first pending token, record prompt+token
-        # into the history buffer — one dispatch per refill cycle.
-        @functools.partial(jax.jit, donate_argnums=(3, 4, 5))
-        def fn(params, rows, plens, pool, tokens_buf, pending, slot_rows,
-               page_rows, key):
-            sp = rows.shape[1]
-            logits, pool = tfm.prefill_into_pages(
-                params, cfg, rows, plens, pool, page_rows,
-                use_flash=use_flash,
-            )
-            lg = logits
-            if g.min_new_tokens > 0:
-                lg = jnp.where(
-                    (jnp.arange(cfg.vocab_size) == eos)[None, :], -1e10, lg
-                )
-            tok, logp = sample_token(
-                lg, key, temperature=g.temperature, top_k=g.top_k,
-                top_p=g.top_p, greedy=g.greedy,
-            )
-            tokens_buf = tokens_buf.at[slot_rows, :sp].set(rows, mode="drop")
-            tokens_buf = tokens_buf.at[slot_rows, plens].set(tok, mode="drop")
-            pending = pending.at[slot_rows].set(tok, mode="drop")
-            return tok, logp, pool, tokens_buf, pending
-
-        self._gen_fns[sig] = fn
-        return fn
-
-    def _get_paged_spec_decode_fn(
-        self, n_slots: int, n_pages: int, max_pages: int, buf_w: int,
-        n_steps: int, g: GenerationHyperparameters,
-    ):
-        K = g.spec_decode_k
-        sig = (
-            "paged_spec_decode", n_slots, n_pages, max_pages, buf_w,
-            n_steps, K, g.spec_ngram, g.min_new_tokens, g.greedy, g.top_p,
-            g.top_k, g.temperature,
-        )
-        if sig in self._gen_fns:
-            return self._gen_fns[sig]
-        cfg = self.cfg
-        eos = self.eos_token_id
-        from areal_tpu.ops.ngram import propose_ngram
-
-        out_w = n_steps * (K + 1)
-        rows = jnp.arange(n_slots)
-
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def fn(params, pool, tokens_buf, pending, page_table, cache_len,
-               gen_count, done, key):
-            out_toks = jnp.full((n_slots, out_w), -1, jnp.int32)
-            out_logps = jnp.zeros((n_slots, out_w), jnp.float32)
-            out_fill = jnp.zeros((n_slots,), jnp.int32)
-
-            def body(t, st):
-                (pool, tokens_buf, pending, cache_len, gen_count, done,
-                 out_toks, out_logps, out_fill) = st
-                drafts = propose_ngram(
-                    tokens_buf, cache_len + 1, K, g.spec_ngram
-                )  # [B, K]
-                inputs = jnp.concatenate(
-                    [pending[:, None], drafts], axis=1
-                )  # [B, K+1]
-                # No clamp: reserve() before the chunk guarantees every
-                # written position has a mapped page.
-                positions = cache_len[:, None] + jnp.arange(K + 1)[None, :]
-                logits, pool2 = tfm.decode_step_spec_paged(
-                    params, cfg,
-                    jnp.where(done[:, None], eos, inputs),
-                    positions, pool, page_table, cache_len,
-                )  # [B, K+1, V]
-                sub = jax.random.fold_in(key, t)
-                (
-                    tokens_buf, pending2, cache_len2, gen_count2, new_done,
-                    out_toks, out_logps, out_fill,
-                ) = _spec_emit(
-                    cfg, g, eos, rows, logits, drafts, sub, pending,
-                    cache_len, gen_count, done, out_toks, out_logps,
-                    out_fill, tokens_buf,
-                )
-                return (
-                    pool2, tokens_buf, pending2, cache_len2, gen_count2,
-                    new_done, out_toks, out_logps, out_fill,
-                )
-
-            st = (pool, tokens_buf, pending, cache_len, gen_count, done,
-                  out_toks, out_logps, out_fill)
-            st = jax.lax.fori_loop(0, n_steps, body, st)
-            (pool, tokens_buf, pending, cache_len, gen_count, done,
-             out_toks, out_logps, _) = st
-            return (
-                out_toks, out_logps, tokens_buf, pool, pending,
-                cache_len, gen_count, done,
-            )
-
-        self._gen_fns[sig] = fn
-        self.decode_compiles += 1
-        self._m_decode_compiles.inc()
-        logger.info(
-            f"compiled paged spec decoder n_slots={n_slots} "
-            f"pool={n_pages}x{self.kv_page_size} steps={n_steps} K={K}"
         )
         return fn
 

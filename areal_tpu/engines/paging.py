@@ -95,6 +95,11 @@ class PageAllocator:
         self.cow_copies = 0
         self.shared_mappings = 0
         self.debug_check = os.environ.get("AREAL_PAGING_CHECK") == "1"
+        # Device bytes per pool page (all layers, K+V, codes + scales
+        # for int8 pools).  The engine stamps this after building the
+        # device pool — the allocator can't know dtypes or model shape —
+        # so `allocated_bytes()` reports real HBM held by mapped pages.
+        self.page_bytes = 0
         # Process-wide counters (the allocator itself is per-session):
         # the prefix-cache hit rate and CoW traffic the fleet watchdog
         # trends across generate calls.
@@ -121,6 +126,16 @@ class PageAllocator:
 
     def allocated_pages(self) -> int:
         return self.n_pages - len(self.free)
+
+    def allocated_bytes(self) -> int:
+        """HBM held by currently-mapped pages (0 until the engine
+        stamps `page_bytes`); shared pages count once — that is the
+        point of sharing."""
+        return self.allocated_pages() * int(self.page_bytes)
+
+    def pool_bytes(self) -> int:
+        """Total device bytes of the backing pool, free pages included."""
+        return self.n_pages * int(self.page_bytes)
 
     def _alloc_page(self) -> int:
         p = self.free.pop()

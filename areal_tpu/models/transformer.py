@@ -37,6 +37,7 @@ from areal_tpu.ops.attention import (
     packed_attention,
     paged_decode_attention,
     paged_decode_attention_chunk,
+    ragged_paged_attention,
     repeat_kv,
 )
 from areal_tpu.ops.norms import apply_rotary, rms_norm, rope_cos_sin
@@ -762,12 +763,22 @@ def prefill(
     segment_ids: jax.Array,  # [B, S] 1 where valid, 0 pad (single segment/row)
     cache: KVCache,
     use_flash: "bool | None" = None,
+    quantize_kv: bool = False,
 ) -> Tuple[jax.Array, KVCache]:
     """Run the prompt through the model, filling cache[:, :, :S] and
     returning fp32 logits [B, V] at each row's LAST VALID position (the
     distribution over the first generated token).  Computing the head only
     there keeps prefill memory at [B, V] instead of [B, S, V] — at a 152k
-    vocab that is the difference between 40 MB and 10 GB."""
+    vocab that is the difference between 40 MB and 10 GB.
+
+    quantize_kv=True (requires an int8 `cache` with scales) quantizes each
+    layer's fresh K/V ONCE and attends over the DEQUANTIZED values —
+    "quantize once, attend dequantized".  That makes prefill numerically
+    identical to feeding the same tokens through the chunked decode path
+    (which always reads its just-written quantized pool): every attention
+    read anywhere sees dequant(quant(fresh)), so int8 generation is
+    chunk-boundary-invariant instead of depending on how much of the
+    prompt was prefilled in one shot."""
     positions = positions_from_segments(segment_ids)
     x = _embed(params, cfg, tokens, positions)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
@@ -776,8 +787,17 @@ def prefill(
         blk = layer_in
         h = _norm(carry, blk["ln1"], blk.get("ln1_b"), cfg)
         q, k, v = _block_kv(h, blk, cfg, cos, sin)
+        if quantize_kv:
+            kq, ksc = kv_quant(k)
+            vq, vsc = kv_quant(v)
+            k_at = kv_dequant(kq, ksc, k.dtype)
+            v_at = kv_dequant(vq, vsc, v.dtype)
+            out = (kq, ksc, vq, vsc)
+        else:
+            k_at, v_at = k, v
+            out = (k, v)
         attn = packed_attention(
-            q, k, v, segment_ids, causal=True, use_flash=use_flash
+            q, k_at, v_at, segment_ids, causal=True, use_flash=use_flash
         )
         y = attn.reshape(*carry.shape[:2], cfg.q_dim) @ blk["wo"]
         if cfg.proj_bias:
@@ -785,17 +805,37 @@ def prefill(
         y = carry + y
         h2 = _norm(y, blk["ln2"], blk.get("ln2_b"), cfg)
         y = y + (_mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk, cfg))
-        return y, (k, v)
+        return y, out
 
-    x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
-    new_cache = KVCache(
-        k=jax.lax.dynamic_update_slice(
-            cache.k, ks.astype(cache.k.dtype), (0, 0, 0, 0, 0)
-        ),
-        v=jax.lax.dynamic_update_slice(
-            cache.v, vs.astype(cache.v.dtype), (0, 0, 0, 0, 0)
-        ),
-    )
+    if quantize_kv:
+        x, (kq, ksc, vq, vsc) = jax.lax.scan(body, x, params["blocks"])
+        # Emit int8 + scales DIRECTLY: re-quantizing a dequantized value
+        # is not idempotent (round(126*s/127 / s') flips codes), so the
+        # codes produced here are the ones every later read must see.
+        new_cache = KVCache(
+            k=jax.lax.dynamic_update_slice(
+                cache.k, kq.astype(cache.k.dtype), (0, 0, 0, 0, 0)
+            ),
+            v=jax.lax.dynamic_update_slice(
+                cache.v, vq.astype(cache.v.dtype), (0, 0, 0, 0, 0)
+            ),
+            k_scale=jax.lax.dynamic_update_slice(
+                cache.k_scale, ksc.astype(cache.k_scale.dtype), (0, 0, 0, 0)
+            ),
+            v_scale=jax.lax.dynamic_update_slice(
+                cache.v_scale, vsc.astype(cache.v_scale.dtype), (0, 0, 0, 0)
+            ),
+        )
+    else:
+        x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
+        new_cache = KVCache(
+            k=jax.lax.dynamic_update_slice(
+                cache.k, ks.astype(cache.k.dtype), (0, 0, 0, 0, 0)
+            ),
+            v=jax.lax.dynamic_update_slice(
+                cache.v, vs.astype(cache.v.dtype), (0, 0, 0, 0, 0)
+            ),
+        )
     x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
     # Gather each row's last valid hidden state before the (huge) head matmul.
     # (index of the last nonzero segment: works for left- and right-aligned
@@ -1036,36 +1076,45 @@ def prefill_into_slots(
     seg = (
         jnp.arange(sp)[None, :] < prompt_lens[:, None]
     ).astype(jnp.int32)
-    # Prefill computes in the model dtype; quantization (if the target
-    # cache is int8) happens once at the scatter below.
-    row_dtype = cfg.dtype if cache.quantized else cache.k.dtype
-    row_cache = KVCache(
-        k=jnp.zeros(
-            (cfg.n_layers, m, sp, cfg.n_kv_heads, cfg.head_dim), row_dtype
-        ),
-        v=jnp.zeros(
-            (cfg.n_layers, m, sp, cfg.n_kv_heads, cfg.head_dim), row_dtype
-        ),
-    )
+    row_cache = _prefill_row_cache(cfg, m, sp, cache)
     logits, row_cache = prefill(
-        params, cfg, tokens, seg, row_cache, use_flash=use_flash
+        params, cfg, tokens, seg, row_cache, use_flash=use_flash,
+        quantize_kv=cache.quantized,
     )
     if cache.quantized:
-        kq, ks = kv_quant(row_cache.k)
-        vq, vs = kv_quant(row_cache.v)
+        # The prefill already quantized once and attended dequantized —
+        # scatter its CODES as-is (re-quantizing here would flip codes
+        # and break parity with the chunked serving admission).
         return logits, KVCache(
-            k=cache.k.at[:, slot_rows, :sp].set(kq, mode="drop"),
-            v=cache.v.at[:, slot_rows, :sp].set(vq, mode="drop"),
+            k=cache.k.at[:, slot_rows, :sp].set(row_cache.k, mode="drop"),
+            v=cache.v.at[:, slot_rows, :sp].set(row_cache.v, mode="drop"),
             k_scale=cache.k_scale.at[:, slot_rows, :sp].set(
-                ks, mode="drop"
+                row_cache.k_scale, mode="drop"
             ),
             v_scale=cache.v_scale.at[:, slot_rows, :sp].set(
-                vs, mode="drop"
+                row_cache.v_scale, mode="drop"
             ),
         )
     new_k = cache.k.at[:, slot_rows, :sp].set(row_cache.k, mode="drop")
     new_v = cache.v.at[:, slot_rows, :sp].set(row_cache.v, mode="drop")
     return logits, KVCache(k=new_k, v=new_v)
+
+
+def _prefill_row_cache(cfg: ModelConfig, m: int, sp: int, cache) -> KVCache:
+    """Scratch per-row dense cache for a batched admission prefill,
+    matching the target cache's quantization (int8 codes + scales when
+    the target pool is int8, so the scatters move codes verbatim)."""
+    shape = (cfg.n_layers, m, sp, cfg.n_kv_heads, cfg.head_dim)
+    if cache.quantized:
+        return KVCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(shape[:-1], jnp.bfloat16),
+            v_scale=jnp.zeros(shape[:-1], jnp.bfloat16),
+        )
+    return KVCache(
+        k=jnp.zeros(shape, cache.k.dtype), v=jnp.zeros(shape, cache.k.dtype)
+    )
 
 
 # --------------------------------------------------------------------------
@@ -1294,6 +1343,86 @@ def decode_step_spec_paged(
     )
 
 
+def decode_step_ragged_paged(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [T] int32 — PACKED token stream
+    positions: jax.Array,  # [T] int32 — flat cache position (== RoPE pos)
+    cache: PagedKVCache,
+    page_table: jax.Array,  # [B, max_pages] int32, sentinel = n_pages
+    row_of: jax.Array,  # [T] int32 — owning slot per token; >= B = dead lane
+) -> Tuple[jax.Array, PagedKVCache]:
+    """The megakernel forward: one packed [T] stream of query lanes with
+    per-token windows, instead of a [B, Q] slab with per-row q_lens.
+
+    `decode_step_spec_paged(q_lens=...)` pays B*Q query lanes of embed /
+    QKV / MLP / head compute per step and MASKS the dead ones; here the
+    serving chunk packs only live lanes (decode rows contribute 1,
+    chunked-prefill / episode-observation rows their granted slice,
+    spec-verify rows pending+drafts) so the whole transformer stack —
+    not just attention — runs at ∝ T.  Token t writes its K/V at flat
+    position `positions[t]` of slot `row_of[t]` and attends
+    [0, positions[t]] through that slot's page-table row
+    (`ragged_paged_attention`: Pallas stream kernel or XLA per-token
+    gather).  Dead lanes (row_of >= B, the stream's slack) drop their
+    cache writes, emit zero attention, and produce garbage logits the
+    caller never reads.  Same pool-in/pool-out single-compilation
+    contract as `decode_step_paged`."""
+    t = tokens.shape[0]
+    b = page_table.shape[0]
+    live = row_of < b
+    rid = jnp.minimum(row_of.astype(jnp.int32), b - 1)
+    pt_tok = jnp.take(page_table, rid, axis=0)  # [T, max_pages]
+    positions = jnp.where(live, positions, 0).astype(jnp.int32)
+    x = _embed(params, cfg, tokens, positions)[:, None, :]  # [T, 1, D]
+    cos, sin = rope_cos_sin(positions[:, None], cfg.head_dim, cfg.rope_theta)
+    wp_page, wp_off = _page_of(pt_tok, positions, cache.page_size)
+    # Dead lanes must not scatter (2**30 = the `_page_of` OOB drop).
+    wp_page = jnp.where(live, wp_page, jnp.int32(2**30))
+    valid_to = jnp.where(live, positions + 1, 0).astype(jnp.int32)
+    quant = cache.quantized
+
+    def body(carry, blk):
+        y, kc, vc, ksc, vsc, li = carry
+        h = _norm(y, blk["ln1"], blk.get("ln1_b"), cfg)
+        q, k, v = _block_kv(h, blk, cfg, cos, sin)  # [T, 1, h, d]
+        kc, vc, ksc, vsc, k_pool_l, v_pool_l, ks_l, vs_l = (
+            _cache_update_read(
+                kc, vc, ksc, vsc, k[:, 0], v[:, 0], li, (wp_page, wp_off),
+                quant, q.dtype, dequant=False,
+            )
+        )
+        attn = ragged_paged_attention(
+            q[:, 0], k_pool_l, v_pool_l, pt_tok, valid_to,
+            k_scale=ks_l, v_scale=vs_l,
+        )
+        ao = attn.reshape(t, 1, cfg.q_dim) @ blk["wo"]
+        if cfg.proj_bias:
+            ao = ao + blk["bo"]
+        y = y + ao
+        h2 = _norm(y, blk["ln2"], blk.get("ln2_b"), cfg)
+        y = y + (
+            _mlp_moe(h2, blk, cfg)[0] if cfg.is_moe else _mlp_dense(h2, blk, cfg)
+        )
+        return (y, kc, vc, ksc, vsc, li + 1), None
+
+    ksc0 = cache.k_scale if quant else jnp.zeros((0,), jnp.bfloat16)
+    vsc0 = cache.v_scale if quant else jnp.zeros((0,), jnp.bfloat16)
+    (x, kc, vc, ksc, vsc, _), _ = jax.lax.scan(
+        body,
+        (x, cache.k, cache.v, ksc0, vsc0, jnp.int32(0)),
+        params["blocks"],
+    )
+    x = _norm(x, params["final_ln"], params.get("final_ln_b"), cfg)
+    logits = _head(params, cfg, x)[:, 0]  # [T, V]
+    return logits, PagedKVCache(
+        k=kc, v=vc,
+        k_scale=ksc if quant else None,
+        v_scale=vsc if quant else None,
+        page_size=cache.page_size,
+    )
+
+
 def prefill_into_pages(
     params: Params,
     cfg: ModelConfig,
@@ -1319,17 +1448,10 @@ def prefill_into_pages(
     seg = (
         jnp.arange(sp)[None, :] < prompt_lens[:, None]
     ).astype(jnp.int32)
-    row_dtype = cfg.dtype if cache.quantized else cache.k.dtype
-    row_cache = KVCache(
-        k=jnp.zeros(
-            (cfg.n_layers, m, sp, cfg.n_kv_heads, cfg.head_dim), row_dtype
-        ),
-        v=jnp.zeros(
-            (cfg.n_layers, m, sp, cfg.n_kv_heads, cfg.head_dim), row_dtype
-        ),
-    )
+    row_cache = _prefill_row_cache(cfg, m, sp, cache)
     logits, row_cache = prefill(
-        params, cfg, tokens, seg, row_cache, use_flash=use_flash
+        params, cfg, tokens, seg, row_cache, use_flash=use_flash,
+        quantize_kv=cache.quantized,
     )
 
     def chunked(a):  # [L, M, SP, ...] -> [L, M * n_chunks, ps, ...]
@@ -1337,13 +1459,17 @@ def prefill_into_pages(
 
     flat = page_rows.reshape(-1)
     if cache.quantized:
-        kq, ks = kv_quant(row_cache.k)
-        vq, vs = kv_quant(row_cache.v)
+        # Codes + scales scatter verbatim (quantized once inside the
+        # prefill, attended dequantized there — see `prefill`).
         return logits, PagedKVCache(
-            k=cache.k.at[:, flat].set(chunked(kq), mode="drop"),
-            v=cache.v.at[:, flat].set(chunked(vq), mode="drop"),
-            k_scale=cache.k_scale.at[:, flat].set(chunked(ks), mode="drop"),
-            v_scale=cache.v_scale.at[:, flat].set(chunked(vs), mode="drop"),
+            k=cache.k.at[:, flat].set(chunked(row_cache.k), mode="drop"),
+            v=cache.v.at[:, flat].set(chunked(row_cache.v), mode="drop"),
+            k_scale=cache.k_scale.at[:, flat].set(
+                chunked(row_cache.k_scale), mode="drop"
+            ),
+            v_scale=cache.v_scale.at[:, flat].set(
+                chunked(row_cache.v_scale), mode="drop"
+            ),
             page_size=ps,
         )
     return logits, PagedKVCache(

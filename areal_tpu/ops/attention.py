@@ -344,6 +344,61 @@ def paged_decode_attention_chunk(
     )
 
 
+def ragged_paged_attention(
+    q: jax.Array,  # [T, n_q, d] — packed token stream (no batch/Q dims)
+    k_pool: jax.Array,  # [P, ps, n_kv, d] — one layer's pool view
+    v_pool: jax.Array,
+    page_table_tok: jax.Array,  # [T, max_pages] int32 — PER-TOKEN tables
+    valid_to: jax.Array,  # [T] int — one past each token's window; 0 = dead
+    k_scale: "Optional[jax.Array]" = None,  # [P, ps, n_kv]: int8 pool
+    v_scale: "Optional[jax.Array]" = None,
+) -> jax.Array:
+    """Ragged paged attention over a PACKED token stream.
+
+    The serving megakernel's attention op: instead of a [n_slots, W] slab
+    where every row pays W query lanes, the caller packs all live query
+    lanes of the chunk — decode rows (1 lane), chunked-prefill /
+    episode-observation rows (their granted slice), spec-verify rows
+    (pending + drafts) — into one [T] stream.  Token t attends its own
+    window [0, valid_to[t]) of the row it belongs to, addressed through
+    its own (pre-gathered) page-table row.  Dead stream lanes carry
+    valid_to == 0 and emit exact zeros; the Pallas kernel skips their
+    pages entirely (eliminated, not masked), the XLA fallback gathers
+    per-token windows so its compute is ∝ T rather than ∝ n_slots * W.
+
+    Returns [T, n_q, d] in q.dtype.
+    """
+    if _decode_kernel_enabled():
+        from areal_tpu.ops.pallas.paged_attention import (
+            ragged_paged_attention_kernel,
+        )
+
+        return ragged_paged_attention_kernel(
+            q, k_pool, v_pool, page_table_tok, valid_to, k_scale, v_scale
+        )
+    t = q.shape[0]
+    k_cache = paged_gather_layer(k_pool, page_table_tok)  # [T, mp*ps, ...]
+    v_cache = paged_gather_layer(v_pool, page_table_tok)
+    ks = (
+        None
+        if k_scale is None
+        else paged_gather_layer(k_scale, page_table_tok)
+    )
+    vs = (
+        None
+        if v_scale is None
+        else paged_gather_layer(v_scale, page_table_tok)
+    )
+    # Q=1 decode formulation with T "rows": each packed token is its own
+    # attention problem.  decode_attention zeroes empty-window rows, which
+    # is exactly the dead-lane (valid_to == 0) contract.
+    out = decode_attention(
+        q[:, None], k_cache, v_cache, jnp.zeros((t,), jnp.int32),
+        jnp.asarray(valid_to, jnp.int32), k_scale=ks, v_scale=vs,
+    )
+    return out[:, 0]
+
+
 @functools.partial(jax.jit, static_argnames=("causal",))
 def _dispatch_ref(q, k, v, segment_ids, causal):
     return packed_attention_reference(q, k, v, segment_ids, causal=causal)

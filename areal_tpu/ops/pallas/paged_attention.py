@@ -201,6 +201,151 @@ def paged_decode_attention_chunk_kernel(
     return out.reshape(b, nq_tok, n_q, d).astype(q.dtype)
 
 
+def _ragged_stream_kernel(
+    pt_ref, vt_ref,  # scalar prefetch: [T, mp] per-token page tables,
+    # [T] per-token windows (one past last visible slot; 0 = dead lane)
+    q_ref, k_ref, v_ref, ks_ref, vs_ref,  # inputs
+    o_ref,  # output
+    m_scr, l_scr, acc_scr,  # scratch
+    *, scale: float, page_size: int, n_pages_grid: int, quant: bool,
+):
+    """One grid row per PACKED stream token: the serving megakernel.
+
+    Unlike `_paged_chunk_kernel` (one grid row per slot, W query lanes
+    masked per row), the stream carries only live query lanes — decode,
+    chunked-prefill, episode-observation and spec-verify tokens side by
+    side, each with its own page-table row and its own window
+    [0, vt_ref[ti]).  A token's cost is ceil(vt/ps) page-dots over rep
+    query heads; there are no dead in-row lanes to mask.  Stream slack
+    lanes (vt == 0) skip every page and emit exact zeros.
+
+    Init and finish are UNCONDITIONAL: a dead lane has zero `run`
+    iterations, so the final write must come from the initialized
+    scratch, not from compute."""
+    ti = pl.program_id(0)
+    pi = pl.program_id(2)
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    vt = vt_ref[ti]
+    run = (vt > 0) & (pi * page_size < vt)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [rep, d]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [ps, d]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quant:
+            k = k * ks_ref[0].astype(jnp.float32)
+            v = v * vs_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [rep, ps]
+        pos = pi * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        mask = pos < vt
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    @pl.when(pi == n_pages_grid - 1)
+    def _finish():
+        # Dead lanes (vt == 0) divide 0/1e-30 -> exact zeros, matching
+        # the XLA ragged fallback.
+        o_ref[0, 0] = (
+            acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@jax.jit
+def ragged_paged_attention_kernel(
+    q: jax.Array,  # [T, n_q, d] — packed token stream
+    k_pool: jax.Array,  # [P, ps, n_kv, d] — one layer's pool view
+    v_pool: jax.Array,
+    page_table_tok: jax.Array,  # [T, max_pages] int32 (sentinel >= P)
+    valid_to: jax.Array,  # [T] int32 — one past each token's window
+    k_scale: Optional[jax.Array] = None,  # [P, ps, n_kv] when int8
+    v_scale: Optional[jax.Array] = None,
+) -> jax.Array:
+    from jax.experimental.pallas import tpu as pltpu
+
+    t, n_q, d = q.shape
+    n_pool, ps, n_kv = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
+    mp = page_table_tok.shape[1]
+    rep = n_q // n_kv
+    quant = k_scale is not None
+    from areal_tpu.ops.attention import clamp_page_table
+
+    pt = clamp_page_table(page_table_tok, n_pool)
+    vt = jnp.broadcast_to(valid_to, (t,)).astype(jnp.int32)
+    qh = q.reshape(t, n_kv, rep, d)
+    if quant:
+        ks, vs = k_scale, v_scale
+    else:
+        ks = jnp.zeros((n_pool, ps, n_kv), jnp.bfloat16)
+        vs = ks
+
+    kern = functools.partial(
+        _ragged_stream_kernel,
+        scale=d**-0.5, page_size=ps, n_pages_grid=mp, quant=quant,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(t, n_kv, mp),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, rep, d), lambda ti, g, pi, pt, vt: (ti, g, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, ps, 1, d),
+                lambda ti, g, pi, pt, vt: (pt[ti, pi], 0, g, 0),
+            ),
+            pl.BlockSpec(
+                (1, ps, 1, d),
+                lambda ti, g, pi, pt, vt: (pt[ti, pi], 0, g, 0),
+            ),
+            pl.BlockSpec(
+                (1, ps, 1),
+                lambda ti, g, pi, pt, vt: (pt[ti, pi], 0, g),
+            ),
+            pl.BlockSpec(
+                (1, ps, 1),
+                lambda ti, g, pi, pt, vt: (pt[ti, pi], 0, g),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, rep, d), lambda ti, g, pi, pt, vt: (ti, g, 0, 0)
+        ),
+        scratch_shapes=[
+            _vmem((rep, 1), jnp.float32),
+            _vmem((rep, 1), jnp.float32),
+            _vmem((rep, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, n_kv, rep, d), jnp.float32),
+        interpret=_interpret(),
+    )(pt, vt, qh, k_pool, v_pool, ks, vs)
+    return out.reshape(t, n_q, d).astype(q.dtype)
+
+
 @jax.jit
 def paged_decode_attention_kernel(
     q: jax.Array,  # [B, 1, n_q, d]

@@ -94,6 +94,7 @@ def spec_accept(
     top_k: int = 0,
     top_p: float = 1.0,
     greedy: bool = False,
+    n_valid: Optional[jax.Array] = None,  # [B] int32 — live logit positions
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Exact speculative verification of K deterministic drafts.
 
@@ -108,13 +109,29 @@ def spec_accept(
     draft d w.p. p(d); on reject, resample from p with d's mass removed).
     Logps follow `sample_token`'s convention: the unwarped
     temperature-scaled distribution's log-density of the emitted token.
+
+    `n_valid` makes the verification RAGGED: row b only forwarded its
+    first n_valid[b] positions (pending + n_valid-1 drafts), so logits
+    past that are garbage — drafts at j >= n_valid-1 are treated as
+    rejected, which keeps the closing draw at a position < n_valid.
+    Truncating speculation early is always distribution-exact (it is
+    the K' = n_valid-1 instance of the same scheme); the serving chunk
+    uses this when its lane budget grants a row fewer than K+1 query
+    lanes.  Rows with n_valid == 0 return garbage the caller masks.
     """
     b, k1, v = logits.shape
     k = k1 - 1
     scaled = logits / jnp.maximum(temperature, 1e-6)
+    live_draft = None
+    if n_valid is not None and k > 0:
+        live_draft = (
+            jnp.arange(k)[None, :] < (n_valid - 1)[:, None]
+        )  # [B, K]
     if greedy:
         argm = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
         acc = drafts == argm[:, :k]  # [B, K]
+        if live_draft is not None:
+            acc = acc & live_draft
         n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
         # Closing token = argmax at the first rejected position (or bonus).
         close = jnp.take_along_axis(argm, n_acc[:, None], axis=1)[:, 0]
@@ -130,6 +147,8 @@ def spec_accept(
         key, k_acc, k_res = jax.random.split(key, 3)
         u_acc = jax.random.uniform(k_acc, (b, k))
         acc = u_acc < p_draft
+        if live_draft is not None:
+            acc = acc & live_draft
         n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
         # Closing draw at position n_acc: from the residual (draft masked
         # out) on rejection, from the untouched dist on the bonus position.

@@ -1461,6 +1461,13 @@ def main():
     p.add_argument("--no-kv-share-prefix", action="store_true",
                    help="disable copy-on-write prompt page sharing "
                         "(prefix cache) in the serving plane")
+    p.add_argument("--serving-admit-lanes", type=int, default=None,
+                   help="extra packed-stream query lanes above one-per-"
+                        "slot in the ragged serving chunk (0 = auto: "
+                        "4x the widest per-row q_len; or "
+                        "AREAL_SERVING_ADMIT_LANES). More lanes admit "
+                        "prompts faster per chunk at a wider compiled "
+                        "stream")
     p.add_argument("--token", default="",
                    help="shared secret (or AREAL_GEN_TOKEN)")
     p.add_argument("--zmq-port", type=int, default=None,
@@ -1502,6 +1509,7 @@ def main():
         kv_pool_pages=args.kv_pool_pages,
         prefill_chunk_tokens=args.prefill_chunk_tokens,
         kv_share_prefix=False if args.no_kv_share_prefix else None,
+        serving_admit_lanes=args.serving_admit_lanes,
     )
     server = GenerationServer(
         engine, host=args.host, port=args.port, token=args.token,

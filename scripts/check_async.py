@@ -1841,6 +1841,29 @@ def check_agents(n_episodes: int = 3) -> int:
             f"same-prompt episodes missed the prefix cache "
             f"(hits={eng.episode_prefix_hits}, want >= {n_episodes - 1})"
         )
+    # Ragged serving-path accounting: every episode admission and every
+    # tool-observation continuation is a ragged q_len row inside the
+    # serving chunk — the legacy standalone-prefill program must never
+    # fire in the turn loop, and the packed stream must never compute a
+    # misassigned live lane (dead lanes are eliminated, not masked).
+    if eng.prefill_dispatches != 0:
+        failures.append(
+            f"episode turn loop dispatched {eng.prefill_dispatches} "
+            f"legacy admit prefill(s), want 0: observations must ride "
+            f"the ragged serving path"
+        )
+    if eng.dead_live_lanes != 0:
+        failures.append(
+            f"packed stream computed {eng.dead_live_lanes} misassigned "
+            f"live lane(s), want exactly 0"
+        )
+    if not (eng.lanes_live > 0
+            and eng.lanes_live + eng.lanes_slack == eng.lanes_dispatched):
+        failures.append(
+            f"lane counters do not partition the dispatched stream: "
+            f"live={eng.lanes_live} slack={eng.lanes_slack} "
+            f"dispatched={eng.lanes_dispatched}"
+        )
 
     # ---- Leg 2: greedy identity vs single-shot replay ---------------
     # Every assistant turn must be token-identical to a fresh engine

@@ -44,6 +44,7 @@ INVARIANT_LEGS = (
     "stall_compare",
     "overlap_compare",
     "nan_chaos_compare",
+    "ragged_compare",
 )
 
 
@@ -80,6 +81,17 @@ RULES: Dict[str, MetricRule] = {
     # means sentinels or escalation thresholds changed behavior.
     "quarantined_steps": MetricRule("exact"),
     "quarantine_rollbacks": MetricRule("exact"),
+    # Ragged packed-stream legs (scripts/measure_paged.py --mode ragged):
+    # the workload is deterministic (greedy, min_new == max_new), so the
+    # lane accounting is structural, not noisy.  dead_live_lanes is the
+    # dead-lane-compute-eliminated contract (exactly 0); the stream must
+    # never widen past its compiled budget or lose occupancy.
+    "dead_live_lanes": MetricRule("exact"),
+    "lane_budget": MetricRule("max", abs_tol=0),
+    "masked_slab_lanes": MetricRule("max", abs_tol=0),
+    "lanes_dispatched": MetricRule("max", abs_tol=0),
+    "lane_occupancy": MetricRule("higher", rel_tol=0.05),
+    "prefill_dispatches": MetricRule("max", abs_tol=0),
 }
 
 
@@ -180,6 +192,7 @@ def default_baselines() -> List[str]:
         "bench_serving_cpu8_*.json",
         "bench_overlap_cpu8_*.json",
         "bench_nanchaos_cpu8_*.json",
+        "bench_ragged_cpu8_*.json",
     )
     out: List[str] = []
     for pat in pats:
@@ -194,7 +207,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--baseline", action="append", default=[],
                    help="baseline bench JSONL (repeatable; default: newest "
                         "committed bench_paged/bench_serving/bench_overlap/"
-                        "bench_nanchaos files)")
+                        "bench_nanchaos/bench_ragged files)")
     p.add_argument("--fresh", action="append", default=[],
                    help="fresh bench JSONL to gate (repeatable)")
     p.add_argument("--self-check", action="store_true",

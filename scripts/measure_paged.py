@@ -14,7 +14,7 @@ line with the contract metrics:
   - kv_pool_utilization: live tokens / allocated cache tokens (chunk-
                         averaged) — paged must be >= dense
 
-Two serving-plane legs ride along (--mode stall / sweep / all):
+Serving-plane legs ride along (--mode stall / sweep / ragged / all):
 
   - stall: the SAME oversubscribed workload traced twice — legacy
     two-program admit (prefill_chunk_tokens=0, a separate prefill
@@ -27,6 +27,16 @@ Two serving-plane legs ride along (--mode stall / sweep / all):
     prefix sharing the group's prompt pages are mapped once, so the
     same pool holds >= 3x as many concurrently live rows
     (peak_live_slots) at group size 8.
+  - ragged: packed-stream lane accounting for the fused ragged serving
+    chunk.  Three legs (plain K=0, spec K=2, int8) run the same
+    workload through the unified admit; each reports the lane counters
+    (lanes_dispatched / lanes_live / lanes_slack / dead_live_lanes)
+    plus the masked-slab lane count the legacy [n_slots, W] layout
+    would have paid.  The ragged_compare invariants: dead-lane compute
+    is exactly 0, one compiled program, zero standalone prefills, the
+    packed stream is strictly narrower than the slab, and greedy spec
+    output is token-identical to greedy plain (the argmax chain does
+    not care how tokens were grouped into drafts).
 
 Runs with AREAL_PAGING_CHECK=1 so every allocator transition is
 invariant-checked while the numbers are gathered.
@@ -37,7 +47,9 @@ Usage (from the repo root; takes a few minutes):
 
 The committed artifact is the stdout of one run, saved under a
 timestamped name (bench_paged_cpu8_<UTC>.log for the compare leg,
-bench_serving_cpu8_<UTC>.log for stall+sweep) and cited from PERF.md.
+bench_serving_cpu8_<UTC>.log for stall+sweep,
+bench_ragged_cpu8_<UTC>.log for the ragged lane legs) and cited from
+PERF.md.
 """
 
 import argparse
@@ -69,7 +81,7 @@ def main():
     ap.add_argument("--max-new", type=int, default=4096)
     ap.add_argument("--page-size", type=int, default=128)
     ap.add_argument("--mode", default="all",
-                    choices=("compare", "stall", "sweep", "all"))
+                    choices=("compare", "stall", "sweep", "ragged", "all"))
     ap.add_argument("--out", default=None,
                     help="also append JSON lines to this file")
     args = ap.parse_args()
@@ -221,6 +233,11 @@ def main():
                 "prefill_dispatches": eng.prefill_dispatches,
                 "admission_prefill_spans": n_prefill,
                 "admission_prefill_ms": round(prefill_us / 1000.0, 1),
+                # Packed-stream lane counters (0 on the two_program leg,
+                # which has no serving chunk).
+                "lanes_dispatched": eng.lanes_dispatched,
+                "lanes_live": eng.lanes_live,
+                "dead_live_lanes": eng.dead_live_lanes,
             })
             print(f"--- stall attribution: {name} ---", flush=True)
             print(trace_report.format_report(trace), flush=True)
@@ -244,12 +261,114 @@ def main():
                 and eng_a.prefill_dispatches == 0
             ),
             "serving_decode_compiles": eng_a.decode_compiles,
+            # Dead query lanes are ELIMINATED by the packed stream, not
+            # masked: a live lane assigned outside its row's grant would
+            # count here, and the contract is exactly zero.
+            "dead_query_lanes_zero": eng_a.dead_live_lanes == 0,
         })
         return (
             toks_equal
             and n_prefill_b > 0
             and n_prefill_a == 0
             and eng_a.decode_compiles == 1
+            and eng_a.dead_live_lanes == 0
+        )
+
+    def run_ragged():
+        """Ragged packed-stream lane accounting: plain / spec / int8
+        legs through the ONE unified serving admit, plus the invariant
+        leg the regression gate pins (dead-lane compute exactly 0)."""
+        rnew = min(args.max_new, 192)
+
+        def ragged_leg(name, spec_k, kv_dtype):
+            gg = GenerationHyperparameters(
+                n=1, max_new_tokens=rnew, min_new_tokens=rnew,
+                greedy=True, spec_decode_k=spec_k,
+            )
+            eng = GeneratorEngine(
+                cfg, params, mesh, eos_token_id=EOS, max_decode_batch=8,
+                kv_paged=True, kv_page_size=args.page_size,
+                kv_cache_dtype=kv_dtype,
+            )
+            t0 = time.time()
+            out = eng.generate(sample, MicroBatchSpec(), gg, inflight=True)
+            dt = time.time() - t0
+            gen_tokens = int(
+                sum(t for r in out.seqlens["packed_input_ids"] for t in r)
+            ) - sum(PROMPT_LENS)
+            # The masked-slab lane count the legacy [n_slots, W] layout
+            # pays per inner step, reconstructed the way the engine
+            # sizes its session.
+            n_slots = min(
+                max(eng.batch_shard, eng.max_decode_batch),
+                len(PROMPT_LENS),
+            )
+            while n_slots % eng.batch_shard:
+                n_slots += 1
+            slab = n_slots * max(eng.prefill_chunk_tokens, spec_k + 1)
+            emit({
+                "leg": f"ragged_{name}",
+                "prompts": len(PROMPT_LENS),
+                "max_new_tokens": rnew,
+                "spec_decode_k": spec_k,
+                "kv_cache_dtype": kv_dtype,
+                "gen_tokens": gen_tokens,
+                "wall_seconds": round(dt, 2),
+                "gen_tokens_per_sec": round(gen_tokens / dt, 1),
+                "decode_compiles": eng.decode_compiles,
+                "prefill_dispatches": eng.prefill_dispatches,
+                "lane_budget": eng.serving_lane_budget,
+                "masked_slab_lanes": slab,
+                "lanes_dispatched": eng.lanes_dispatched,
+                "lanes_live": eng.lanes_live,
+                "lanes_slack": eng.lanes_slack,
+                "dead_live_lanes": eng.dead_live_lanes,
+                "lane_occupancy": round(
+                    eng.lanes_live / max(1, eng.lanes_dispatched), 4
+                ),
+            })
+            return out, eng, slab
+
+        out_p, eng_p, slab_p = ragged_leg("plain", 0, "auto")
+        out_s, eng_s, slab_s = ragged_leg("spec", 2, "auto")
+        out_8, eng_8, slab_8 = ragged_leg("int8", 0, "int8")
+        legs = ((eng_p, slab_p), (eng_s, slab_s), (eng_8, slab_8))
+        toks_equal = bool(
+            np.array_equal(
+                np.asarray(out_p.data["packed_input_ids"]),
+                np.asarray(out_s.data["packed_input_ids"]),
+            )
+        )
+        emit({
+            "leg": "ragged_compare",
+            "greedy_spec_tokens_identical": toks_equal,
+            "dead_lane_compute_zero": all(
+                e.dead_live_lanes == 0 for e, _ in legs
+            ),
+            "decode_compiles_once": all(
+                e.decode_compiles == 1 for e, _ in legs
+            ),
+            "zero_standalone_prefills": all(
+                e.prefill_dispatches == 0 for e, _ in legs
+            ),
+            "lane_partition_holds": all(
+                e.lanes_live + e.lanes_slack == e.lanes_dispatched
+                for e, _ in legs
+            ),
+            "packed_narrower_than_slab": all(
+                e.serving_lane_budget < s for e, s in legs
+            ),
+        })
+        return (
+            toks_equal
+            and all(e.dead_live_lanes == 0 for e, _ in legs)
+            and all(e.decode_compiles == 1 for e, _ in legs)
+            and all(e.prefill_dispatches == 0 for e, _ in legs)
+            and all(
+                e.lanes_live + e.lanes_slack == e.lanes_dispatched
+                for e, _ in legs
+            )
+            and all(e.serving_lane_budget < s for e, s in legs)
         )
 
     def run_sweep():
@@ -314,6 +433,8 @@ def main():
         ok = run_stall() and ok
     if args.mode in ("sweep", "all"):
         ok = run_sweep() and ok
+    if args.mode in ("ragged", "all"):
+        ok = run_ragged() and ok
 
     if args.out:
         with open(args.out, "a") as f:

@@ -230,13 +230,11 @@ class TestGenerate:
         assert legacy.prefill_dispatches == 3
 
     def test_spec_admissions_are_batched(self, cfg, params, rng):
-        """Same contract on the speculative path (which previously also
-        paid one host sync per admission).  Spec retirement is not lockstep
-        (per-row draft acceptance varies), so bound the dispatch count
-        instead of pinning it: the first wave fills all 4 slots in ONE
-        dispatch, and each later wave admits every slot freed since the
-        last chunk — far fewer dispatches than the 8 a serial admission
-        loop would pay."""
+        """The strongest form of the contract on the speculative path:
+        spec rows are just ragged q_lens in the serving chunk, so
+        admission prefill happens INSIDE the one compiled program —
+        zero standalone prefill dispatches (a fortiori batched; the
+        old two-program spec admit paid one dispatch per wave)."""
         mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
         eng = GeneratorEngine(
             cfg, params, mesh, eos_token_id=EOS, max_decode_batch=4
@@ -247,7 +245,8 @@ class TestGenerate:
             spec_decode_k=2,
         )
         eng.generate(sample, MicroBatchSpec(), g)
-        assert 2 <= eng.prefill_dispatches < 8
+        assert eng.prefill_dispatches == 0
+        assert eng.decode_compiles == 1
 
     def test_weight_hotswap_changes_output(self, cfg, params, engine, rng):
         sample = _prompt_sample(rng, cfg, lens=(6,))
@@ -332,10 +331,16 @@ class TestInt8KVCache:
         b = np.asarray(out_full.data["packed_input_ids"])
         # A lossy cache may flip greedy argmax on near-ties — a tiny
         # random model's logits are nearly flat, so demand high (not
-        # perfect) agreement plus finite, well-formed outputs.
+        # perfect) agreement plus finite, well-formed outputs.  Chunked
+        # int8 admission scores in-prompt attention against the stored
+        # codes (quantize-once), so later prompt positions see the same
+        # quantization error decode sees — slightly more near-tie flips
+        # vs bf16 than the old full-precision one-shot prefill.  The
+        # exact contract is int8-serving == dense-int8-window, pinned
+        # by tests/test_paged_kv.py::test_int8_rides_serving_plane.
         assert a.shape == b.shape
         agree = float((a == b).mean())
-        assert agree >= 0.9, f"token agreement {agree:.2f}"
+        assert agree >= 0.85, f"token agreement {agree:.2f}"
         assert np.isfinite(
             np.asarray(out_q8.data["packed_logprobs"])
         ).all()

@@ -572,16 +572,62 @@ class TestServingPlaneEquivalence:
         assert out is not None and eng.resume_replays == 1
         _assert_same_output(ref, out)
 
-    def test_spec_path_keeps_two_program_admit(
-        self, cfg, params, mesh, rng
-    ):
-        """Documented degradation: speculative decoding does NOT ride
-        the serving plane (draft buffers make admission stateful) — it
-        keeps the legacy prefill-program admit, so a spec generate still
-        dispatches standalone prefills even with prefill_chunk_tokens
-        set.  If this starts failing because spec admissions became
-        chunked, delete this test and extend TestServingPlaneEquivalence
-        to the spec path instead."""
+    def test_spec_rides_serving_plane(self, cfg, params, mesh, rng):
+        """Speculative decoding is just another ragged q_len in the
+        serving chunk: a spec generate dispatches ZERO standalone
+        prefills, compiles exactly ONE program across continuous mixed
+        admits (5 requests, 2 slots), and its greedy output is token-
+        identical to the plain serving path — greedy speculation is the
+        argmax chain whatever the draft grouping."""
+        spec = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
+            kv_page_size=8, prefill_chunk_tokens=4, max_decode_batch=2,
+        )
+        plain = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
+            kv_page_size=8, prefill_chunk_tokens=4, max_decode_batch=2,
+        )
+        sample = _prompt_sample(rng, cfg, self.LENS)
+        gs = GenerationHyperparameters(
+            n=1, max_new_tokens=10, greedy=True, spec_decode_k=2
+        )
+        gp = GenerationHyperparameters(n=1, max_new_tokens=10, greedy=True)
+        osp = spec.generate(sample, MicroBatchSpec(), gs)
+        opl = plain.generate(sample, MicroBatchSpec(), gp, inflight=True)
+        _assert_same_output(osp, opl)
+        assert spec.prefill_dispatches == 0
+        assert spec.decode_compiles == 1
+        assert spec.cache_copy_bytes == 0
+
+    def test_int8_rides_serving_plane(self, cfg, params, mesh, rng):
+        """int8 KV rides the same chunked admission: token-identical to
+        the dense int8 window.  Chunk boundaries cannot shift the
+        numerics because fresh KV is quantized ONCE when first written
+        and every later chunk re-reads the stored codes — re-quantizing
+        a dequantized value is NOT idempotent, so the prefill emits
+        codes directly (models/transformer.py prefill quantize_kv)."""
+        dense = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=False,
+            max_decode_batch=2, kv_cache_dtype="int8",
+        )
+        serving = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
+            kv_page_size=8, prefill_chunk_tokens=4, max_decode_batch=2,
+            kv_cache_dtype="int8",
+        )
+        sample = _prompt_sample(rng, cfg, self.LENS)
+        g = GenerationHyperparameters(n=1, max_new_tokens=8, greedy=True)
+        od = dense.generate(sample, MicroBatchSpec(), g, inflight=True)
+        os_ = serving.generate(sample, MicroBatchSpec(), g, inflight=True)
+        _assert_same_output(od, os_)
+        assert serving.prefill_dispatches == 0
+        assert serving.decode_compiles == 1
+
+    def test_lane_accounting_dead_lanes_zero(self, cfg, params, mesh, rng):
+        """The packed stream's lane counters: every dispatched lane is
+        either live or budgeted slack (they partition T*steps), and the
+        live-but-misassigned count — a packing bug detector — is
+        exactly 0.  Dead query lanes are eliminated, not masked."""
         eng = GeneratorEngine(
             cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
             kv_page_size=8, prefill_chunk_tokens=4, max_decode_batch=2,
@@ -591,22 +637,116 @@ class TestServingPlaneEquivalence:
             n=1, max_new_tokens=10, greedy=True, spec_decode_k=2
         )
         eng.generate(sample, MicroBatchSpec(), g)
-        assert eng.prefill_dispatches > 0
+        assert eng.serving_lane_budget > 0
+        assert eng.lanes_dispatched > 0
+        assert 0 < eng.lanes_live <= eng.lanes_dispatched
+        assert eng.lanes_live + eng.lanes_slack == eng.lanes_dispatched
+        assert eng.dead_live_lanes == 0
 
-    def test_int8_keeps_two_program_admit(self, cfg, params, mesh, rng):
-        """int8 KV also keeps the legacy admit: chunked prefill would
-        score later prompt chunks against the quantized cache of earlier
-        ones, breaking the int8 bit-parity contract with the dense
-        window (see _generate_inflight)."""
+    def test_spec_without_serving_plane_is_rejected(
+        self, cfg, params, mesh, rng
+    ):
+        """The legacy two-program spec admit is gone: spec decoding over
+        the paged pool with the serving plane disabled must fail fast
+        with a clear message, not silently fall back."""
         eng = GeneratorEngine(
             cfg, params, mesh, eos_token_id=EOS, kv_paged=True,
-            kv_page_size=8, prefill_chunk_tokens=4, max_decode_batch=2,
-            kv_cache_dtype="int8",
+            kv_page_size=8, prefill_chunk_tokens=0, max_decode_batch=2,
         )
-        sample = _prompt_sample(rng, cfg, self.LENS)
-        g = GenerationHyperparameters(n=1, max_new_tokens=8, greedy=True)
-        eng.generate(sample, MicroBatchSpec(), g, inflight=True)
-        assert eng.prefill_dispatches > 0
+        sample = _prompt_sample(rng, cfg, (5,))
+        g = GenerationHyperparameters(
+            n=1, max_new_tokens=4, greedy=True, spec_decode_k=2
+        )
+        with pytest.raises(ValueError, match="serving plane"):
+            eng.generate(sample, MicroBatchSpec(), g)
+
+
+class TestRaggedStreamKernel:
+    """The fused ragged megakernel (`ragged_paged_attention_kernel`):
+    one grid over per-lane q_lens — decode, chunked-prefill, and
+    spec-verify lanes mixed in one stream — must match the XLA gather
+    fallback, contribute ZERO output for dead lanes (valid_to == 0:
+    the kernel's flash loop runs no KV blocks and the unconditional
+    finish normalises the empty accumulator to exact zeros), and obey
+    the sentinel page rule under poisoning."""
+
+    def _stream(self, rng):
+        n_pool, ps, n_kv, d, rep = 10, 8, 2, 16, 3
+        n_q = n_kv * rep
+        k = jnp.asarray(
+            rng.standard_normal((n_pool, ps, n_kv, d)), jnp.float32
+        )
+        v = jnp.asarray(
+            rng.standard_normal((n_pool, ps, n_kv, d)), jnp.float32
+        )
+        # 4 rows: decode (1 lane), prefill slice (4 lanes), spec verify
+        # (3 lanes), dead row (0 lanes) + 4 slack lanes -> T = 12.
+        pt = np.full((4, 3), n_pool, np.int32)
+        pt[0] = (0, 1, 2)
+        pt[1, :2] = (3, 4)
+        pt[2, 0] = 5
+        pt[3] = (6, 7, 8)
+        row_of = np.array([0, 1, 1, 1, 1, 2, 2, 2, 4, 4, 4, 4], np.int32)
+        pos = np.array([19, 9, 10, 11, 12, 2, 3, 4, 0, 0, 0, 0], np.int32)
+        live = row_of < 4
+        pt_tok = np.take(pt, np.minimum(row_of, 3), axis=0)
+        vt = np.where(live, pos + 1, 0).astype(np.int32)
+        q = jnp.asarray(
+            rng.standard_normal((12, n_q, d)), jnp.float32
+        )
+        return q, k, v, jnp.asarray(pt_tok), jnp.asarray(vt)
+
+    def test_kernel_matches_fallback_and_kills_dead_lanes(self, rng):
+        from areal_tpu.ops.attention import ragged_paged_attention
+        from areal_tpu.ops.pallas.paged_attention import (
+            ragged_paged_attention_kernel,
+        )
+
+        q, k, v, pt_tok, vt = self._stream(rng)
+        out_fb = ragged_paged_attention(q, k, v, pt_tok, vt)
+        out_kn = ragged_paged_attention_kernel(q, k, v, pt_tok, vt)
+        np.testing.assert_allclose(
+            np.asarray(out_fb), np.asarray(out_kn), rtol=2e-5, atol=2e-5
+        )
+        # Dead lanes (valid_to == 0): exact zeros from BOTH paths.
+        assert float(jnp.max(jnp.abs(out_fb[8:]))) == 0.0
+        assert float(jnp.max(jnp.abs(out_kn[8:]))) == 0.0
+
+    def test_sentinel_pages_add_no_mass(self, rng):
+        from areal_tpu.ops.pallas.paged_attention import (
+            ragged_paged_attention_kernel,
+        )
+
+        q, k, v, pt_tok, vt = self._stream(rng)
+        n_pool = k.shape[0]
+        k_bad = k.at[n_pool - 1].set(1e9)
+        v_bad = v.at[n_pool - 1].set(1e9)
+        out = ragged_paged_attention_kernel(q, k, v, pt_tok, vt)
+        out_bad = ragged_paged_attention_kernel(q, k_bad, v_bad, pt_tok, vt)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out_bad))
+
+    def test_int8_pool_parity(self, rng):
+        from areal_tpu.ops.attention import ragged_paged_attention
+        from areal_tpu.ops.pallas.paged_attention import (
+            ragged_paged_attention_kernel,
+        )
+
+        q, _, _, pt_tok, vt = self._stream(rng)
+        n_pool, ps, n_kv, d = 10, 8, 2, 16
+        r = np.random.default_rng(3)
+        k8 = jnp.asarray(r.integers(-127, 128, (n_pool, ps, n_kv, d)), jnp.int8)
+        v8 = jnp.asarray(r.integers(-127, 128, (n_pool, ps, n_kv, d)), jnp.int8)
+        ks = jnp.asarray(
+            np.abs(r.standard_normal((n_pool, ps, n_kv))) + 0.1, jnp.bfloat16
+        )
+        vs = jnp.asarray(
+            np.abs(r.standard_normal((n_pool, ps, n_kv))) + 0.1, jnp.bfloat16
+        )
+        o_fb = ragged_paged_attention(q, k8, v8, pt_tok, vt, ks, vs)
+        o_kn = ragged_paged_attention_kernel(q, k8, v8, pt_tok, vt, ks, vs)
+        np.testing.assert_allclose(
+            np.asarray(o_fb), np.asarray(o_kn), rtol=3e-5, atol=3e-5
+        )
 
 
 class TestGenServerBudgetValidation:
