@@ -112,6 +112,10 @@ class APIGenerateInput:
     # bitwise replay across runs is not guaranteed — batching follows
     # arrival timing).
     seed: Optional[int] = None
+    # Causal-lineage id minted at rollout dispatch; rides the transport
+    # (X-Areal-Trace header / ZMQ frame field) so the server's request
+    # spans and lineage stamps join the dispatcher's root.
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -205,7 +209,9 @@ class LLMAPIClient(BoundedAgenerateMixin):
         self.token = token or _os.environ.get("AREAL_GEN_TOKEN", "")
         self.max_inflight = max_inflight
 
-    def _post(self, path: str, payload: Dict) -> Dict:
+    def _post(
+        self, path: str, payload: Dict, trace_id: Optional[str] = None
+    ) -> Dict:
         import json as _json
         import urllib.error
         import urllib.request
@@ -213,6 +219,8 @@ class LLMAPIClient(BoundedAgenerateMixin):
         headers = {"Content-Type": "application/json"}
         if self.token:
             headers["X-Areal-Token"] = self.token
+        if trace_id:
+            headers["X-Areal-Trace"] = trace_id
         req = urllib.request.Request(
             self.url + path, data=_json.dumps(payload).encode(),
             headers=headers,
@@ -268,6 +276,7 @@ class LLMAPIClient(BoundedAgenerateMixin):
                 "stop": [list(s) for s in g.stop],
                 "seed": inp.seed,
             },
+            trace_id=inp.trace_id,
         )
         return APIGenerateOutput(
             qid=inp.qid,
@@ -320,6 +329,7 @@ class LLMAPIClient(BoundedAgenerateMixin):
         gconfig: GenerationHyperparameters,
         token_budget: int = 0,
         seed: int = 0,
+        trace_id: Optional[str] = None,
     ) -> Dict:
         return self._post(
             "/episode",
@@ -331,6 +341,7 @@ class LLMAPIClient(BoundedAgenerateMixin):
                 "token_budget": int(token_budget),
                 "seed": int(seed),
             },
+            trace_id=trace_id,
         )
 
     def episode_extend(self, episode_id: str, obs_ids) -> Dict:

@@ -30,7 +30,13 @@ fall more than 20% below its recent peak).
 Fleet signals available to rules: ``goodput`` (tokens/s summed over gen
 servers, rate of ``areal_gen_tokens_total`` between scrapes),
 ``staleness_p50`` / ``staleness_p99`` (from the
-``areal_replay_staleness`` histogram), ``queue_depth``,
+``areal_replay_staleness`` histogram), ``sample_e2e_p50`` /
+``sample_e2e_p99`` / ``sample_admit_p99`` (per-sample causal-lineage
+latencies: dispatch → train consumption and dispatch → replay
+admission, from the ``areal_sample_e2e_seconds`` /
+``areal_sample_admit_seconds`` histograms — e.g. ``warn:
+sample_e2e_p99 <= 30`` alerts when the slowest samples take more than
+30 s dispatch-to-train), ``queue_depth``,
 ``kv_utilization``, ``idle_frac``, ``version_skew`` (max-min serving
 weight version across gen servers), ``backpressure`` (rate of
 ``areal_rollout_backpressure_total``), ``in_flight``,
@@ -177,13 +183,17 @@ def _series_sum(samples, name: str) -> Optional[float]:
     return sum(vals) if vals else None
 
 
-def _staleness_quantile(samples, q: float) -> float:
+def _hist_quantile(samples, series: str, q: float) -> float:
     pts = [
         (float(labels["le"]), v)
         for n, labels, v in samples
-        if n == "areal_replay_staleness_bucket" and "le" in labels
+        if n == f"{series}_bucket" and "le" in labels
     ]
     return quantile_from_buckets(pts, q)
+
+
+def _staleness_quantile(samples, q: float) -> float:
+    return _hist_quantile(samples, "areal_replay_staleness", q)
 
 
 @dataclasses.dataclass
@@ -289,6 +299,17 @@ def fleet_signals(
         signals["staleness_p50"] = p50
     if not math.isnan(p99):
         signals["staleness_p99"] = p99
+    # Per-sample lineage latencies (seconds): dispatch -> train
+    # consumption and dispatch -> replay admission, from the replay
+    # buffer's stage histograms.  Absent until the first sample trains.
+    for sig, series, q in (
+        ("sample_e2e_p50", "areal_sample_e2e_seconds", 0.50),
+        ("sample_e2e_p99", "areal_sample_e2e_seconds", 0.99),
+        ("sample_admit_p99", "areal_sample_admit_seconds", 0.99),
+    ):
+        v = _hist_quantile(all_samples, series, q)
+        if not math.isnan(v):
+            signals[sig] = v
     bp = _series_sum(all_samples, "areal_rollout_backpressure_total")
     if bp is not None:
         signals["backpressure"] = bp
@@ -387,7 +408,8 @@ def render_table(rows: List[Dict[str, object]],
         if row.get("error"):
             lines.append(f"    !! {row['error']}")
     keys = (
-        "goodput", "staleness_p50", "staleness_p99", "queue_depth",
+        "goodput", "staleness_p50", "staleness_p99", "sample_e2e_p50",
+        "sample_e2e_p99", "sample_admit_p99", "queue_depth",
         "kv_utilization", "idle_frac", "version_skew", "backpressure",
         "pipeline_fill", "pipeline_bubble", "anomalies",
         "quarantine_streak", "push_rejected",

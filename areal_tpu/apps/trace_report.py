@@ -27,23 +27,42 @@ plus a per-step overlap fraction (how much of the stages' summed busy
 time ran concurrently — 0 under the barrier scheduler, > 0 once chunks
 of different stages execute at the same time).
 
+``--lineage`` switches to the causal-lineage view: joins the merged
+shards by ``trace_id`` (the ``lineage:*`` instant events every stage of
+the async-RL pipeline stamps) and renders one end-to-end timeline per
+sample — dispatched → first-token → generated → graded → admitted →
+trained — plus stage-transition p50/p99 and a staleness-vs-latency
+breakdown keyed on the admission-time weight-version lag.
+
+``--flight`` renders the flight-recorder dumps
+(``flightrec_<role>_<rank>.json``, written next to the shards when a
+fault trips) as one cross-process timeline of the last ``--window``
+seconds before the fault instant.  It reads the dumps directly — no
+merge, no validation — because the trace may be torn at exactly the
+moment you need this view.
+
 ``--json`` emits the report as one JSON object with a stable schema
 (``json_report``) instead of the human tables, for dashboards and the
 regression tooling:
 
-    {"version": 2,
+    {"version": 3,
      "rows": [{"step", "pid", "process", "window_us", "compute_us",
                "comms_us", "host_us", "idle_us"}, ...],
      "bubbles": [{"process", "step", "start_us", "dur_us",
                   "after_span", "before_span"}, ...],
      "pipeline": [{"step", "window_us", "overlap_frac",
                    "stages": [{"stage", "n_chunks", "busy_us", "fill",
-                               "bubble_us"}, ...]}, ...]}
+                               "bubble_us"}, ...]}, ...],
+     "lineage": {"summary": {"n", "complete", "in_flight", "failed",
+                             "rejected_stale", "orphans", "e2e_p50_us",
+                             "e2e_p99_us", "transitions", "staleness"},
+                 "traces": [{"trace_id", "qid", "root", "complete",
+                             "e2e_us", "version_lag", "stages"}, ...]}}
 
 ``version`` bumps on any breaking change; consumers must reject
-versions they don't know.  v2 is additive over v1: every v1 field is
-unchanged, ``pipeline`` is new (empty list when the trace has no
-``pipe:*`` spans, i.e. any non-pipelined run).
+versions they don't know.  v2 was additive over v1 (``pipeline``); v3
+is additive over v2: ``lineage`` is new (empty traces/zero counts when
+the trace carries no ``lineage:*`` events, i.e. any pre-lineage run).
 """
 
 import argparse
@@ -352,12 +371,232 @@ def format_report(trace, top: int = 5) -> str:
     return "\n".join(lines)
 
 
-# v2 is additive over v1: rows/bubbles unchanged, "pipeline" added.
-JSON_VERSION = 2
+# ---------------------------------------------------------------------------
+# causal lineage: join merged shards by trace_id into per-sample timelines
+# ---------------------------------------------------------------------------
+
+# The canonical stage order of the async-RL pipeline; transitions between
+# adjacent present stages are what the p50/p99 table reports.
+_LINEAGE_TRANSITIONS = (
+    ("dispatch", "first_token"),
+    ("first_token", "generated"),
+    ("generated", "graded"),
+    ("graded", "admitted"),
+    ("admitted", "trained"),
+)
+
+
+def _pctl(vals: List[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    vals = sorted(vals)
+    return float(vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))])
+
+
+def lineage_rows(trace) -> List[Dict[str, Any]]:
+    """-> one row per trace_id: {trace_id, qid, root, stages: {stage:
+    first_ts_us}, complete, e2e_us, version_lag}.  ``complete`` means
+    the sample's timeline runs dispatch → trained; ``version_lag`` is
+    the admission-time staleness the replay buffer stamped."""
+    by_tid: Dict[str, Dict[str, Any]] = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "i" or str(e.get("cat", "")) != "lineage":
+            continue
+        a = e.get("args") or {}
+        tid = str(a.get("trace_id", ""))
+        if not tid:
+            continue
+        row = by_tid.setdefault(
+            tid,
+            {
+                "trace_id": tid,
+                "qid": "",
+                "root": False,
+                "stages": {},
+                "version_lag": None,
+            },
+        )
+        stage = str(a.get("stage", ""))
+        ts = int(e.get("ts", 0))
+        if stage and (
+            stage not in row["stages"] or ts < row["stages"][stage]
+        ):
+            row["stages"][stage] = ts
+        if a.get("root"):
+            row["root"] = True
+        if a.get("qid") and not row["qid"]:
+            row["qid"] = str(a["qid"])
+        if stage == "admitted" and a.get("version_lag") is not None:
+            row["version_lag"] = int(a["version_lag"])
+    rows = []
+    for tid in sorted(by_tid):
+        row = by_tid[tid]
+        st = row["stages"]
+        row["complete"] = "dispatch" in st and "trained" in st
+        row["e2e_us"] = (
+            st["trained"] - st["dispatch"] if row["complete"] else None
+        )
+        rows.append(row)
+    return rows
+
+
+def lineage_summary(trace) -> Dict[str, Any]:
+    """Fleet view of the joined timelines: counts (complete / in-flight
+    at shutdown / failed / rejected / orphaned), end-to-end and
+    stage-transition p50/p99, and staleness-vs-latency keyed on the
+    admission version lag."""
+    rows = lineage_rows(trace)
+    complete = [r for r in rows if r["complete"]]
+    terminal = ("trained", "failed", "rejected_stale")
+    in_flight = [
+        r["trace_id"]
+        for r in rows
+        if r["root"] and not any(s in r["stages"] for s in terminal)
+    ]
+    transitions: Dict[str, Dict[str, float]] = {}
+    for a, b in _LINEAGE_TRANSITIONS:
+        deltas = [
+            float(r["stages"][b] - r["stages"][a])
+            for r in rows
+            if a in r["stages"] and b in r["stages"]
+        ]
+        if deltas:
+            transitions[f"{a}->{b}"] = {
+                "n": len(deltas),
+                "p50_us": _pctl(deltas, 0.5),
+                "p99_us": _pctl(deltas, 0.99),
+            }
+    e2e = [float(r["e2e_us"]) for r in complete]
+    by_lag: Dict[int, List[float]] = {}
+    for r in complete:
+        if r["version_lag"] is not None:
+            by_lag.setdefault(r["version_lag"], []).append(
+                float(r["e2e_us"])
+            )
+    return {
+        "n": len(rows),
+        "complete": len(complete),
+        "in_flight": len(in_flight),
+        "failed": sum(1 for r in rows if "failed" in r["stages"]),
+        "rejected_stale": sum(
+            1 for r in rows if "rejected_stale" in r["stages"]
+        ),
+        "orphans": [r["trace_id"] for r in rows if not r["root"]],
+        "e2e_p50_us": _pctl(e2e, 0.5),
+        "e2e_p99_us": _pctl(e2e, 0.99),
+        "transitions": transitions,
+        "staleness": [
+            {
+                "version_lag": lag,
+                "n": len(v),
+                "p50_us": _pctl(v, 0.5),
+                "p99_us": _pctl(v, 0.99),
+            }
+            for lag, v in sorted(by_lag.items())
+        ],
+    }
+
+
+def format_lineage(trace) -> str:
+    rows = lineage_rows(trace)
+    if not rows:
+        return (
+            "no lineage:* events in this trace (pre-lineage run, or the "
+            "dispatcher was not traced)"
+        )
+    s = lineage_summary(trace)
+    lines = [
+        f"{'trace_id':<22} {'qid':<14} {'lag':>3} {'e2e_ms':>9}  timeline"
+    ]
+    for r in rows:
+        order = sorted(r["stages"].items(), key=lambda kv: kv[1])
+        t0 = order[0][1]
+        tl = " -> ".join(
+            f"{st}@{(ts - t0) / 1000.0:.1f}ms" for st, ts in order
+        )
+        e2e = (
+            f"{r['e2e_us'] / 1000.0:9.1f}" if r["complete"] else
+            f"{'-':>9}"
+        )
+        lag = "-" if r["version_lag"] is None else str(r["version_lag"])
+        lines.append(
+            f"{r['trace_id']:<22} {r['qid']:<14} {lag:>3} {e2e}  {tl}"
+        )
+    lines.append("")
+    lines.append(
+        f"{s['n']} traces: {s['complete']} complete, "
+        f"{s['in_flight']} in-flight, {s['failed']} failed, "
+        f"{s['rejected_stale']} rejected stale, "
+        f"{len(s['orphans'])} orphaned; e2e p50 "
+        f"{s['e2e_p50_us'] / 1000.0:.1f} ms, p99 "
+        f"{s['e2e_p99_us'] / 1000.0:.1f} ms"
+    )
+    for name, t in s["transitions"].items():
+        lines.append(
+            f"  {name:<24} n={t['n']:<4} p50 {t['p50_us'] / 1000.0:8.1f} "
+            f"ms  p99 {t['p99_us'] / 1000.0:8.1f} ms"
+        )
+    for b in s["staleness"]:
+        lines.append(
+            f"  lag={b['version_lag']:<2} n={b['n']:<4} e2e p50 "
+            f"{b['p50_us'] / 1000.0:8.1f} ms  p99 "
+            f"{b['p99_us'] / 1000.0:8.1f} ms"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: cross-process timeline around the fault instant
+# ---------------------------------------------------------------------------
+
+
+def format_flight(trace_dir: str, window_s: float = 10.0) -> str:
+    """Render every flightrec dump in ``trace_dir`` as one merged
+    timeline of the last ``window_s`` seconds before the latest fault.
+    Reads the dumps directly — the trace itself may be torn at exactly
+    the moment this view matters."""
+    dumps = tracer.read_flight_dumps(trace_dir)
+    if not dumps:
+        return f"no flightrec_*.json dumps in {trace_dir}"
+    fault_us = max(int(d.get("t_dump_us", 0)) for d in dumps)
+    lo_us = fault_us - int(window_s * 1e6)
+    lines = [
+        f"{len(dumps)} flight dump(s); fault window: last "
+        f"{window_s:.1f}s before t={fault_us}us"
+    ]
+    for d in sorted(dumps, key=lambda d: int(d.get("t_dump_us", 0))):
+        lines.append(
+            f"  {d.get('role', '?')}_{d.get('rank', '?')} "
+            f"(pid {d.get('pid', '?')}): {d.get('reason', '?')} with "
+            f"{len(d.get('events', []))} ring events"
+        )
+    merged = []
+    for d in dumps:
+        who = f"{d.get('role', '?')}_{d.get('rank', '?')}"
+        for ev in d.get("events", []):
+            t = int(ev.get("t_us", 0))
+            if t >= lo_us:
+                merged.append((t, who, ev))
+    merged.sort(key=lambda x: x[0])
+    for t, who, ev in merged:
+        rest = {
+            k: v for k, v in ev.items() if k not in ("t_us", "kind")
+        }
+        detail = " ".join(f"{k}={v}" for k, v in sorted(rest.items()))
+        lines.append(
+            f"  {(t - fault_us) / 1e6:+9.3f}s {who:<16} "
+            f"{ev.get('kind', '?'):<10} {detail}"
+        )
+    return "\n".join(lines)
+
+
+# v3 is additive over v2: rows/bubbles/pipeline unchanged, "lineage"
+# added (see module docstring).
+JSON_VERSION = 3
 
 
 def json_report(trace, top: int = 5) -> Dict[str, Any]:
-    """Machine-readable report, schema v2 (see module docstring).  The
+    """Machine-readable report, schema v3 (see module docstring).  The
     internal ``_covered`` interval list is stripped from rows — it is an
     implementation detail of the precedence subtraction, not contract."""
     rows = [
@@ -369,6 +608,10 @@ def json_report(trace, top: int = 5) -> Dict[str, Any]:
         "rows": rows,
         "bubbles": bubbles(trace, top=top),
         "pipeline": pipeline_rows(trace),
+        "lineage": {
+            "summary": lineage_summary(trace),
+            "traces": lineage_rows(trace),
+        },
     }
 
 
@@ -386,14 +629,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     p.add_argument(
         "--json", action="store_true",
-        help="emit the stable v2 JSON report instead of tables",
+        help="emit the stable v3 JSON report instead of tables",
     )
     p.add_argument(
         "--pipeline", action="store_true",
         help="per-stage fill/overlap of the pipelined step executor "
         "(from pipe:* spans) instead of the stall tables",
     )
+    p.add_argument(
+        "--lineage", action="store_true",
+        help="per-sample causal timelines joined by trace_id "
+        "(dispatch -> ... -> trained) instead of the stall tables",
+    )
+    p.add_argument(
+        "--flight", action="store_true",
+        help="render flightrec_*.json dumps around the fault instant "
+        "(skips merge + validation: the trace may be torn)",
+    )
+    p.add_argument(
+        "--window", type=float, default=10.0,
+        help="seconds of flight-recorder history to render (--flight)",
+    )
     args = p.parse_args(argv)
+    if args.flight:
+        d = (
+            args.path
+            if os.path.isdir(args.path)
+            else os.path.dirname(os.path.abspath(args.path))
+        )
+        print(format_flight(d, window_s=args.window))
+        return 0
     if os.path.isdir(args.path):
         out = args.out or os.path.join(args.path, "trace.json")
         trace = tracer.merge_shards(args.path, out_path=out)
@@ -411,6 +676,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps(json_report(trace, top=args.top)))
     elif args.pipeline:
         print(format_pipeline(trace))
+    elif args.lineage:
+        print(format_lineage(trace))
     else:
         print(format_report(trace, top=args.top))
     return 0

@@ -39,6 +39,24 @@ Categories drive the stall-attribution report (apps/trace_report.py):
 ``compute`` (device math), ``comms`` (data/param movement and the waits
 on it), ``host`` (CPU-side work: data loading, grading).  Uncategorized
 spans are timeline-only; uncovered step time is reported as idle.
+
+Two planes ride on top of the span stream:
+
+- **Causal lineage**: :func:`new_trace_id` mints a per-sample id at
+  rollout dispatch; :func:`lineage` stamps ``lineage:<stage>`` instants
+  (dispatch/first_token/generated/graded/admitted/trained) carrying the
+  id through every process the sample touches, so ``trace_report
+  --lineage`` can join merged shards into per-sample end-to-end
+  timelines.  The dispatch stamp is the *root* (``root=True``);
+  :func:`validate_trace` rejects child events whose trace_id never
+  appears on a root.
+- **Flight recorder**: an always-on bounded ring of recent structured
+  events (span closures when tracing is enabled, plus explicit
+  :func:`flight_event` calls for dispatch decisions, breaker
+  transitions, quarantine verdicts, weight pushes — those record even
+  with ``AREAL_TRACE=0``).  It costs a deque append until a fault:
+  :func:`flight_dump` writes the ring as ``flightrec_<role>_<rank>.json``
+  next to the trace shards for ``trace_report --flight``.
 """
 
 import atexit
@@ -53,8 +71,14 @@ from typing import Any, Dict, List, Optional
 # 65536 absorbs many steps between flushes before dropping the oldest.
 _RING_CAP = 65536
 
+# Flight-recorder ring: process-wide, always on.  Appends are GIL-atomic
+# (no lock); 512 recent events is several seconds of fleet activity —
+# enough context around a fault instant without unbounded memory.
+_FLIGHT_CAP = 512
+
 _lock = threading.Lock()
 _buffers: List[collections.deque] = []  # every thread's ring, for flush
+_flight: collections.deque = collections.deque(maxlen=_FLIGHT_CAP)
 _tls = threading.local()
 
 _state: Dict[str, Any] = {
@@ -172,6 +196,15 @@ class _Span:
         if self.args:
             ev["args"] = self.args
         _buf().append(ev)
+        _flight.append(
+            {
+                "t_us": int(time.time() * 1e6),
+                "kind": "span",
+                "name": self.name,
+                "dur_us": ev["dur"],
+                "tid": ev["tid"],
+            }
+        )
         return False
 
 
@@ -274,6 +307,126 @@ def complete(
     _buf().append(ev)
 
 
+# ---------------- causal lineage ----------------
+
+
+def new_trace_id() -> str:
+    """Mint a per-sample lineage id (rollout dispatch is the root)."""
+    import uuid
+
+    return "tr-" + uuid.uuid4().hex[:16]
+
+
+def lineage(stage: str, trace_id: str, root: bool = False, **args) -> None:
+    """Stamp one lineage stage for ``trace_id`` in this process.
+
+    Emits a ``lineage:<stage>`` instant into the trace stream (when
+    enabled) so ``trace_report --lineage`` can join merged shards into a
+    per-sample timeline, AND always records the stamp in the flight ring
+    — a fault dump shows the victim's recent per-sample activity even
+    with AREAL_TRACE=0.  ``root=True`` marks the minting stage
+    (dispatch); every other stamp must share a root's trace_id or
+    validate_trace flags it as an orphan."""
+    if not trace_id:
+        return
+    if _state["enabled"]:
+        a = {"trace_id": trace_id, "stage": stage}
+        if root:
+            a["root"] = True
+        a.update(args)
+        _buf().append(
+            {
+                "ph": "i",
+                "name": f"lineage:{stage}",
+                "cat": "lineage",
+                "ts": time.monotonic_ns() // 1000,
+                "tid": threading.get_ident(),
+                "s": "t",
+                "args": a,
+            }
+        )
+    fe = {
+        "t_us": int(time.time() * 1e6),
+        "kind": "lineage",
+        "stage": stage,
+        "trace_id": trace_id,
+    }
+    fe.update(args)
+    _flight.append(fe)
+
+
+# ---------------- flight recorder ----------------
+
+
+def flight_event(kind: str, **fields) -> None:
+    """Record one structured event in the always-on flight ring (dispatch
+    decisions, breaker transitions, quarantine verdicts, weight pushes).
+    Costs one deque append; nothing is written until flight_dump()."""
+    fe = {"t_us": int(time.time() * 1e6), "kind": kind}
+    fe.update(fields)
+    _flight.append(fe)
+
+
+def flight_events() -> List[Dict[str, Any]]:
+    """Snapshot the flight ring (oldest first)."""
+    return list(_flight)
+
+
+def flight_dump(
+    reason: str,
+    role: Optional[str] = None,
+    rank: Optional[int] = None,
+    dir: Optional[str] = None,
+) -> Optional[str]:
+    """Dump the flight ring as ``flightrec_<role>_<rank>.json`` next to
+    the trace shards.  Called from fault paths (worker death, quarantine
+    escalation, checksum-rejected push, chaos kill).  role/rank default
+    to the tracer identity; dir falls back to the configured trace dir
+    then AREAL_TRACE_DIR.  Returns the path, or None when no dump
+    location is known."""
+    d = dir or _state["dir"] or os.environ.get("AREAL_TRACE_DIR")
+    if not d:
+        return None
+    role = role if role is not None else (_state["role"] or "proc")
+    rank = rank if rank is not None else _state["rank"]
+    path = os.path.join(d, f"flightrec_{role}_{rank}.json")
+    doc = {
+        "role": str(role),
+        "rank": int(rank),
+        "pid": os.getpid(),
+        "reason": str(reason),
+        "t_dump_us": int(time.time() * 1e6),
+        "events": list(_flight),
+    }
+    try:
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f, default=_json_default)
+    except OSError:
+        return None
+    return path
+
+
+def read_flight_dumps(trace_dir: str) -> List[Dict[str, Any]]:
+    """Load every ``flightrec_*.json`` in ``trace_dir`` (unparseable or
+    torn dumps are skipped)."""
+    import glob
+
+    dumps = []
+    for path in sorted(
+        glob.glob(os.path.join(trace_dir, "flightrec_*.json"))
+    ):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) and isinstance(doc.get("events"), list):
+            doc["path"] = path
+            dumps.append(doc)
+    return dumps
+
+
 # ---------------- flush / shard IO ----------------
 
 
@@ -352,6 +505,7 @@ def _reset_for_tests() -> None:
         )
         for b in _buffers:
             b.clear()
+        _flight.clear()
 
 
 atexit.register(flush)
@@ -474,6 +628,46 @@ def validate_trace(trace: Dict[str, Any]) -> List[str]:
             errors.append(f"event {i} ({e.get('name')}): bad dur")
         if ph == "C" and not isinstance(e.get("args"), dict):
             errors.append(f"event {i} ({e.get('name')}): counter sans args")
+        if len(errors) > 20:
+            errors.append("... (truncated)")
+            break
+    if len(errors) <= 20:
+        errors.extend(_validate_lineage(evs))
+    return errors
+
+
+def _validate_lineage(evs: List[Dict[str, Any]]) -> List[str]:
+    """Lineage frame checks: every ``lineage:*`` event carries string
+    trace_id/stage args, and any event stamped with a trace_id (lineage
+    instants and request spans alike) must share a trace_id that appears
+    on a root (``root=True``) lineage event somewhere in the merged
+    trace — an orphan child means a broken propagation path."""
+    errors: List[str] = []
+    roots = set()
+    stamped = []  # (index, event, trace_id)
+    for i, e in enumerate(evs):
+        args = e.get("args")
+        if not isinstance(args, dict):
+            continue
+        tid = args.get("trace_id")
+        name = e.get("name")
+        is_lineage = isinstance(name, str) and name.startswith("lineage:")
+        if is_lineage:
+            if not isinstance(tid, str) or not tid:
+                errors.append(f"event {i} ({name}): lineage sans trace_id")
+                continue
+            if not isinstance(args.get("stage"), str):
+                errors.append(f"event {i} ({name}): lineage sans stage")
+            if args.get("root"):
+                roots.add(tid)
+        if isinstance(tid, str) and tid:
+            stamped.append((i, name, tid))
+    for i, name, tid in stamped:
+        if tid not in roots:
+            errors.append(
+                f"event {i} ({name}): orphan trace_id {tid!r} "
+                f"(no root lineage event)"
+            )
         if len(errors) > 20:
             errors.append("... (truncated)")
             break

@@ -149,7 +149,9 @@ def _normalize_item(item: Dict[str, Any]):
     if isinstance(payload, dict):
         return task, text, payload
     payload = {
-        k: v for k, v in item.items() if k not in ("task", "text")
+        k: v
+        for k, v in item.items()
+        if k not in ("task", "text", "trace_id")
     }
     if payload and not _legacy_schema_warned:
         _legacy_schema_warned = True
@@ -164,8 +166,11 @@ def _normalize_item(item: Dict[str, Any]):
 def grade_item(item: Dict[str, Any]) -> bool:
     """Grade one item via the verifier registry — the single dispatch
     shared by the FaaS handler, the RemoteVerifier local fallback, and
-    the in-process reward fabric."""
+    the in-process reward fabric.  An item carrying a ``trace_id`` gets
+    a per-backend grade span plus a ``graded`` lineage stamp, joining
+    verification into the sample's causal timeline."""
     task, text, payload = _normalize_item(item)
+    trace_id = str(item.get("trace_id") or "")
     fn = _VERIFIERS.get(task)
     if fn is None:
         if task not in _unknown_tasks_warned:
@@ -174,8 +179,17 @@ def grade_item(item: Dict[str, Any]) -> bool:
                 f"no verifier backend for task {task!r} "
                 f"(registered: {verifier_names()}); reward 0"
             )
+        if trace_id:
+            tracer.lineage(
+                "graded", trace_id, task=task, passed=False,
+                backend="missing",
+            )
         return False
-    return bool(fn(text, payload))
+    with tracer.span(f"grade:{task}", cat="host", task=task):
+        ok = bool(fn(text, payload))
+    if trace_id:
+        tracer.lineage("graded", trace_id, task=task, passed=ok)
+    return ok
 
 
 # Pre-registry name, kept for existing call sites.

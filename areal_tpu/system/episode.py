@@ -49,7 +49,7 @@ from areal_tpu.api.model_api import (
     GenerationHyperparameters,
     SlotGoneError,
 )
-from areal_tpu.base import logging, metrics
+from areal_tpu.base import logging, metrics, tracer
 from areal_tpu.base.faults import FaultError, FaultInjector
 from areal_tpu.system.replay import Trajectory
 
@@ -460,11 +460,16 @@ class ServerEpisodeClient:
         gconfig: GenerationHyperparameters,
         token_budget: int = 0,
         seed: int = 0,
+        trace_id: Optional[str] = None,
     ):
         self.api = api_client
         self.gconfig = gconfig
         self.token_budget = int(token_budget)
         self.seed = int(seed)
+        # Rides every turn of the episode to the server (HTTP header /
+        # ZMQ frame), keeping the whole multi-turn conversation on one
+        # causal timeline.
+        self.trace_id = trace_id
         self._last_version = 0
 
     def version(self) -> int:
@@ -475,10 +480,15 @@ class ServerEpisodeClient:
         return out
 
     def start(self, ep_id: str, prompt_ids: Sequence[int]) -> Dict:
+        kw: Dict[str, Any] = {}
+        if self.trace_id:
+            # Only plumbed when set — duck-typed clients predating the
+            # lineage plane keep working without the kwarg.
+            kw["trace_id"] = self.trace_id
         return self._note_version(
             self.api.episode_start(
                 ep_id, prompt_ids, self.gconfig,
-                token_budget=self.token_budget, seed=self.seed,
+                token_budget=self.token_budget, seed=self.seed, **kw,
             )
         )
 
@@ -551,13 +561,24 @@ class EpisodeController:
     # -- the loop ---------------------------------------------------------
 
     def run_episode(
-        self, episode_id: str, prompt_ids: Sequence[int]
+        self,
+        episode_id: str,
+        prompt_ids: Sequence[int],
+        trace_id: Optional[str] = None,
     ) -> Episode:
         ep = Episode(episode_id=episode_id, prompt_ids=list(prompt_ids))
+        # Child spans carry the trace_id only when the dispatcher minted
+        # one — an untraced in-process episode emits plain spans.
+        targs: Dict[str, Any] = (
+            {"trace_id": trace_id} if trace_id else {}
+        )
         _M_ACTIVE.inc()
         try:
             v0 = self.client.version()
-            out = self.client.start(episode_id, prompt_ids)
+            with tracer.span(
+                "episode_turn", episode_id=episode_id, turn=0, **targs
+            ):
+                out = self.client.start(episode_id, prompt_ids)
             while True:
                 reason = str(out.get("stop_reason", ""))
                 ep.turns.append(
@@ -609,7 +630,13 @@ class EpisodeController:
                     )
                 )
                 v0 = self.client.version()
-                out = self._extend_or_readmit(ep, obs)
+                with tracer.span(
+                    "episode_turn",
+                    episode_id=episode_id,
+                    turn=len(ep.turns),
+                    **targs,
+                ):
+                    out = self._extend_or_readmit(ep, obs)
         finally:
             _M_ACTIVE.dec()
             try:
@@ -636,17 +663,23 @@ def make_episode_runner(
     runs one full episode against it (slot pinning means the whole
     episode stays on that server)."""
 
-    def run(api_client: Any, qid: str, prompt_ids: Sequence[int]) -> Episode:
+    def run(
+        api_client: Any,
+        qid: str,
+        prompt_ids: Sequence[int],
+        trace_id: Optional[str] = None,
+    ) -> Episode:
         controller = EpisodeController(
             ServerEpisodeClient(
-                api_client, gconfig, token_budget=token_budget, seed=seed
+                api_client, gconfig, token_budget=token_budget, seed=seed,
+                trace_id=trace_id,
             ),
             tools,
             parse_tool_call,
             encode_observation,
             max_turns=max_turns,
         )
-        return controller.run_episode(qid, prompt_ids)
+        return controller.run_episode(qid, prompt_ids, trace_id=trace_id)
 
     return run
 

@@ -120,6 +120,10 @@ class _Pending:
     # Enqueue timestamp (monotonic ns) — the request lifetime span in the
     # trace runs from here to completion, covering queue + batch-merge wait.
     t_enq: Optional[int] = None
+    # Causal-lineage id carried over the transport (X-Areal-Trace header
+    # / ZMQ frame field); stamps the request span + lineage instants so
+    # the sample joins its dispatcher's root in the merged trace.
+    trace_id: Optional[str] = None
 
 
 def _gkey(p: _Pending):
@@ -194,6 +198,10 @@ class GenerationServer:
         # A kill fault tears down WITHOUT deregistering (a preempted node
         # runs no graceful teardown; its announcement expires by TTL).
         self._crashed = False
+        # episode_id -> trace_id: extend/release turns join the lineage
+        # root their start op carried (ops on one episode are serialized
+        # by the controller, so plain dict ops under the GIL suffice).
+        self._episode_traces: Dict[str, str] = {}
 
         srv = self
 
@@ -234,6 +242,11 @@ class GenerationServer:
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n))
+                    # Trace context rides the header so any client (or a
+                    # proxy) can stamp it without touching the body.
+                    trace_hdr = self.headers.get("X-Areal-Trace")
+                    if trace_hdr and isinstance(req, dict):
+                        req.setdefault("trace_id", trace_hdr)
                     if self.path == "/generate":
                         self._send(200, srv._handle_generate(req))
                     elif self.path == "/episode":
@@ -317,6 +330,13 @@ class GenerationServer:
             if self._faults.kill_due():
                 logger.warning("FAULT kill: crashing the generation server")
                 self._crashed = True
+                # Black-box dump: the ring holds the victim's last
+                # dispatches/spans — the post-mortem a preempted node
+                # otherwise takes to its grave.
+                tracer.flight_event("kill", port=self.port)
+                tracer.flight_dump(
+                    "fault_kill", role="gen_server", rank=self.port
+                )
                 self.close()
                 return
             self._stop.wait(0.05)
@@ -398,6 +418,10 @@ class GenerationServer:
                         done=threading.Event(),
                         seed=req.get("seed"),
                         t_enq=time.monotonic_ns(),
+                        trace_id=(
+                            str(req["trace_id"])
+                            if req.get("trace_id") else None
+                        ),
                     )
                     self._queue.put(p)
                     jobs.append((ident, rid, p))
@@ -605,7 +629,20 @@ class GenerationServer:
             params = integrity.corrupt_params(params)
         with self._update_mutex:
             if checksum is not None:
-                integrity.verify_checksum(params, checksum)
+                try:
+                    integrity.verify_checksum(params, checksum)
+                except integrity.WeightChecksumError:
+                    # A corrupted push is a fault instant: dump the ring
+                    # so the post-mortem shows what this server was doing
+                    # when the bad payload arrived.
+                    tracer.flight_event(
+                        "push_rejected", port=self.port,
+                        version=self.version,
+                    )
+                    tracer.flight_dump(
+                        "push_rejected", role="gen_server", rank=self.port
+                    )
+                    raise
             self.pause()
             try:
                 with self._engine_lock:
@@ -655,6 +692,7 @@ class GenerationServer:
             done=threading.Event(),
             seed=(int(req["seed"]) if req.get("seed") is not None else None),
             t_enq=time.monotonic_ns(),
+            trace_id=(str(req["trace_id"]) if req.get("trace_id") else None),
         )
         self._queue.put(p)
         while not p.done.wait(timeout=1.0):
@@ -692,9 +730,21 @@ class GenerationServer:
         ep_id = str(req.get("episode_id", ""))
         if not ep_id:
             raise ValueError("episode op needs a non-empty episode_id")
+        # Lineage: the start op carries the trace_id (header/frame); later
+        # turns on this episode inherit it from the per-episode store.
+        trace_id = str(req["trace_id"]) if req.get("trace_id") else None
+        if op == "start" and trace_id:
+            self._episode_traces[ep_id] = trace_id
+        elif trace_id is None:
+            trace_id = self._episode_traces.get(ep_id)
         if op == "release":
+            self._episode_traces.pop(ep_id, None)
             with self._engine_lock:
                 released = bool(eng.episode_release(ep_id))
+            if trace_id:
+                tracer.lineage(
+                    "turn", trace_id, episode_id=ep_id, op="release"
+                )
             return {
                 "episode_id": ep_id,
                 "released": released,
@@ -740,10 +790,20 @@ class GenerationServer:
                     self._engine_lock.release()
         except SlotGoneError:
             _M_EPISODE_SLOT_LOST.inc()
+            self._episode_traces.pop(ep_id, None)
             raise
         out = dict(out)
         out["version"] = version
         out["version_start"] = version_start
+        if trace_id:
+            tracer.lineage(
+                "turn",
+                trace_id,
+                episode_id=ep_id,
+                op=op,
+                stop_reason=str(out.get("stop_reason", "")),
+                version=version,
+            )
         return out
 
     def _handle_update(self, req: Dict) -> Dict:
@@ -883,14 +943,19 @@ class GenerationServer:
                 (time.monotonic_ns() - p.t_enq) / 1e9
             )
         if p.t_enq is not None:
-            tracer.complete(
-                f"request:{p.qid}",
-                start_ns=p.t_enq,
+            args = dict(
                 qid=p.qid,
                 n=p.gconfig.n,
                 prompt_len=len(p.prompt_ids),
                 error=True,
             )
+            if p.trace_id:
+                args["trace_id"] = p.trace_id
+            tracer.complete(
+                f"request:{p.qid}", start_ns=p.t_enq, **args
+            )
+        if p.trace_id:
+            tracer.lineage("failed", p.trace_id, qid=p.qid, error=msg)
         p.done.set()
 
     def _run_subgroup(self, group: List[_Pending]):
@@ -927,6 +992,11 @@ class GenerationServer:
                 locked = True
                 try:
                     version_start = self.version
+                    for p in group:
+                        if p.trace_id:
+                            tracer.lineage(
+                                "first_token", p.trace_id, qid=p.qid
+                            )
                     out = self.engine.generate(
                         sample, MicroBatchSpec(), g, seed=seed
                     )
@@ -963,12 +1033,22 @@ class GenerationServer:
                     _M_REQUEST_SECONDS.observe(
                         (time.monotonic_ns() - p.t_enq) / 1e9
                     )
-                    tracer.complete(
-                        f"request:{p.qid}",
-                        start_ns=p.t_enq,
+                    args = dict(
                         qid=p.qid,
                         n=p.gconfig.n,
                         prompt_len=len(p.prompt_ids),
+                        error=bool(p.error),
+                    )
+                    if p.trace_id:
+                        args["trace_id"] = p.trace_id
+                    tracer.complete(
+                        f"request:{p.qid}", start_ns=p.t_enq, **args
+                    )
+                if p.trace_id:
+                    tracer.lineage(
+                        "generated",
+                        p.trace_id,
+                        qid=p.qid,
                         error=bool(p.error),
                     )
                 p.done.set()
@@ -1218,6 +1298,7 @@ class ZMQGenClient(BoundedAgenerateMixin):
                 "prompt_ids": list(map(int, inp.prompt_ids)),
                 "gconfig": dataclasses.asdict(inp.gconfig),
                 "seed": inp.seed,
+                "trace_id": inp.trace_id,
             }
             for inp in inps
         ]
@@ -1268,6 +1349,7 @@ class ZMQGenClient(BoundedAgenerateMixin):
         gconfig: GenerationHyperparameters,
         token_budget: int = 0,
         seed: int = 0,
+        trace_id: Optional[str] = None,
     ) -> Dict:
         return self._episode_call(
             {
@@ -1277,6 +1359,7 @@ class ZMQGenClient(BoundedAgenerateMixin):
                 "gconfig": dataclasses.asdict(gconfig),
                 "token_budget": int(token_budget),
                 "seed": int(seed),
+                "trace_id": trace_id,
             }
         )
 

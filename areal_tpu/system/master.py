@@ -602,6 +602,15 @@ class MasterWorker:
             "max_recoveries": self.max_recoveries,
         }
         logger.error(f"FAULT_REPORT {json.dumps(report, sort_keys=True)}")
+        # Flight recorder: preserve the last seconds of structured events
+        # around the death — the ring is cheap to keep and priceless now.
+        tracer.flight_event(
+            "worker_dead",
+            worker_id=err.worker_id,
+            reason=err.reason,
+            step=self.step_info.global_step,
+        )
+        tracer.flight_dump("worker_dead", role="master", rank=0)
         if self._recoveries > self.max_recoveries:
             raise RuntimeError(
                 f"recovery budget exhausted ({self.max_recoveries}): "
@@ -672,6 +681,12 @@ class MasterWorker:
                 sort_keys=True,
             )
         )
+        tracer.flight_event(
+            "quarantine",
+            step=self.step_info.global_step,
+            verdict=verdict,
+            consecutive=self._consecutive_quarantines,
+        )
         return True
 
     async def _quarantine_rollback(self) -> None:
@@ -694,6 +709,12 @@ class MasterWorker:
             "max_recoveries": self.max_recoveries,
         }
         logger.error(f"FAULT_REPORT {json.dumps(report, sort_keys=True)}")
+        tracer.flight_event(
+            "quarantine_escalation",
+            step=self.step_info.global_step,
+            consecutive=self._consecutive_quarantines,
+        )
+        tracer.flight_dump("quarantine_rollback", role="master", rank=0)
         if self._recoveries > self.max_recoveries:
             raise RuntimeError(
                 f"recovery budget exhausted ({self.max_recoveries}): "
@@ -932,6 +953,12 @@ class MasterWorker:
         traj = self.replay.get_batch(1, timeout=0)[0]
         await self._flush_replay_drops()
         staleness = traj.staleness(self._trainer_version)
+        tracer.flight_event(
+            "train_chunk",
+            qid=traj.qid,
+            staleness=traj.staleness(self._trainer_version),
+            version=self._trainer_version,
+        )
         results.update(traj.data["stats"])
         rest = [n for n in self.dfg.nodes if n not in self._source_nodes]
         await asyncio.gather(*[self._run_mfc(n, results) for n in rest])

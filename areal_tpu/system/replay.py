@@ -53,6 +53,20 @@ _M_EVENTS = _reg.counter(
     "dropped_stale / consumed",
     ("event",),
 )
+# Per-sample pipeline latencies, measured from the dispatch stamp the
+# rollout controller mints (monotonic).  The e2e histogram backs the
+# sample_e2e_p50/p99 fleet signals in apps/metrics_report.py.
+_LAT_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 120.0)
+_M_E2E = _reg.histogram(
+    "areal_sample_e2e_seconds",
+    "dispatch -> train-consumption wall time per trajectory",
+    buckets=_LAT_BUCKETS,
+)
+_M_ADMIT = _reg.histogram(
+    "areal_sample_admit_seconds",
+    "dispatch -> replay-admission wall time per trajectory",
+    buckets=_LAT_BUCKETS,
+)
 
 
 @dataclasses.dataclass
@@ -77,6 +91,11 @@ class Trajectory:
     # Arbitrary payload (e.g. the reward row, or a prebuilt
     # SequenceSample) — the buffer never inspects it.
     data: Any = None
+    # Causal lineage: the trace_id minted at rollout dispatch ("" = not
+    # part of a lineage capture) and the monotonic dispatch timestamp
+    # the per-sample latency histograms measure from (0.0 = unknown).
+    trace_id: str = ""
+    t_dispatch: float = 0.0
 
     def staleness(self, trainer_version: int) -> int:
         return trainer_version - self.version_start
@@ -159,12 +178,23 @@ class ReplayBuffer:
                     del self._entries[:n]
                     self.consumed += n
                     _M_EVENTS.labels("consumed").inc(n)
+                    now = time.monotonic()
                     for t in out:
                         # Per-group retirement stamp + the staleness the
                         # trainer actually trains on — the distribution
                         # the staleness_p99 SLO watches.
                         t.retired_version = self._version
                         _M_STALENESS.observe(t.staleness(self._version))
+                        if t.t_dispatch:
+                            _M_E2E.observe(max(0.0, now - t.t_dispatch))
+                        if t.trace_id:
+                            tracer.lineage(
+                                "trained",
+                                t.trace_id,
+                                qid=t.qid,
+                                staleness=t.staleness(self._version),
+                                trainer_version=self._version,
+                            )
                     self._emit_gauges_locked()
                     return out
                 if deadline is not None:
@@ -218,6 +248,13 @@ class ReplayBuffer:
             if traj.staleness(self._version) > self.max_head_offpolicyness:
                 self.rejected += 1
                 _M_EVENTS.labels("rejected").inc()
+                if traj.trace_id:
+                    tracer.lineage(
+                        "rejected_stale",
+                        traj.trace_id,
+                        qid=traj.qid,
+                        version_lag=traj.staleness(self._version),
+                    )
                 self._emit_gauges_locked()
                 if strict:
                     raise StaleTrajectoryError(
@@ -238,6 +275,18 @@ class ReplayBuffer:
             self._entries.append(traj)
             self.accepted += 1
             _M_EVENTS.labels("accepted").inc()
+            if traj.t_dispatch:
+                _M_ADMIT.observe(
+                    max(0.0, time.monotonic() - traj.t_dispatch)
+                )
+            if traj.trace_id:
+                tracer.lineage(
+                    "admitted",
+                    traj.trace_id,
+                    qid=traj.qid,
+                    version_lag=traj.staleness(self._version),
+                    version_start=traj.version_start,
+                )
             self._emit_gauges_locked()
             self._cond.notify_all()
             return True

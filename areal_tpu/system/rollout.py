@@ -53,6 +53,7 @@ rides along so fleet churn is observable across restarts.
 
 import asyncio
 import dataclasses
+import inspect
 import time
 from typing import (
     Any,
@@ -186,6 +187,19 @@ class RolloutController:
         self.breaker_threshold = breaker_threshold
         self.breaker_cooldown_s = breaker_cooldown_s
         self.episode_runner = episode_runner
+        # Lineage: pass trace_id through to the runner only when its
+        # signature can take it — external runners predating the causal
+        # lineage plane keep working unchanged.
+        self._runner_takes_trace = False
+        if episode_runner is not None:
+            try:
+                sig = inspect.signature(episode_runner)
+                self._runner_takes_trace = "trace_id" in sig.parameters or any(
+                    p.kind == inspect.Parameter.VAR_KEYWORD
+                    for p in sig.parameters.values()
+                )
+            except (TypeError, ValueError):
+                pass
         self.stat = RolloutStat()
         # Prompts consumed from the data stream since trial start
         # (persisted via state_dict -> RecoverInfo).
@@ -268,6 +282,7 @@ class RolloutController:
                     if s.breaker.state == CircuitBreaker.OPEN
                 )
             )
+            tracer.flight_event("breaker", state=state)
 
         return CircuitBreaker(
             threshold=self.breaker_threshold,
@@ -551,7 +566,9 @@ class RolloutController:
             yield batch[0]
             yielded += 1
 
-    async def _generate_with_retries(self, qid: str, prompt_ids: List[int]):
+    async def _generate_with_retries(
+        self, qid: str, prompt_ids: List[int], trace_id: str = ""
+    ):
         """Dispatch with deadline + bounded redispatch.  Each failure
         excludes the observed-failing server for this prompt, records a
         breaker failure, and backs off exponentially; returns the output
@@ -570,12 +587,28 @@ class RolloutController:
                 # chosen server's serving weights — a persistently
                 # positive gauge means weight sync is falling behind.
                 self._m_version_lag.set(self.replay.version - int(srv_version))
+            tracer.flight_event(
+                "dispatch",
+                trace_id=trace_id,
+                qid=qid,
+                sid=srv.sid,
+                attempt=attempt,
+            )
             err = reason = None
             try:
                 if self.episode_runner is not None:
-                    coro = asyncio.to_thread(
-                        self.episode_runner, srv.client, qid, prompt_ids
-                    )
+                    if self._runner_takes_trace:
+                        coro = asyncio.to_thread(
+                            self.episode_runner,
+                            srv.client,
+                            qid,
+                            prompt_ids,
+                            trace_id=trace_id or None,
+                        )
+                    else:
+                        coro = asyncio.to_thread(
+                            self.episode_runner, srv.client, qid, prompt_ids
+                        )
                 else:
                     coro = srv.client.agenerate(
                         APIGenerateInput(
@@ -583,6 +616,7 @@ class RolloutController:
                             prompt_ids=prompt_ids,
                             gconfig=self.gconfig,
                             seed=self.seed,
+                            trace_id=trace_id or None,
                         )
                     )
                 if self.dispatch_timeout_s > 0:
@@ -618,6 +652,19 @@ class RolloutController:
         return None
 
     async def _dispatch(self, qid: str, prompt_ids: List[int]) -> None:
+        # Lineage root: every prompt's causal timeline starts here.  The
+        # trace_id rides the request (HTTP header / ZMQ frame) through
+        # gen server, grader, replay admission, and train consumption.
+        trace_id = tracer.new_trace_id()
+        t_dispatch = time.monotonic()
+        tracer.lineage(
+            "dispatch",
+            trace_id,
+            root=True,
+            qid=qid,
+            prompt_len=len(prompt_ids),
+            trainer_version=self.replay.version,
+        )
         async with self._sem:
             self.stat.submitted += 1
             self.stat.in_flight += 1
@@ -628,7 +675,9 @@ class RolloutController:
                 backpressured=0,
             )
             try:
-                out = await self._generate_with_retries(qid, prompt_ids)
+                out = await self._generate_with_retries(
+                    qid, prompt_ids, trace_id
+                )
             finally:
                 self.stat.in_flight -= 1
                 self._m_in_flight.set(self.stat.in_flight)
@@ -637,6 +686,7 @@ class RolloutController:
                 # — visible in stat/metrics — never silently dropped.
                 self.stat.failed += 1
                 self._m_dispatched.labels("failed").inc()
+                tracer.lineage("failed", trace_id, qid=qid, error="exhausted")
                 return
             self.stat.completed += 1
         if self.episode_runner is not None:
@@ -653,6 +703,8 @@ class RolloutController:
                 version_start=out.version_start,
                 version_end=out.version,
             )
+        traj.trace_id = trace_id
+        traj.t_dispatch = t_dispatch
         # Lossless backpressure on the put side too: a completed response
         # holds until the trainer drains a slot rather than evicting an
         # unconsumed sample.  Too-stale responses fall through to put()

@@ -376,12 +376,18 @@ def check_trainer_plane(fileroot: str) -> int:
 
 def check_chaos(n_prompts: int = 40, kill_after_s: float = 2.5) -> int:
     """Elastic-fleet chaos leg: 3 discovered servers, one killed
-    mid-decode via AREAL_FAULTS, zero lost prompts."""
+    mid-decode via AREAL_FAULTS, zero lost prompts.  Runs traced: the
+    killed victim must leave a flight-recorder dump containing its last
+    dispatch, and the merged shards must join >= 95% of the consumed
+    trajectories into complete dispatch -> trained lineage timelines."""
+    import json
+
     import jax
     import numpy as np
 
     from areal_tpu.api.model_api import GenerationHyperparameters
-    from areal_tpu.base import name_resolve
+    from areal_tpu.apps import trace_report
+    from areal_tpu.base import name_resolve, tracer
     from areal_tpu.base.name_resolve import MemoryNameResolveRepository
     from areal_tpu.base.topology import ParallelConfig, make_mesh
     from areal_tpu.engines.generator import GeneratorEngine
@@ -398,6 +404,14 @@ def check_chaos(n_prompts: int = 40, kill_after_s: float = 2.5) -> int:
     name_resolve.set_default(MemoryNameResolveRepository())
     exp, trial = "chaos", "t0"
     failures = []
+
+    # Traced run: lineage events land in shards, and AREAL_TRACE_DIR
+    # gives the victim's fault-kill flight dump somewhere to go.
+    trace_dir = tempfile.mkdtemp(prefix="areal_tpu_chaos_trace_")
+    os.environ["AREAL_TRACE_DIR"] = trace_dir
+    tracer.configure(
+        role="chaos", rank=0, dir=trace_dir, enabled=True, force=True
+    )
 
     cfg = tiny_config()
     params = tfm.init_params(cfg, jax.random.PRNGKey(0))
@@ -609,6 +623,70 @@ def check_chaos(n_prompts: int = 40, kill_after_s: float = 2.5) -> int:
     if victim._faults is None or victim._faults.fired.get("kill", 0) < 1:
         failures.append("the AREAL_FAULTS kill fault never fired")
 
+    # ---- flight recorder: the victim must have dumped its ring ------
+    flight_path = os.path.join(
+        trace_dir, f"flightrec_gen_server_{victim_port}.json"
+    )
+    if not os.path.exists(flight_path):
+        failures.append(
+            f"killed victim left no flight-recorder dump at {flight_path}"
+        )
+    else:
+        with open(flight_path) as f:
+            dump = json.load(f)
+        events = dump.get("events", [])
+        if dump.get("reason") != "fault_kill":
+            failures.append(
+                f"flight dump reason {dump.get('reason')!r} != 'fault_kill'"
+            )
+        if not any(e.get("kind") == "kill" for e in events):
+            failures.append("flight dump ring is missing the kill event")
+        if not any(
+            e.get("kind") == "dispatch" and e.get("sid") == victim_sid
+            for e in events
+        ):
+            failures.append(
+                "flight dump does not contain the victim's last dispatch"
+            )
+    rendered = trace_report.format_flight(trace_dir, window_s=60.0)
+    if rendered.startswith("no flightrec"):
+        failures.append("trace_report --flight rendered no dumps")
+
+    # ---- lineage: >= 95% of consumed trajectories join end to end ---
+    tracer.flush()
+    trace = tracer.merge_shards(
+        trace_dir, out_path=os.path.join(trace_dir, "trace.json")
+    )
+    os.environ.pop("AREAL_TRACE_DIR", None)
+    errors = tracer.validate_trace(trace)
+    if errors:
+        failures.append(f"merged chaos trace invalid: {errors[:3]}")
+    summary = trace_report.lineage_summary(trace)
+    if summary["orphans"]:
+        failures.append(
+            f"orphan lineage traces (no dispatch root): "
+            f"{summary['orphans'][:3]}"
+        )
+    if summary["n"] != n_prompts:
+        failures.append(
+            f"expected {n_prompts} lineage roots, got {summary['n']}"
+        )
+    if summary["complete"] < 0.95 * len(consumed):
+        failures.append(
+            f"lineage joined only {summary['complete']} of "
+            f"{len(consumed)} consumed trajectories dispatch->trained"
+        )
+    accounted = (
+        summary["complete"] + summary["in_flight"]
+        + summary["rejected_stale"] + summary["failed"]
+    )
+    if accounted < summary["n"]:
+        failures.append(
+            f"unaccounted lineage traces: {summary['n'] - accounted} of "
+            f"{summary['n']} neither complete, in-flight, rejected, nor "
+            f"failed"
+        )
+
     for f in failures:
         print(f"FAIL[chaos]: {f}")
     if not failures:
@@ -620,8 +698,15 @@ def check_chaos(n_prompts: int = 40, kill_after_s: float = 2.5) -> int:
             f"victim {victim_sid} killed at t={kill_after_s}s, breaker "
             f"opened x{vb.opens} and re-closed x{vb.closes}; staleness "
             f"seen {sorted(set(staleness_seen))} <= cap {cap}; "
-            f"membership epoch {ctl.membership_epoch}"
+            f"membership epoch {ctl.membership_epoch}; lineage "
+            f"{summary['complete']}/{summary['n']} complete "
+            f"(+{summary['in_flight']} in-flight, "
+            f"{summary['rejected_stale']} rejected) with 0 orphans; "
+            f"victim flight dump at {flight_path}"
         )
+        print()
+        print("--- trace_report --flight (last 60s before the kill) ---")
+        print(rendered)
     return len(failures)
 
 

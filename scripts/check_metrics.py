@@ -20,6 +20,13 @@ enabled vs disabled (metrics.configure), decode-chunk wall time measured
 by the existing tracer — instrumentation on the hot path must stay
 within noise of the uninstrumented run.
 
+Part 3 is the lineage/flight overhead guard: with the tracer OFF
+(AREAL_TRACE=0) the causal-lineage stamps and flight-recorder ring
+appends are the only cost that remains always-on, so the same decode
+burst with per-request dispatch/first-token/generated stamping must
+stay within noise of the unstamped run — and the ring must actually
+have accumulated the events while no shard was written.
+
 Exit 0 iff every check passes.  CI-friendly: CPU-only, tiny random
 model, under a minute end to end.
 """
@@ -300,6 +307,116 @@ def check_overhead(n_repeats: int) -> int:
     return len(failures)
 
 
+def check_lineage_overhead(n_repeats: int) -> int:
+    """AREAL_TRACE=0 A/B: lineage stamps + flight-ring appends are the
+    only observability cost that stays on when tracing is disabled, so
+    a decode burst with per-request dispatch/first-token/generated
+    stamping must be within noise of the same burst without stamps."""
+    import jax
+    import numpy as np
+
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.base import tracer
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.engines.generator import GeneratorEngine
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.models.config import tiny_config
+
+    cfg = tiny_config()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(2))
+    mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+    engine = GeneratorEngine(
+        cfg, params, mesh, eos_token_id=cfg.vocab_size + 7,
+        max_decode_batch=2,
+    )
+    rng = np.random.default_rng(2)
+    lens = (6, 7, 6, 8, 6, 7)
+
+    def sample():
+        data = np.concatenate(
+            [rng.integers(8, cfg.vocab_size, size=l) for l in lens]
+        ).astype(np.int32)
+        return SequenceSample(
+            keys={"packed_prompts"},
+            ids=[f"p{i}" for i in range(len(lens))],
+            seqlens={"packed_prompts": [[l] for l in lens]},
+            data={"packed_prompts": data},
+        )
+
+    g = GenerationHyperparameters(n=1, max_new_tokens=48)
+
+    def run_leg(stamped: bool):
+        # The AREAL_TRACE=0 posture: no shard dir, tracer disabled.
+        tracer.configure(
+            role="lineage_overhead", rank=int(stamped), dir=None,
+            enabled=False, force=True,
+        )
+        durs = []
+        for r in range(n_repeats):
+            s = sample()
+            t0 = time.perf_counter()
+            if stamped:
+                tids = [tracer.new_trace_id() for _ in lens]
+                for q, tid in enumerate(tids):
+                    tracer.lineage("dispatch", tid, root=True, qid=f"q{q}")
+                    tracer.flight_event(
+                        "dispatch", trace_id=tid, qid=f"q{q}", sid="s0"
+                    )
+            engine.generate(
+                s, MicroBatchSpec(), g, seed=300 + r, inflight=True
+            )
+            if stamped:
+                for q, tid in enumerate(tids):
+                    tracer.lineage("first_token", tid, qid=f"q{q}")
+                    tracer.lineage("generated", tid, qid=f"q{q}")
+            durs.append((time.perf_counter() - t0) * 1e3)
+        return durs
+
+    failures = []
+    try:
+        run_leg(stamped=True)  # warmup: pay the compiles once
+        durs_plain = run_leg(stamped=False)
+        durs_stamped = run_leg(stamped=True)
+        # The stamps must have hit the always-on ring even with the
+        # tracer off — otherwise this A/B measured nothing.
+        ring = tracer.flight_events()
+        if not any(e.get("kind") == "lineage" for e in ring):
+            failures.append(
+                "flight ring holds no lineage events after the stamped "
+                "leg — the always-on path was not exercised"
+            )
+        if tracer.flush() is not None:
+            failures.append(
+                "tracer wrote a shard with AREAL_TRACE=0 posture"
+            )
+    finally:
+        tracer.configure(
+            role="metrics_check", rank=0, dir=None, enabled=False,
+            force=True,
+        )
+
+    med_plain = statistics.median(durs_plain)
+    med_stamped = statistics.median(durs_stamped)
+    # Same bound as the registry A/B: a few dict/deque appends per
+    # multi-hundred-ms burst; 1.5x median + 2ms is CI-stable.
+    if med_stamped > med_plain * 1.5 + 2.0:
+        failures.append(
+            f"decode burst slowed with lineage/flight stamping: "
+            f"median {med_stamped:.2f}ms vs {med_plain:.2f}ms plain"
+        )
+    for f in failures:
+        print(f"FAIL[lineage-overhead]: {f}")
+    if not failures:
+        print(
+            f"OK[lineage-overhead]: AREAL_TRACE=0 burst median "
+            f"{med_stamped:.2f}ms with lineage/flight stamps vs "
+            f"{med_plain:.2f}ms without ({n_repeats} bursts each) — "
+            f"within noise; ring kept the stamps, no shard written"
+        )
+    return len(failures)
+
+
 def main() -> int:
     p = argparse.ArgumentParser(prog="check_metrics")
     p.add_argument("--prompts", type=int, default=12)
@@ -309,6 +426,7 @@ def main() -> int:
 
     n_fail = check_metrics_plane(args.prompts)
     n_fail += check_overhead(args.repeats)
+    n_fail += check_lineage_overhead(args.repeats)
     if n_fail:
         print(f"FAIL: {n_fail} check(s) failed")
         return 1

@@ -624,3 +624,112 @@ class TestAsyncServing:
                 assert len(o.output_ids[0]) >= 1
         finally:
             srv.close()
+
+
+class TestLineagePropagation:
+    """Causal-lineage propagation over both transports: a trace_id
+    minted at the (simulated) dispatcher must ride the HTTP header /
+    ZMQ frame into the server and come back out of the merged shards
+    as per-turn and per-request lineage stamps."""
+
+    def test_http_episode_turns_carry_trace_id(self, tmp_path, cfg):
+        from areal_tpu.base import tracer
+
+        mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+        params = tfm.init_params(cfg, jax.random.PRNGKey(13))
+        eng = GeneratorEngine(
+            cfg, params, mesh, eos_token_id=cfg.vocab_size + 7,
+            kv_paged=True, kv_page_size=8, prefill_chunk_tokens=4,
+            max_decode_batch=2,
+        )
+        srv = GenerationServer(eng, max_wait_ms=2.0)
+        try:
+            tracer.configure(
+                role="gen_server", rank=0, dir=str(tmp_path),
+                enabled=True, force=True,
+            )
+            client = LLMAPIClient(srv.url)
+            rng = np.random.default_rng(7)
+            prompt = [
+                int(x) for x in rng.integers(8, cfg.vocab_size, size=10)
+            ]
+            # Probe the greedy continuation for a guaranteed stop seq.
+            probe = client.generate(APIGenerateInput(
+                qid="probe", prompt_ids=prompt,
+                gconfig=GenerationHyperparameters(
+                    n=1, max_new_tokens=8, greedy=True
+                ),
+            ))
+            toks = [int(t) for t in probe.output_ids[0]]
+            g = GenerationHyperparameters(
+                n=1, max_new_tokens=8, greedy=True,
+                stop=(tuple(toks[2:4]),),
+            )
+            tid = tracer.new_trace_id()
+            tracer.lineage("dispatch", tid, root=True, qid="ep-lin")
+            # trace_id rides the X-Areal-Trace header on start; the
+            # server's episode->trace store then resolves it for the
+            # extend, which does NOT carry the header.
+            client.episode_start(
+                "ep-lin", prompt, g, token_budget=64, trace_id=tid
+            )
+            obs = [int(x) for x in np.asarray(prompt[:3]) + 1]
+            client.episode_extend("ep-lin", obs)
+            client.episode_release("ep-lin")
+            tracer.flush()
+            trace = tracer.merge_shards(str(tmp_path))
+            assert tracer.validate_trace(trace) == []
+            turns = [
+                e for e in trace["traceEvents"]
+                if e.get("ph") == "i" and e.get("cat") == "lineage"
+                and e["args"].get("stage") == "turn"
+            ]
+            assert len(turns) >= 2  # start + extend both stamped
+            assert all(e["args"]["trace_id"] == tid for e in turns)
+            ops = {e["args"].get("op") for e in turns}
+            assert {"start", "extend"} <= ops
+        finally:
+            tracer._reset_for_tests()
+            srv.close()
+
+    def test_zmq_generate_carries_trace_id(self, tmp_path, engine):
+        from areal_tpu.base import tracer
+        from areal_tpu.system.gen_server import ZMQGenClient
+
+        srv = GenerationServer(engine, max_wait_ms=2.0, zmq_port=0)
+        try:
+            tracer.configure(
+                role="gen_server", rank=0, dir=str(tmp_path),
+                enabled=True, force=True,
+            )
+            tid = tracer.new_trace_id()
+            tracer.lineage("dispatch", tid, root=True, qid="z-lin")
+            zc = ZMQGenClient(srv.zmq_url)
+            out = zc.generate(APIGenerateInput(
+                qid="z-lin", prompt_ids=[9, 10, 11],
+                gconfig=GenerationHyperparameters(
+                    n=1, max_new_tokens=4, greedy=True
+                ),
+                trace_id=tid,
+            ))
+            assert out.output_ids[0]
+            tracer.flush()
+            trace = tracer.merge_shards(str(tmp_path))
+            assert tracer.validate_trace(trace) == []
+            stages = {
+                e["args"]["stage"]
+                for e in trace["traceEvents"]
+                if e.get("ph") == "i" and e.get("cat") == "lineage"
+                and e["args"].get("trace_id") == tid
+            }
+            # The same id the ZMQ frame carried in came out as the
+            # server-side serving stamps.
+            assert {"dispatch", "first_token", "generated"} <= stages
+            req = next(
+                e for e in trace["traceEvents"]
+                if e.get("ph") == "X" and e["name"] == "request:z-lin"
+            )
+            assert req["args"]["trace_id"] == tid
+        finally:
+            tracer._reset_for_tests()
+            srv.close()

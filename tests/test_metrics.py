@@ -330,7 +330,7 @@ class TestCheckRegression:
 
 
 class TestTraceReportJSON:
-    def test_v2_schema_additive_over_v1(self):
+    def test_v3_schema_additive_over_v2(self):
         trace = {
             "traceEvents": [
                 {"ph": "M", "name": "process_name", "pid": 1,
@@ -342,9 +342,14 @@ class TestTraceReportJSON:
             ]
         }
         rep = json_report(trace)
-        assert rep["version"] == 2
-        assert set(rep) == {"version", "rows", "bubbles", "pipeline"}
+        assert rep["version"] == 3
+        assert set(rep) == {"version", "rows", "bubbles", "pipeline",
+                            "lineage"}
         assert rep["pipeline"] == []  # no pipe:* spans in this trace
+        # v3's lineage key is additive: empty join for traces without
+        # lineage stamps, v2 keys byte-identical.
+        assert rep["lineage"]["traces"] == []
+        assert rep["lineage"]["summary"]["n"] == 0
         row = rep["rows"][0]
         assert set(row) == {"step", "pid", "process", "window_us",
                             "compute_us", "comms_us", "host_us", "idle_us"}

@@ -286,6 +286,170 @@ def test_merge_stats_partial_denominator_drops_key():
     assert merged["loss_denominator"] == pytest.approx(10.0)
 
 
+# ---------------- causal lineage + flight recorder ----------------
+
+
+def _lineage_event(stage, tid, ts, root=False, **args):
+    a = {"trace_id": tid, "stage": stage}
+    if root:
+        a["root"] = True
+    a.update(args)
+    return {
+        "ph": "i", "name": f"lineage:{stage}", "cat": "lineage",
+        "ts": ts, "pid": 1, "tid": 1, "s": "t", "args": a,
+    }
+
+
+def _lineage_fixture(orphan=False):
+    """Fixture pair for the validator: one fully joined dispatch ->
+    trained timeline, optionally plus a graded stamp whose trace_id
+    never appears on any root (an orphan the validator must reject)."""
+    ms = 1000
+    evs = [
+        {"ph": "M", "name": "process_name", "pid": 1, "tid": 0, "ts": 0,
+         "args": {"name": "ctl_0"}},
+        {"ph": "X", "name": "step", "ts": 0, "dur": 40 * ms, "pid": 1,
+         "tid": 1},
+        _lineage_event("dispatch", "tr-good", 0, root=True, qid="q0"),
+        _lineage_event("first_token", "tr-good", 5 * ms, qid="q0"),
+        _lineage_event("generated", "tr-good", 10 * ms, qid="q0"),
+        _lineage_event("graded", "tr-good", 12 * ms, passed=True),
+        _lineage_event("admitted", "tr-good", 15 * ms, version_lag=1),
+        _lineage_event("trained", "tr-good", 30 * ms),
+    ]
+    if orphan:
+        evs.append(
+            _lineage_event("graded", "tr-orphan", 9 * ms, passed=False)
+        )
+    return {"traceEvents": evs}
+
+
+def test_validate_trace_accepts_joined_lineage():
+    assert tracer.validate_trace(_lineage_fixture()) == []
+
+
+def test_validate_trace_rejects_orphan_lineage():
+    errors = tracer.validate_trace(_lineage_fixture(orphan=True))
+    assert any("orphan" in e and "tr-orphan" in e for e in errors)
+
+
+def test_lineage_rows_join_stages_into_timeline():
+    rows = trace_report.lineage_rows(_lineage_fixture())
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["qid"] == "q0" and r["root"] and r["complete"]
+    assert r["e2e_us"] == 30_000 and r["version_lag"] == 1
+    assert set(r["stages"]) == {
+        "dispatch", "first_token", "generated", "graded", "admitted",
+        "trained",
+    }
+
+
+def test_lineage_summary_counts_and_transitions():
+    s = trace_report.lineage_summary(_lineage_fixture(orphan=True))
+    assert s["n"] == 2 and s["complete"] == 1
+    assert s["orphans"] == ["tr-orphan"]
+    assert s["transitions"]["dispatch->first_token"]["n"] == 1
+    assert s["transitions"]["admitted->trained"]["p50_us"] == 15_000
+    assert s["e2e_p50_us"] == 30_000
+
+
+def test_lineage_stamps_roundtrip_through_shards(tmp_path):
+    _configure(tmp_path, role="ctl", rank=0)
+    tid = tracer.new_trace_id()
+    assert tid.startswith("tr-")
+    with tracer.span("step", step=1):
+        tracer.lineage("dispatch", tid, root=True, qid="q0")
+        tracer.lineage("trained", tid)
+    tracer.flush()
+    trace = tracer.merge_shards(str(tmp_path))
+    assert tracer.validate_trace(trace) == []
+    s = trace_report.lineage_summary(trace)
+    assert s["n"] == s["complete"] == 1 and not s["orphans"]
+
+
+def test_flight_ring_always_on_and_bounded(tmp_path):
+    # Tracer fully disabled: the ring still records (that's the point —
+    # a chaos dump must work with AREAL_TRACE=0) and nothing hits disk.
+    for i in range(600):
+        tracer.flight_event("dispatch", qid=f"q{i}")
+    tracer.lineage("dispatch", "tr-x", root=True, qid="q600")
+    ring = tracer.flight_events()
+    assert len(ring) == 512  # bounded: oldest entries evicted
+    assert ring[0]["qid"] == "q89"
+    assert ring[-1]["kind"] == "lineage"
+    assert ring[-1]["trace_id"] == "tr-x"
+    assert tracer.flush() is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_flight_dump_roundtrip_and_report(tmp_path):
+    tracer.flight_event("dispatch", trace_id="tr-1", qid="q0", sid="s1")
+    tracer.flight_event("kill", port=4242)
+    path = tracer.flight_dump(
+        "fault_kill", role="gen_server", rank=7, dir=str(tmp_path)
+    )
+    assert path.endswith("flightrec_gen_server_7.json")
+    dumps = tracer.read_flight_dumps(str(tmp_path))
+    assert len(dumps) == 1
+    d = dumps[0]
+    assert d["reason"] == "fault_kill" and d["role"] == "gen_server"
+    assert [e["kind"] for e in d["events"]] == ["dispatch", "kill"]
+    rendered = trace_report.format_flight(str(tmp_path), window_s=60.0)
+    assert "fault_kill" in rendered and "gen_server_7" in rendered
+    assert "kill" in rendered and "trace_id=tr-1" in rendered
+    # Torn dump alongside: skipped, not fatal.
+    (tmp_path / "flightrec_torn_0.json").write_text('{"reason": "x"')
+    assert len(tracer.read_flight_dumps(str(tmp_path))) == 1
+
+
+def test_flight_dump_without_dir_is_noop(monkeypatch):
+    monkeypatch.delenv("AREAL_TRACE_DIR", raising=False)
+    tracer.flight_event("kill", port=1)
+    assert tracer.flight_dump("fault_kill") is None
+
+
+def test_replay_stamps_admission_and_training_lineage(tmp_path):
+    import time as _time
+
+    from areal_tpu.system.replay import ReplayBuffer, Trajectory
+
+    _configure(tmp_path, role="replay", rank=0)
+
+    def traj(qid, version_start=0):
+        t = Trajectory(
+            qid=qid, prompt_ids=[1, 2], output_ids=[[3, 4]],
+            output_logprobs=[[0.0, 0.0]], no_eos=[False],
+            version_start=version_start, version_end=version_start,
+        )
+        t.trace_id = tracer.new_trace_id()
+        t.t_dispatch = _time.monotonic()
+        tracer.lineage("dispatch", t.trace_id, root=True, qid=qid)
+        return t
+
+    rb = ReplayBuffer(capacity=4, max_head_offpolicyness=1)
+    with tracer.span("step", step=1):
+        good = traj("q-good")
+        assert rb.put(good)
+        assert rb.get_batch(1, timeout=0)[0].qid == "q-good"
+        rb.set_version(3)
+        stale = traj("q-stale", version_start=0)
+        assert not rb.put(stale)
+
+    tracer.flush()
+    trace = tracer.merge_shards(str(tmp_path))
+    assert tracer.validate_trace(trace) == []
+    rows = {r["qid"]: r for r in trace_report.lineage_rows(trace)}
+    assert rows["q-good"]["complete"]
+    assert {"dispatch", "admitted", "trained"} <= set(
+        rows["q-good"]["stages"]
+    )
+    assert rows["q-good"]["version_lag"] == 0
+    assert not rows["q-stale"]["complete"]
+    assert "rejected_stale" in rows["q-stale"]["stages"]
+    assert "admitted" not in rows["q-stale"]["stages"]
+
+
 # ---------------- gen_server integration ----------------
 
 
