@@ -310,6 +310,39 @@ class LLMAPIClient(BoundedAgenerateMixin):
         update_weights_from_disk)."""
         return int(self._post("/update_weights", {"path": path})["version"])
 
+    def push_weights(self, meta: Dict, payload: bytes) -> Dict:
+        """Binary fabric push (system/paramstore.py): POST /param_push
+        with an octet-stream body — 8-byte big-endian meta length + meta
+        JSON + the raw serialized params.  The JSON `_post` plane cannot
+        carry a multi-MB binary payload; this is the one binary route."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        mb = _json.dumps(meta).encode()
+        body = len(mb).to_bytes(8, "big") + mb + payload
+        headers = {"Content-Type": "application/octet-stream"}
+        if self.token:
+            headers["X-Areal-Token"] = self.token
+        req = urllib.request.Request(
+            self.url + "/param_push", data=body, headers=headers
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                out = _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                err = _json.loads(e.read()).get("error", "")
+            except Exception:
+                err = ""
+            raise RuntimeError(
+                f"generation server /param_push failed: HTTP {e.code} "
+                f"{err}"
+            ) from e
+        if "error" in out:
+            raise RuntimeError(f"generation server error: {out['error']}")
+        return out
+
     def pause(self) -> Dict:
         """Interrupt in-flight decode at the next chunk boundary."""
         return self._post("/pause", {})

@@ -52,7 +52,15 @@ plane: sentinel trips summed over kinds, the master's live run of
 consecutive quarantined steps, and checksum-rejected weight pushes —
 e.g. ``crit: quarantine_streak <= 2`` pages one step before the
 escalation ladder rolls the trial back to the last good checkpoint),
-plus any raw unlabeled series name.
+``weight_version_skew`` / ``push_p99`` (parameter distribution fabric,
+system/paramstore.py: max-min serving weight version across gen servers
+— an alias of ``version_skew`` named for fabric SLOs — and the p99 of
+``areal_param_push_seconds``, one observation per whole-fleet broadcast
+or cross-set realloc push — e.g. ``warn: weight_version_skew <= 1``
+requires laggards to stay within the v-1 staleness bound the store's
+refcounts guarantee, and ``crit: push_p99 <= 30`` pages when weight
+distribution is eating the training step), plus any raw unlabeled
+series name.
 
 Exit status: 0 if no CRIT fired over the run, 1 otherwise (``--count``
 bounds the run; without it the poller runs until interrupted).
@@ -293,6 +301,10 @@ def fleet_signals(
     signals["version_skew"] = (
         max(versions) - min(versions) if versions else 0.0
     )
+    # Fabric alias: the same spread, named for parameter-distribution
+    # SLOs (``warn: weight_version_skew <= 1`` asserts the store's
+    # staleness bound — orphaned subtrees serve head-1, never head-2).
+    signals["weight_version_skew"] = signals["version_skew"]
     p50 = _staleness_quantile(all_samples, 0.50)
     p99 = _staleness_quantile(all_samples, 0.99)
     if not math.isnan(p50):
@@ -370,6 +382,14 @@ def fleet_signals(
     pr = _series_sum(all_samples, "areal_gen_weight_push_rejected_total")
     if pr is not None:
         signals["push_rejected"] = pr
+    # Parameter distribution fabric: whole-push latency p99 (one
+    # areal_param_push_seconds observation per fleet broadcast or
+    # cross-set realloc push).  ``crit: push_p99 <= 30`` pages when
+    # weight distribution starts eating the training step.  Absent
+    # until the first push.
+    pp = _hist_quantile(all_samples, "areal_param_push_seconds", 0.99)
+    if not math.isnan(pp):
+        signals["push_p99"] = pp
     # Raw unlabeled series become rule-addressable too (last wins on
     # duplicates; labeled series need the computed signals above).
     for n, labels, v in all_samples:
@@ -412,7 +432,8 @@ def render_table(rows: List[Dict[str, object]],
         "sample_e2e_p99", "sample_admit_p99", "queue_depth",
         "kv_utilization", "idle_frac", "version_skew", "backpressure",
         "pipeline_fill", "pipeline_bubble", "anomalies",
-        "quarantine_streak", "push_rejected",
+        "quarantine_streak", "push_rejected", "weight_version_skew",
+        "push_p99",
     )
     fleet = ", ".join(
         f"{k}={signals[k]:.4g}" for k in keys if k in signals

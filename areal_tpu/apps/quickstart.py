@@ -354,6 +354,8 @@ def cmd_ppo_math(args):
         overlap_window=args.overlap_window,
         pipeline_chunk_seqs=args.pipeline_chunk_seqs,
         inmem_weight_sync=args.inmem_weight_sync,
+        param_push_tree=args.param_push_tree,
+        param_push_fanout=args.param_push_fanout,
         gen_backend_args=(
             {"kv_cache_dtype": args.kv_cache_dtype}
             if args.kv_cache_dtype != "auto" else {}
@@ -519,6 +521,17 @@ def main(argv=None):
                          "around weight pushes (in-flight decodes halt at "
                          "a chunk boundary and resume on their KV pages) "
                          "instead of draining the server")
+    pp.add_argument("--param-push-tree", action="store_true",
+                    help="decoupled serving: distribute weight pushes "
+                         "down a broadcast tree over the gen-server "
+                         "fleet (serialize once, servers relay to their "
+                         "children before applying; O(log N) push "
+                         "wall-time) instead of N serial point-to-point "
+                         "pushes; requires --gen-server-url")
+    pp.add_argument("--param-push-fanout", type=int, default=2,
+                    help="broadcast-tree fan-out per relay server "
+                         "(with --param-push-tree; depth ~ "
+                         "log_fanout(N))")
     pp.add_argument("--pipeline-overlap", action="store_true",
                     help="overlap the stages INSIDE a step: slice the "
                          "batch into rollout-group chunks and stream each "

@@ -70,6 +70,14 @@ def gen_server(experiment_name: str, trial_name: str, server_id: str) -> str:
     return f"{gen_servers(experiment_name, trial_name)}/{server_id}"
 
 
+def param_store(experiment_name: str, trial_name: str) -> str:
+    """Versioned parameter-store rendezvous (system/paramstore.py): the
+    pushing trainer publishes its head version number here so a
+    late-joining or multi-slice trainer continues version time instead
+    of restarting it."""
+    return f"{trial_root(experiment_name, trial_name)}/param_store"
+
+
 def metrics_root(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/metrics"
 

@@ -72,6 +72,12 @@ class RecoverInfo:
     # recovered supervisor resumes epochs monotonically instead of
     # restarting at 0 and re-counting scale actions.
     fleet_state: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Parameter distribution fabric: the store's version watermark
+    # (ParamStore.state_dict() — just {"head": n}) so a recovered trial
+    # republishes at head+1 and laggards' staleness accounting stays
+    # monotonic across the restart.  Payloads are NOT persisted; the
+    # recovered master re-publishes from its restored model weights.
+    paramstore_state: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # Numerical-integrity guard plane: quarantined steps (anomaly verdict
     # + offending batch ids, see base/integrity.py quarantine_entry) and
     # the live consecutive-quarantine count, persisted so a restarted

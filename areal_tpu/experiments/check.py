@@ -253,6 +253,20 @@ def check_ppo_math(cfg) -> None:
             "and are ignored under gen_server_url (configure the "
             "standalone gen_server instead)"
         )
+    if getattr(cfg, "param_push_fanout", 2) < 1:
+        _fail(
+            f"param_push_fanout must be >= 1, got "
+            f"{getattr(cfg, 'param_push_fanout', 2)}"
+        )
+    if getattr(cfg, "param_push_tree", False) and not cfg.gen_server_url:
+        # The broadcast fabric fans out over the remote gen-server
+        # fleet; the in-process path hot-swaps weights directly and has
+        # nothing to relay through — a tree flag there would no-op.
+        _fail(
+            "param_push_tree requires gen_server_url (the broadcast "
+            "fabric distributes over the remote serving fleet; the "
+            "in-process engine swaps weights directly)"
+        )
     if (
         cfg.rollout_ahead > 0
         or mho is not None

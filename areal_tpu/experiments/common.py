@@ -279,6 +279,16 @@ class PPOMathConfig:
     # interrupted requests resume on their existing KV pages).  The
     # in-process path always hot-swaps in memory.
     inmem_weight_sync: bool = False
+    # Broadcast-tree weight distribution (system/paramstore.py): when
+    # True, set_params on the remote generator publishes ONE serialized
+    # payload into a versioned ParamStore and pushes it down a fan-out
+    # tree over the live fleet (each server relays to `param_push_fanout`
+    # children before applying) instead of N serial point-to-point
+    # pushes — O(log N) push wall-time at fleet scale.  Requires
+    # gen_server_url (remote serving); the in-process path has no fleet
+    # to fan out over.
+    param_push_tree: bool = False
+    param_push_fanout: int = 2
     # Extra GeneratorEngine kwargs (e.g. max_decode_batch, or forcing
     # donation_safe_swap — config check rejects the alias mode under
     # rollout_ahead>0).  Defaults supplied by build_ppo_math win unless
@@ -406,6 +416,10 @@ def _remote_gen_shard(cfg: "PPOMathConfig", actor_gen, actor_if):
                 ],
                 "model_type": model_type,
                 "inmem_sync": cfg.inmem_weight_sync,
+                "push_mode": (
+                    "fabric" if cfg.param_push_tree else "disk"
+                ),
+                "push_fanout": cfg.param_push_fanout,
             },
         ),
         interface=actor_if,

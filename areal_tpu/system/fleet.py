@@ -247,6 +247,12 @@ class FleetSupervisor:
         ),
         recover_root: Optional[str] = None,
         clock: Callable[[], float] = time.monotonic,
+        # Parameter-fabric repair hook (BroadcastFabric.repair or a
+        # fake): invoked once per control-loop tick so servers that
+        # joined/lagged between pushes (a fresh spawn, a breaker-open
+        # subtree orphaned mid-broadcast) are caught up to the store
+        # head without waiting for the next training step's push.
+        param_repair: Optional[Callable[[], Any]] = None,
     ):
         self.experiment = experiment
         self.trial = trial
@@ -261,6 +267,7 @@ class FleetSupervisor:
         self.idle_frac = idle_frac
         self.scale_up_signals = set(scale_up_signals)
         self.recover_root = recover_root
+        self.param_repair = param_repair
         self._clock = clock
         self.history: List[Dict[str, float]] = []
         self.membership_epoch = 0
@@ -404,5 +411,10 @@ class FleetSupervisor:
             if decision.action != "hold":
                 self.apply(decision)
                 actions.append(decision)
+            if self.param_repair is not None:
+                try:
+                    self.param_repair()
+                except Exception as e:  # noqa: BLE001 — repair is advisory
+                    logger.warning(f"param repair failed: {e!r}")
             i += 1
         return actions

@@ -241,6 +241,18 @@ class GenerationServer:
                     return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
+                    if self.path == "/param_push":
+                        # Binary plane (system/paramstore.py): the body
+                        # is a meta-length prefix + meta JSON + the raw
+                        # serialized params — it must never reach the
+                        # JSON parse below.
+                        from areal_tpu.system import paramstore
+
+                        meta, blob = paramstore.unframe_push_body(
+                            self.rfile.read(n)
+                        )
+                        self._send(200, srv._handle_param_push(meta, blob))
+                        return
                     req = json.loads(self.rfile.read(n))
                     # Trace context rides the header so any client (or a
                     # proxy) can stamp it without touching the body.
@@ -380,7 +392,7 @@ class GenerationServer:
             msg["rid"] = rid
             router.send_multipart([ident, json.dumps(msg).encode()])
 
-        def handle(ident, payload: bytes):
+        def handle(ident, payload: bytes, blob: Optional[bytes] = None):
             try:
                 req = json.loads(payload)
                 rid = req.get("rid")
@@ -468,6 +480,28 @@ class GenerationServer:
 
                     threading.Thread(target=_upd, daemon=True).start()
                     jobs.append((ident, rid, p))
+                elif cmd == "param_push":
+                    # Binary fabric push (system/paramstore.py): the
+                    # serialized params ride a THIRD frame, relayed
+                    # verbatim — relaying + applying blocks, so spawn
+                    # like update_weights to keep the ROUTER responsive.
+                    p = _Pending(
+                        qid="", prompt_ids=[],
+                        gconfig=GenerationHyperparameters(),
+                        done=threading.Event(),
+                    )
+
+                    def _pp(p=p, req=dict(req), blob=blob):
+                        try:
+                            p.result = self._handle_param_push(
+                                req, blob if blob is not None else b""
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            p.error = repr(e)
+                        p.done.set()
+
+                    threading.Thread(target=_pp, daemon=True).start()
+                    jobs.append((ident, rid, p))
                 else:
                     reply(ident, rid, {"error": f"unknown cmd {cmd!r}"})
             except Exception as e:  # noqa: BLE001 — malformed fields
@@ -480,8 +514,15 @@ class GenerationServer:
                 # Short poll while replies are pending keeps added reply
                 # latency ~10ms; idle ticks stay cheap at 100ms.
                 while router.poll(10 if jobs else 100):
-                    ident, payload = router.recv_multipart()
-                    handle(ident, payload)
+                    # 2 frames = JSON request; a 3rd frame carries a
+                    # binary param_push payload (relayed verbatim —
+                    # never JSON, never pickled).
+                    frames = router.recv_multipart()
+                    handle(
+                        frames[0],
+                        frames[1] if len(frames) > 1 else b"",
+                        frames[2] if len(frames) > 2 else None,
+                    )
                 still = []
                 for ident, rid, p in jobs:
                     if p.done.is_set():
@@ -606,22 +647,36 @@ class GenerationServer:
         with self._resume_cond:
             self._resume_cond.notify_all()
 
-    def update_weights_inmem(self, params, checksum=None) -> int:
+    def update_weights_inmem(self, params, checksum=None, version=None) -> int:
         """Interruptible in-memory weight push (async RL): pause at a
         chunk boundary, hot-swap the given params pytree directly into
         the engine (no disk checkpoint), bump the version, resume —
         interrupted requests continue on their existing KV pages, so the
-        push costs one chunk of replay instead of a full drain.  Python
-        API only: a params pytree cannot ship over the JSON transports.
+        push costs one chunk of replay instead of a full drain.
+        Reachable from the Python API and, since the parameter fabric
+        (system/paramstore.py), from the binary ``param_push`` wire on
+        both transports via :meth:`_handle_param_push`.
+
+        `version` (fabric pushes) sets the ABSOLUTE serving version so
+        the fleet tracks the store's version time; a push at or behind
+        the current version is an idempotent no-op (a repair and a relay
+        racing on one server must not double-apply).  Without it the
+        version bumps by one (Python-API pushes).
 
         `checksum` (from ``integrity.params_checksum`` at the pusher) is
         verified BEFORE the swap; a mismatch raises
         :class:`~areal_tpu.base.integrity.WeightChecksumError`, bumps
         ``areal_gen_weight_push_rejected_total``, and leaves the server
         decoding on its previous healthy weights — the pusher retries.
-        The ``corrupt_push@point=weight_push`` chaos kind corrupts the
-        incoming payload here, modeling in-flight corruption against the
-        real verification path."""
+        A server therefore NEVER serves a torn version: the swap is
+        atomic under the engine lock and only checksummed payloads reach
+        it.  The ``corrupt_push@point=weight_push`` chaos kind corrupts
+        the incoming payload here, modeling in-flight corruption against
+        the real verification path."""
+        if version is not None:
+            with self._health_lock:
+                if int(version) <= self.version:
+                    return self.version
         if (
             self._faults is not None
             and self._faults.poison("weight_push") == "corrupt_push"
@@ -646,9 +701,20 @@ class GenerationServer:
             self.pause()
             try:
                 with self._engine_lock:
+                    with self._health_lock:
+                        if (
+                            version is not None
+                            and int(version) <= self.version
+                        ):
+                            # Raced with another push of the same (or a
+                            # newer) version while waiting on the mutex.
+                            return self.version
                     self.engine.set_params(params)
                     with self._health_lock:
-                        self.version += 1
+                        if version is None:
+                            self.version += 1
+                        else:
+                            self.version = int(version)
                         v = self.version
                     self.inmem_updates += 1
                     _M_WEIGHT_VERSION.set(v)
@@ -657,6 +723,76 @@ class GenerationServer:
                 self.resume()
         logger.info(f"weights updated in memory -> version {v}")
         return v
+
+    def _handle_param_push(self, req: Dict, payload: bytes) -> Dict:
+        """One hop of a fabric broadcast (system/paramstore.py): relay
+        the raw payload to this node's subtree children FIRST (the
+        fan-out must keep moving even when the local apply is slow),
+        then deserialize against the engine's own treedef and apply via
+        the interruptible checksummed :meth:`update_weights_inmem`.
+
+        The ack aggregates per-sid outcomes for the whole subtree:
+        ``applied`` (sids now serving the pushed version) and ``failed``
+        (orphaned sids + why).  A local reject/failure never fails the
+        ack — degradation is PER-SUBTREE and the pusher counts orphans.
+        """
+        # Chaos: a point-scoped kill here models a relay preempted
+        # mid-broadcast — crash semantics (no deregistration), black-box
+        # flight dump, subtree orphaned.
+        if self._faults is not None and self._faults.kill_point(
+            "param_push"
+        ):
+            logger.warning("FAULT kill: crashing relay mid-broadcast")
+            self._crashed = True
+            tracer.flight_event("kill", port=self.port)
+            tracer.flight_dump(
+                "fault_kill", role="gen_server", rank=self.port
+            )
+            self.close()
+            raise RuntimeError("server killed at param_push")
+        self._fire_fault("param_push")
+        from areal_tpu.system import paramstore
+
+        version = int(req["version"])
+        manifest = req["manifest"]
+        checksum = (
+            np.asarray(req["checksum"], np.float64)
+            if req.get("checksum") is not None else None
+        )
+        node = req.get("subtree") or {}
+        sid = str(node.get("sid") or f"s{self.port}")
+        applied, failed = paramstore.relay_subtrees(
+            node.get("children") or [],
+            {
+                "cmd": "param_push",
+                "version": version,
+                "manifest": manifest,
+                "checksum": req.get("checksum"),
+            },
+            payload,
+            token=self._token,
+            timeout_s=float(req.get("timeout_s", 120.0)),
+        )
+        try:
+            like = getattr(self.engine, "params", None)
+            if like is None:
+                raise RuntimeError(
+                    "engine exposes no params pytree to deserialize "
+                    "against"
+                )
+            params = paramstore.deserialize_params(like, manifest, payload)
+            self.update_weights_inmem(
+                params, checksum=checksum, version=version
+            )
+            applied.insert(0, sid)
+        except Exception as e:  # noqa: BLE001 — per-subtree degradation
+            logger.warning(f"local param_push apply failed: {e!r}")
+            failed.append({"sid": sid, "error": repr(e)})
+        return {
+            "version": self.version,
+            "applied": applied,
+            "failed": failed,
+        }
 
     def _await_resume(self) -> None:
         """Block a parked _run_subgroup until resume() (engine lock NOT
@@ -1136,7 +1272,9 @@ class ZMQGenClient(BoundedAgenerateMixin):
         # their requests over the one connection.
         import concurrent.futures as _cf
 
-        self._send_q: "queue.Queue[bytes]" = queue.Queue()
+        # Each entry is a frame LIST: [json] for ordinary requests,
+        # [json, payload] for binary param pushes.
+        self._send_q: "queue.Queue[List[bytes]]" = queue.Queue()
         self._pending: Dict[int, _cf.Future] = {}
         self._plock = threading.Lock()
         self._rid = 0
@@ -1165,7 +1303,7 @@ class ZMQGenClient(BoundedAgenerateMixin):
         sock = zmq.Context.instance().socket(zmq.DEALER)
         sock.connect(addr)
         self._ready.set()
-        outbox: "collections.deque[bytes]" = collections.deque()
+        outbox: "collections.deque[List[bytes]]" = collections.deque()
 
         def fail_all(err: str) -> None:
             # Also purge queued frames: their futures are failed, so
@@ -1192,7 +1330,7 @@ class ZMQGenClient(BoundedAgenerateMixin):
                     pass
                 while outbox:
                     try:
-                        sock.send(outbox[0], zmq.NOBLOCK)
+                        sock.send_multipart(outbox[0], zmq.NOBLOCK)
                         outbox.popleft()
                     except zmq.Again:
                         break  # HWM full: retry next tick, stay stoppable
@@ -1238,7 +1376,9 @@ class ZMQGenClient(BoundedAgenerateMixin):
     def close(self) -> None:
         self._stop_evt.set()
 
-    def _call_many(self, reqs: List[Dict]) -> List[Dict]:
+    def _call_many(
+        self, reqs: List[Dict], extras: Optional[List[Optional[bytes]]] = None
+    ) -> List[Dict]:
         import concurrent.futures as _cf
 
         # Fail fast instead of enqueueing onto a dead IO loop: a call made
@@ -1256,17 +1396,20 @@ class ZMQGenClient(BoundedAgenerateMixin):
             )
         futs = []
         with self._plock:
-            for req in reqs:
+            for i, req in enumerate(reqs):
                 self._rid += 1
                 rid = self._rid
                 f: _cf.Future = _cf.Future()
                 self._pending[rid] = f
                 futs.append((rid, f))
-                self._send_q.put(
+                frames = [
                     json.dumps(
                         dict(req, rid=rid, token=self.token)
                     ).encode()
-                )
+                ]
+                if extras is not None and extras[i] is not None:
+                    frames.append(extras[i])
+                self._send_q.put(frames)
         deadline = time.monotonic() + self.timeout_s
         out = []
         try:
@@ -1324,6 +1467,14 @@ class ZMQGenClient(BoundedAgenerateMixin):
     def update_weights_from_disk(self, path: str) -> int:
         out = self._call_many([{"cmd": "update_weights", "path": path}])[0]
         return int(out["version"])
+
+    def push_weights(self, meta: Dict, payload: bytes) -> Dict:
+        """Binary fabric push (system/paramstore.py): the meta rides the
+        JSON frame, the serialized params ride a second raw frame —
+        relayed verbatim hop to hop, never re-encoded."""
+        return self._call_many(
+            [dict(meta, cmd="param_push")], extras=[payload]
+        )[0]
 
     def pause(self) -> Dict:
         return self._call_many([{"cmd": "pause"}])[0]
@@ -1402,9 +1553,20 @@ class RemoteGeneratorEngine(Engine):
         # chunk boundary around the push, so a sync costs one chunk of
         # decode latency instead of a full drain of in-flight requests.
         inmem_sync: bool = False,
+        # "fabric" routes set_params through the versioned parameter
+        # store + broadcast tree (system/paramstore.py): serialize once,
+        # relay server-to-server, O(log N) push wall-time, no disk
+        # checkpoint.  "disk" keeps the reference's save+POST loop.
+        push_mode: str = "disk",
+        push_fanout: int = 2,
     ):
         self.cfg = cfg
         self.inmem_sync = inmem_sync
+        if push_mode not in ("disk", "fabric"):
+            raise ValueError(f"unknown push_mode {push_mode!r}")
+        self.push_mode = push_mode
+        self.push_fanout = int(push_fanout)
+        self._fabric = None  # built lazily on the first fabric push
         # Multiple URLs = the reference's one-server-per-DP-rank shape
         # (sglang.py:161-226): prompts round-robin across servers, weight
         # updates broadcast to all.
@@ -1474,7 +1636,14 @@ class RemoteGeneratorEngine(Engine):
         )
 
     def set_params(self, params) -> None:
-        """Persist -> POST /update_weights (the reference's disk path)."""
+        """Ship new weights to every serving rank.  Fabric mode
+        (push_mode="fabric"): publish once into the versioned store and
+        broadcast-tree push over the binary wire — no disk checkpoint,
+        O(log N) wall-time.  Disk mode: persist -> POST /update_weights
+        (the reference's path)."""
+        if self.push_mode == "fabric":
+            self._fabric_push(params)
+            return
         from areal_tpu.models.hf import registry as hf
 
         os.makedirs(self.sync_dir, exist_ok=True)
@@ -1502,6 +1671,37 @@ class RemoteGeneratorEngine(Engine):
             if self.inmem_sync:
                 for c in self.clients:
                     c.resume()
+
+    def _fabric_push(self, params) -> None:
+        """Versioned-store push: to_host + checksum + serialize ONCE,
+        then fan out over the broadcast tree.  Orphaned subtrees (a
+        relay died mid-push) keep serving their pinned previous version
+        and catch up on the next push — a partial push degrades
+        staleness, never correctness (every apply is checksummed)."""
+        import jax
+
+        from areal_tpu.system import paramstore
+
+        if self._fabric is None:
+            store = paramstore.ParamStore()
+            # Membership is the engine's static client set: sid = url.
+            self._fabric = paramstore.BroadcastFabric(
+                store,
+                discovery=lambda: {c.url: c.url for c in self.clients},
+                fanout=self.push_fanout,
+            )
+        host = jax.tree.map(
+            lambda x: np.ascontiguousarray(np.asarray(x)), params
+        )
+        self._fabric.store.publish(host)
+        report = self._fabric.push()
+        if report.orphans:
+            logger.warning(
+                f"fabric push v{report.version}: "
+                f"{len(report.orphans)} server(s) orphaned "
+                f"({[o['sid'] for o in report.orphans]}); they serve the "
+                "previous version until the next push"
+            )
 
 
 register_backend(

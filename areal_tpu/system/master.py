@@ -1665,7 +1665,14 @@ class MasterWorker:
                 # swapping; a payload corrupted in flight raises
                 # WeightChecksumError (and bumps the rejection counter)
                 # instead of serving poisoned weights, and the push is
-                # re-dispatched once with fresh transfer ids.
+                # re-dispatched once with fresh transfer ids.  The
+                # sender's serialize-once cache (worker._handle_param_send)
+                # makes the retry reuse the gathered host tree, checksum,
+                # and wire encoding — only the corrupted-in-flight copy
+                # is re-shipped, nothing is re-gathered.
+                from areal_tpu.system.paramstore import M_PUSH_SECONDS
+
+                push_t0 = time.monotonic()
                 for attempt in (1, 2):
                     xfer_ids = list(
                         range(
@@ -1724,6 +1731,9 @@ class MasterWorker:
                             f"weight push to {hook.target} rejected by "
                             f"receiver checksum ({e}); retrying once"
                         )
+                # Same fleet signal the broadcast fabric feeds: push_p99
+                # in metrics_report covers realloc and fabric pushes.
+                M_PUSH_SECONDS.observe(time.monotonic() - push_t0)
                 for i, send_r in enumerate(resps[: len(group)]):
                     # Only member 0 actually sends (sender=i==0); the
                     # rest reply bytes=0 and must not bump the transfer

@@ -1,0 +1,698 @@
+"""Versioned parameter store + broadcast-tree distribution fabric.
+
+The missing layer between trainer and gen fleet (ROADMAP open item 2):
+until now every in-memory weight push was the master serially shipping
+the full tree point-to-point to each server — O(servers) push wall-time
+and a single point of failure.  RLAX (arxiv 2512.06392) and Podracer
+(arxiv 2104.06272) both decouple learners from actors through a
+versioned parameter-distribution layer; this module is that layer for
+the TPU process model:
+
+- **ParamStore** — the publisher serializes a params pytree ONCE per
+  version into a flat little-endian byte payload plus a (dtype, shape)
+  manifest, stamped with the per-leaf-norm checksum from
+  ``base/integrity.py``.  Versions carry reference counts: a version is
+  retained while any live server or in-flight dispatch pins it, so a
+  breaker-open or mid-episode server can still pull version v-1 on its
+  next health cycle under the ``max_head_offpolicyness`` staleness
+  bound.  Stale pins expire by TTL (a crashed holder never releases).
+
+- **Broadcast tree** — ``plan_tree`` splits the live membership (from
+  ``names.gen_servers`` discovery, the same closure
+  ``fleet.fleet_discovery`` returns) into a deterministic fan-out tree.
+  Each server receives the payload with its OWN subtree spec and relays
+  the raw bytes to its children over the existing ZMQ/HTTP transports
+  *before* applying locally via the interruptible
+  ``update_weights_inmem`` path — push wall-time is O(log N) hops
+  instead of O(N) sends.  A relay failure orphans exactly that subtree
+  (counted in ``areal_param_push_orphans_total``); orphans keep serving
+  their pinned previous version and re-attach to the root on the next
+  push, because the tree is rebuilt from live membership every time.
+
+- **BroadcastFabric** — the pusher-side driver: publish → plan → push →
+  pin → retire, plus ``repair()`` (point-to-point catch-up for laggards
+  the health cycle finds behind head) and a ``p2p`` mode that preserves
+  the old serial loop as the A/B baseline ``scripts/measure_push.py``
+  measures against.
+
+Wire format (shared by both transports; the payload bytes are relayed
+VERBATIM hop to hop — serialized once per version, never re-encoded):
+
+- HTTP ``POST /param_push``: body = 8-byte big-endian meta length +
+  meta JSON + raw payload (``frame_push_body``/``unframe_push_body``).
+- ZMQ: a 3-frame ``param_push`` request — [identity, meta JSON, payload]
+  on the server ROUTER, [meta JSON, payload] from the client DEALER.
+
+Every fabric metric is registered HERE and only here (the metrics-names
+lint rule is one-name-one-site); the master/worker push paths and the
+gen server import the handles.
+
+jax is imported lazily: serialization helpers accept host numpy pytrees
+and arealint's CI job imports modules without jax installed.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from areal_tpu.base import integrity, logging, metrics
+
+logger = logging.getLogger("paramstore")
+
+# ---------------- metrics (one registration site) ----------------
+
+_REG = metrics.default_registry()
+M_VERSIONS_LIVE = _REG.gauge(
+    "areal_paramstore_versions_live",
+    "parameter versions currently retained by the store",
+)
+M_PINS = _REG.gauge(
+    "areal_paramstore_pins",
+    "live version pins (servers + in-flight dispatches) across versions",
+)
+M_PUSH_BYTES = _REG.counter(
+    "areal_param_push_bytes_total",
+    "parameter payload bytes shipped by push/relay hops",
+)
+M_PUSH_SECONDS = _REG.histogram(
+    "areal_param_push_seconds",
+    "wall time of one fleet-wide parameter push",
+)
+M_PUSH_ORPHANS = _REG.counter(
+    "areal_param_push_orphans_total",
+    "servers orphaned by a failed relay subtree during a push",
+)
+
+# ---------------- serialization (once per version) ----------------
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """np.dtype by name, resolving jax's ml_dtypes extras (bfloat16)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def serialize_params(tree: Any) -> Tuple[List[Dict], bytes]:
+    """Flatten a params pytree into (manifest, payload) — the manifest
+    lists (dtype, shape) per leaf in ``jax.tree.leaves`` order, the
+    payload is the leaves' raw bytes concatenated.  No pytree-path codec
+    is needed: pusher and receiver share the model structure, so the
+    receiver rebuilds with its OWN treedef (``deserialize_params``)."""
+    import jax
+
+    leaves = jax.tree.leaves(tree)
+    arrs = [np.ascontiguousarray(np.asarray(x)) for x in leaves]
+    manifest = [
+        {"dtype": str(a.dtype), "shape": list(a.shape)} for a in arrs
+    ]
+    payload = b"".join(a.tobytes() for a in arrs)
+    return manifest, payload
+
+
+def deserialize_params(like: Any, manifest: List[Dict], payload: bytes):
+    """Rebuild a params pytree from (manifest, payload) using `like`'s
+    treedef.  Leaves are zero-copy read-only views over the payload —
+    engines place them onto device anyway.  A structural mismatch
+    (different leaf count/shape/dtype) raises before any leaf is built:
+    a payload for a different model must never reach the swap."""
+    import jax
+
+    like_leaves, treedef = jax.tree.flatten(like)
+    if len(like_leaves) != len(manifest):
+        raise ValueError(
+            f"param payload has {len(manifest)} leaves; this model has "
+            f"{len(like_leaves)} — wrong model for this fleet"
+        )
+    out, off = [], 0
+    for i, spec in enumerate(manifest):
+        dt = _np_dtype(str(spec["dtype"]))
+        shape = tuple(int(s) for s in spec["shape"])
+        want = tuple(np.asarray(like_leaves[i]).shape)
+        if shape != want:
+            raise ValueError(
+                f"param payload leaf {i} has shape {shape}; model "
+                f"expects {want}"
+            )
+        n = int(np.prod(shape)) if shape else 1
+        arr = np.frombuffer(
+            payload, dtype=dt, count=n, offset=off
+        ).reshape(shape)
+        out.append(arr)
+        off += n * dt.itemsize
+    if off != len(payload):
+        raise ValueError(
+            f"param payload is {len(payload)} bytes; manifest describes "
+            f"{off}"
+        )
+    return treedef.unflatten(out)
+
+
+def frame_push_body(meta: Dict, payload: bytes) -> bytes:
+    """HTTP /param_push body: 8-byte big-endian meta length + meta JSON
+    + raw payload (binary bodies cannot ride the JSON transport)."""
+    mb = json.dumps(meta).encode()
+    return len(mb).to_bytes(8, "big") + mb + payload
+
+
+def unframe_push_body(body: bytes) -> Tuple[Dict, bytes]:
+    if len(body) < 8:
+        raise ValueError("param_push body too short for its meta prefix")
+    mlen = int.from_bytes(body[:8], "big")
+    if 8 + mlen > len(body):
+        raise ValueError("param_push meta prefix exceeds the body")
+    meta = json.loads(body[8 : 8 + mlen])
+    return meta, body[8 + mlen :]
+
+
+# ---------------- the versioned store ----------------
+
+
+@dataclasses.dataclass
+class ParamVersion:
+    """One published version: the serialize-once payload + its manifest
+    and checksum, reused verbatim across every target, relay hop, and
+    checksum-reject retry."""
+
+    version: int
+    manifest: List[Dict]
+    payload: bytes
+    checksum: Optional[np.ndarray]
+    published_s: float
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.payload)
+
+
+class ParamStore:
+    """Versioned parameter store with per-version reference counts.
+
+    ``publish`` serializes once and bumps the head version; ``pin``
+    records a named holder on a version (servers pin EXCLUSIVELY — a
+    holder serves exactly one version; in-flight dispatches pin
+    additively and ``release`` on completion).  ``retire`` drops
+    versions that are not the head, not within the ``retain`` newest,
+    and hold no live pins — after expiring pins older than
+    ``pin_ttl_s`` (a crashed holder never releases; its pins age out
+    exactly like its fleet announcement).  ``retain=2`` keeps v-1
+    pullable even before anyone pins it, which is what lets a server
+    that missed a push catch up within the staleness bound."""
+
+    def __init__(
+        self,
+        retain: int = 2,
+        pin_ttl_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if retain < 1:
+            raise ValueError(f"retain must be >= 1, got {retain}")
+        self.retain = int(retain)
+        self.pin_ttl_s = float(pin_ttl_s)
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._head = 0
+        self._versions: Dict[int, ParamVersion] = {}
+        # version -> holder -> last pin/refresh stamp (clock units)
+        self._pins: Dict[int, Dict[str, float]] = {}
+
+    # -- publishing --
+
+    def publish(
+        self,
+        params: Any = None,
+        checksum: Optional[np.ndarray] = None,
+        *,
+        manifest: Optional[List[Dict]] = None,
+        payload: Optional[bytes] = None,
+    ) -> int:
+        """Serialize ONCE and retain under the next version number.
+        Pass either a params pytree (serialized + checksummed here) or a
+        pre-serialized (manifest, payload) pair."""
+        if params is not None:
+            manifest, payload = serialize_params(params)
+            if checksum is None:
+                checksum = integrity.params_checksum(params)
+        if manifest is None or payload is None:
+            raise ValueError("publish needs params or (manifest, payload)")
+        with self._lock:
+            self._head += 1
+            v = self._head
+            self._versions[v] = ParamVersion(
+                version=v,
+                manifest=list(manifest),
+                payload=bytes(payload),
+                checksum=(
+                    None if checksum is None
+                    else np.asarray(checksum, np.float64)
+                ),
+                published_s=self._clock(),
+            )
+            self._retire_locked()
+        logger.info(
+            f"published version {v} ({len(payload)} bytes, "
+            f"{len(manifest)} leaves)"
+        )
+        return v
+
+    @property
+    def head(self) -> int:
+        with self._lock:
+            return self._head
+
+    def get(self, version: int) -> Optional[ParamVersion]:
+        with self._lock:
+            return self._versions.get(int(version))
+
+    def live_versions(self) -> List[int]:
+        with self._lock:
+            return sorted(self._versions)
+
+    # -- reference counts --
+
+    def pin(self, version: int, holder: str, exclusive: bool = True) -> bool:
+        """Pin `version` for `holder` (refreshing its TTL stamp).  With
+        ``exclusive`` (server semantics: one served version per server)
+        the holder's pins on other versions are released.  Returns False
+        when the version is unknown/already retired — a pin cannot
+        resurrect dropped bytes."""
+        version = int(version)
+        with self._lock:
+            if version not in self._versions:
+                if exclusive:
+                    self._release_holder_locked(holder)
+                    self._retire_locked()
+                return False
+            if exclusive:
+                for v, holders in self._pins.items():
+                    if v != version:
+                        holders.pop(holder, None)
+            self._pins.setdefault(version, {})[holder] = self._clock()
+            self._retire_locked()
+            return True
+
+    def release(self, version: int, holder: str) -> None:
+        with self._lock:
+            self._pins.get(int(version), {}).pop(holder, None)
+            self._retire_locked()
+
+    def release_holder(self, holder: str) -> None:
+        """Drop every pin held by `holder` (server drained/reaped)."""
+        with self._lock:
+            self._release_holder_locked(holder)
+            self._retire_locked()
+
+    def _release_holder_locked(self, holder: str) -> None:
+        for holders in self._pins.values():
+            holders.pop(holder, None)
+
+    def pins(self, version: int) -> List[str]:
+        with self._lock:
+            return sorted(self._pins.get(int(version), {}))
+
+    # -- retention --
+
+    def retire(self) -> List[int]:
+        """Expire stale pins, then drop every version that is neither
+        the head, within the `retain` newest, nor pinned.  Returns the
+        versions dropped."""
+        with self._lock:
+            return self._retire_locked()
+
+    def _retire_locked(self) -> List[int]:
+        now = self._clock()
+        for holders in self._pins.values():
+            for h, stamp in list(holders.items()):
+                if now - stamp > self.pin_ttl_s:
+                    holders.pop(h)
+        dropped = []
+        for v in sorted(self._versions):
+            if v > self._head - self.retain:
+                continue
+            if self._pins.get(v):
+                continue
+            del self._versions[v]
+            self._pins.pop(v, None)
+            dropped.append(v)
+        # Pin maps for versions already gone hold nothing worth keeping.
+        for v in [v for v in self._pins if v not in self._versions]:
+            if not self._pins[v]:
+                del self._pins[v]
+        M_VERSIONS_LIVE.set(len(self._versions))
+        M_PINS.set(sum(len(h) for h in self._pins.values()))
+        if dropped:
+            logger.info(f"retired versions {dropped}")
+        return dropped
+
+    # -- persistence (RecoverInfo.paramstore_state) --
+
+    def state_dict(self) -> Dict:
+        """Version COUNTER state only: payloads are step products a
+        restarted trainer re-publishes, but the head number must stay
+        monotonic across restarts or rejoining servers would see
+        version time run backwards."""
+        with self._lock:
+            return {"head": self._head}
+
+    def load_state_dict(self, state: Optional[Dict]) -> None:
+        if not state:
+            return
+        with self._lock:
+            self._head = max(self._head, int(state.get("head", 0)))
+
+
+# ---------------- the broadcast tree ----------------
+
+
+def plan_tree(
+    members: List[Tuple[str, str]], fanout: int = 2
+) -> List[Dict]:
+    """Deterministic fan-out tree over (sid, url) members: the sorted
+    membership splits into ≤ `fanout` balanced contiguous chunks, each
+    chunk's first member relays to a recursively planned subtree of the
+    rest — depth O(log_fanout N).  Returns the root's child nodes, each
+    ``{"sid", "url", "children": [...]}``; membership changes between
+    pushes simply replan (nothing is stateful)."""
+    members = sorted(members)
+    fanout = max(1, int(fanout))
+    if not members:
+        return []
+    k = min(fanout, len(members))
+    base, extra = divmod(len(members), k)
+    nodes, off = [], 0
+    for i in range(k):
+        size = base + (1 if i < extra else 0)
+        chunk = members[off : off + size]
+        off += size
+        sid, url = chunk[0]
+        nodes.append(
+            {
+                "sid": sid,
+                "url": url,
+                "children": plan_tree(chunk[1:], fanout),
+            }
+        )
+    return nodes
+
+
+def subtree_sids(node: Dict) -> List[str]:
+    out = [str(node["sid"])]
+    for c in node.get("children") or ():
+        out.extend(subtree_sids(c))
+    return out
+
+
+def tree_depth(nodes: List[Dict]) -> int:
+    if not nodes:
+        return 0
+    return 1 + max(tree_depth(n.get("children") or []) for n in nodes)
+
+
+# ---------------- push transport ----------------
+
+
+def push_payload(
+    url: str,
+    meta: Dict,
+    payload: bytes,
+    token: str = "",
+    timeout_s: float = 120.0,
+) -> Dict:
+    """Ship one (meta, payload) push to a server over its transport
+    (zmq:// → 2-frame DEALER request; http:// → binary POST
+    /param_push).  The payload bytes go out VERBATIM — this is the only
+    hop primitive, so every hop counts into the bytes total and no hop
+    ever re-serializes."""
+    mb_len = len(json.dumps(meta).encode())
+    if url.startswith("zmq://"):
+        from areal_tpu.system.gen_server import ZMQGenClient
+
+        client = ZMQGenClient(url, timeout_s=timeout_s, token=token)
+        try:
+            ack = client.push_weights(meta, payload)
+        finally:
+            client.close()
+    else:
+        from areal_tpu.api.model_api import LLMAPIClient
+
+        ack = LLMAPIClient(url, timeout_s=timeout_s, token=token)\
+            .push_weights(meta, payload)
+    M_PUSH_BYTES.inc(len(payload) + mb_len)
+    return ack
+
+
+def relay_subtrees(
+    children: List[Dict],
+    base_meta: Dict,
+    payload: bytes,
+    token: str = "",
+    timeout_s: float = 120.0,
+) -> Tuple[List[str], List[Dict]]:
+    """Push `payload` to each child subtree concurrently; aggregate the
+    (applied, failed) sid sets the acks report.  A child that cannot be
+    reached orphans its WHOLE subtree — degradation is per-subtree, and
+    the orphans re-attach when the next push replans over live
+    membership."""
+    applied: List[str] = []
+    failed: List[Dict] = []
+    if not children:
+        return applied, failed
+    from concurrent.futures import ThreadPoolExecutor
+
+    def one(node: Dict):
+        return push_payload(
+            str(node["url"]),
+            dict(base_meta, subtree=node),
+            payload,
+            token=token,
+            timeout_s=timeout_s,
+        )
+
+    with ThreadPoolExecutor(len(children)) as pool:
+        for node, fut in [
+            (n, pool.submit(one, n)) for n in children
+        ]:
+            try:
+                ack = fut.result()
+                applied.extend(str(s) for s in ack.get("applied", ()))
+                failed.extend(ack.get("failed", ()))
+            except Exception as e:  # noqa: BLE001 — orphan the subtree
+                logger.warning(
+                    f"relay to {node['sid']} failed: {e!r}; subtree "
+                    "orphaned until the next push"
+                )
+                failed.extend(
+                    {"sid": s, "error": repr(e)}
+                    for s in subtree_sids(node)
+                )
+    return applied, failed
+
+
+# ---------------- the pusher-side fabric ----------------
+
+
+@dataclasses.dataclass
+class PushReport:
+    version: int
+    targets: int
+    applied: List[str]
+    orphans: List[Dict]  # [{"sid", "error"}]
+    seconds: float
+    nbytes: int
+    depth: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.orphans and self.targets == len(self.applied)
+
+
+class BroadcastFabric:
+    """Drives pushes from a ParamStore over live fleet membership.
+
+    `discovery` is the ``fleet_discovery(experiment, trial)`` closure
+    (sid → url); membership is re-listed on EVERY push, so joins,
+    drains, and expiries between pushes rebuild the tree instead of
+    wedging it.  ``mode="p2p"`` preserves the old serial point-to-point
+    loop as the A/B baseline for ``scripts/measure_push.py``."""
+
+    def __init__(
+        self,
+        store: ParamStore,
+        discovery: Callable[[], Dict[str, str]],
+        fanout: int = 2,
+        mode: str = "tree",
+        token: str = "",
+        timeout_s: float = 120.0,
+        experiment: str = "",
+        trial: str = "trial",
+    ):
+        if mode not in ("tree", "p2p"):
+            raise ValueError(f"unknown push mode {mode!r}")
+        if fanout < 1:
+            raise ValueError(f"fanout must be >= 1, got {fanout}")
+        self.store = store
+        self.discovery = discovery
+        self.fanout = int(fanout)
+        self.mode = mode
+        self.token = token
+        self.timeout_s = float(timeout_s)
+        self.experiment = experiment
+        self.trial = trial
+
+    def _base_meta(self, pv: ParamVersion) -> Dict:
+        return {
+            "cmd": "param_push",
+            "version": pv.version,
+            "manifest": pv.manifest,
+            "checksum": (
+                None if pv.checksum is None else pv.checksum.tolist()
+            ),
+        }
+
+    def _announce_head(self) -> None:
+        """Publish the store head under ``names.param_store`` — the
+        rendezvous key a late-joining (or multi-slice) trainer reads to
+        continue version time instead of restarting it."""
+        if not self.experiment:
+            return
+        from areal_tpu.base import name_resolve, names
+
+        try:
+            name_resolve.add(
+                names.param_store(self.experiment, self.trial),
+                str(self.store.head),
+                replace=True,
+                delete_on_exit=True,
+            )
+        except Exception:  # noqa: BLE001 — rendezvous is best-effort
+            logger.warning("param_store head announce failed", exc_info=True)
+
+    def push(self, version: Optional[int] = None) -> PushReport:
+        """Push `version` (default: head) to the whole live fleet."""
+        v = int(version) if version is not None else self.store.head
+        pv = self.store.get(v)
+        if pv is None:
+            raise KeyError(f"version {v} is not retained by the store")
+        membership = sorted(dict(self.discovery() or {}).items())
+        t0 = time.monotonic()
+        base = self._base_meta(pv)
+        applied: List[str] = []
+        failed: List[Dict] = []
+        if self.mode == "p2p":
+            # The historic serial loop: one direct send per server, no
+            # relaying.  Kept as the measurable A/B baseline.
+            roots = [
+                {"sid": sid, "url": url, "children": []}
+                for sid, url in membership
+            ]
+            for node in roots:
+                a, f = relay_subtrees(
+                    [node], base, pv.payload,
+                    token=self.token, timeout_s=self.timeout_s,
+                )
+                applied.extend(a)
+                failed.extend(f)
+        else:
+            roots = plan_tree(membership, self.fanout)
+            applied, failed = relay_subtrees(
+                roots, base, pv.payload,
+                token=self.token, timeout_s=self.timeout_s,
+            )
+        dt = time.monotonic() - t0
+        M_PUSH_SECONDS.observe(dt)
+        if failed:
+            M_PUSH_ORPHANS.inc(len(failed))
+        for sid in applied:
+            self.store.pin(v, f"server:{sid}")
+        self.store.retire()
+        self._announce_head()
+        report = PushReport(
+            version=v,
+            targets=len(membership),
+            applied=applied,
+            orphans=failed,
+            seconds=dt,
+            nbytes=pv.nbytes,
+            depth=tree_depth(roots),
+        )
+        logger.info(
+            f"pushed v{v} to {len(applied)}/{len(membership)} servers "
+            f"in {dt * 1e3:.1f}ms (depth {report.depth}, "
+            f"{len(failed)} orphaned)"
+        )
+        return report
+
+    # -- laggard catch-up --
+
+    def push_to(self, sid: str, url: str, version: int) -> Dict:
+        """Direct (no relay) push of one retained version to one server
+        — the v-1 pull path: a mid-episode or breaker-recovering server
+        catches up to the freshest version its staleness bound admits
+        without waiting for the next fleet-wide push."""
+        pv = self.store.get(version)
+        if pv is None:
+            raise KeyError(
+                f"version {version} is not retained by the store"
+            )
+        ack = push_payload(
+            url,
+            dict(
+                self._base_meta(pv),
+                subtree={"sid": sid, "url": url, "children": []},
+            ),
+            pv.payload,
+            token=self.token,
+            timeout_s=self.timeout_s,
+        )
+        self.store.pin(version, f"server:{sid}")
+        self.store.retire()
+        return ack
+
+    def poll_versions(self) -> Dict[str, Optional[int]]:
+        """Served weight version per live member (None: unreachable)."""
+        out: Dict[str, Optional[int]] = {}
+        from areal_tpu.system.gen_server import make_gen_client
+
+        for sid, url in sorted(dict(self.discovery() or {}).items()):
+            client = None
+            try:
+                client = make_gen_client(
+                    url, token=self.token, timeout_s=30.0
+                )
+                out[sid] = int(client.health()["version"])
+            except Exception:  # noqa: BLE001 — dead member
+                out[sid] = None
+            finally:
+                if client is not None and hasattr(client, "close"):
+                    client.close()
+        return out
+
+    def repair(self) -> List[str]:
+        """Bring every reachable laggard back to head with a direct
+        push.  Orphans from a failed relay subtree land here on the next
+        health cycle (or simply on the next fleet-wide push)."""
+        head = self.store.head
+        if head == 0 or self.store.get(head) is None:
+            return []
+        repaired = []
+        membership = dict(self.discovery() or {})
+        for sid, ver in self.poll_versions().items():
+            if ver is None or ver >= head:
+                continue
+            try:
+                self.push_to(sid, membership[sid], head)
+                repaired.append(sid)
+            except Exception:  # noqa: BLE001 — next cycle retries
+                logger.warning(
+                    f"repair push to {sid} failed", exc_info=True
+                )
+        if repaired:
+            logger.info(f"repaired laggards to v{head}: {repaired}")
+        return repaired

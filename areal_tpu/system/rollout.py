@@ -161,6 +161,18 @@ class RolloutController:
         # it on a worker thread, so deadline/retry/breaker semantics
         # apply to the whole episode.
         episode_runner: Optional[Callable[[Any, str, List[int]], Any]] = None,
+        # Versioned parameter store (system/paramstore.py).  When set,
+        # the controller maintains the store's refcounts from what it
+        # already observes: each health poll pins the server's reported
+        # serving version under ``server:{sid}`` (exclusive — the pin
+        # FOLLOWS the server as it upgrades), each dispatch pins the
+        # trainer version under ``dispatch:{qid}`` until the prompt
+        # terminates, and a fleet reap releases every pin the departed
+        # server held.  Net effect: a version is retired only when no
+        # live server serves it and no in-flight prompt was dispatched
+        # against it — the refcount lifecycle that lets a
+        # breaker-open/mid-episode laggard still pull head-1.
+        paramstore: Optional[Any] = None,
     ):
         if not clients and discovery is None:
             raise ValueError(
@@ -187,6 +199,7 @@ class RolloutController:
         self.breaker_threshold = breaker_threshold
         self.breaker_cooldown_s = breaker_cooldown_s
         self.episode_runner = episode_runner
+        self.paramstore = paramstore
         # Lineage: pass trace_id through to the runner only when its
         # signature can take it — external runners predating the causal
         # lineage plane keep working unchanged.
@@ -335,6 +348,14 @@ class RolloutController:
             del self._by_sid[st.sid]
             changed = True
             logger.info(f"fleet reap: {st.sid}")
+            if self.paramstore is not None:
+                # A dead/drained server no longer holds its version
+                # alive (TTL expiry in the store covers the crash case
+                # where no reap is ever observed).
+                try:
+                    self.paramstore.release_holder(f"server:{st.sid}")
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
             close = getattr(st.client, "close", None)
             if st.dynamic and callable(close):
                 try:
@@ -406,6 +427,18 @@ class RolloutController:
         st.health = h
         st.healthy = True
         br.record_success()
+        if self.paramstore is not None and h.get("version") is not None:
+            # Exclusive pin: the holder tracks the server's CURRENT
+            # serving version, releasing its previous pin as it
+            # upgrades.  A laggard (breaker-open during a push) keeps
+            # head-1 alive in the store until it catches up or is
+            # reaped.
+            try:
+                self.paramstore.pin(
+                    int(h["version"]), f"server:{st.sid}", exclusive=True
+                )
+            except Exception:  # noqa: BLE001 — accounting, not dispatch
+                pass
         cap = int(h.get("capacity", 0))
         if cap > 0 and self.autosize_inflight:
             # Size each client's agenerate bound to what its server can
@@ -674,6 +707,19 @@ class RolloutController:
                 in_flight=self.stat.in_flight,
                 backpressured=0,
             )
+            # In-flight pin: the version this prompt was dispatched
+            # against stays resident in the store until the prompt
+            # terminates, so a server finishing a long episode can
+            # still be repaired to that version if it lags.
+            if self.paramstore is not None:
+                try:
+                    self.paramstore.pin(
+                        self.replay.version,
+                        f"dispatch:{qid}",
+                        exclusive=False,
+                    )
+                except Exception:  # noqa: BLE001 — accounting only
+                    pass
             try:
                 out = await self._generate_with_retries(
                     qid, prompt_ids, trace_id
@@ -681,6 +727,11 @@ class RolloutController:
             finally:
                 self.stat.in_flight -= 1
                 self._m_in_flight.set(self.stat.in_flight)
+                if self.paramstore is not None:
+                    try:
+                        self.paramstore.release_holder(f"dispatch:{qid}")
+                    except Exception:  # noqa: BLE001 — accounting only
+                        pass
             if out is None:
                 # Exhausted every retry: the prompt is explicitly failed
                 # — visible in stat/metrics — never silently dropped.

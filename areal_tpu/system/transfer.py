@@ -57,6 +57,25 @@ class TransferPlane:
     def send(self, dst: int, xfer_id: int, payload: Any) -> int:
         raise NotImplementedError
 
+    def send_many(
+        self,
+        dsts: List[int],
+        xfer_ids: List[int],
+        payload: Any,
+        encoded: Optional[Tuple[bytes, List]] = None,
+    ) -> int:
+        """Fan one payload out to many targets, ENCODING IT ONCE — the
+        param-push fix: the old per-target send() re-walked and
+        re-pickled the full tree per destination (and again on a
+        checksum-reject retry).  `encoded` lets the caller cache the
+        ``encode_oob`` result across retries too.  Returns total wire
+        bytes (the per-target payload summed: that is what a pod
+        ships)."""
+        total = 0
+        for dst, xid in zip(dsts, xfer_ids):
+            total += self.send(dst, xid, payload)
+        return total
+
     def recv(self, timeout: float = 300.0) -> Tuple[int, Any]:
         """Returns (xfer_id, payload)."""
         raise NotImplementedError
@@ -88,6 +107,27 @@ class InProcTransfer(TransferPlane):
             nbytes = payload_nbytes(meta, buffers)
             targs["bytes"] = nbytes
         return nbytes
+
+    def send_many(
+        self,
+        dsts: List[int],
+        xfer_ids: List[int],
+        payload: Any,
+        encoded: Optional[Tuple[bytes, List]] = None,
+    ) -> int:
+        # One encode (for the byte count a pod would ship), N reference
+        # moves — the in-process mirror of the zero-re-serialization
+        # fan-out below.
+        with tracer.span(
+            "xfer_send", cat="comms", dsts=len(dsts)
+        ) as targs:
+            meta, buffers = encoded or encode_oob(payload)
+            nbytes = payload_nbytes(meta, buffers)
+            for dst, xid in zip(dsts, xfer_ids):
+                self.inboxes[dst].put((xid, payload))
+            total = nbytes * len(dsts)
+            targs["bytes"] = total
+        return total
 
     def recv(self, timeout: float = 300.0) -> Tuple[int, Any]:
         with tracer.span("xfer_recv", cat="comms"):
@@ -130,28 +170,53 @@ class ZMQTransfer(TransferPlane):
             f"worker {worker_index} transfer plane bound at {self._addr}"
         )
 
-    def send(self, dst: int, xfer_id: int, payload: Any) -> int:
+    def _sock_for(self, dst: int):
+        # Caller holds self._lock.
         import zmq
 
-        # Multipart zero-copy framing: frame 0 = pickle metadata, frames
-        # 1.. = raw array buffers (protocol-5 out-of-band) — numpy data is
-        # handed to zmq without an intermediate pickle copy.
-        with tracer.span("xfer_send", cat="comms", dst=dst) as targs:
-            meta, buffers = encode_oob((xfer_id, payload))
-            frames = [meta] + [b.raw() for b in buffers]
-            with self._lock:
-                if dst not in self._push:
-                    addr = name_resolve.wait(
-                        pushpull_name(self.experiment, self.trial, dst),
-                        timeout=300,
-                    )
-                    s = self._ctx.socket(zmq.PUSH)
-                    s.connect(addr)
-                    self._push[dst] = s
-                self._push[dst].send_multipart(frames, copy=False)
+        if dst not in self._push:
+            addr = name_resolve.wait(
+                pushpull_name(self.experiment, self.trial, dst),
+                timeout=300,
+            )
+            s = self._ctx.socket(zmq.PUSH)
+            s.connect(addr)
+            self._push[dst] = s
+        return self._push[dst]
+
+    def send(self, dst: int, xfer_id: int, payload: Any) -> int:
+        return self.send_many([dst], [xfer_id], payload)
+
+    def send_many(
+        self,
+        dsts: List[int],
+        xfer_ids: List[int],
+        payload: Any,
+        encoded: Optional[Tuple[bytes, List]] = None,
+    ) -> int:
+        # Multipart zero-copy framing: frame 0 = the tiny xfer-id pickle
+        # (per-target), frame 1 = payload pickle metadata, frames 2.. =
+        # raw array buffers (protocol-5 out-of-band).  The xfer id rides
+        # its OWN frame so the big payload encoding is computed ONCE and
+        # shared verbatim across every target — and, via `encoded`,
+        # across a checksum-reject retry (the old framing pickled
+        # (xfer_id, payload) together, re-walking the full tree per
+        # target).
+        with tracer.span(
+            "xfer_send", cat="comms", dsts=len(dsts)
+        ) as targs:
+            meta, buffers = encoded or encode_oob(payload)
+            shared = [meta] + [b.raw() for b in buffers]
             nbytes = payload_nbytes(meta, buffers)
-            targs["bytes"] = nbytes
-        return nbytes
+            total = 0
+            with self._lock:
+                for dst, xid in zip(dsts, xfer_ids):
+                    self._sock_for(dst).send_multipart(
+                        [pickle.dumps(xid)] + shared, copy=False
+                    )
+                    total += nbytes
+            targs["bytes"] = total
+        return total
 
     def recv(self, timeout: float = 300.0) -> Tuple[int, Any]:
         import zmq
@@ -170,10 +235,12 @@ class ZMQTransfer(TransferPlane):
             # multi-process runs — exactly where CI coverage is thinnest.
             # The send side stays zero-copy; this is the single
             # unavoidable receive copy.
-            return pickle.loads(
-                frames[0].buffer,
-                buffers=[bytearray(f.buffer) for f in frames[1:]],
+            xid = pickle.loads(frames[0].buffer)
+            payload = pickle.loads(
+                frames[1].buffer,
+                buffers=[bytearray(f.buffer) for f in frames[2:]],
             )
+            return xid, payload
 
     def close(self) -> None:
         with self._lock:

@@ -175,6 +175,11 @@ class ModelWorker:
         self.models: Dict[str, Model] = {}
         self.interfaces: Dict[str, Any] = {}
         self.data_cache: Dict[str, SequenceSample] = {}
+        # Serialize-once cache for param pushes, keyed by model name:
+        # (host tree, checksum, wire encoding) survive across targets
+        # AND across the master's checksum-reject retry; invalidated by
+        # identity when a train step replaces the device tree.
+        self._param_send_cache: Dict[str, Dict] = {}
         # Open pipeline-overlapped train streams, keyed by model name
         # (mfc_stream_begin -> N x mfc_stream_chunk -> mfc_stream_end).
         self._streams: Dict[str, Dict[str, Any]] = {}
@@ -785,33 +790,70 @@ class ModelWorker:
         ``weight_push_checksum``), the payload carries a content
         checksum stamped BEFORE the wire so the receiver can reject a
         push corrupted in flight instead of swapping poisoned weights in
-        (see base/integrity.py)."""
+        (see base/integrity.py).
+
+        Serialize-once discipline: the (host gather, checksum, wire
+        encoding) triple is computed once per distinct device tree and
+        cached — ``send_many`` shares the encoding across every target,
+        and a checksum-reject retry (the master re-dispatches this
+        request wholesale) reuses the cache instead of re-gathering and
+        re-pickling the full tree.  The cache is validated by OBJECT
+        identity of the tree and its first leaf (jax updates replace
+        leaf arrays, never mutates them), so a train step naturally
+        invalidates it.  A poisoned attempt (`corrupt_push` chaos)
+        encodes its corrupted copy fresh and never touches the cache —
+        the retry must land the clean payload."""
         import jax
 
         from areal_tpu.base import integrity
         from areal_tpu.base.distributed import to_host
+        from areal_tpu.system.paramstore import M_PUSH_BYTES
 
         t0 = time.monotonic()
         params = self.models[req["model_name"]].engine.get_params()
-        host = jax.tree.map(to_host, params)
+        leaves = jax.tree.leaves(params)
+        cache = self._param_send_cache.get(req["model_name"])
+        if (
+            cache is None
+            or cache["params"] is not params
+            or (leaves and cache["leaf0"] is not leaves[0])
+            or cache["with_checksum"] != bool(req.get("checksum", True))
+        ):
+            host = jax.tree.map(to_host, params)
+            cache = {
+                "params": params,
+                "leaf0": leaves[0] if leaves else None,
+                "with_checksum": bool(req.get("checksum", True)),
+                "host": host,
+                "checksum": (
+                    integrity.params_checksum(host)
+                    if req.get("checksum", True)
+                    else None
+                ),
+                "encoded": None,
+            }
+            self._param_send_cache[req["model_name"]] = cache
+        host, checksum = cache["host"], cache["checksum"]
         nbytes = 0
         if req.get("sender", True):
-            checksum = (
-                integrity.params_checksum(host)
-                if req.get("checksum", True)
-                else None
-            )
+            encoded = cache["encoded"]
             if (
                 self._faults is not None
                 and self._faults.poison("weight_push") == "corrupt_push"
             ):
                 host = integrity.corrupt_params(host)
+                encoded = None  # poisoned payloads are never cached
             dsts = req.get("dsts") or [req["dst"]]
             xids = req.get("xfer_ids") or [req["xfer_id"]]
-            for dst, xid in zip(dsts, xids):
-                nbytes += self.transfer.send(
-                    dst, xid, ("params", host, checksum)
-                )
+            payload = ("params", host, checksum)
+            if encoded is None and host is cache["host"]:
+                from areal_tpu.system.transfer import encode_oob
+
+                encoded = cache["encoded"] = encode_oob(payload)
+            nbytes = self.transfer.send_many(
+                dsts, xids, payload, encoded=encoded
+            )
+            M_PUSH_BYTES.inc(nbytes)
         return {"bytes": nbytes, "seconds": time.monotonic() - t0}
 
     def _handle_param_recv(self, req):

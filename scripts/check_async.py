@@ -94,6 +94,22 @@ reward fabric's sandboxed code backend, and a mid-episode in-memory
 weight push parks the slot at a chunk boundary, swaps weights, and
 resumes the SAME episode to completion.
 
+Part 8 (`--push-chaos`) is the parameter-distribution-fabric chaos leg
+(system/paramstore.py): FIVE discovered gen servers receive a clean
+broadcast-tree weight push (v1), then the first relay in the tree — a
+node with two children — is killed mid-broadcast
+(`kill@point=param_push&skip=1`) during the v2 push.  Asserted: ZERO
+torn versions (every live server's params verify against the published
+checksum of exactly the version it reports — laggards serve v1 = head-1,
+NEVER v-2, the store retains v1 purely through the orphans' pins under
+retain=1); the kill orphans exactly the victim's subtree (3 servers,
+counted in `areal_param_push_orphans_total`) while the other subtree
+applies v2; the victim's fault-kill flight dump exists and
+`trace_report --flight` renders it; after a restart on the same port,
+`BroadcastFabric.repair()` catches the laggards up to head and the next
+fleet push (v3) converges all five servers with no orphans,
+`areal_gen_weight_push_rejected_total` never moving.
+
 Exit 0 iff every check passes.  CI-friendly: CPU-only, tiny random
 model, a few minutes end to end.
 """
@@ -706,6 +722,285 @@ def check_chaos(n_prompts: int = 40, kill_after_s: float = 2.5) -> int:
         )
         print()
         print("--- trace_report --flight (last 60s before the kill) ---")
+        print(rendered)
+    return len(failures)
+
+
+def check_push_chaos(n_servers: int = 5, fanout: int = 2) -> int:
+    """Parameter-distribution-fabric chaos leg (see module docstring,
+    Part 8): kill the first relay mid-broadcast, prove zero torn
+    versions + the v-1 staleness bound, repair, converge."""
+    import json
+
+    import jax
+
+    from areal_tpu.apps import trace_report
+    from areal_tpu.base import faults, integrity, name_resolve, tracer
+    from areal_tpu.base.name_resolve import MemoryNameResolveRepository
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.engines.generator import GeneratorEngine
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.system import paramstore
+    from areal_tpu.system.fleet import fleet_discovery
+    from areal_tpu.system.gen_server import GenerationServer
+    from areal_tpu.system.paramstore import (
+        BroadcastFabric,
+        ParamStore,
+        plan_tree,
+        subtree_sids,
+    )
+
+    name_resolve.set_default(MemoryNameResolveRepository())
+    exp, trial = "pushchaos", "t0"
+    failures = []
+    trace_dir = tempfile.mkdtemp(prefix="areal_tpu_push_chaos_trace_")
+    os.environ["AREAL_TRACE_DIR"] = trace_dir
+    tracer.configure(
+        role="push_chaos", rank=0, dir=trace_dir, enabled=True, force=True
+    )
+
+    cfg = tiny_config()
+    mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+
+    def metric(m):
+        return m._default().get()
+
+    servers = []
+    for i in range(n_servers):
+        eng = GeneratorEngine(
+            cfg,
+            tfm.init_params(cfg, jax.random.PRNGKey(i)),
+            mesh,
+            eos_token_id=cfg.vocab_size + 7,
+        )
+        srv = GenerationServer(eng, max_wait_ms=2.0, zmq_port=None)
+        # Long TTL: the crashed victim's announcement must outlive the
+        # dead window (crash semantics skip deregistration).
+        srv.announce(exp, trial, ttl=30.0)
+        servers.append(srv)
+    by_sid = {f"s{s.port}": s for s in servers}
+    restarted = {}
+
+    # The victim is the FIRST relay in the planned tree: with 5 sorted
+    # members at fanout 2 the chunks split [3, 2], so the lowest sid
+    # heads the larger subtree and relays to two children — killing it
+    # orphans exactly those three servers.
+    discovery = fleet_discovery(exp, trial)
+    roots = plan_tree(sorted(discovery().items()), fanout)
+    victim_node = roots[0]
+    victim_sid = str(victim_node["sid"])
+    victim_subtree = set(subtree_sids(victim_node))
+    victim = by_sid[victim_sid]
+    victim_port = victim.port
+    victim_engine = victim.engine
+    if len(victim_node["children"]) != 2 or len(victim_subtree) != 3:
+        failures.append(
+            f"tree plan surprise: victim {victim_sid} heads subtree "
+            f"{sorted(victim_subtree)} (expected itself + 2 children)"
+        )
+    # Point-scoped kill, armed AFTER construction so the victim is
+    # chosen from the planned tree: the first param_push applies
+    # cleanly (skip=1), the second — the v2 relay hop — crashes the
+    # server mid-broadcast.
+    victim._faults = faults.FaultInjector.parse(
+        "kill@point=param_push&skip=1"
+    )
+
+    # retain=1 on purpose: v1 surviving the v2 push below proves the
+    # ORPHANS' pins (not a retention window) are what keep head-1
+    # pullable for laggards.
+    store = ParamStore(retain=1)
+    fabric = BroadcastFabric(
+        store, discovery=discovery, fanout=fanout, timeout_s=30.0,
+        experiment=exp, trial=trial,
+    )
+    rejected0 = metric(integrity.M_PUSH_REJECTED)
+    orphans0 = metric(paramstore.M_PUSH_ORPHANS)
+
+    pushed = [
+        jax.block_until_ready(
+            tfm.init_params(cfg, jax.random.PRNGKey(100 + i))
+        )
+        for i in range(3)
+    ]
+    checksums = [integrity.params_checksum(p) for p in pushed]
+
+    def verify_fleet(live, want_version_of):
+        """Every live server's params must verify against the checksum
+        of EXACTLY the version it reports — the zero-torn-versions
+        invariant."""
+        for sid, srv in live.items():
+            v = srv.version
+            want = want_version_of(sid)
+            if v != want:
+                failures.append(
+                    f"{sid} serves v{v}, expected v{want}"
+                )
+                continue
+            if v == 0:
+                continue
+            try:
+                integrity.verify_checksum(
+                    srv.engine.params, checksums[v - 1]
+                )
+            except integrity.WeightChecksumError as e:
+                failures.append(
+                    f"TORN VERSION on {sid}: serving v{v} but params "
+                    f"do not verify: {e}"
+                )
+
+    try:
+        # ---- push v1: a clean fleet-wide broadcast ------------------
+        store.publish(pushed[0], checksums[0])
+        r1 = fabric.push()
+        if not r1.ok or sorted(r1.applied) != sorted(by_sid):
+            failures.append(
+                f"clean v1 push did not reach the whole fleet: "
+                f"applied={sorted(r1.applied)} orphans={r1.orphans}"
+            )
+        if r1.depth < 2:
+            failures.append(
+                f"v1 push depth {r1.depth} < 2: the tree degenerated "
+                "to a star, nothing relayed"
+            )
+        verify_fleet(by_sid, lambda sid: 1)
+
+        # ---- push v2: the victim dies mid-broadcast -----------------
+        store.publish(pushed[1], checksums[1])
+        r2 = fabric.push()
+        orphaned = {str(o["sid"]) for o in r2.orphans}
+        if orphaned != victim_subtree:
+            failures.append(
+                f"expected the kill to orphan exactly the victim "
+                f"subtree {sorted(victim_subtree)}, got "
+                f"{sorted(orphaned)}"
+            )
+        if sorted(r2.applied) != sorted(set(by_sid) - victim_subtree):
+            failures.append(
+                f"v2 push applied {sorted(r2.applied)}, expected the "
+                f"non-victim subtree "
+                f"{sorted(set(by_sid) - victim_subtree)}"
+            )
+        if metric(paramstore.M_PUSH_ORPHANS) - orphans0 != len(
+            victim_subtree
+        ):
+            failures.append(
+                "areal_param_push_orphans_total moved by "
+                f"{metric(paramstore.M_PUSH_ORPHANS) - orphans0}, "
+                f"expected {len(victim_subtree)}"
+            )
+        if victim._faults.fired.get("kill", 0) != 1:
+            failures.append("the param_push kill fault never fired")
+        # Staleness bound: every surviving laggard serves v1 — head-1,
+        # NEVER v-2 (= v0 here, the unversioned boot weights).
+        live = {
+            sid: srv for sid, srv in by_sid.items() if sid != victim_sid
+        }
+        verify_fleet(
+            live,
+            lambda sid: 1 if sid in victim_subtree else 2,
+        )
+        skew = max(s.version for s in live.values()) - min(
+            s.version for s in live.values()
+        )
+        if skew != 1:
+            failures.append(
+                f"post-kill weight_version_skew {skew}, expected 1"
+            )
+        # The store must still retain v1 — held alive purely by the
+        # orphans' pins (retain=1 would otherwise have dropped it).
+        if 1 not in store.live_versions():
+            failures.append(
+                "store retired v1 while orphans still pin it: the "
+                "v-1 pull path is gone"
+            )
+
+        # ---- the victim's black box ---------------------------------
+        flight_path = os.path.join(
+            trace_dir, f"flightrec_gen_server_{victim_port}.json"
+        )
+        if not os.path.exists(flight_path):
+            failures.append(
+                f"killed relay left no flight dump at {flight_path}"
+            )
+        else:
+            with open(flight_path) as f:
+                dump = json.load(f)
+            if dump.get("reason") != "fault_kill":
+                failures.append(
+                    f"flight dump reason {dump.get('reason')!r} != "
+                    "'fault_kill'"
+                )
+        rendered = trace_report.format_flight(trace_dir, window_s=60.0)
+        if rendered.startswith("no flightrec"):
+            failures.append("trace_report --flight rendered no dumps")
+
+        # ---- restart + repair: laggards catch up to head ------------
+        victim._collector_thread.join(timeout=60)
+        srv = GenerationServer(
+            victim_engine, port=victim_port, max_wait_ms=2.0,
+            zmq_port=None, version=1,
+        )
+        srv.announce(exp, trial, ttl=30.0)
+        restarted["server"] = srv
+        by_sid[victim_sid] = srv
+        repaired = fabric.repair()
+        if sorted(repaired) != sorted(victim_subtree):
+            failures.append(
+                f"repair caught up {sorted(repaired)}, expected the "
+                f"orphaned subtree {sorted(victim_subtree)}"
+            )
+        verify_fleet(by_sid, lambda sid: 2)
+
+        # ---- push v3: the whole fleet converges ---------------------
+        store.publish(pushed[2], checksums[2])
+        r3 = fabric.push()
+        if not r3.ok or sorted(r3.applied) != sorted(by_sid):
+            failures.append(
+                f"post-repair v3 push did not converge: "
+                f"applied={sorted(r3.applied)} orphans={r3.orphans}"
+            )
+        verify_fleet(by_sid, lambda sid: 3)
+        # Every pin moved to v3: the stale versions retire.
+        if store.live_versions() != [3]:
+            failures.append(
+                f"store retains {store.live_versions()} after "
+                "convergence, expected [3]"
+            )
+        if metric(integrity.M_PUSH_REJECTED) - rejected0 != 0:
+            failures.append(
+                "areal_gen_weight_push_rejected_total moved: a "
+                "checksum rejection fired during the chaos run"
+            )
+    finally:
+        os.environ.pop("AREAL_TRACE_DIR", None)
+        for s in servers:
+            if s is victim:
+                continue
+            s.close()
+        if "server" in restarted:
+            restarted["server"].close()
+        elif not victim._crashed:
+            victim.close()
+
+    for f in failures:
+        print(f"FAIL[push-chaos]: {f}")
+    if not failures:
+        print(
+            f"OK[push-chaos]: v1 broadcast reached {len(by_sid)}/"
+            f"{len(by_sid)} servers (depth {r1.depth}); killing relay "
+            f"{victim_sid} mid-v2 orphaned exactly its subtree "
+            f"{sorted(victim_subtree)} (skew 1, laggards at v1 = "
+            f"head-1, store kept v1 via pins); zero torn versions "
+            f"(every applied version checksum-verified, "
+            f"push_rejected delta 0); repair() caught up "
+            f"{len(victim_subtree)} laggards and the v3 push "
+            f"converged all {len(by_sid)} (store retains [3]); "
+            f"victim flight dump rendered"
+        )
+        print()
+        print("--- trace_report --flight (the killed relay) ---")
         print(rendered)
     return len(failures)
 
@@ -2122,6 +2417,11 @@ def main() -> int:
                         "(multi-turn tool-use episodes on persistent "
                         "KV slots, sandboxed code reward, mid-episode "
                         "weight push)")
+    p.add_argument("--push-chaos", action="store_true",
+                   help="run ONLY the parameter-distribution-fabric "
+                        "chaos leg (5 servers, broadcast-tree push, "
+                        "first relay killed mid-broadcast; zero torn "
+                        "versions + v-1 staleness bound asserted)")
     args = p.parse_args()
 
     if args.trainer_chaos_victim:
@@ -2156,6 +2456,15 @@ def main() -> int:
             print(f"FAIL: {n_fail} agent check(s) failed")
             return 1
         print("OK: agent-serving runtime verified end to end")
+        return 0
+
+    if args.push_chaos:
+        n_fail = check_push_chaos()
+        if n_fail:
+            print(f"FAIL: {n_fail} push-chaos check(s) failed")
+            return 1
+        print("OK: parameter distribution fabric survived the killed "
+              "relay")
         return 0
 
     if args.chaos:

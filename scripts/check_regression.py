@@ -35,7 +35,8 @@ from typing import Dict, List, Optional, Tuple
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-DISCRIMINATORS = ("group_n", "kv_share_prefix", "prompt_len")
+DISCRIMINATORS = ("group_n", "kv_share_prefix", "prompt_len",
+                  "mode", "n_servers")
 
 # Legs carrying boolean invariants, not perf metrics — every boolean that
 # was true in the baseline must stay true.
@@ -45,6 +46,7 @@ INVARIANT_LEGS = (
     "overlap_compare",
     "nan_chaos_compare",
     "ragged_compare",
+    "push_compare",
 )
 
 
@@ -92,6 +94,13 @@ RULES: Dict[str, MetricRule] = {
     "lanes_dispatched": MetricRule("max", abs_tol=0),
     "lane_occupancy": MetricRule("higher", rel_tol=0.05),
     "prefill_dispatches": MetricRule("max", abs_tol=0),
+    # Parameter-distribution-fabric legs (scripts/measure_push.py): the
+    # per-hop latency is injected (deterministic), but the CPU-side
+    # apply work shares the box with CI noise — the wall-clock band is
+    # generous, and tree_depth is structural (it moves only if
+    # plan_tree changes shape).
+    "push_seconds": MetricRule("lower", rel_tol=0.60),
+    "tree_depth": MetricRule("max", abs_tol=0),
 }
 
 
@@ -193,6 +202,7 @@ def default_baselines() -> List[str]:
         "bench_overlap_cpu8_*.json",
         "bench_nanchaos_cpu8_*.json",
         "bench_ragged_cpu8_*.json",
+        "bench_push_cpu8_*.json",
     )
     out: List[str] = []
     for pat in pats:

@@ -1,0 +1,254 @@
+"""Broadcast-tree vs point-to-point weight-push scaling on the CPU
+test cluster.
+
+Pushes the SAME ~8 MB synthetic parameter payload from a
+``ParamStore`` through a ``BroadcastFabric`` (system/paramstore.py) to
+fleets of N in {2, 4, 8, 16} discovered gen servers, once per push
+mode:
+
+  - p2p:  the historic serial point-to-point loop — one direct send per
+          server, no relaying.  Wall time grows linearly in N.
+  - tree: the fan-out broadcast — the pusher sends to at most `fanout`
+          roots, each relay re-ships the VERBATIM payload bytes to its
+          children before applying locally, so wall time grows with
+          tree DEPTH (O(log N)), not fleet size.
+
+Every push goes over the real transports (binary POST /param_push) and
+every apply runs the real checksummed interruptible
+``update_weights_inmem`` swap — the only stub is the engine behind each
+server (a params-holding shell; no decode work competes with the push).
+
+One modeled quantity: every server is armed with the repo's own fault
+injector (``slow@point=param_push&ms=<--hop-ms>``), adding a fixed
+per-hop latency at the start of each ``_handle_param_push``.  The whole
+fleet runs as threads of ONE process on loopback, where a "hop" is a
+memcpy and the GIL serializes the Python framing — conditions under
+which NO topology can show a wall-time difference.  The injected delay
+stands in for the per-hop cost that dominates on a real fleet (NIC
+egress of the payload + the engine's pause/swap/resume) and sleeps
+release the GIL, so the tree's concurrent relays genuinely overlap:
+p2p pays N serial hops, the tree pays ~depth of them.  The delay is
+identical for both modes and every fleet size — the A/B compares
+topology only.
+
+Emits one JSON line per (mode, n_servers) leg — the median push wall
+time over --reps fleet-wide pushes of distinct versions — plus a
+``push_compare`` invariant leg the regression gate pins:
+
+  - tree_sublinear:        an 8x fleet (2 -> 16) must cost < 0.8 * 8x
+                           the 2-server tree push (the relay critical
+                           path grows with depth, but the total apply
+                           work is linear and all N applies share this
+                           one box's cores — so the margin is against
+                           LINEAR scaling, not against depth alone)
+  - tree_beats_p2p_at_max: the tree must beat serial p2p outright at
+                           the largest fleet
+  - depth_log_bounded:     the planned tree is never deeper than
+                           ceil(log_fanout(N)) + 1
+  - every_push_complete:   every measured push reached all N servers
+                           (zero orphans) and every apply was
+                           checksum-verified (rejected counter pinned
+                           at 0)
+
+Usage (from the repo root; takes ~a minute):
+    python scripts/measure_push.py [--reps 5] [--fanout 2] [--out FILE]
+
+The committed artifact is the stdout of one run, saved as
+bench_push_cpu8_<UTC>.json (+ .log) and cited from PERF.md.
+"""
+
+import argparse
+import json
+import math
+import os
+import statistics
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("AREAL_PAGING_CHECK", "1")
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+FLEETS = (2, 4, 8, 16)
+PAYLOAD_MB = 8
+
+
+class _StubEngine:
+    """A params-holding shell behind each GenerationServer: the push
+    path only needs a pytree to deserialize against and an atomic
+    set_params — no decode runs during the measurement, so the numbers
+    isolate transport + deserialize + checksummed swap."""
+
+    def __init__(self, params):
+        self.params = params
+
+    def set_params(self, params):
+        self.params = params
+
+
+def synth_params(n_leaves: int, total_mb: int):
+    """A dict pytree of float32 leaves totalling ~total_mb MB."""
+    per_leaf = total_mb * (1 << 20) // (4 * n_leaves)
+    rng = np.random.default_rng(7)
+    return {
+        f"layer_{i:02d}/w": rng.standard_normal(
+            per_leaf, dtype=np.float32
+        )
+        for i in range(n_leaves)
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5,
+                    help="measured pushes per (mode, fleet) leg")
+    ap.add_argument("--fanout", type=int, default=2)
+    ap.add_argument("--hop-ms", type=float, default=25.0,
+                    help="injected per-hop latency (models NIC egress "
+                         "+ engine swap; see module docstring)")
+    ap.add_argument("--out", default=None,
+                    help="also append JSON lines to this file")
+    args = ap.parse_args()
+
+    import jax
+
+    from areal_tpu.base import faults, integrity, name_resolve
+    from areal_tpu.base.name_resolve import MemoryNameResolveRepository
+    from areal_tpu.system.fleet import fleet_discovery
+    from areal_tpu.system.gen_server import GenerationServer
+    from areal_tpu.system.paramstore import (
+        BroadcastFabric,
+        ParamStore,
+        serialize_params,
+    )
+
+    assert len(jax.devices()) == 8, (
+        f"expected the 8-virtual-device CPU cluster, got "
+        f"{len(jax.devices())} devices"
+    )
+    name_resolve.set_default(MemoryNameResolveRepository())
+
+    params = synth_params(n_leaves=16, total_mb=PAYLOAD_MB)
+    checksum = integrity.params_checksum(params)
+    manifest, payload = serialize_params(params)
+    rejected0 = integrity.M_PUSH_REJECTED._default().get()
+
+    lines = []
+
+    def emit(obj):
+        line = json.dumps(obj)
+        print(line, flush=True)
+        lines.append(line)
+
+    def leg(mode: str, n: int):
+        exp, trial = f"pushbench_{mode}", f"n{n}"
+        servers = []
+        for i in range(n):
+            # All stubs share the initial pytree — it only serves as
+            # the treedef to deserialize against; set_params replaces
+            # each server's reference independently.
+            srv = GenerationServer(
+                _StubEngine(params), max_wait_ms=2.0, zmq_port=None,
+            )
+            if args.hop_ms > 0:
+                srv._faults = faults.FaultInjector.parse(
+                    f"slow@point=param_push&ms={args.hop_ms}"
+                )
+            srv.announce(exp, trial, ttl=60.0)
+            servers.append(srv)
+        store = ParamStore(retain=2)
+        fabric = BroadcastFabric(
+            store, discovery=fleet_discovery(exp, trial),
+            fanout=args.fanout, mode=mode, timeout_s=120.0,
+        )
+        times, complete, depth = [], True, 0
+        try:
+            # Warmup + reps measured pushes, a fresh version each time
+            # (the serialized payload is REUSED — serialization is paid
+            # once per version at publish, never per push, and never
+            # inside the measured window).
+            for rep in range(args.reps + 1):
+                store.publish(
+                    checksum=checksum, manifest=manifest, payload=payload
+                )
+                r = fabric.push()
+                complete = complete and r.ok
+                depth = r.depth
+                if rep > 0:
+                    times.append(r.seconds)
+        finally:
+            for s in servers:
+                s.close()
+        med = statistics.median(times)
+        emit({
+            "leg": "push",
+            "mode": mode,
+            "n_servers": n,
+            "fanout": args.fanout,
+            "hop_ms": args.hop_ms,
+            "payload_bytes": len(payload),
+            "tree_depth": depth,
+            "pushes": len(times),
+            "push_seconds": round(med, 4),
+            "push_seconds_min": round(min(times), 4),
+            "push_seconds_max": round(max(times), 4),
+            "fleet_mb_per_sec": round(
+                n * len(payload) / (1 << 20) / med, 1
+            ),
+            "every_push_complete": complete,
+        })
+        return med, depth, complete
+
+    results = {}
+    for mode in ("p2p", "tree"):
+        for n in FLEETS:
+            results[(mode, n)] = leg(mode, n)
+
+    n_max = FLEETS[-1]
+    t2, _, _ = results[("tree", FLEETS[0])]
+    t_max, depth_max, _ = results[("tree", n_max)]
+    p_max, _, _ = results[("p2p", n_max)]
+    growth = n_max // FLEETS[0]
+    rejected = (
+        integrity.M_PUSH_REJECTED._default().get() - rejected0
+    )
+    checks = {
+        "tree_sublinear": t_max < t2 * growth * 0.8,
+        "tree_beats_p2p_at_max": t_max < p_max,
+        "depth_log_bounded": depth_max <= (
+            math.ceil(math.log(n_max, max(2, args.fanout))) + 1
+        ),
+        "every_push_complete": all(c for _, _, c in results.values()),
+        "zero_checksum_rejects": rejected == 0,
+    }
+    emit({
+        "leg": "push_compare",
+        "fanout": args.fanout,
+        "hop_ms": args.hop_ms,
+        "payload_bytes": len(payload),
+        "tree_seconds_by_n": {
+            str(n): round(results[("tree", n)][0], 4) for n in FLEETS
+        },
+        "p2p_seconds_by_n": {
+            str(n): round(results[("p2p", n)][0], 4) for n in FLEETS
+        },
+        "p2p_over_tree_at_max": round(p_max / t_max, 2),
+        "tree_scale_factor_2_to_16": round(t_max / t2, 2),
+        **checks,
+    })
+
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write("\n".join(lines) + "\n")
+    sys.exit(0 if all(checks.values()) else 1)
+
+
+if __name__ == "__main__":
+    main()

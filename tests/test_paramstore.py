@@ -1,0 +1,361 @@
+"""Versioned parameter store + broadcast-tree distribution fabric
+(system/paramstore.py): serialize-once wire format, deterministic tree
+planning, the refcount lifecycle (pin on dispatch, release on retire,
+TTL expiry for dead holders, the v-1 pull path), and end-to-end
+broadcasts over both transports against real generation servers."""
+
+import jax
+import numpy as np
+import pytest
+
+from areal_tpu.base import integrity
+from areal_tpu.base.topology import ParallelConfig, make_mesh
+from areal_tpu.engines.generator import GeneratorEngine
+from areal_tpu.models import transformer as tfm
+from areal_tpu.models.config import tiny_config
+from areal_tpu.system import paramstore
+from areal_tpu.system.gen_server import GenerationServer, ZMQGenClient
+from areal_tpu.system.paramstore import (
+    BroadcastFabric,
+    ParamStore,
+    deserialize_params,
+    frame_push_body,
+    plan_tree,
+    serialize_params,
+    subtree_sids,
+    tree_depth,
+    unframe_push_body,
+)
+
+EOS = 7
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_config()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return tfm.init_params(cfg, jax.random.PRNGKey(11))
+
+
+def _make_server(cfg, key, **kw):
+    mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+    p = tfm.init_params(cfg, jax.random.PRNGKey(key))
+    eng = GeneratorEngine(cfg, p, mesh, eos_token_id=EOS)
+    return GenerationServer(eng, max_wait_ms=2.0, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Wire format
+
+
+class TestSerialization:
+    def test_round_trip_preserves_leaves(self, params):
+        manifest, payload = serialize_params(params)
+        assert len(manifest) == len(jax.tree.leaves(params))
+        rebuilt = deserialize_params(params, manifest, payload)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(rebuilt)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_checksum_survives_the_wire(self, params):
+        manifest, payload = serialize_params(params)
+        ck = integrity.params_checksum(params)
+        rebuilt = deserialize_params(params, manifest, payload)
+        integrity.verify_checksum(rebuilt, ck)  # must not raise
+
+    def test_leaf_count_mismatch_rejected(self, params):
+        manifest, payload = serialize_params(params)
+        with pytest.raises(ValueError, match="leaves"):
+            deserialize_params(params, manifest[:-1], payload)
+
+    def test_shape_mismatch_rejected(self, params):
+        manifest, payload = serialize_params(params)
+        bad = [dict(m) for m in manifest]
+        bad[0] = dict(bad[0], shape=[9991])
+        with pytest.raises(ValueError, match="shape"):
+            deserialize_params(params, bad, payload)
+
+    def test_truncated_payload_rejected(self, params):
+        manifest, payload = serialize_params(params)
+        with pytest.raises(ValueError, match="buffer|bytes"):
+            deserialize_params(params, manifest, payload[:-4])
+
+    def test_http_body_framing(self):
+        meta = {"cmd": "param_push", "version": 3}
+        body = frame_push_body(meta, b"\x00\x01payload")
+        m, p = unframe_push_body(body)
+        assert m == meta and p == b"\x00\x01payload"
+        with pytest.raises(ValueError):
+            unframe_push_body(b"\x00" * 4)
+
+
+# ---------------------------------------------------------------------------
+# Tree planning
+
+
+class TestPlanTree:
+    def _members(self, n):
+        return [(f"s{i:02d}", f"http://host{i}") for i in range(n)]
+
+    def test_covers_every_member_exactly_once(self):
+        for n in (1, 2, 5, 16, 33):
+            roots = plan_tree(self._members(n), fanout=2)
+            sids = [s for r in roots for s in subtree_sids(r)]
+            assert sorted(sids) == [f"s{i:02d}" for i in range(n)]
+
+    def test_depth_is_logarithmic(self):
+        assert tree_depth(plan_tree(self._members(1), 2)) == 1
+        assert tree_depth(plan_tree(self._members(16), 2)) <= 5
+        assert tree_depth(plan_tree(self._members(64), 4)) <= 4
+        # fanout=1 degenerates to a relay chain
+        assert tree_depth(plan_tree(self._members(5), 1)) == 5
+
+    def test_deterministic_regardless_of_input_order(self):
+        m = self._members(7)
+        assert plan_tree(list(reversed(m)), 2) == plan_tree(m, 2)
+
+    def test_empty_membership(self):
+        assert plan_tree([], 2) == []
+        assert tree_depth([]) == 0
+
+
+# ---------------------------------------------------------------------------
+# The refcount lifecycle
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _publish(store, n_versions=1, nbytes=8):
+    for _ in range(n_versions):
+        v = store.publish(
+            manifest=[{"dtype": "uint8", "shape": [nbytes]}],
+            payload=bytes(nbytes),
+        )
+    return v
+
+
+class TestRefcounts:
+    def test_retain_window_without_pins(self):
+        store = ParamStore(retain=2)
+        _publish(store, 3)
+        assert store.live_versions() == [2, 3]
+        assert store.head == 3
+        assert store.get(1) is None
+
+    def test_dispatch_pin_holds_then_release_retires(self):
+        # Pin on dispatch, release on terminal -> retire: the core
+        # in-flight lifecycle.
+        store = ParamStore(retain=1)
+        _publish(store, 1)
+        assert store.pin(1, "dispatch:q0", exclusive=False)
+        assert store.pin(1, "dispatch:q1", exclusive=False)
+        _publish(store, 2)  # head=3; v1 outside retain but pinned
+        assert 1 in store.live_versions()
+        store.release(1, "dispatch:q0")
+        assert 1 in store.live_versions()  # q1 still holds it
+        store.release_holder("dispatch:q1")
+        assert 1 not in store.live_versions()
+
+    def test_server_pin_is_exclusive_and_moves(self):
+        # A server serves exactly one version: its pin FOLLOWS it as it
+        # upgrades, releasing the old version.
+        store = ParamStore(retain=1)
+        _publish(store, 1)
+        store.pin(1, "server:s0")
+        _publish(store, 2)  # head=3
+        assert store.live_versions() == [1, 3]  # v1 pinned, v2 dropped
+        store.pin(3, "server:s0")  # the laggard caught up
+        assert store.live_versions() == [3]
+
+    def test_ttl_expires_dead_holders(self):
+        # A crashed server never releases; its pins age out like its
+        # fleet announcement (release on death).
+        clock = FakeClock()
+        store = ParamStore(retain=1, pin_ttl_s=30.0, clock=clock)
+        _publish(store, 1)
+        store.pin(1, "server:dead")
+        _publish(store, 1)
+        assert 1 in store.live_versions()
+        clock.t = 31.0
+        store.retire()
+        assert store.live_versions() == [2]
+
+    def test_pin_cannot_resurrect_a_retired_version(self):
+        store = ParamStore(retain=1)
+        _publish(store, 3)
+        assert not store.pin(1, "server:slow")
+        assert store.pins(1) == []
+
+    def test_repin_refreshes_ttl(self):
+        clock = FakeClock()
+        store = ParamStore(retain=1, pin_ttl_s=10.0, clock=clock)
+        _publish(store, 1)
+        store.pin(1, "server:s0")
+        _publish(store, 1)
+        clock.t = 8.0
+        store.pin(1, "server:s0")  # health cycle refresh
+        clock.t = 16.0  # 16s after first pin, 8s after refresh
+        store.retire()
+        assert 1 in store.live_versions()
+
+    def test_version_counter_survives_recovery(self):
+        store = ParamStore()
+        _publish(store, 4)
+        sd = store.state_dict()
+        assert sd == {"head": 4}
+        fresh = ParamStore()
+        fresh.load_state_dict(sd)
+        assert fresh.head == 4
+        assert _publish(fresh, 1) == 5  # version time is monotonic
+        fresh.load_state_dict({"head": 2})  # stale state never rewinds
+        assert fresh.head == 5
+
+
+# ---------------------------------------------------------------------------
+# End-to-end broadcasts (real servers, both transports)
+
+
+class TestBroadcast:
+    def test_http_tree_push_applies_everywhere(self, cfg, params):
+        servers = [_make_server(cfg, key) for key in (1, 2, 3)]
+        try:
+            store = ParamStore()
+            store.publish(params)
+            fabric = BroadcastFabric(
+                store,
+                discovery=lambda: {
+                    f"s{s.port}": s.url for s in servers
+                },
+                fanout=2,
+            )
+            report = fabric.push()
+            assert report.ok
+            assert report.version == 1
+            assert sorted(report.applied) == sorted(
+                f"s{s.port}" for s in servers
+            )
+            assert report.depth == 2  # 3 members, fanout 2: not a star
+            assert all(s.version == 1 for s in servers)
+            # Applied servers hold exclusive pins on the pushed version.
+            assert store.pins(1) == sorted(
+                f"server:s{s.port}" for s in servers
+            )
+            # Every applied version passed the per-leaf-norm checksum:
+            # the servers now produce identical params.
+            ck = integrity.params_checksum(params)
+            for s in servers:
+                integrity.verify_checksum(s.engine.params, ck)
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_zmq_push_weights(self, cfg, params):
+        srv = _make_server(cfg, 5, zmq_port=0)
+        try:
+            manifest, payload = serialize_params(params)
+            client = ZMQGenClient(srv.zmq_url, timeout_s=30.0)
+            try:
+                ack = client.push_weights(
+                    {
+                        "version": 1,
+                        "manifest": manifest,
+                        "checksum": integrity.params_checksum(
+                            params
+                        ).tolist(),
+                        "subtree": {
+                            "sid": "z0", "url": srv.zmq_url,
+                            "children": [],
+                        },
+                    },
+                    payload,
+                )
+            finally:
+                client.close()
+            assert ack["version"] == 1
+            assert ack["applied"] == ["z0"]
+            assert srv.version == 1
+        finally:
+            srv.close()
+
+    def test_push_is_idempotent_at_version(self, cfg, params):
+        # A repair and a relay racing on one server must not
+        # double-apply: a push at/behind the serving version no-ops.
+        srv = _make_server(cfg, 6)
+        try:
+            store = ParamStore()
+            store.publish(params)
+            fabric = BroadcastFabric(
+                store, discovery=lambda: {f"s{srv.port}": srv.url}
+            )
+            fabric.push()
+            updates_before = srv.inmem_updates
+            report = fabric.push()  # same version again
+            assert report.ok
+            assert srv.version == 1
+            assert srv.inmem_updates == updates_before  # no second swap
+        finally:
+            srv.close()
+
+    def test_v_minus_one_pull_path(self, cfg, params):
+        # A laggard (mid-episode / breaker-open during the broadcast)
+        # pulls the PREVIOUS version directly — head-1, inside the
+        # max_head_offpolicyness staleness bound — while the rest of
+        # the fleet serves head.
+        srv = _make_server(cfg, 7)
+        try:
+            store = ParamStore(retain=2)
+            store.publish(params)
+            store.publish(tfm.init_params(cfg, jax.random.PRNGKey(8)))
+            fabric = BroadcastFabric(store, discovery=lambda: {})
+            ack = fabric.push_to(f"s{srv.port}", srv.url, store.head - 1)
+            assert ack["version"] == 1
+            assert srv.version == store.head - 1
+            assert store.pins(1) == [f"server:s{srv.port}"]
+        finally:
+            srv.close()
+
+    def test_relay_failure_orphans_only_that_subtree(self, cfg, params):
+        # Two live servers + one dead URL: the dead relay's subtree is
+        # orphaned and counted; the rest of the fleet still applies.
+        servers = [_make_server(cfg, key) for key in (9, 10)]
+        try:
+            members = {f"s{s.port}": s.url for s in servers}
+            # Sorts first => becomes a relay with a child subtree.
+            members["a_dead"] = "http://127.0.0.1:9/"
+            store = ParamStore()
+            store.publish(params)
+            fabric = BroadcastFabric(
+                store, discovery=lambda: members, fanout=2,
+                timeout_s=2.0,
+            )
+            report = fabric.push()
+            assert not report.ok
+            orphaned = {o["sid"] for o in report.orphans}
+            assert "a_dead" in orphaned
+            applied = set(report.applied)
+            assert applied | orphaned == set(members)
+            for s in servers:
+                if f"s{s.port}" in applied:
+                    assert s.version == 1
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_push_bytes_metric_counts_per_hop(self, params):
+        # Serialize-once is observable: one fleet push of N members
+        # ships exactly N payload copies (one per tree edge), no
+        # re-serialization multiplier.
+        before = paramstore.M_PUSH_BYTES._default().get()
+        store = ParamStore()
+        _publish(store, 1, nbytes=1000)
+        fabric = BroadcastFabric(store, discovery=lambda: {})
+        fabric.push()  # zero members: no bytes moved
+        assert paramstore.M_PUSH_BYTES._default().get() == before
