@@ -1,0 +1,448 @@
+"""Per-MFC profile store: measured records the placement advisor learns from.
+
+The trace plane already carries everything a cost model needs — per-MFC
+compute spans with tokens/tflops/MFU (system/worker.py), ``xfer:data``
+transfer spans with byte counts and the consuming MFC, ``param_realloc``
+reshard spans, KV-pool and param/opt memory watermarks (engine
+``perf_counters()``) — but each run throws it away when the trial ends.
+This module harvests a merged Chrome trace (``trace_report --json``'s
+input) into compact per-MFC records keyed by
+
+    (mfc, model_shape, layout, batch_shape)
+
+and persists them as versioned JSONL under the trial dir
+(``{fileroot}/logs/{experiment}/{trial}/profiles.jsonl``, next to
+``stats.jsonl``), so later advisor runs — possibly on a different box —
+can calibrate a roofline against every shape this cluster has ever
+measured (analysis/costmodel.py).
+
+Stdlib-only on purpose: the advisor CLI and the lint app must run on a
+bare CPU box with no jax import.
+
+Record grammar (one JSON object per line):
+
+    {"v": 1, "kind": "mfc",
+     "key": {"mfc": "actor@0:generate", "model_shape": "l2h64q4kv2v512",
+             "layout": "d4", "batch_shape": "n8x64"},
+     "metrics": {"calls", "wall_s_sum", "wall_s_mean", "tokens_sum",
+                 "tokens_mean", "seqs_mean", "tflops_mean", "mfu_mean",
+                 "xfer_bytes_mean", "pool_peak_bytes", "param_bytes",
+                 "opt_bytes", "compiles"},
+     "meta": {...}}
+    {"v": 1, "kind": "step", "step": 3, "wall_s": 1.25}
+    {"v": 1, "kind": "topo", "levels": [["a@0:generate"], ...]}
+
+``v`` is the record schema version: bump on breaking shape changes.
+Loading SKIPS records from a newer version (forward compatibility: an
+old advisor must not misread a new store) and counts them so callers can
+warn.
+"""
+
+import dataclasses
+import json
+import os
+from collections import defaultdict
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+PROFILE_VERSION = 1
+
+# Span-arg fields copied verbatim from an mfc:* compute span into the
+# record's metrics (max over calls — watermarks and monotonic counters).
+_WATERMARK_ARGS = (
+    "pool_bytes",
+    "pool_peak_bytes",
+    "param_bytes",
+    "opt_bytes",
+    "compiles",
+)
+
+
+def default_path(fileroot: str, experiment: str, trial: str) -> str:
+    """The trial-dir profile store, next to stats.jsonl / the trace
+    shards (base/monitor.StatsLogger convention)."""
+    return os.path.join(
+        fileroot, "logs", experiment, trial, "profiles.jsonl"
+    )
+
+
+def _bucket_pow2(x: float) -> int:
+    """Round up to a power of two so near-identical batch shapes share a
+    profile key instead of fragmenting the store per step."""
+    n = 1
+    x = max(int(x), 1)
+    while n < x:
+        n *= 2
+    return n
+
+
+def batch_shape_of(seqs: int, tokens: int) -> str:
+    """Stable batch-shape key: sequence count x pow2-bucketed mean
+    per-sequence length (``n8x64``)."""
+    seqs = max(int(seqs), 1)
+    return f"n{seqs}x{_bucket_pow2(tokens / seqs)}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ProfileKey:
+    mfc: str          # "model_key:interface_type"
+    model_shape: str  # "l{layers}h{hidden}q{qheads}kv{kvheads}v{vocab}"
+    layout: str       # ParallelConfig.to_str(), e.g. "d4f2"
+    batch_shape: str  # batch_shape_of(), e.g. "n8x64"
+
+    def to_dict(self) -> Dict[str, str]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, str]) -> "ProfileKey":
+        return cls(
+            mfc=str(d.get("mfc", "")),
+            model_shape=str(d.get("model_shape", "")),
+            layout=str(d.get("layout", "")),
+            batch_shape=str(d.get("batch_shape", "")),
+        )
+
+
+@dataclasses.dataclass
+class ProfileRecord:
+    key: ProfileKey
+    calls: int = 0
+    wall_s_sum: float = 0.0
+    tokens_sum: int = 0
+    seqs_sum: int = 0
+    tflops_sum: float = 0.0
+    tflops_n: int = 0
+    mfu_sum: float = 0.0
+    mfu_n: int = 0
+    xfer_bytes_sum: float = 0.0
+    watermarks: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    @property
+    def wall_s_mean(self) -> float:
+        return self.wall_s_sum / max(self.calls, 1)
+
+    @property
+    def tokens_mean(self) -> float:
+        return self.tokens_sum / max(self.calls, 1)
+
+    def metrics(self) -> Dict[str, float]:
+        m = {
+            "calls": self.calls,
+            "wall_s_sum": round(self.wall_s_sum, 6),
+            "wall_s_mean": round(self.wall_s_mean, 6),
+            "tokens_sum": self.tokens_sum,
+            "tokens_mean": round(self.tokens_mean, 3),
+            "seqs_mean": round(self.seqs_sum / max(self.calls, 1), 3),
+            "xfer_bytes_mean": round(
+                self.xfer_bytes_sum / max(self.calls, 1), 3
+            ),
+        }
+        if self.tflops_n:
+            m["tflops_mean"] = round(self.tflops_sum / self.tflops_n, 9)
+        if self.mfu_n:
+            m["mfu_mean"] = round(self.mfu_sum / self.mfu_n, 6)
+        m.update(self.watermarks)
+        return m
+
+    def to_entry(self, meta: Optional[Dict[str, Any]] = None) -> Dict:
+        e = {
+            "v": PROFILE_VERSION,
+            "kind": "mfc",
+            "key": self.key.to_dict(),
+            "metrics": self.metrics(),
+        }
+        if meta:
+            e["meta"] = dict(meta)
+        return e
+
+
+# ---------------------------------------------------------------------------
+# Harvest: merged Chrome trace -> records
+# ---------------------------------------------------------------------------
+
+
+def _step_windows(trace) -> List[Tuple[Optional[int], int, int]]:
+    steps = []
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") == "X" and e.get("name") == "step":
+            num = (e.get("args") or {}).get("step")
+            steps.append(
+                (
+                    int(num) if num is not None else None,
+                    int(e["ts"]),
+                    int(e["ts"]) + int(e["dur"]),
+                )
+            )
+    return sorted(steps, key=lambda t: t[1])
+
+
+def _mfc_spans(trace) -> List[Dict]:
+    """Worker compute spans carrying an ``mfc`` arg.  Stream chunk spans
+    (``:train_chunk``) are pieces of a ``:train_step`` whole and are
+    skipped — the profile records whole MFC executions."""
+    out = []
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X" or e.get("cat") != "compute":
+            continue
+        a = e.get("args") or {}
+        mfc = a.get("mfc")
+        if not mfc or str(mfc).endswith(":train_chunk"):
+            continue
+        out.append(e)
+    return out
+
+
+def harvest_trace(
+    trace: Dict[str, Any],
+    meta: Optional[Dict[str, Any]] = None,
+    skip_warmup: int = 0,
+) -> List[Dict[str, Any]]:
+    """Aggregate a merged trace into profile-store entries: one ``mfc``
+    entry per (mfc, model_shape, layout, batch_shape), one ``step``
+    entry per master step window, and one ``topo`` entry with the
+    execution levels inferred from span timing (two MFCs whose spans
+    overlap a step window concurrently share a level — the DFG topology
+    as actually scheduled).
+
+    ``skip_warmup`` drops the first N step windows entirely (spans and
+    step entries): warm-up steps carry jit-compile time no roofline can
+    transfer, so calibration harvests skip them."""
+    windows = _step_windows(trace)
+    cut_ts = (
+        windows[skip_warmup - 1][2]
+        if 0 < skip_warmup <= len(windows)
+        else None
+    )
+    if cut_ts is not None:
+        windows = windows[skip_warmup:]
+    recs: Dict[ProfileKey, ProfileRecord] = {}
+    per_mfc_spans: Dict[str, List[Tuple[int, int]]] = defaultdict(list)
+    for e in _mfc_spans(trace):
+        if cut_ts is not None and int(e["ts"]) < cut_ts:
+            continue
+        a = e.get("args") or {}
+        mfc = str(a["mfc"])
+        tokens = int(a.get("tokens") or 0)
+        seqs = int(a.get("seqs") or 0) or 1
+        key = ProfileKey(
+            mfc=mfc,
+            model_shape=str(a.get("model_shape", "")),
+            layout=str(a.get("layout", "")),
+            batch_shape=batch_shape_of(seqs, tokens),
+        )
+        r = recs.setdefault(key, ProfileRecord(key=key))
+        # Streamed train MFCs stamp their summed busy seconds (the end
+        # span wraps only the optimizer step; chunk work happened in
+        # separate :train_chunk spans) — prefer that over span duration.
+        wall = (
+            float(a["wall_s"])
+            if a.get("wall_s") is not None
+            else int(e.get("dur", 0)) / 1e6
+        )
+        r.calls += 1
+        r.wall_s_sum += wall
+        r.tokens_sum += tokens
+        r.seqs_sum += seqs
+        if a.get("tflops") is not None:
+            r.tflops_sum += float(a["tflops"])
+            r.tflops_n += 1
+        if a.get("mfu") is not None:
+            r.mfu_sum += float(a["mfu"])
+            r.mfu_n += 1
+        for wk in _WATERMARK_ARGS:
+            if a.get(wk) is not None:
+                r.watermarks[wk] = max(
+                    float(r.watermarks.get(wk, 0.0)), float(a[wk])
+                )
+        per_mfc_spans[mfc].append(
+            (int(e["ts"]), int(e["ts"]) + int(e.get("dur", 0)))
+        )
+
+    # Transfer attribution: xfer:data spans stamped with the consuming
+    # MFC (system/master.py _ensure_data).  Mean bytes per call of that
+    # MFC — every record of the mfc shares the attribution (transfers
+    # are keyed by consumer, not by batch shape).
+    xfer_by_mfc: Dict[str, float] = defaultdict(float)
+    realloc_bytes = 0.0
+    realloc_s = 0.0
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        a = e.get("args") or {}
+        name = str(e.get("name", ""))
+        if name == "xfer:data" and a.get("mfc"):
+            xfer_by_mfc[str(a["mfc"])] += float(a.get("bytes") or 0)
+        elif name.startswith(("param_realloc:", "reshard")):
+            realloc_bytes += float(a.get("bytes") or 0)
+            realloc_s += int(e.get("dur", 0)) / 1e6
+    for key, r in recs.items():
+        total = xfer_by_mfc.get(key.mfc, 0.0)
+        if total:
+            # Split the mfc's total over its records by call share.
+            calls_all = sum(
+                x.calls for k, x in recs.items() if k.mfc == key.mfc
+            )
+            r.xfer_bytes_sum = total * r.calls / max(calls_all, 1)
+
+    entries: List[Dict[str, Any]] = [
+        recs[k].to_entry(meta) for k in sorted(recs, key=lambda k: k.mfc)
+    ]
+
+    for step, lo, hi in windows:
+        e: Dict[str, Any] = {
+            "v": PROFILE_VERSION,
+            "kind": "step",
+            "step": step,
+            "wall_s": round((hi - lo) / 1e6, 6),
+        }
+        if realloc_bytes:
+            e["realloc_bytes"] = realloc_bytes / max(len(windows), 1)
+            e["realloc_s"] = realloc_s / max(len(windows), 1)
+        entries.append(e)
+
+    levels = infer_levels(per_mfc_spans, windows)
+    if levels:
+        entries.append(
+            {"v": PROFILE_VERSION, "kind": "topo", "levels": levels}
+        )
+    return entries
+
+
+def infer_levels(
+    spans_by_mfc: Dict[str, List[Tuple[int, int]]],
+    windows: List[Tuple[Optional[int], int, int]],
+) -> List[List[str]]:
+    """Execution levels from measured concurrency: within each step
+    window, sort MFCs by first span start; an MFC that starts before the
+    current level's earliest end joins it (they ran concurrently), else
+    it opens the next level.  Majority vote across steps keeps one noisy
+    window from scrambling the topology."""
+    if not spans_by_mfc:
+        return []
+    if not windows:
+        lo = min(s for iv in spans_by_mfc.values() for s, _ in iv)
+        hi = max(e for iv in spans_by_mfc.values() for _, e in iv)
+        windows = [(None, lo, hi)]
+    votes: Dict[Tuple[Tuple[str, ...], ...], int] = defaultdict(int)
+    for _, lo, hi in windows:
+        starts: List[Tuple[int, int, str]] = []
+        for mfc, iv in spans_by_mfc.items():
+            inside = [(s, e) for s, e in iv if s >= lo and s < hi]
+            if inside:
+                starts.append(
+                    (min(s for s, _ in inside),
+                     min(e for _, e in inside), mfc)
+                )
+        if not starts:
+            continue
+        starts.sort()
+        levels: List[List[str]] = [[starts[0][2]]]
+        level_end = starts[0][1]
+        for s, e, mfc in starts[1:]:
+            if s < level_end:
+                levels[-1].append(mfc)
+                level_end = min(level_end, e)
+            else:
+                levels.append([mfc])
+                level_end = e
+        votes[tuple(tuple(sorted(lv)) for lv in levels)] += 1
+    if not votes:
+        return []
+    best = max(votes.items(), key=lambda kv: (kv[1], kv[0]))[0]
+    return [list(lv) for lv in best]
+
+
+# ---------------------------------------------------------------------------
+# Store: versioned JSONL under the trial dir
+# ---------------------------------------------------------------------------
+
+
+class ProfileStore:
+    """Append-only JSONL store of profile entries.  Loading skips
+    entries stamped with a NEWER schema version (``skipped_newer``
+    counts them); malformed lines are skipped too (a torn tail from a
+    killed run must not poison the whole store)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.skipped_newer = 0
+        self.skipped_bad = 0
+
+    def append(self, entries: Iterable[Dict[str, Any]]) -> int:
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        n = 0
+        with open(self.path, "a") as f:
+            for e in entries:
+                e = dict(e)
+                e.setdefault("v", PROFILE_VERSION)
+                f.write(json.dumps(e, sort_keys=True) + "\n")
+                n += 1
+        return n
+
+    def load(self) -> List[Dict[str, Any]]:
+        self.skipped_newer = 0
+        self.skipped_bad = 0
+        out: List[Dict[str, Any]] = []
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    e = json.loads(ln)
+                    v = int(e.get("v", 0))
+                except (ValueError, TypeError, AttributeError):
+                    self.skipped_bad += 1
+                    continue
+                if v > PROFILE_VERSION:
+                    self.skipped_newer += 1
+                    continue
+                out.append(e)
+        return out
+
+    def records(self) -> List[Tuple[ProfileKey, Dict[str, float]]]:
+        """(key, metrics) for every ``mfc`` entry, oldest first."""
+        return [
+            (ProfileKey.from_dict(e.get("key") or {}),
+             dict(e.get("metrics") or {}))
+            for e in self.load()
+            if e.get("kind") == "mfc"
+        ]
+
+    def latest(self) -> Dict[ProfileKey, Dict[str, float]]:
+        """Newest metrics per key (later appends win)."""
+        out: Dict[ProfileKey, Dict[str, float]] = {}
+        for key, metrics in self.records():
+            out[key] = metrics
+        return out
+
+    def step_walls(self) -> List[float]:
+        return [
+            float(e["wall_s"])
+            for e in self.load()
+            if e.get("kind") == "step" and e.get("wall_s") is not None
+        ]
+
+    def levels(self) -> List[List[str]]:
+        lv: List[List[str]] = []
+        for e in self.load():
+            if e.get("kind") == "topo" and e.get("levels"):
+                lv = [list(x) for x in e["levels"]]
+        return lv
+
+
+def harvest_to_store(
+    trace: Dict[str, Any],
+    path: str,
+    meta: Optional[Dict[str, Any]] = None,
+    skip_warmup: int = 0,
+) -> int:
+    """One-call harvest: trace -> entries -> append.  Returns the number
+    of entries written."""
+    store = ProfileStore(path)
+    return store.append(
+        harvest_trace(trace, meta=meta, skip_warmup=skip_warmup)
+    )

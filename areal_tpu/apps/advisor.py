@@ -1,0 +1,377 @@
+"""Offline placement advisor: rank candidate parallelism/placement plans
+against a calibrated cost model (ROADMAP item 3's search stage).
+
+    python -m areal_tpu.apps.advisor <profiles.jsonl | trace dir/json>
+        [--devices N] [--mem-budget-gb G] [--windows 1,2,4]
+        [--chunk-seqs 0,2,4] [--split] [--top K] [--json]
+
+Input is a profile store (``analysis/profile.py`` JSONL) or a trace —
+a merged ``trace.json`` / shard dir is harvested in-memory first.  The
+advisor then:
+
+1. calibrates a roofline from the measured records
+   (``costmodel.calibrate``): achieved FLOP/s per device per MFC,
+   constant walls for FLOP-less MFCs;
+2. scores the CURRENT layout: per-MFC predicted wall vs measured, and
+   the DFG-composed predicted step vs the measured step walls — the
+   predicted-vs-measured error every placement PR must cite (PERF.md);
+3. enumerates candidate plans — every (data, fsdp, model) factorization
+   of ``--devices`` for gen and train layouts, colocated and (with
+   ``--split``) disaggregated gen/train with per-step weight-realloc
+   cost, crossed with ``overlap_window`` x ``pipeline_chunk_seqs`` —
+   filters them by the device/memory budget, and ranks by predicted
+   step time (``costmodel.predict_plan`` / ``rank_plans``).
+
+``--json`` emits one stable JSON object (schema below) instead of the
+human table.  ``ADVISOR_JSON_VERSION`` bumps on any breaking change;
+consumers must reject versions they don't know:
+
+    {"version": 1,
+     "store": {"n_records", "skipped_newer"},
+     "roofline": {"eff_flops_per_dev", "fixed_wall_s",
+                  "xfer_bytes_per_s", "overhead_s", ...},
+     "levels": [["actor:generate"], ...],
+     "current": {"layouts": {mfc: layout}, "measured_step_s",
+                 "predicted_step_s", "pred_err",
+                 "per_mfc": [{"mfc", "layout", "batch_shape",
+                              "measured_wall_s", "predicted_wall_s",
+                              "err", "compute_bound"}, ...]},
+     "candidates": [{"name", "gen_layout", "train_layout", "colocated",
+                     "overlap_window", "pipeline_chunk_seqs",
+                     "predicted_step_s", "predicted_mem_gb", "feasible",
+                     "per_mfc": [...]}, ...],   # ranked, top K
+     "n_enumerated": int}
+
+Stdlib-only end to end (profile + costmodel are jax-free): runs on a
+laptop against a store scp'd off the cluster.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.analysis import costmodel
+from areal_tpu.analysis.profile import (
+    ProfileKey,
+    ProfileStore,
+    harvest_trace,
+)
+
+ADVISOR_JSON_VERSION = 1
+
+
+def _load_entries(path: str) -> List[Dict[str, Any]]:
+    """Profile entries from a store file, a merged trace.json, or a
+    trace shard dir (harvested in-memory — nothing is written)."""
+    if os.path.isdir(path):
+        from areal_tpu.base import tracer
+
+        return harvest_trace(tracer.merge_shards(path))
+    if path.endswith(".jsonl"):
+        return ProfileStore(path).load()
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        return harvest_trace(doc)
+    raise SystemExit(f"unrecognized input {path!r}: expected a profile "
+                     "store (.jsonl), a merged trace.json, or a shard dir")
+
+
+class _MemStore(ProfileStore):
+    """A ProfileStore over in-memory entries (trace inputs)."""
+
+    def __init__(self, entries: List[Dict[str, Any]]):
+        super().__init__(path="<memory>")
+        self._entries = entries
+
+    def load(self) -> List[Dict[str, Any]]:
+        return list(self._entries)
+
+
+def current_report(
+    store: ProfileStore, rf: costmodel.Roofline,
+    levels: List[List[str]],
+) -> Dict[str, Any]:
+    """Predicted-vs-measured for the measured layout: the calibration
+    residual a placement PR cites, and the fleet `advisor_pred_err`
+    signal's offline twin."""
+    latest = store.latest()
+    per_mfc = []
+    walls: Dict[str, float] = {}
+    layouts: Dict[str, str] = {}
+    for key, m in sorted(latest.items(), key=lambda kv: kv[0].mfc):
+        p = costmodel.predict_mfc(key, m, rf)
+        measured = float(m.get("wall_s_mean", 0.0))
+        err = (
+            abs(p.wall_s - measured) / measured if measured > 0 else 0.0
+        )
+        per_mfc.append(
+            {
+                "mfc": key.mfc,
+                "layout": key.layout,
+                "batch_shape": key.batch_shape,
+                "measured_wall_s": round(measured, 6),
+                "predicted_wall_s": round(p.wall_s, 6),
+                "err": round(err, 6),
+                "compute_bound": p.compute_bound,
+            }
+        )
+        walls[key.mfc] = max(walls.get(key.mfc, 0.0), measured)
+        layouts.setdefault(key.mfc, key.layout)
+    step_walls = store.step_walls()
+    measured_step = (
+        statistics.median(step_walls) if step_walls else 0.0
+    )
+    predicted_step = costmodel.compose_step(levels, walls)
+    pred_err = (
+        abs(predicted_step - measured_step) / measured_step
+        if measured_step > 0
+        else 0.0
+    )
+    return {
+        "layouts": layouts,
+        "measured_step_s": round(measured_step, 6),
+        "predicted_step_s": round(predicted_step, 6),
+        "pred_err": round(pred_err, 6),
+        "per_mfc": per_mfc,
+    }
+
+
+def enumerate_plans(
+    devices: int,
+    latest: Dict[ProfileKey, Dict[str, float]],
+    windows: List[int],
+    chunk_seqs: List[int],
+    include_split: bool = False,
+) -> List[costmodel.CandidatePlan]:
+    """The candidate grid: colocated plans pair every gen layout with
+    every train layout over the full device pool; split plans give each
+    side half the pool and pay the gen weights over the fabric every
+    step."""
+    gen_param_bytes = max(
+        (
+            float(m.get("param_bytes") or 0.0)
+            for k, m in latest.items()
+            if k.mfc.endswith(":generate")
+        ),
+        default=0.0,
+    )
+    plans: List[costmodel.CandidatePlan] = []
+    full = costmodel.enumerate_layouts(devices)
+    halves = (
+        costmodel.enumerate_layouts(devices // 2)
+        if include_split and devices >= 2
+        else []
+    )
+    for w in windows:
+        for cs in chunk_seqs:
+            for g in full:
+                for t in full:
+                    plans.append(
+                        costmodel.CandidatePlan(
+                            name=f"co:{g}|{t}:w{w}c{cs}",
+                            gen_layout=g,
+                            train_layout=t,
+                            colocated=True,
+                            overlap_window=w,
+                            pipeline_chunk_seqs=cs,
+                        )
+                    )
+            for g in halves:
+                for t in halves:
+                    plans.append(
+                        costmodel.CandidatePlan(
+                            name=f"split:{g}|{t}:w{w}c{cs}",
+                            gen_layout=g,
+                            train_layout=t,
+                            colocated=False,
+                            overlap_window=w,
+                            pipeline_chunk_seqs=cs,
+                            realloc_bytes=gen_param_bytes,
+                        )
+                    )
+    return plans
+
+
+def advise(
+    store: ProfileStore,
+    devices: int,
+    mem_budget_gb: float = 0.0,
+    windows: Optional[List[int]] = None,
+    chunk_seqs: Optional[List[int]] = None,
+    include_split: bool = False,
+    top: int = 10,
+) -> Dict[str, Any]:
+    """The full advisor pass as one JSON-ready dict (schema v1)."""
+    records = store.records()
+    rf = costmodel.calibrate(records)
+    levels = store.levels()
+    latest = store.latest()
+    if not levels:
+        # No measured topology: every MFC its own level (serial).
+        levels = [[k.mfc] for k in sorted(latest, key=lambda k: k.mfc)]
+        seen = set()
+        levels = [
+            lv for lv in levels
+            if lv[0] not in seen and not seen.add(lv[0])
+        ]
+    batch_seqs = int(
+        max(
+            (
+                float(m.get("seqs_mean") or 0.0)
+                for k, m in latest.items()
+                if k.mfc.endswith(":train_step")
+            ),
+            default=0.0,
+        )
+    )
+    plans = enumerate_plans(
+        devices,
+        latest,
+        windows=windows or [1, 2, 4],
+        chunk_seqs=chunk_seqs or [0, 2, 4],
+        include_split=include_split,
+    )
+    preds = [
+        costmodel.predict_plan(
+            plan,
+            latest,
+            levels,
+            rf,
+            batch_seqs=batch_seqs,
+            mem_budget_bytes=mem_budget_gb * 1e9,
+        )
+        for plan in plans
+    ]
+    ranked = costmodel.rank_plans(preds)
+    return {
+        "version": ADVISOR_JSON_VERSION,
+        "store": {
+            "n_records": len(records),
+            "skipped_newer": store.skipped_newer,
+        },
+        "roofline": rf.to_dict(),
+        "levels": [list(lv) for lv in levels],
+        "current": current_report(store, rf, levels),
+        "candidates": [p.to_dict() for p in ranked[: max(top, 1)]],
+        "n_enumerated": len(plans),
+    }
+
+
+def format_table(report: Dict[str, Any]) -> str:
+    cur = report["current"]
+    lines = [
+        f"profile store: {report['store']['n_records']} records "
+        f"({report['store']['skipped_newer']} newer-version skipped)",
+        f"current layout(s): "
+        + (
+            ", ".join(
+                f"{m}={l}" for m, l in sorted(cur["layouts"].items())
+            )
+            or "(none)"
+        ),
+        f"measured step {cur['measured_step_s']:.4f}s, composed "
+        f"prediction {cur['predicted_step_s']:.4f}s "
+        f"(err {cur['pred_err']:.1%})",
+        "",
+        "per-MFC predicted vs measured:",
+        f"  {'mfc':<28} {'layout':<10} {'measured':>10} {'predicted':>10}"
+        f" {'err':>7} bound",
+    ]
+    for r in cur["per_mfc"]:
+        lines.append(
+            f"  {r['mfc']:<28} {r['layout']:<10} "
+            f"{r['measured_wall_s']:>9.4f}s {r['predicted_wall_s']:>9.4f}s"
+            f" {r['err']:>6.1%} "
+            f"{'compute' if r['compute_bound'] else 'other'}"
+        )
+    lines += [
+        "",
+        f"top candidate plans ({report['n_enumerated']} enumerated):",
+        f"  {'#':>3} {'plan':<28} {'step_s':>9} {'mem_gb':>8} feasible",
+    ]
+    for i, c in enumerate(report["candidates"], 1):
+        lines.append(
+            f"  {i:>3} {c['name']:<28} {c['predicted_step_s']:>9.4f} "
+            f"{c['predicted_mem_gb']:>8.3f} "
+            f"{'yes' if c['feasible'] else 'NO'}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="areal_tpu.apps.advisor")
+    p.add_argument(
+        "path",
+        help="profiles.jsonl store, merged trace.json, or trace shard dir",
+    )
+    p.add_argument(
+        "--devices", type=int, default=8,
+        help="device budget for candidate layouts",
+    )
+    p.add_argument(
+        "--mem-budget-gb", type=float, default=0.0,
+        help="per-device HBM budget; 0 disables the feasibility filter",
+    )
+    p.add_argument(
+        "--windows", default="1,2,4",
+        help="overlap_window values to enumerate (comma-separated)",
+    )
+    p.add_argument(
+        "--chunk-seqs", default="0,2,4",
+        help="pipeline_chunk_seqs values to enumerate (0 = unchunked)",
+    )
+    p.add_argument(
+        "--split", action="store_true",
+        help="also enumerate disaggregated gen/train plans (half the "
+        "device pool each + per-step weight realloc cost)",
+    )
+    p.add_argument("--top", type=int, default=10, help="plans to emit")
+    p.add_argument(
+        "--harvest-to", default=None,
+        help="also append harvested/loaded entries to this store path",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="emit the stable v1 JSON report instead of tables",
+    )
+    args = p.parse_args(argv)
+    if args.path.endswith(".jsonl") and not os.path.isdir(args.path):
+        # A real store: keep it, so skipped_newer reflects the file.
+        store: ProfileStore = ProfileStore(args.path)
+        entries = store.load()
+    else:
+        entries = _load_entries(args.path)
+        store = _MemStore(entries)
+    if args.harvest_to:
+        n = ProfileStore(args.harvest_to).append(entries)
+        if not args.json:
+            print(f"appended {n} entries -> {args.harvest_to}")
+    if not store.records():
+        print(
+            f"no MFC profile records in {args.path!r} (need a traced "
+            "run with profile-stamped spans)",
+            file=sys.stderr,
+        )
+        return 1
+    report = advise(
+        store,
+        devices=args.devices,
+        mem_budget_gb=args.mem_budget_gb,
+        windows=[int(x) for x in args.windows.split(",") if x],
+        chunk_seqs=[int(x) for x in args.chunk_seqs.split(",") if x],
+        include_split=args.split,
+        top=args.top,
+    )
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(format_table(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -59,8 +59,15 @@ system/paramstore.py: max-min serving weight version across gen servers
 or cross-set realloc push — e.g. ``warn: weight_version_skew <= 1``
 requires laggards to stay within the v-1 staleness bound the store's
 refcounts guarantee, and ``crit: push_p99 <= 30`` pages when weight
-distribution is eating the training step), plus any raw unlabeled
-series name.
+distribution is eating the training step), ``advisor_pred_err`` /
+``mfc_mfu_min`` / ``mfc_mfu_max`` (placement-advisor plane,
+apps/advisor.py: the master's online cost-model residual
+``areal_master_advisor_pred_err_ratio`` and the min/max of the labeled
+per-MFC MFU gauges — e.g. ``warn: advisor_pred_err <= 0.5`` flags when
+the DFG-composed prediction stops tracking the measured step, so the
+advisor's offline rankings are running on stale physics, and ``warn:
+mfc_mfu_min >= 0.02`` surfaces an MFC whose current placement is
+starving it), plus any raw unlabeled series name.
 
 Exit status: 0 if no CRIT fired over the run, 1 otherwise (``--count``
 bounds the run; without it the poller runs until interrupted).
@@ -390,6 +397,27 @@ def fleet_signals(
     pp = _hist_quantile(all_samples, "areal_param_push_seconds", 0.99)
     if not math.isnan(pp):
         signals["push_p99"] = pp
+    # Placement-advisor health: the master's online cost-model residual
+    # (DFG-composed per-MFC walls vs the measured step,
+    # areal_master_advisor_pred_err_ratio) and the spread of per-MFC MFU
+    # (the labeled areal_mfc_mfu_ratio gauges -> computed min/max).
+    # ``warn: advisor_pred_err <= 0.5`` flags when the advisor's
+    # composition stops tracking reality (its rankings are then stale);
+    # ``warn: mfc_mfu_min >= 0.02`` surfaces an MFC whose placement is
+    # starving it.  Absent until the first completed step.
+    ae = [
+        v for n, labels, v in all_samples
+        if n == "areal_master_advisor_pred_err_ratio"
+    ]
+    if ae:
+        signals["advisor_pred_err"] = max(ae)
+    mfus = [
+        v for n, labels, v in all_samples
+        if n == "areal_mfc_mfu_ratio" and labels.get("mfc") != "all"
+    ]
+    if mfus:
+        signals["mfc_mfu_min"] = min(mfus)
+        signals["mfc_mfu_max"] = max(mfus)
     # Raw unlabeled series become rule-addressable too (last wins on
     # duplicates; labeled series need the computed signals above).
     for n, labels, v in all_samples:

@@ -45,7 +45,7 @@ moment you need this view.
 (``json_report``) instead of the human tables, for dashboards and the
 regression tooling:
 
-    {"version": 3,
+    {"version": 4,
      "rows": [{"step", "pid", "process", "window_us", "compute_us",
                "comms_us", "host_us", "idle_us"}, ...],
      "bubbles": [{"process", "step", "start_us", "dur_us",
@@ -57,12 +57,18 @@ regression tooling:
                              "rejected_stale", "orphans", "e2e_p50_us",
                              "e2e_p99_us", "transitions", "staleness"},
                  "traces": [{"trace_id", "qid", "root", "complete",
-                             "e2e_us", "version_lag", "stages"}, ...]}}
+                             "e2e_us", "version_lag", "stages"}, ...]},
+     "profile": [<analysis/profile.py harvest_trace entries: per-MFC
+                  records keyed (mfc, model_shape, layout, batch_shape),
+                  per-step walls, inferred topology levels>]}
 
 ``version`` bumps on any breaking change; consumers must reject
 versions they don't know.  v2 was additive over v1 (``pipeline``); v3
-is additive over v2: ``lineage`` is new (empty traces/zero counts when
-the trace carries no ``lineage:*`` events, i.e. any pre-lineage run).
+was additive over v2 (``lineage``, empty traces/zero counts when the
+trace carries no ``lineage:*`` events); v4 is additive over v3:
+``profile`` is new — the placement advisor's profile-store entries
+harvested from this trace (empty list when no MFC spans carry profile
+args, i.e. any pre-advisor run).
 """
 
 import argparse
@@ -590,15 +596,17 @@ def format_flight(trace_dir: str, window_s: float = 10.0) -> str:
     return "\n".join(lines)
 
 
-# v3 is additive over v2: rows/bubbles/pipeline unchanged, "lineage"
-# added (see module docstring).
-JSON_VERSION = 3
+# v4 is additive over v3: rows/bubbles/pipeline/lineage unchanged,
+# "profile" added (see module docstring).
+JSON_VERSION = 4
 
 
 def json_report(trace, top: int = 5) -> Dict[str, Any]:
-    """Machine-readable report, schema v3 (see module docstring).  The
+    """Machine-readable report, schema v4 (see module docstring).  The
     internal ``_covered`` interval list is stripped from rows — it is an
     implementation detail of the precedence subtraction, not contract."""
+    from areal_tpu.analysis import profile as _profile
+
     rows = [
         {k: v for k, v in r.items() if not k.startswith("_")}
         for r in attribute(trace)
@@ -612,6 +620,7 @@ def json_report(trace, top: int = 5) -> Dict[str, Any]:
             "summary": lineage_summary(trace),
             "traces": lineage_rows(trace),
         },
+        "profile": _profile.harvest_trace(trace),
     }
 
 

@@ -493,6 +493,21 @@ class GeneratorEngine(HostOffloadMixin, Engine):
         self.load_state = (int(n), self.kv_utilization)
         self._m_live_slots.set(n)
 
+    def perf_counters(self) -> Dict[str, int]:
+        """Memory/compile counters for the worker's MFC spans (profile
+        store fields; analysis/profile.py _WATERMARK_ARGS)."""
+        out = {"compiles": int(self.decode_compiles)}
+        if self.params is not None:
+            out["param_bytes"] = int(
+                sum(int(x.nbytes) for x in jax.tree.leaves(self.params))
+            )
+        ps = self.last_pool_stats
+        if ps.get("pool_bytes") is not None:
+            out["pool_bytes"] = int(ps["pool_bytes"])
+        if ps.get("peak_allocated_bytes") is not None:
+            out["pool_peak_bytes"] = int(ps["peak_allocated_bytes"])
+        return out
+
     # ---------------- interruption (async weight sync) ----------------
 
     def interrupt(self) -> None:

@@ -229,6 +229,30 @@ class TrainEngine(HostOffloadMixin, Engine):
             self._pp_microbatches = self._pp_mesh.shape[
                 sharding.PIPE_AXIS
             ]
+        # Lazy byte-size cache for perf_counters(): param/opt global
+        # bytes never change shape after init, so sum the leaves once.
+        self._tree_bytes: Optional[Tuple[int, int]] = None
+
+    def perf_counters(self) -> Dict[str, int]:
+        """Memory/compile counters for the worker's MFC spans (profile
+        store fields; analysis/profile.py _WATERMARK_ARGS): global
+        param/optimizer bytes plus the engine's jit-trace surface."""
+        if self._tree_bytes is None:
+            self._tree_bytes = (
+                sum(int(x.nbytes) for x in jax.tree.leaves(self.params)),
+                sum(int(x.nbytes) for x in jax.tree.leaves(self.opt_state)),
+            )
+        compiles = 0
+        for gf, gaf in self._grad_fns.values():
+            compiles += gf._cache_size() + gaf._cache_size()
+        for fn in (self._apply_fn, self._scaled_apply_fn):
+            if fn is not None:
+                compiles += fn._cache_size()
+        return {
+            "param_bytes": self._tree_bytes[0],
+            "opt_bytes": self._tree_bytes[1],
+            "compiles": compiles,
+        }
 
     # ---------------- core jitted fns ----------------
 

@@ -312,6 +312,17 @@ class MasterWorker:
             "last step's achieved TFLOP/s, per MFC",
             ("mfc",),
         )
+        # Online cost-model residual: |composed per-MFC walls - measured
+        # step| / measured step (analysis/costmodel.compose_step over the
+        # DFG levels).  The advisor's offline predictions inherit this
+        # composition, so a drifting residual means its rankings are
+        # running on stale physics (apps/metrics_report.py
+        # `advisor_pred_err` SLO).
+        self._m_advisor_err = reg.gauge(
+            "areal_master_advisor_pred_err_ratio",
+            "relative error of DFG-composed per-MFC walls vs measured "
+            "step seconds, last step",
+        )
         # Pipeline-overlap attribution: per-stage busy fraction of the
         # streamed step window and the idle gap between a stage's first
         # and last chunk (the bubble the overlap is meant to shrink).
@@ -572,6 +583,30 @@ class MasterWorker:
                     gauge.labels("all").set(float(v))
                 elif k.endswith("/" + suffix):
                     gauge.labels(k[: -(len(suffix) + 1)]).set(float(v))
+        self._export_advisor_residual(stats, step_seconds)
+
+    def _export_advisor_residual(
+        self, stats: Dict[str, float], step_seconds: float
+    ) -> None:
+        """Compose this step's measured per-MFC walls through the DFG
+        levels (the same composition apps/advisor.py predicts with) and
+        publish the relative error vs the measured step."""
+        from areal_tpu.analysis import costmodel
+
+        walls: Dict[str, float] = {}
+        for node in self.dfg.nodes:
+            v = stats.get(f"{node.name}/perf/time_s")
+            if v is None and len(self.dfg.nodes) == 1:
+                v = stats.get("perf/time_s")
+            if v is not None:
+                walls[node.name] = float(v)
+        if not walls or step_seconds <= 0:
+            return
+        levels = [
+            [n.name for n in lvl] for lvl in self.dfg.topological_order()
+        ]
+        pred = costmodel.compose_step(levels, walls)
+        self._m_advisor_err.set(abs(pred - step_seconds) / step_seconds)
 
     async def _post_step(self):
         if self.save_ctl.check():
@@ -1300,6 +1335,10 @@ class MasterWorker:
                     with tracer.span(
                         "xfer:data", cat="comms",
                         src=src, dst=dst, n=len(sids),
+                        # Same label the worker stamps on the consuming
+                        # compute span, so the profile store can join
+                        # transfer bytes to their MFC.
+                        mfc=f"{node.model_name}:{node.interface_type.value}",
                     ) as targs:
                         send_r, recv_r = await asyncio.gather(
                             self.pool.request(

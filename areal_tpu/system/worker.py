@@ -174,6 +174,10 @@ class ModelWorker:
         self._xfer_recv_busy = False
         self.models: Dict[str, Model] = {}
         self.interfaces: Dict[str, Any] = {}
+        # Per-model mesh layout string ("d4f2m2"), stamped onto every
+        # MFC span so the profile store (analysis/profile.py) can key
+        # records by (mfc, model_shape, layout, batch_shape).
+        self._layouts: Dict[str, str] = {}
         self.data_cache: Dict[str, SequenceSample] = {}
         # Serialize-once cache for param pushes, keyed by model name:
         # (host tree, checksum, wire encoding) survive across targets
@@ -271,6 +275,7 @@ class ModelWorker:
             self.models[key] = Model(
                 name=key, engine=engine, tokenizer=self.tokenizer, config=cfg
             )
+            self._layouts[key] = shard.parallel.to_str()
             self.interfaces[key] = make_interface(
                 shard.interface.type_, **shard.interface.args
             )
@@ -489,14 +494,23 @@ class ModelWorker:
             )
             if tracer.enabled():
                 targs["mfc"] = f"{model_key}:{itype.value}"
-                key0 = next(iter(sample.keys))
+                # Same key preference as _mfc_perf: train samples carry
+                # per-sequence scalar keys (rewards, ...) whose "lens"
+                # are 1 — counting those as tokens poisons the profile.
+                key0 = (
+                    "packed_input_ids"
+                    if "packed_input_ids" in sample.keys
+                    else next(iter(sample.keys))
+                )
                 targs["tokens"] = int(
                     sum(sum(s) for s in sample.seqlens[key0])
                 )
+                targs["seqs"] = len(sample.seqlens[key0])
                 if "perf/tflops" in perf:
                     targs["tflops"] = perf["perf/tflops"]
                 if "perf/mfu" in perf:
                     targs["mfu"] = perf["perf/mfu"]
+                self._span_profile_fields(model_key, model, targs)
 
         if out_sample is not None:
             for one in out_sample.unpack():
@@ -533,6 +547,7 @@ class ModelWorker:
             "state": interface.train_stream_begin(model, mb_spec),
             "busy_s": 0.0,
             "tokens": 0,
+            "seqs": 0,
             "sum_sq": 0.0,
             "n_chunks": 0,
         }
@@ -551,8 +566,12 @@ class ModelWorker:
             req.get("shard_meta"),
             req.get("input_key_remap", {}),
         )
+        # Seed the span with one arg: the tracer only attaches its args
+        # dict to the event when non-empty at span exit, and the fields
+        # below are stamped after the block (same dict, flushed later).
         with tracer.span(
-            f"mfc:{model_key}:train_chunk", cat="compute"
+            f"mfc:{model_key}:train_chunk", cat="compute",
+            mfc=f"{model_key}:train_chunk",
         ) as targs:
             with self.timers.record("mfc_train_chunk"):
                 t0 = time.monotonic()
@@ -562,9 +581,17 @@ class ModelWorker:
                 seconds = time.monotonic() - t0
         st["busy_s"] += seconds
         st["n_chunks"] += 1
-        key0 = next(iter(sample.keys))
+        # Prefer the packed key (see _mfc_perf): a scalar key's seqlens
+        # are all 1, which would undercount the stream's token total and
+        # poison the end-of-stream FLOP/MFU accounting.
+        key0 = (
+            "packed_input_ids"
+            if "packed_input_ids" in sample.keys
+            else next(iter(sample.keys))
+        )
         lens = [sum(s) for s in sample.seqlens[key0]]
         st["tokens"] += int(sum(lens))
+        st["seqs"] += len(lens)
         st["sum_sq"] += float(sum(l * l for l in lens))
         if tracer.enabled():
             targs["mfc"] = f"{model_key}:train_chunk"
@@ -597,8 +624,11 @@ class ModelWorker:
         model = self.models[model_key]
         interface = self.interfaces[model_key]
         mb_spec: MicroBatchSpec = req.get("mb_spec") or MicroBatchSpec()
+        # Seeded like train_chunk above: args written after the block
+        # only reach the trace when the dict was non-empty at exit.
         with tracer.span(
-            f"mfc:{model_key}:train_step", cat="compute"
+            f"mfc:{model_key}:train_step", cat="compute",
+            mfc=f"{model_key}:train_step",
         ) as targs:
             with self.timers.record("mfc_train_step"):
                 t0 = time.monotonic()
@@ -631,9 +661,35 @@ class ModelWorker:
         if tracer.enabled():
             targs["mfc"] = mfc_label
             targs["stream_chunks"] = st["n_chunks"]
+            targs["tokens"] = st["tokens"]
+            targs["seqs"] = st["seqs"]
+            # Busy seconds over all chunks + the optimizer step: the
+            # span itself wraps only the latter (profile-store wall).
+            targs["wall_s"] = round(busy, 6)
+            if "perf/tflops" in perf:
+                targs["tflops"] = perf["perf/tflops"]
             if "perf/mfu" in perf:
                 targs["mfu"] = perf["perf/mfu"]
+            self._span_profile_fields(model_key, model, targs)
         return {"meta": None, "stats": {**dict(result or {}), **perf}}
+
+    def _span_profile_fields(self, model_key, model, targs) -> None:
+        """Profile-store fields on MFC spans (analysis/profile.py keys
+        records by them): mesh layout, model shape, and the engine's
+        memory/compile counters."""
+        targs["layout"] = self._layouts.get(model_key, "")
+        cfg = model.config
+        if cfg is not None:
+            targs["model_shape"] = (
+                f"l{cfg.n_layers}h{cfg.hidden_dim}q{cfg.n_q_heads}"
+                f"kv{cfg.n_kv_heads}v{cfg.vocab_size}"
+            )
+        counters = getattr(model.engine, "perf_counters", None)
+        if counters is not None:
+            try:
+                targs.update(counters())
+            except Exception as e:  # accounting must never fail the MFC
+                logger.warning(f"perf counters failed: {e!r}")
 
     def _mfc_perf(
         self, model, itype, sample, result, seconds: float

@@ -1,0 +1,375 @@
+"""Profile store + cost model + placement advisor (analysis/profile.py,
+analysis/costmodel.py, apps/advisor.py).
+
+Everything here is stdlib-only by design — the advisor must run on a
+box with no jax — so the tests build synthetic traces/records instead
+of running engines (scripts/check_advisor.py covers the live loop).
+"""
+
+import json
+
+import pytest
+
+from areal_tpu.analysis import costmodel
+from areal_tpu.analysis.profile import (
+    PROFILE_VERSION,
+    ProfileKey,
+    ProfileStore,
+    batch_shape_of,
+    harvest_trace,
+)
+from areal_tpu.apps import advisor
+
+
+def _span(name, ts, dur, cat="compute", **args):
+    e = {"ph": "X", "name": name, "ts": ts, "dur": dur, "tid": 1}
+    if cat:
+        e["cat"] = cat
+    if args:
+        e["args"] = args
+    return e
+
+
+def _synthetic_trace():
+    """Two steps; gen then reward+train concurrently (two levels)."""
+    ev = []
+    for step, t0 in ((0, 0), (1, 2_000_000)):
+        ev.append(_span("step", t0, 1_500_000, cat=None, step=step))
+        ev.append(
+            _span(
+                "mfc:a@0:generate", t0 + 10, 800_000,
+                mfc="a@0:generate", tokens=1024, seqs=8,
+                tflops=0.004, mfu=0.1, layout="d4",
+                model_shape="l2h64q4kv2v512",
+                pool_peak_bytes=1e6, param_bytes=2e6,
+            )
+        )
+        ev.append(
+            _span(
+                "mfc:r@0:inference", t0 + 900_000, 240_000,
+                mfc="r@0:inference", tokens=1024, seqs=8, layout="d1",
+            )
+        )
+        ev.append(
+            _span(
+                "mfc:a@0:train_step", t0 + 900_000, 500_000,
+                mfc="a@0:train_step", tokens=1024, seqs=8,
+                tflops=0.012, layout="d4",
+                model_shape="l2h64q4kv2v512",
+                param_bytes=2e6, opt_bytes=4e6,
+            )
+        )
+    ev.append(
+        _span("xfer:data", 850_000, 30_000, cat="comms",
+              mfc="a@0:train_step", bytes=5e6)
+    )
+    return {"traceEvents": ev}
+
+
+class TestProfileStore:
+    def test_batch_shape_pow2_bucketing(self):
+        assert batch_shape_of(8, 1024) == "n8x128"
+        assert batch_shape_of(8, 1000) == "n8x128"  # 125 -> 128
+        assert batch_shape_of(1, 0) == "n1x1"
+
+    def test_harvest_round_trip(self, tmp_path):
+        entries = harvest_trace(_synthetic_trace(), meta={"leg": "t"})
+        store = ProfileStore(str(tmp_path / "profiles.jsonl"))
+        store.append(entries)
+        recs = store.records()
+        by_mfc = {k.mfc: m for k, m in recs}
+        assert set(by_mfc) == {
+            "a@0:generate", "r@0:inference", "a@0:train_step"
+        }
+        gen = by_mfc["a@0:generate"]
+        assert gen["calls"] == 2
+        assert gen["wall_s_mean"] == pytest.approx(0.8)
+        assert gen["tflops_mean"] == pytest.approx(0.004)
+        assert gen["pool_peak_bytes"] == 1e6
+        key = next(k for k, _ in recs if k.mfc == "a@0:generate")
+        assert key.layout == "d4"
+        assert key.batch_shape == "n8x128"
+        # xfer:data attribution lands on the consuming MFC only.
+        assert by_mfc["a@0:train_step"]["xfer_bytes_mean"] == \
+            pytest.approx(2.5e6)
+        assert by_mfc["a@0:generate"]["xfer_bytes_mean"] == 0
+        assert store.step_walls() == [1.5, 1.5]
+        # Inferred topology: gen alone, then reward+train concurrent.
+        assert store.levels() == [
+            ["a@0:generate"], ["a@0:train_step", "r@0:inference"]
+        ]
+
+    def test_skip_warmup_drops_first_window(self):
+        entries = harvest_trace(_synthetic_trace(), skip_warmup=1)
+        steps = [e for e in entries if e["kind"] == "step"]
+        assert [e["step"] for e in steps] == [1]
+        gen = next(
+            e for e in entries
+            if e["kind"] == "mfc"
+            and e["key"]["mfc"] == "a@0:generate"
+        )
+        assert gen["metrics"]["calls"] == 1
+
+    def test_newer_version_and_torn_lines_skipped(self, tmp_path):
+        path = str(tmp_path / "profiles.jsonl")
+        store = ProfileStore(path)
+        store.append(harvest_trace(_synthetic_trace()))
+        with open(path, "a") as f:
+            f.write(json.dumps({
+                "v": PROFILE_VERSION + 1, "kind": "mfc",
+                "key": {"mfc": "future@0:generate"}, "metrics": {},
+            }) + "\n")
+            f.write('{"torn tail\n')
+        recs = store.records()
+        assert store.skipped_newer == 1
+        assert store.skipped_bad == 1
+        assert all(k.mfc != "future@0:generate" for k, _ in recs)
+
+    def test_latest_wins_on_reappend(self, tmp_path):
+        store = ProfileStore(str(tmp_path / "p.jsonl"))
+        key = ProfileKey("m@0:generate", "s", "d1", "n1x64")
+        store.append([
+            {"kind": "mfc", "key": key.to_dict(),
+             "metrics": {"wall_s_mean": 1.0}},
+            {"kind": "mfc", "key": key.to_dict(),
+             "metrics": {"wall_s_mean": 2.0}},
+        ])
+        assert store.latest()[key]["wall_s_mean"] == 2.0
+
+
+class TestLayoutGrammar:
+    def test_parse_and_round_trip(self):
+        axes = costmodel.parse_layout("d4f2m2")
+        assert axes == {"data": 4, "fsdp": 2, "model": 2,
+                        "pipe": 1, "seq": 1}
+        assert costmodel.layout_str(axes) == "d4f2m2"
+        assert costmodel.layout_devices("d4f2m2") == 16
+        assert costmodel.batch_shards("d4f2m2") == 8
+        assert costmodel.param_shards("d4f2m2") == 4
+
+    def test_garbage_parses_single_device(self):
+        assert costmodel.layout_devices("not-a-layout") == 1
+        assert costmodel.layout_devices("") == 1
+
+    def test_enumerate_layouts_factorizations(self):
+        layouts = costmodel.enumerate_layouts(8)
+        # Every (d, f, m) factorization of 8: 10 distinct triples.
+        assert len(layouts) == 10
+        assert all(costmodel.layout_devices(s) == 8 for s in layouts)
+        assert "d8" in layouts and "d1m8" in layouts
+        assert len(set(layouts)) == len(layouts)
+
+
+class TestPartitionRules:
+    RULES = [
+        (r"attention/w", ("model", None)),
+        (r".*", (None, "fsdp")),
+    ]
+
+    def test_first_match_and_scalar_replicate(self):
+        specs = costmodel.match_partition_rules(
+            self.RULES,
+            {"attention/w": (64, 64), "mlp/w": (64, 256),
+             "scale": ()},
+        )
+        assert specs["attention/w"] == ("model", None)
+        assert specs["mlp/w"] == (None, "fsdp")
+        assert specs["scale"] == ()
+
+    def test_unmatched_raises(self):
+        with pytest.raises(ValueError, match="no partition rule"):
+            costmodel.match_partition_rules(
+                [(r"^only_this$", (None,))], {"other": (4, 4)}
+            )
+
+    def test_realloc_plan_bytes_counts_moved_params_only(self):
+        shapes = {"attention/w": (64, 64), "mlp/w": (64, 256)}
+        same = costmodel.realloc_plan_bytes(
+            shapes, self.RULES, self.RULES
+        )
+        assert same == 0
+        dst = [(r".*", (None, "fsdp"))]
+        moved = costmodel.realloc_plan_bytes(
+            shapes, self.RULES, dst, dtype_bytes=4
+        )
+        assert moved == 64 * 64 * 4  # only attention/w changed spec
+
+
+class TestCostModel:
+    def _record(self, mfc="a@0:train_step", layout="d4", wall=1.0,
+                tflops=0.01, **extra):
+        key = ProfileKey(mfc, "l2h64q4kv2v512", layout, "n8x128")
+        m = {"calls": 2, "wall_s_mean": wall, "wall_s_sum": 2 * wall,
+             "seqs_mean": 8.0}
+        if tflops:
+            m["tflops_mean"] = tflops
+        m.update(extra)
+        return key, m
+
+    def test_same_layout_reproduces_measurement(self):
+        key, m = self._record()
+        rf = costmodel.calibrate([(key, m)])
+        p = costmodel.predict_mfc(key, m, rf)
+        assert p.wall_s == pytest.approx(1.0, rel=1e-6)
+        assert p.compute_bound
+
+    def test_flopless_mfc_scales_per_sequence(self):
+        key, m = self._record(
+            mfc="r@0:inference", layout="d1", wall=0.8, tflops=None
+        )
+        rf = costmodel.calibrate([(key, m)])
+        assert "r@0:inference" in rf.fixed_s_per_seq
+        p = costmodel.predict_mfc(key, m, rf)
+        assert p.wall_s == pytest.approx(0.8, rel=1e-3)
+        half = dict(m, seqs_mean=4.0)
+        p4 = costmodel.predict_mfc(key, half, rf)
+        # Half the sequences -> roughly half the wall (per-seq model).
+        assert p4.wall_s == pytest.approx(
+            rf.overhead_s + (0.8 - rf.overhead_s) / 2, rel=1e-3
+        )
+
+    def test_compose_step_barrier(self):
+        walls = {"a": 1.0, "b": 3.0, "c": 2.0}
+        assert costmodel.compose_step([["a"], ["b", "c"]], walls) == 4.0
+        # Unknown MFCs contribute nothing, not infinity.
+        assert costmodel.compose_step([["zzz"], ["a"]], walls) == 1.0
+
+    def test_compose_step_pipelined_bounds(self):
+        levels = [["g"], ["t"]]
+        walls = {"g": 2.0, "t": 2.0}
+        serial = costmodel.compose_step_pipelined(
+            levels, walls, n_chunks=4, overlap_window=1
+        )
+        assert serial == 4.0  # window 1 degrades to the barrier sum
+        full = costmodel.compose_step_pipelined(
+            levels, walls, n_chunks=4, overlap_window=4
+        )
+        # fill + steady state: sum(t) + (n-1)*max(t), t = 0.5 each.
+        expected_full = 1.0 + 3 * 0.5
+        assert full < serial
+        assert full >= expected_full - 1e-9
+        w2 = costmodel.compose_step_pipelined(
+            levels, walls, n_chunks=4, overlap_window=2
+        )
+        assert full < w2 < serial  # window throttles the hiding
+
+    def test_rank_plans_synthetic_roofline_exact_order(self):
+        key, m = self._record(layout="d1", wall=8.0, tflops=0.08)
+        rf = costmodel.calibrate([(key, m)])
+        latest = {key: m}
+        levels = [["a@0:train_step"]]
+        plans = [
+            costmodel.CandidatePlan("d8", "d8", "d8"),
+            costmodel.CandidatePlan("d1", "d1", "d1"),
+            costmodel.CandidatePlan("m8", "m8", "m8"),
+        ]
+        preds = [
+            costmodel.predict_plan(p, latest, levels, rf)
+            for p in plans
+        ]
+        ranked = costmodel.rank_plans(preds)
+        # 8 devices beat 1; pure data beats pure model parallelism
+        # (batch_axis_eff 0.97/doubling > model_axis_eff 0.85).
+        assert [p.plan.name for p in ranked] == ["d8", "m8", "d1"]
+
+    def test_infeasible_plans_trail(self):
+        key, m = self._record(
+            layout="d1", wall=8.0, tflops=0.08,
+            param_bytes=8e9, opt_bytes=16e9,
+        )
+        rf = costmodel.calibrate([(key, m)])
+        latest = {key: m}
+        levels = [["a@0:train_step"]]
+        # 24 GB of param+opt state: d8 replicates (24 GB/device), m8
+        # shards 8 ways (3 GB/device) — only m8 fits a 4 GB budget.
+        fast_but_fat = costmodel.predict_plan(
+            costmodel.CandidatePlan("d8", "d8", "d8"),
+            latest, levels, rf, mem_budget_bytes=4e9,
+        )
+        slow_but_fits = costmodel.predict_plan(
+            costmodel.CandidatePlan("m8", "m8", "m8"),
+            latest, levels, rf, mem_budget_bytes=4e9,
+        )
+        assert not fast_but_fat.feasible  # d8 replicates params
+        assert slow_but_fits.feasible     # m8 shards them 8 ways
+        ranked = costmodel.rank_plans([fast_but_fat, slow_but_fits])
+        assert ranked[0].plan.name == "m8"
+
+
+class TestAdvisorJSON:
+    def _store(self, tmp_path):
+        store = ProfileStore(str(tmp_path / "profiles.jsonl"))
+        store.append(harvest_trace(_synthetic_trace()))
+        return store
+
+    def test_schema_v1_pin(self, tmp_path):
+        report = advisor.advise(
+            self._store(tmp_path), devices=4, top=3
+        )
+        assert set(report) == {
+            "version", "store", "roofline", "levels", "current",
+            "candidates", "n_enumerated",
+        }
+        assert report["version"] == advisor.ADVISOR_JSON_VERSION == 1
+        assert set(report["store"]) == {"n_records", "skipped_newer"}
+        cur = report["current"]
+        assert set(cur) == {
+            "layouts", "measured_step_s", "predicted_step_s",
+            "pred_err", "per_mfc",
+        }
+        assert {r["mfc"] for r in cur["per_mfc"]} == {
+            "a@0:generate", "r@0:inference", "a@0:train_step"
+        }
+        for r in cur["per_mfc"]:
+            assert set(r) == {
+                "mfc", "layout", "batch_shape", "measured_wall_s",
+                "predicted_wall_s", "err", "compute_bound",
+            }
+        assert len(report["candidates"]) == 3
+        cand = report["candidates"][0]
+        for k in ("name", "gen_layout", "train_layout", "colocated",
+                  "overlap_window", "pipeline_chunk_seqs",
+                  "predicted_step_s", "predicted_mem_gb", "feasible",
+                  "per_mfc"):
+            assert k in cand
+        # 3 windows x 3 chunk sizes x 6 gen x 6 train layouts of 4 dev.
+        assert report["n_enumerated"] == 3 * 3 * 6 * 6
+        json.dumps(report)  # pure-JSON serializable
+
+    def test_candidates_ranked_fastest_first(self, tmp_path):
+        report = advisor.advise(self._store(tmp_path), devices=4, top=10)
+        steps = [c["predicted_step_s"] for c in report["candidates"]
+                 if c["feasible"]]
+        assert steps == sorted(steps)
+
+    def test_cli_json_round_trips(self, tmp_path, capsys):
+        store = self._store(tmp_path)
+        rc = advisor.main(["--json", "--devices", "4", store.path])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["version"] == 1
+        assert report["store"]["n_records"] == 3
+
+    def test_cli_table_mode(self, tmp_path, capsys):
+        store = self._store(tmp_path)
+        rc = advisor.main(["--devices", "4", "--top", "2", store.path])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "per-MFC predicted vs measured" in out
+        assert "top candidate plans" in out
+
+    def test_cli_empty_store_errors(self, tmp_path, capsys):
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        rc = advisor.main(["--devices", "4", str(empty)])
+        assert rc == 1
+        assert "no MFC profile records" in capsys.readouterr().err
+
+    def test_split_plans_pay_realloc(self, tmp_path):
+        report = advisor.advise(
+            self._store(tmp_path), devices=4, include_split=True,
+            windows=[1], chunk_seqs=[0], top=200,
+        )
+        names = [c["name"] for c in report["candidates"]]
+        assert any(n.startswith("split:") for n in names)
+        assert any(n.startswith("co:") for n in names)

@@ -375,6 +375,29 @@ def test_majority_no_answer_cluster_wins():
     assert _majority_correct("math", texts, info) is False
 
 
+def test_majority_sympy_fallback_clusters_symbolic_forms():
+    """When the fast string/Fraction match can't pair two extractable
+    answers, the sympy grader breaks the tie: \\sqrt{2}/2 and 0.7071
+    must share a cluster and outvote two distinct wrong answers."""
+    from areal_tpu.scheduler.evaluator import _majority_correct
+
+    texts = [
+        r"thus \boxed{\frac{\sqrt{2}}{2}}",
+        r"thus \boxed{0.7071}",
+        r"thus \boxed{3}",
+        r"thus \boxed{5}",
+    ]
+    info = {"solutions": [r"\boxed{\frac{\sqrt{2}}{2}}"]}
+    assert _majority_correct("math", texts, info) is True
+    # The fast tier alone cannot pair these two forms — proves the
+    # clustering above really exercised the sympy fallback.
+    from areal_tpu.interfaces.math_verify import answers_match, extract_answer
+
+    p0 = extract_answer(texts[0]) or ""
+    p1 = extract_answer(texts[1]) or ""
+    assert not answers_match(p0, p1)
+
+
 def test_maj_at_k_multi_dataset_flat_key(tmp_path):
     ckpt = _write_ckpt(tmp_path / "ckpts", 1)
     d1 = tmp_path / "a.jsonl"

@@ -319,3 +319,66 @@ class TestDecodeAttentionKernel:
             np.asarray(out_k.data["packed_input_ids"]),
             np.asarray(out_d.data["packed_input_ids"]),
         )
+
+    def test_empty_window_rows_zero_kernel_vs_fallback(
+        self, rng, monkeypatch
+    ):
+        """Rows whose live window is empty (valid_from >= valid_to) must
+        emit exact zeros on BOTH paths — the XLA fallback zeroes the
+        softmax of an all-NEG_INF row instead of keeping its uniform
+        distribution over garbage, and the Pallas kernel's running-max
+        formulation produces zeros natively.  Parked generation slots
+        hit this every step, so a mismatch here corrupts real decodes."""
+        from areal_tpu.ops import attention
+
+        b, s = 4, 128
+        q, k, v, _, _ = self._mk(rng, b=b, s=s)
+        lo = jnp.asarray([0, 64, s, 100], jnp.int32)
+        hi = jnp.asarray([64, 64, 64, 40], jnp.int32)  # rows 1-3 empty
+        empty = np.asarray(lo) >= np.asarray(hi)
+        assert empty.tolist() == [False, True, True, True]
+
+        monkeypatch.setattr(attention, "_DECODE_KERNEL_SNAPSHOT", False)
+        out_xla = np.asarray(attention.decode_attention(q, k, v, lo, hi))
+        monkeypatch.setattr(attention, "_DECODE_KERNEL_SNAPSHOT", True)
+        out_ker = np.asarray(attention.decode_attention(q, k, v, lo, hi))
+        monkeypatch.setattr(attention, "_DECODE_KERNEL_SNAPSHOT", None)
+
+        np.testing.assert_array_equal(out_xla[empty], 0.0)
+        np.testing.assert_array_equal(out_ker[empty], 0.0)
+        assert np.abs(out_xla[~empty]).max() > 0  # live row is real
+        np.testing.assert_allclose(out_ker, out_xla, rtol=2e-5, atol=2e-5)
+
+    def test_empty_window_rows_zero_chunk_kernel_vs_fallback(
+        self, rng, monkeypatch
+    ):
+        """Chunk form of the empty-window parity: query i of a row sees
+        [valid_from, valid_to0 + i), so a row with valid_from >=
+        valid_to0 + Q - 1 has EVERY query fully masked."""
+        from areal_tpu.ops import attention
+
+        b, s, Q, nq, nkv, d = 3, 128, 3, 8, 2, 128
+        q = jnp.asarray(rng.standard_normal((b, Q, nq, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, nkv, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, s, nkv, d)), jnp.float32)
+        lo = jnp.asarray([0, s, 90], jnp.int32)
+        to0 = jnp.asarray([64, 64, 30], jnp.int32)  # rows 1-2: all empty
+        empty = np.asarray(lo)[:, None] >= (
+            np.asarray(to0)[:, None] + np.arange(Q)[None, :]
+        )  # [B, Q]
+        assert empty.all(axis=1).tolist() == [False, True, True]
+
+        monkeypatch.setattr(attention, "_DECODE_KERNEL_SNAPSHOT", False)
+        out_xla = np.asarray(
+            attention.decode_attention_chunk(q, k, v, lo, to0)
+        )
+        monkeypatch.setattr(attention, "_DECODE_KERNEL_SNAPSHOT", True)
+        out_ker = np.asarray(
+            attention.decode_attention_chunk(q, k, v, lo, to0)
+        )
+        monkeypatch.setattr(attention, "_DECODE_KERNEL_SNAPSHOT", None)
+
+        np.testing.assert_array_equal(out_xla[empty], 0.0)
+        np.testing.assert_array_equal(out_ker[empty], 0.0)
+        assert np.abs(out_xla[~empty]).max() > 0
+        np.testing.assert_allclose(out_ker, out_xla, rtol=2e-5, atol=2e-5)

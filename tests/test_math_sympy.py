@@ -233,3 +233,39 @@ class TestChoiceExtractionRobustness:
         assert verify_math("The correct options are (C) and (A).", ["AC"])
         assert verify_math("B and D. B is right because...", ["BD"])
         assert not verify_math("(C) and (A) and (D)", ["AC"])
+
+    def test_positional_scan_last_letter_wins_across_styles(self):
+        """POSITIONAL pin: the LAST letter wins whether parenthesized or
+        standalone — a paren-beats-standalone priority would grade (A)
+        here and misgrade the self-correction."""
+        from areal_tpu.interfaces.math_verify import choice_answer_clean
+
+        assert choice_answer_clean("(A) is wrong, the answer is B") == "B"
+        assert choice_answer_clean("B is tempting but (C)") == "C"
+        # Bare A/I stay weak regardless of position: a strong earlier
+        # candidate beats a trailing English-word letter.
+        assert choice_answer_clean("The answer is (B). I am sure.") == "B"
+        # ...but with no strong candidate anywhere, the weak one counts.
+        assert choice_answer_clean("I") == "I"
+        assert choice_answer_clean("probably A") == "A"
+        # F-J extension (10-option sets the A-E reference would miss).
+        assert choice_answer_clean("the answer is (J)") == "J"
+
+    def test_is_multi_choice_row_evidence_gate(self):
+        """Row-level evidence decides; gold-string inference is only the
+        no-evidence fallback (a math gold of 'C' must not silently grade
+        as a choice row when the row says it is not one)."""
+        from areal_tpu.interfaces.math_verify import is_multi_choice
+
+        # No evidence: infer from the gold string.
+        assert is_multi_choice("B")
+        assert is_multi_choice("ACD")
+        assert not is_multi_choice("1/2")
+        assert not is_multi_choice("")
+        # Row says choice: still requires a letters-only gold (a choice
+        # row whose gold is the option TEXT grades as a plain answer).
+        assert is_multi_choice("B", is_choice=True)
+        assert not is_multi_choice("the rain in spain", is_choice=True)
+        # Row says NOT choice: letter-shaped math golds stay math.
+        assert not is_multi_choice("C", is_choice=False)
+        assert not is_multi_choice("AB", is_choice=False)

@@ -258,6 +258,36 @@ class TestSLO:
         sig2, _ = mr.fleet_signals(roles, prev=prev)
         assert sig2["goodput"] == pytest.approx(32.0)  # (480-160)/10
 
+    def test_advisor_fleet_signals(self):
+        samples = [
+            ("areal_master_advisor_pred_err_ratio", {}, 0.12),
+            ("areal_mfc_mfu_ratio", {"mfc": "actor@0:train_step"}, 0.08),
+            ("areal_mfc_mfu_ratio", {"mfc": "actor@0:generate"}, 0.02),
+            ("areal_mfc_mfu_ratio", {"mfc": "all"}, 0.05),
+        ]
+        roles = [mr.RoleScrape("master/0", t=10.0, samples=samples)]
+        sig, _ = mr.fleet_signals(roles, prev=None)
+        assert sig["advisor_pred_err"] == pytest.approx(0.12)
+        # min/max over the labeled per-MFC gauges, "all" excluded.
+        assert sig["mfc_mfu_min"] == pytest.approx(0.02)
+        assert sig["mfc_mfu_max"] == pytest.approx(0.08)
+        # Absent series -> absent signals (SLO rules skip, not trip).
+        sig2, _ = mr.fleet_signals(
+            [mr.RoleScrape("master/0", t=10.0, samples=[])], prev=None
+        )
+        assert "advisor_pred_err" not in sig2
+        assert "mfc_mfu_min" not in sig2
+
+    def test_advisor_slo_rules_evaluate(self):
+        err = mr.parse_slo_rule("warn: advisor_pred_err <= 0.5")
+        mfu = mr.parse_slo_rule("warn: mfc_mfu_min >= 0.02")
+        assert err.evaluate([{"advisor_pred_err": 0.7}]) is not None
+        assert err.evaluate([{"advisor_pred_err": 0.2}]) is None
+        assert mfu.evaluate([{"mfc_mfu_min": 0.01}]) is not None
+        assert mfu.evaluate([{"mfc_mfu_min": 0.05}]) is None
+        # Absent signal (run without an advisor plane): not a violation.
+        assert err.evaluate([{}]) is None
+
 
 def _load_script(name):
     path = os.path.join(REPO_ROOT, "scripts", name)
@@ -330,7 +360,7 @@ class TestCheckRegression:
 
 
 class TestTraceReportJSON:
-    def test_v3_schema_additive_over_v2(self):
+    def test_v4_schema_additive_over_v3(self):
         trace = {
             "traceEvents": [
                 {"ph": "M", "name": "process_name", "pid": 1,
@@ -342,20 +372,42 @@ class TestTraceReportJSON:
             ]
         }
         rep = json_report(trace)
-        assert rep["version"] == 3
+        assert rep["version"] == 4
         assert set(rep) == {"version", "rows", "bubbles", "pipeline",
-                            "lineage"}
+                            "lineage", "profile"}
         assert rep["pipeline"] == []  # no pipe:* spans in this trace
-        # v3's lineage key is additive: empty join for traces without
-        # lineage stamps, v2 keys byte-identical.
+        # v3's lineage key stays byte-identical; v4's profile key is
+        # additive — for a trace with no profile-stamped mfc spans it
+        # still carries the step entries (kind == "step").
         assert rep["lineage"]["traces"] == []
         assert rep["lineage"]["summary"]["n"] == 0
+        assert all(e["kind"] in ("mfc", "step", "topo")
+                   for e in rep["profile"])
+        assert any(e["kind"] == "step" for e in rep["profile"])
+        assert not any(e["kind"] == "mfc" for e in rep["profile"])
         row = rep["rows"][0]
         assert set(row) == {"step", "pid", "process", "window_us",
                             "compute_us", "comms_us", "host_us", "idle_us"}
         assert "_covered" not in row
         assert row["compute_us"] == 50 and row["idle_us"] == 50
         json.dumps(rep)  # must be pure-JSON serializable
+
+    def test_v4_profile_key_carries_mfc_records(self):
+        trace = {
+            "traceEvents": [
+                {"ph": "X", "name": "step", "pid": 1, "tid": 1,
+                 "ts": 0, "dur": 100, "args": {"step": 0}},
+                {"ph": "X", "name": "mfc:a@0:generate", "cat": "compute",
+                 "pid": 1, "tid": 1, "ts": 10, "dur": 50,
+                 "args": {"mfc": "a@0:generate", "tokens": 64,
+                          "seqs": 2, "layout": "d1"}},
+            ]
+        }
+        rep = json_report(trace)
+        mfc = [e for e in rep["profile"] if e["kind"] == "mfc"]
+        assert len(mfc) == 1
+        assert mfc[0]["key"]["mfc"] == "a@0:generate"
+        assert mfc[0]["metrics"]["calls"] == 1
 
 
 def _lint(src):
