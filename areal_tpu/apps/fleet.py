@@ -17,6 +17,14 @@ backpressure) adds one server; a sustained idle window (goodput ~0,
 fleet idle) drains one.  Membership epochs persist through the trial's
 ``RecoverInfo`` when ``--recover-root`` is given, so a restarted
 supervisor resumes its epoch counter instead of re-counting from 0.
+
+``--verifier-spawn-cmd`` adds a second, independently-scaled **verifier
+lane** (:class:`~areal_tpu.system.fleet.SupervisorLane` over
+``python -m areal_tpu.apps.verifier`` workers): grade-latency /
+queue-depth CRITs (``--verifier-slo``, default scale-up signals
+``grade_latency_p99`` and ``verifier_queue_depth``) spawn a grading
+worker, an idle pool drains one, and a TTL-evicted crash is refilled
+back to ``--verifier-min-servers`` without waiting out the cooldown.
 """
 
 import argparse
@@ -24,8 +32,10 @@ import shlex
 import sys
 from typing import List, Optional
 
-from areal_tpu.base import logging
-from areal_tpu.system.fleet import FleetSupervisor, LocalProcessFleet
+from areal_tpu.base import logging, names
+from areal_tpu.system.fleet import (
+    FleetSupervisor, LocalProcessFleet, SupervisorLane,
+)
 
 logger = logging.getLogger("fleet")
 
@@ -57,6 +67,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--recover-root", default=None,
                    help="trial recover dir: persists membership epochs "
                         "through RecoverInfo.fleet_state")
+    p.add_argument("--verifier-spawn-cmd", default="",
+                   help="verifier-worker launch command "
+                        "({port}/{experiment}/{trial} substituted); "
+                        "enables the verifier lane")
+    p.add_argument("--verifier-slo", action="append", default=[],
+                   help="verifier-lane SLO rule, e.g. "
+                        "'crit: grade_latency_p99 <= 5' or "
+                        "'crit: verifier_queue_depth <= 64'; repeatable")
+    p.add_argument("--verifier-base-port", type=int, default=8201)
+    p.add_argument("--verifier-min-servers", type=int, default=1)
+    p.add_argument("--verifier-max-servers", type=int, default=4)
     args = p.parse_args(argv)
 
     from areal_tpu.apps.metrics_report import parse_slo_rule
@@ -81,6 +102,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         spawn, drain = procs.spawn, procs.drain
 
+    lanes = []
+    verifier_procs = None
+    if args.verifier_spawn_cmd:
+        from areal_tpu.system.verifier_pool import list_verifiers
+
+        verifier_procs = LocalProcessFleet(
+            shlex.split(args.verifier_spawn_cmd),
+            experiment=args.experiment,
+            trial=args.trial,
+            base_port=args.verifier_base_port,
+            name_key=names.verifier_server,
+            sid_prefix="v",
+        )
+        lanes.append(
+            SupervisorLane(
+                name="verifier",
+                list_servers=lambda: list_verifiers(
+                    args.experiment, args.trial
+                ),
+                rules=[parse_slo_rule(t) for t in args.verifier_slo],
+                spawn=verifier_procs.spawn,
+                drain=verifier_procs.drain,
+                min_servers=args.verifier_min_servers,
+                max_servers=args.verifier_max_servers,
+                action_cooldown_s=args.action_cooldown,
+                idle_rounds=args.idle_rounds,
+            )
+        )
+
     sup = FleetSupervisor(
         experiment=args.experiment,
         trial=args.trial,
@@ -92,6 +142,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         action_cooldown_s=args.action_cooldown,
         idle_rounds=args.idle_rounds,
         recover_root=args.recover_root,
+        lanes=lanes,
     )
     logger.info(
         f"fleet supervisor: {len(rules)} SLO rule(s), "
@@ -105,6 +156,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     finally:
         if procs is not None:
             procs.shutdown()
+        if verifier_procs is not None:
+            verifier_procs.shutdown()
     for a in actions:
         logger.info(f"action taken: {a.action} {a.victim} ({a.reason})")
     return 0

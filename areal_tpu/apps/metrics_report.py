@@ -67,7 +67,19 @@ per-MFC MFU gauges — e.g. ``warn: advisor_pred_err <= 0.5`` flags when
 the DFG-composed prediction stops tracking the measured step, so the
 advisor's offline rankings are running on stale physics, and ``warn:
 mfc_mfu_min >= 0.02`` surfaces an MFC whose current placement is
-starving it), plus any raw unlabeled series name.
+starving it), ``grade_latency_p99`` / ``verifier_queue_depth`` /
+``verifier_servers`` / ``verifier_breaker_open`` (verifier fleet,
+system/verifier_pool.py: the p99 of ``areal_verifier_grade_seconds``
+over all backends, the pool client's in-flight grade items, live
+members, and open breakers — e.g. ``crit: grade_latency_p99 <= 5``
+tells the supervisor's verifier lane to spawn a worker when sandboxed
+grading starts eating the sample pipeline, and ``crit:
+verifier_queue_depth <= 64`` catches a backed-up pool before episode
+completion stalls on rewards), ``task_reward_min`` (task-mixture
+curriculum, data/mixture.py: the min over the labeled per-task reward
+EMAs ``areal_mixture_task_reward`` — e.g. ``warn: task_reward_min >=
+0.2`` pages when any task stream's reward collapses), plus any raw
+unlabeled series name.
 
 Exit status: 0 if no CRIT fired over the run, 1 otherwise (``--count``
 bounds the run; without it the poller runs until interrupted).
@@ -397,6 +409,35 @@ def fleet_signals(
     pp = _hist_quantile(all_samples, "areal_param_push_seconds", 0.99)
     if not math.isnan(pp):
         signals["push_p99"] = pp
+    # Verifier fleet (system/verifier_pool.py): grade round-trip p99
+    # over all backends and the pool client's in-flight item count —
+    # the capacity signals the supervisor's verifier lane scales on.
+    # ``crit: grade_latency_p99 <= 5`` spawns a worker when sandboxed
+    # grading starts eating the sample pipeline; ``crit:
+    # verifier_queue_depth <= 64`` catches a backed-up pool before
+    # episode completion stalls on rewards.  Absent until the first
+    # pooled grade.
+    gl = _hist_quantile(all_samples, "areal_verifier_grade_seconds", 0.99)
+    if not math.isnan(gl):
+        signals["grade_latency_p99"] = gl
+    vq = _series_sum(all_samples, "areal_verifier_queue_depth")
+    if vq is not None:
+        signals["verifier_queue_depth"] = vq
+    vs = _series_sum(all_samples, "areal_verifier_pool_servers")
+    if vs is not None:
+        signals["verifier_servers"] = vs
+    vb = _series_sum(all_samples, "areal_verifier_breaker_open")
+    if vb is not None:
+        signals["verifier_breaker_open"] = vb
+    # Per-task reward curves (labeled areal_mixture_task_reward gauges
+    # -> computed min): ``warn: task_reward_min >= 0.2`` pages when any
+    # task stream's reward EMA collapses — the curriculum's floor.
+    trs = [
+        v for n, labels, v in all_samples
+        if n == "areal_mixture_task_reward"
+    ]
+    if trs:
+        signals["task_reward_min"] = min(trs)
     # Placement-advisor health: the master's online cost-model residual
     # (DFG-composed per-MFC walls vs the measured step,
     # areal_master_advisor_pred_err_ratio) and the spread of per-MFC MFU
@@ -461,7 +502,8 @@ def render_table(rows: List[Dict[str, object]],
         "kv_utilization", "idle_frac", "version_skew", "backpressure",
         "pipeline_fill", "pipeline_bubble", "anomalies",
         "quarantine_streak", "push_rejected", "weight_version_skew",
-        "push_p99",
+        "push_p99", "grade_latency_p99", "verifier_queue_depth",
+        "verifier_servers", "verifier_breaker_open", "task_reward_min",
     )
     fleet = ", ".join(
         f"{k}={signals[k]:.4g}" for k in keys if k in signals

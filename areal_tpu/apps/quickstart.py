@@ -302,6 +302,24 @@ def _searched_ppo_allocation(args):
     return train, gen
 
 
+def _parse_mixture_weights(specs):
+    """'task=weight' CLI pairs -> {task: float} for PPOMathConfig."""
+    weights = {}
+    for spec in specs:
+        task, sep, w = spec.partition("=")
+        if not sep or not task:
+            raise SystemExit(
+                f"--mixture-weight wants TASK=WEIGHT, got {spec!r}"
+            )
+        try:
+            weights[task] = float(w)
+        except ValueError:
+            raise SystemExit(
+                f"--mixture-weight {spec!r}: weight must be a number"
+            )
+    return weights
+
+
 def cmd_ppo_math(args):
     searched = None
     if args.allocation == "search":
@@ -417,6 +435,9 @@ def cmd_ppo_math(args):
         episode_token_budget=args.episode_token_budget,
         tool_timeout_s=args.tool_timeout_s,
         reward_backend=args.reward_backend,
+        verifier_pool=args.verifier_pool,
+        mixture_weights=_parse_mixture_weights(args.mixture_weight),
+        mixture_adaptive=args.mixture_adaptive,
     )
     plan = exps.build_ppo_math(cfg)
     for wc in plan.worker_configs:
@@ -564,6 +585,17 @@ def main(argv=None):
                     help="force one reward-fabric verifier backend (math, "
                          "code, judge, or a registered name) for every "
                          "sample instead of routing by per-row task")
+    pp.add_argument("--verifier-pool", action="store_true",
+                    help="route grading through the trial's announced "
+                         "verifier-worker fleet (areal_tpu.apps.verifier) "
+                         "instead of grading in-process")
+    pp.add_argument("--mixture-weight", action="append", default=[],
+                    metavar="TASK=WEIGHT",
+                    help="task-mixture curriculum weight, e.g. "
+                         "'math=3' 'code=1'; repeatable")
+    pp.add_argument("--mixture-adaptive", action="store_true",
+                    help="adaptively upweight tasks whose reward EMA is "
+                         "below their watermark")
     pp.set_defaults(fn=cmd_ppo_math)
 
     # Install YAML defaults on whichever subcommand was chosen.

@@ -417,6 +417,7 @@ def lineage_rows(trace) -> List[Dict[str, Any]]:
             {
                 "trace_id": tid,
                 "qid": "",
+                "task": "",
                 "root": False,
                 "stages": {},
                 "version_lag": None,
@@ -432,6 +433,10 @@ def lineage_rows(trace) -> List[Dict[str, Any]]:
             row["root"] = True
         if a.get("qid") and not row["qid"]:
             row["qid"] = str(a["qid"])
+        # Task-mixture stamp (the dispatch root carries it; graders
+        # echo it) — keys per-task e2e attribution.
+        if a.get("task") and not row["task"]:
+            row["task"] = str(a["task"])
         if stage == "admitted" and a.get("version_lag") is not None:
             row["version_lag"] = int(a["version_lag"])
     rows = []
@@ -444,6 +449,14 @@ def lineage_rows(trace) -> List[Dict[str, Any]]:
         )
         rows.append(row)
     return rows
+
+
+def _group_by_task(rows: List[Dict[str, Any]]) -> Dict[str, List[Dict]]:
+    by_task: Dict[str, List[Dict]] = {}
+    for r in rows:
+        if r.get("task"):
+            by_task.setdefault(r["task"], []).append(r)
+    return by_task
 
 
 def lineage_summary(trace) -> Dict[str, Any]:
@@ -491,6 +504,25 @@ def lineage_summary(trace) -> Dict[str, Any]:
         "e2e_p50_us": _pctl(e2e, 0.5),
         "e2e_p99_us": _pctl(e2e, 0.99),
         "transitions": transitions,
+        # Per-task e2e attribution (task-mixture trials): which task
+        # stream the pipeline's latency is going to.  Empty-task rows
+        # (single-stream trials) are omitted.
+        "by_task": [
+            {
+                "task": task,
+                "n": len(trs),
+                "complete": sum(1 for r in trs if r["complete"]),
+                "e2e_p50_us": _pctl(
+                    [float(r["e2e_us"]) for r in trs if r["complete"]],
+                    0.5,
+                ),
+                "e2e_p99_us": _pctl(
+                    [float(r["e2e_us"]) for r in trs if r["complete"]],
+                    0.99,
+                ),
+            }
+            for task, trs in sorted(_group_by_task(rows).items())
+        ],
         "staleness": [
             {
                 "version_lag": lag,
@@ -541,6 +573,13 @@ def format_lineage(trace) -> str:
         lines.append(
             f"  {name:<24} n={t['n']:<4} p50 {t['p50_us'] / 1000.0:8.1f} "
             f"ms  p99 {t['p99_us'] / 1000.0:8.1f} ms"
+        )
+    for b in s["by_task"]:
+        lines.append(
+            f"  task={b['task']:<12} n={b['n']:<4} "
+            f"complete={b['complete']:<4} e2e p50 "
+            f"{b['e2e_p50_us'] / 1000.0:8.1f} ms  p99 "
+            f"{b['e2e_p99_us'] / 1000.0:8.1f} ms"
         )
     for b in s["staleness"]:
         lines.append(

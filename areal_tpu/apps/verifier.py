@@ -1,0 +1,74 @@
+"""Verifier fleet worker entrypoint.
+
+Runs one grading server (``system/verifier_pool.VerifierWorker``) and
+joins it to a trial's verifier fleet: announced under
+``names.verifier_servers`` with a keepalive TTL (so a crash expires out
+of the pool without deregistration) and under the metrics subtree (so
+``metrics_report`` / the fleet supervisor scrape its ``/metrics``).
+
+    python -m areal_tpu.apps.verifier --experiment e --trial t --port 8201
+
+The supervisor's verifier lane spawns exactly this argv (with ``{port}``
+/ ``{experiment}`` / ``{trial}`` substituted) when grade-latency or
+queue-depth SLOs go critical; chaos legs break it via ``AREAL_FAULTS``
+(e.g. ``kill@t=2s`` preempts it mid-grade, ``slow@ms=500&point=grade``
+inflates its grade latency) with no test-only code paths.
+
+Code grading EXECUTES submitted programs: the default bind is loopback,
+and any non-loopback deployment should set a shared token
+(--token / AREAL_REWARD_TOKEN; clients send X-Areal-Token).
+"""
+
+import argparse
+import os
+import time
+
+from areal_tpu.base import logging
+from areal_tpu.system.verifier_pool import VerifierWorker
+
+logger = logging.getLogger("verifier_app")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="areal_tpu.apps.verifier",
+        description="announced reward-verification worker "
+                    "(one member of the autoscaled verifier fleet)",
+    )
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address; non-loopback binds should set --token")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 picks an ephemeral port")
+    p.add_argument("--experiment", required=True)
+    p.add_argument("--trial", required=True)
+    p.add_argument("--server-id", default="",
+                   help="fleet identity (default: port-stable v<port>)")
+    p.add_argument("--ttl", type=float, default=10.0,
+                   help="keepalive TTL for the fleet announcement")
+    p.add_argument("--token", default="",
+                   help="shared secret (or AREAL_REWARD_TOKEN)")
+    p.add_argument("--max-workers", type=int, default=8,
+                   help="grading threads per batch")
+    args = p.parse_args(argv)
+
+    worker = VerifierWorker(
+        args.host,
+        args.port,
+        token=args.token or os.environ.get("AREAL_REWARD_TOKEN", ""),
+        max_workers=args.max_workers,
+    )
+    sid = worker.announce(
+        args.experiment, args.trial, args.server_id or None, ttl=args.ttl
+    )
+    worker.announce_metrics(args.experiment, args.trial, sid)
+    logger.info(f"verifier {sid} serving at {worker.url}")
+    try:
+        while not worker._stop.is_set():
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    worker.close()
+
+
+if __name__ == "__main__":
+    main()

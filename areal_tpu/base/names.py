@@ -70,6 +70,18 @@ def gen_server(experiment_name: str, trial_name: str, server_id: str) -> str:
     return f"{gen_servers(experiment_name, trial_name)}/{server_id}"
 
 
+def verifier_servers(experiment_name: str, trial_name: str) -> str:
+    """Verifier-fleet membership subtree: every live reward-verification
+    worker announces itself here (with a keepalive TTL) and the
+    VerifierPool client / fleet supervisor discover joins and leaves by
+    listing it — the grading mirror of `gen_servers`."""
+    return f"{trial_root(experiment_name, trial_name)}/verifier_servers"
+
+
+def verifier_server(experiment_name: str, trial_name: str, server_id: str) -> str:
+    return f"{verifier_servers(experiment_name, trial_name)}/{server_id}"
+
+
 def param_store(experiment_name: str, trial_name: str) -> str:
     """Versioned parameter-store rendezvous (system/paramstore.py): the
     pushing trainer publishes its head version number here so a

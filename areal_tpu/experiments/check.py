@@ -224,6 +224,25 @@ def check_ppo_math(cfg) -> None:
             "and are ignored under gen_server_url (configure the "
             "standalone gen_server instead)"
         )
+    mw = getattr(cfg, "mixture_weights", {}) or {}
+    for task, w in mw.items():
+        if not isinstance(w, (int, float)) or w <= 0:
+            _fail(
+                f"mixture_weights[{task!r}] must be a positive number "
+                f"(got {w!r}); zero-weight tasks should be omitted"
+            )
+    if getattr(cfg, "mixture_adaptive", False) and not mw:
+        _fail(
+            "mixture_adaptive needs mixture_weights (the adaptive "
+            "scheduler rebalances an explicit task mixture)"
+        )
+    if getattr(cfg, "verifier_pool", False) and not (
+        cfg.experiment_name and cfg.trial_name
+    ):
+        _fail(
+            "verifier_pool needs experiment_name and trial_name to "
+            "discover the announced verifier fleet"
+        )
     if getattr(cfg, "kv_page_size", 128) < 1:
         _fail(f"kv_page_size must be >= 1, got {cfg.kv_page_size}")
     if getattr(cfg, "kv_pool_pages", 0) < 0:

@@ -381,6 +381,20 @@ class PPOMathConfig:
     episode_token_budget: int = 0
     tool_timeout_s: float = 10.0
     reward_backend: str = ""
+    # Verifier service fleet (system/verifier_pool.py): route grading
+    # through the trial's announced verifier workers — load-balanced with
+    # per-server breakers and retry-to-a-different-server, degrading to
+    # the in-process registry when no worker is live.  Precedence over a
+    # fixed remote_url in reward_interface_args.
+    verifier_pool: bool = False
+    # Task-mixture curriculum (data/mixture.py): task -> weight for the
+    # weighted multi-dataset prompt stream ({} = single prompt source).
+    # Adaptive mode upweights tasks whose reward EMA sits below their
+    # watermark (struggling tasks get more rollout budget).
+    mixture_weights: Dict[str, float] = dataclasses.field(
+        default_factory=dict
+    )
+    mixture_adaptive: bool = False
 
 
 def _remote_gen_shard(cfg: "PPOMathConfig", actor_gen, actor_if):
@@ -463,6 +477,10 @@ def build_ppo_math(cfg: PPOMathConfig, tokenizer=None) -> ExperimentPlan:
     rew_args = dict(cfg.reward_interface_args)
     if cfg.reward_backend:
         rew_args.setdefault("reward_backend", cfg.reward_backend)
+    if cfg.verifier_pool:
+        rew_args.setdefault("verifier_pool", True)
+        rew_args.setdefault("pool_experiment", cfg.experiment_name)
+        rew_args.setdefault("pool_trial", cfg.trial_name)
     rew_if = cfg.reward_interface or ModelInterfaceAbstraction(
         "rw-math-code", rew_args
     )

@@ -53,6 +53,16 @@ class MultiTaskRewardInterface(ModelInterface):
     # backend (e.g. "judge") for the whole run regardless of dataset
     # metadata.  "" = dispatch per-row.
     reward_backend: str = ""
+    # Route grading through the announced verifier fleet
+    # (system/verifier_pool.py) instead of a fixed remote_url: batches
+    # load-balance across live workers with per-server breakers and
+    # retry-to-a-different-server, degrading to the in-process registry
+    # when no worker is live.  Takes precedence over remote_url.
+    verifier_pool: bool = False
+    pool_experiment: str = ""
+    pool_trial: str = ""
+    pool_attempt_timeout_s: float = 60.0
+    _pool: Any = dataclasses.field(default=None, init=False, repr=False)
 
     def __post_init__(self):
         if self.dataset_path and not self.id2info:
@@ -105,7 +115,9 @@ class MultiTaskRewardInterface(ModelInterface):
                     }
                 )
                 si += 1
-        if self.remote_url:
+        if self.verifier_pool:
+            oks = self._verifier_pool().verify_batch(todo)
+        elif self.remote_url:
             from areal_tpu.interfaces.reward_service import RemoteVerifier
 
             oks = RemoteVerifier(
@@ -130,6 +142,28 @@ class MultiTaskRewardInterface(ModelInterface):
             data={"rewards": np.asarray(rewards, np.float32)},
             metadata={},
         )
+
+    def _verifier_pool(self):
+        """Lazily build (and cache) the fleet-discovering pool client —
+        one client per interface, so breaker state and membership view
+        survive across inference calls."""
+        if self._pool is None:
+            from areal_tpu.system.verifier_pool import (
+                VerifierPool, verifier_discovery,
+            )
+
+            if not (self.pool_experiment and self.pool_trial):
+                raise ValueError(
+                    "verifier_pool=True needs pool_experiment and "
+                    "pool_trial to discover the announced fleet"
+                )
+            self._pool = VerifierPool(
+                discovery=verifier_discovery(
+                    self.pool_experiment, self.pool_trial
+                ),
+                attempt_timeout_s=self.pool_attempt_timeout_s,
+            )
+        return self._pool
 
     def verify(self, task: str, text: str, info: Dict[str, Any]) -> bool:
         """Grade one response for ``task`` via the verifier-backend

@@ -44,8 +44,19 @@ _RETRYABLE = (urllib.error.URLError, TimeoutError, OSError, ValueError,
               KeyError)
 
 
+class VerifierShapeError(ValueError):
+    """The remote returned a result list whose length mismatches the
+    submitted batch.  Typed (instead of a bare ValueError) so the
+    failure lands under `areal_reward_remote_errors_total{reason=shape}`
+    and is never zipped against the prompts — a short reply silently
+    misaligning rewards with items is the one wire bug retries can't
+    paper over."""
+
+
 def _error_reason(e: BaseException) -> str:
     """Map a transport/protocol failure onto its counter label."""
+    if isinstance(e, VerifierShapeError):
+        return "shape"
     if isinstance(e, urllib.error.HTTPError):
         return "http"
     if isinstance(e, TimeoutError):
@@ -263,6 +274,36 @@ def serve(
     return srv
 
 
+def post_verify(
+    url: str,
+    items: List[Dict[str, Any]],
+    timeout_s: float,
+    token: str = "",
+) -> List[bool]:
+    """One POST /verify round trip against a verification server — the
+    wire protocol shared by RemoteVerifier (single fixed URL) and
+    VerifierPool (load-balanced fleet).  Raises VerifierShapeError on a
+    result/batch length mismatch; callers decide retry policy."""
+    headers = {"Content-Type": "application/json"}
+    tok = token or os.environ.get("AREAL_REWARD_TOKEN", "")
+    if tok:
+        headers["X-Areal-Token"] = tok
+    req = urllib.request.Request(
+        url.rstrip("/") + "/verify",
+        data=json.dumps({"items": items}).encode(),
+        headers=headers,
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        out = json.loads(r.read())
+    results = [bool(x) for x in out["results"]]
+    if len(results) != len(items):
+        raise VerifierShapeError(
+            f"result length mismatch: sent {len(items)} items, got "
+            f"{len(results)} results"
+        )
+    return results
+
+
 @dataclasses.dataclass
 class RemoteVerifier:
     """Client for the reward service with local fallback.
@@ -298,24 +339,7 @@ class RemoteVerifier:
             )
 
     def _round_trip(self, items: List[Dict[str, Any]]) -> List[bool]:
-        headers = {"Content-Type": "application/json"}
-        tok = self.token or os.environ.get("AREAL_REWARD_TOKEN", "")
-        if tok:
-            headers["X-Areal-Token"] = tok
-        req = urllib.request.Request(
-            self.url.rstrip("/") + "/verify",
-            data=json.dumps({"items": items}).encode(),
-            headers=headers,
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-            out = json.loads(r.read())
-        results = [bool(x) for x in out["results"]]
-        if len(results) != len(items):
-            raise ValueError(
-                f"result length mismatch: sent {len(items)} items, got "
-                f"{len(results)} results"
-            )
-        return results
+        return post_verify(self.url, items, self.timeout_s, self.token)
 
     def verify_batch(self, items: List[Dict[str, Any]]) -> List[bool]:
         for attempt in range(1, self.attempts + 1):

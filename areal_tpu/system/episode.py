@@ -692,34 +692,60 @@ def make_episode_runner(
 class RewardFabric:
     """Async facade over the verifier-backend registry: ``submit`` hands
     a grading job to a bounded thread pool and returns a Future, so
-    episode completion never blocks on a sandboxed unit-test run.  With a
-    :class:`~areal_tpu.interfaces.reward_service.RemoteVerifier` the jobs
-    round-trip to the reward FaaS (typed-retry/degradation semantics
-    preserved — a dead service degrades to local grading, never drops
-    rewards); without one they grade in-process via the same registry
-    the service dispatches on."""
+    episode completion never blocks on a sandboxed unit-test run.
 
-    def __init__(self, remote: Any = None, max_workers: int = 8):
+    ``remote`` is anything exposing ``verify_batch(items)`` — a
+    :class:`~areal_tpu.interfaces.reward_service.RemoteVerifier` (one
+    fixed FaaS URL, typed-retry + local fallback) or a
+    :class:`~areal_tpu.system.verifier_pool.VerifierPool` (load-balanced
+    over the announced verifier fleet with per-server breakers and
+    retry-to-a-different-server).  Either way a dead backend degrades to
+    in-process grading, never drops rewards; without a remote, jobs
+    grade in-process via the same registry the service dispatches on.
+
+    ``on_result(task, passed)`` fires as each grade completes — the hook
+    the task-mixture curriculum hangs ``observe_reward`` on, so per-task
+    reward curves update live while grading stays async."""
+
+    def __init__(
+        self,
+        remote: Any = None,
+        max_workers: int = 8,
+        on_result: Optional[Callable[[str, bool], None]] = None,
+    ):
         self.remote = remote
+        self.on_result = on_result
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="reward"
         )
 
     def _grade(self, item: Dict[str, Any]) -> bool:
         if self.remote is not None:
-            return bool(self.remote.verify_batch([item])[0])
-        from areal_tpu.interfaces.reward_service import grade_item
+            ok = bool(self.remote.verify_batch([item])[0])
+        else:
+            from areal_tpu.interfaces.reward_service import grade_item
 
-        return bool(grade_item(item))
+            ok = bool(grade_item(item))
+        if self.on_result is not None:
+            try:
+                self.on_result(str(item.get("task", "")), ok)
+            except Exception:  # noqa: BLE001 — curriculum is advisory
+                logger.exception("reward on_result hook failed")
+        return ok
 
-    def submit(self, task: str, text: str, payload: Dict[str, Any]):
+    def submit(
+        self, task: str, text: str, payload: Dict[str, Any],
+        trace_id: str = "",
+    ):
         """Grade asynchronously; the item travels in the opaque
         ``{"task", "text", "payload"}`` schema every registered backend
-        round-trips without key remapping."""
-        return self._pool.submit(
-            self._grade,
-            {"task": task, "text": text, "payload": dict(payload)},
-        )
+        round-trips without key remapping.  A ``trace_id`` rides the item
+        so the grader's ``graded`` lineage stamp joins the sample's
+        causal timeline (with the task echoed for per-task attribution)."""
+        item = {"task": task, "text": text, "payload": dict(payload)}
+        if trace_id:
+            item["trace_id"] = trace_id
+        return self._pool.submit(self._grade, item)
 
     def grade(
         self, task: str, text: str, payload: Dict[str, Any],

@@ -158,13 +158,18 @@ class FleetDecision:
 
 
 class LocalProcessFleet:
-    """Spawn/drain gen-server *processes* on this host.
+    """Spawn/drain server *processes* on this host.
 
     ``command`` is an argv template; ``{port}``, ``{experiment}`` and
     ``{trial}`` are substituted at spawn time.  Drain deletes the
     server's fleet announcement first (the controller stops dispatching
     to it and finishes in-flight work), then terminates the process
     after a grace period — preemption with manners.
+
+    The announcement subtree is pluggable (``name_key``), so the same
+    class runs the gen-server fleet (default) and the verifier fleet
+    (``name_key=names.verifier_server``, ``sid_prefix="v"`` to match
+    the worker's port-stable ``v<port>`` identity).
     """
 
     def __init__(
@@ -174,12 +179,16 @@ class LocalProcessFleet:
         trial: str,
         base_port: int = 8101,
         drain_grace_s: float = 10.0,
+        name_key: Callable[[str, str, str], str] = names.gen_server,
+        sid_prefix: str = "port",
     ):
         self.command = list(command)
         self.experiment = experiment
         self.trial = trial
         self._next_port = base_port
         self.drain_grace_s = drain_grace_s
+        self.name_key = name_key
+        self.sid_prefix = sid_prefix
         self.procs: Dict[str, subprocess.Popen] = {}
 
     def spawn(self) -> str:
@@ -191,14 +200,14 @@ class LocalProcessFleet:
         ]
         logger.info(f"fleet spawn: {shlex.join(argv)}")
         proc = subprocess.Popen(argv)
-        sid = f"port{port}"
+        sid = f"{self.sid_prefix}{port}"
         self.procs[sid] = proc
         return sid
 
     def drain(self, server_id: str) -> None:
         try:
             name_resolve.delete(
-                names.gen_server(self.experiment, self.trial, server_id)
+                self.name_key(self.experiment, self.trial, server_id)
             )
         except Exception:  # noqa: BLE001 — already gone is fine
             pass
@@ -214,6 +223,155 @@ class LocalProcessFleet:
     def shutdown(self) -> None:
         for sid in list(self.procs):
             self.drain(sid)
+
+
+class SupervisorLane:
+    """One independently-scaled service pool under the supervisor.
+
+    The gen-server fleet is the supervisor's built-in concern; a lane is
+    a SECOND pool with its own membership view, SLO rules, bounds, and
+    cooldown that rides the same control loop (the verifier fleet is the
+    first consumer — RLAX/Podracer-style decoupled pools per pipeline
+    role, each scaled on its own signals).  Three behaviours per tick:
+
+    - **refill** — live membership below ``min_servers`` spawns
+      immediately, bypassing the cooldown: a TTL-evicted crash leaves a
+      hole the lane must repair as liveness, not as a tuning decision;
+    - **scale-up** — a CRIT violation of a rule whose signal is in
+      ``scale_up_signals`` (e.g. ``grade_latency_p99``,
+      ``verifier_queue_depth``) spawns one, respecting ``max_servers``
+      and the cooldown;
+    - **scale-down** — ``idle_rounds`` consecutive scrapes with the
+      ``idle_signal`` at ~0 drain the last member, down to
+      ``min_servers``.
+
+    ``list_servers``/``spawn``/``drain`` are injectable callables
+    (``verifier_pool.list_verifiers`` + ``LocalProcessFleet`` methods in
+    production, fakes in tests); the lane itself never forks.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        list_servers: Callable[[], List[str]],
+        rules: Sequence[Any] = (),  # metrics_report.SLORule
+        spawn: Optional[Callable[[], Any]] = None,
+        drain: Optional[Callable[[str], Any]] = None,
+        min_servers: int = 1,
+        max_servers: int = 8,
+        scale_up_signals: Sequence[str] = (
+            "grade_latency_p99", "verifier_queue_depth",
+        ),
+        action_cooldown_s: float = 30.0,
+        idle_rounds: int = 3,
+        idle_signal: str = "verifier_queue_depth",
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.name = name
+        self.list_servers = list_servers
+        self.rules = list(rules)
+        self.spawn = spawn
+        self.drain = drain
+        self.min_servers = min_servers
+        self.max_servers = max_servers
+        self.scale_up_signals = set(scale_up_signals)
+        self.action_cooldown_s = action_cooldown_s
+        self.idle_rounds = idle_rounds
+        self.idle_signal = idle_signal
+        self._clock = clock
+        self.epoch = 0
+        self._idle_streak = 0
+        self._last_action_t: Optional[float] = None
+
+    def _cooled_down(self) -> bool:
+        return (
+            self._last_action_t is None
+            or self._clock() - self._last_action_t >= self.action_cooldown_s
+        )
+
+    def evaluate(
+        self, history: Sequence[Dict[str, float]]
+    ) -> FleetDecision:
+        """One control-loop step over the SHARED signal history the
+        supervisor already appended to (lanes never append — one scrape,
+        many consumers)."""
+        signals = history[-1] if history else {}
+        live = self.list_servers()
+        n = len(live)
+        if n < self.min_servers:
+            return FleetDecision(
+                "spawn",
+                f"[{self.name}] {n} live < min_servers="
+                f"{self.min_servers} (refill)",
+            )
+        for rule in self.rules:
+            msg = rule.evaluate(history)
+            if (
+                msg is not None
+                and rule.severity == "crit"
+                and rule.signal in self.scale_up_signals
+            ):
+                self._idle_streak = 0
+                if n >= self.max_servers:
+                    return FleetDecision(
+                        "hold",
+                        f"[{self.name}] CRIT but at max_servers="
+                        f"{self.max_servers}: {msg}",
+                    )
+                if not self._cooled_down():
+                    return FleetDecision(
+                        "hold", f"[{self.name}] CRIT but cooling down: {msg}"
+                    )
+                return FleetDecision("spawn", f"[{self.name}] {msg}")
+        idle = signals.get(self.idle_signal, 0.0) <= 0.0
+        self._idle_streak = self._idle_streak + 1 if idle else 0
+        if (
+            self._idle_streak >= self.idle_rounds
+            and n > self.min_servers
+            and self._cooled_down()
+        ):
+            self._idle_streak = 0
+            return FleetDecision(
+                "drain",
+                f"[{self.name}] {self.idle_signal} idle for "
+                f"{self.idle_rounds} consecutive scrapes",
+                victim=sorted(live)[-1],
+            )
+        return FleetDecision("hold", "")
+
+    def apply(self, decision: FleetDecision) -> None:
+        if decision.action == "hold":
+            return
+        if decision.action == "spawn":
+            if self.spawn is None:
+                logger.warning(
+                    f"lane {self.name} would spawn ({decision.reason}) "
+                    "but no spawn action is configured"
+                )
+                return
+            self.spawn()
+        elif decision.action == "drain":
+            if self.drain is None:
+                logger.warning(
+                    f"lane {self.name} would drain {decision.victim} "
+                    f"({decision.reason}) but no drain action is configured"
+                )
+                return
+            self.drain(decision.victim)
+        self._last_action_t = self._clock()
+        self.epoch += 1
+        logger.info(
+            f"lane {self.name} {decision.action} (epoch {self.epoch}): "
+            f"{decision.reason}"
+        )
+
+    def step(
+        self, history: Sequence[Dict[str, float]]
+    ) -> FleetDecision:
+        decision = self.evaluate(history)
+        if decision.action != "hold":
+            self.apply(decision)
+        return decision
 
 
 class FleetSupervisor:
@@ -253,9 +411,13 @@ class FleetSupervisor:
         # subtree orphaned mid-broadcast) are caught up to the store
         # head without waiting for the next training step's push.
         param_repair: Optional[Callable[[], Any]] = None,
+        # Additional independently-scaled pools (e.g. the verifier
+        # fleet) riding the same scrape loop — see SupervisorLane.
+        lanes: Sequence["SupervisorLane"] = (),
     ):
         self.experiment = experiment
         self.trial = trial
+        self.lanes = list(lanes)
         self.rules = list(rules)
         self.spawn = spawn
         self.drain = drain
@@ -291,6 +453,11 @@ class FleetSupervisor:
             self.membership_epoch = int(
                 info.fleet_state.get("membership_epoch", 0)
             )
+            lane_state = info.fleet_state.get("lanes", {}) or {}
+            for lane in self.lanes:
+                st = lane_state.get(lane.name)
+                if st:
+                    lane.epoch = int(st.get("epoch", 0))
             logger.info(
                 f"fleet supervisor recovered at membership epoch "
                 f"{self.membership_epoch}"
@@ -305,6 +472,13 @@ class FleetSupervisor:
         info.fleet_state = {
             "membership_epoch": self.membership_epoch,
             "servers": sorted(self.list_servers()),
+            "lanes": {
+                lane.name: {
+                    "epoch": lane.epoch,
+                    "servers": sorted(lane.list_servers()),
+                }
+                for lane in self.lanes
+            },
         }
         recover.dump(info, self.recover_root)
 
@@ -411,6 +585,11 @@ class FleetSupervisor:
             if decision.action != "hold":
                 self.apply(decision)
                 actions.append(decision)
+            for lane in self.lanes:
+                lane_decision = lane.step(self.history)
+                if lane_decision.action != "hold":
+                    actions.append(lane_decision)
+                    self.persist()
             if self.param_repair is not None:
                 try:
                     self.param_repair()

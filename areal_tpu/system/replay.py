@@ -96,6 +96,10 @@ class Trajectory:
     # the per-sample latency histograms measure from (0.0 = unknown).
     trace_id: str = ""
     t_dispatch: float = 0.0
+    # Task stream this group came from (the mixture scheduler's stamp;
+    # "" = single-stream trial).  Keys the buffer's per-task
+    # consumed/staleness watermarks, which feed the curriculum.
+    task: str = ""
 
     def staleness(self, trainer_version: int) -> int:
         return trainer_version - self.version_start
@@ -144,6 +148,10 @@ class ReplayBuffer:
         self.evicted = 0  # capacity evictions
         self.dropped_stale = 0  # aged past the cap while queued
         self.consumed = 0
+        # Per-task consumption watermarks (task-stamped trajectories
+        # only): consumed count + staleness sum, read back through
+        # task_watermarks() by the mixture scheduler's curriculum loop.
+        self._task_stats: Dict[str, Dict[str, float]] = {}
 
     # ---------------- trainer side ----------------
 
@@ -185,6 +193,15 @@ class ReplayBuffer:
                         # the staleness_p99 SLO watches.
                         t.retired_version = self._version
                         _M_STALENESS.observe(t.staleness(self._version))
+                        if t.task:
+                            st = self._task_stats.setdefault(
+                                t.task,
+                                {"consumed": 0, "staleness_sum": 0.0},
+                            )
+                            st["consumed"] += 1
+                            st["staleness_sum"] += t.staleness(
+                                self._version
+                            )
                         if t.t_dispatch:
                             _M_E2E.observe(max(0.0, now - t.t_dispatch))
                         if t.trace_id:
@@ -318,7 +335,24 @@ class ReplayBuffer:
                 hist[off] = hist.get(off, 0) + 1
             return hist
 
-    def watermarks(self) -> Dict[str, int]:
+    def task_watermarks(self) -> Dict[str, Dict[str, float]]:
+        """Per-task consumption: ``{task: {"consumed", "staleness_mean"}}``
+        over task-stamped trajectories the trainer has retired — the
+        replay-plane half of the curriculum feedback loop
+        (``TaskMixtureStream.sync_replay``)."""
+        with self._cond:
+            out: Dict[str, Dict[str, float]] = {}
+            for task, st in self._task_stats.items():
+                n = int(st["consumed"])
+                out[task] = {
+                    "consumed": n,
+                    "staleness_mean": (
+                        st["staleness_sum"] / n if n else 0.0
+                    ),
+                }
+            return out
+
+    def watermarks(self) -> Dict[str, Any]:
         """Version watermarks + counters, persisted in RecoverInfo so a
         restarted trial resumes admission where it left off."""
         with self._cond:
@@ -333,9 +367,12 @@ class ReplayBuffer:
                 "evicted": self.evicted,
                 "dropped_stale": self.dropped_stale,
                 "consumed": self.consumed,
+                "tasks": {
+                    t: dict(st) for t, st in self._task_stats.items()
+                },
             }
 
-    def load_watermarks(self, wm: Dict[str, int]) -> None:
+    def load_watermarks(self, wm: Dict[str, Any]) -> None:
         with self._cond:
             self._version = int(wm.get("version", 0))
             self.accepted = int(wm.get("accepted", 0))
@@ -343,6 +380,14 @@ class ReplayBuffer:
             self.evicted = int(wm.get("evicted", 0))
             self.dropped_stale = int(wm.get("dropped_stale", 0))
             self.consumed = int(wm.get("consumed", 0))
+            # Absent in pre-mixture records — backfilled empty.
+            self._task_stats = {
+                t: {
+                    "consumed": int(st.get("consumed", 0)),
+                    "staleness_sum": float(st.get("staleness_sum", 0.0)),
+                }
+                for t, st in (wm.get("tasks") or {}).items()
+            }
             self._cond.notify_all()
 
     # ---------------- internals (lock held) ----------------

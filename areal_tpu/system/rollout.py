@@ -116,18 +116,35 @@ class ServerState:
 
 def _normalize_prompt(item, cursor: int):
     """Accept (qid, prompt_ids) pairs, {"qid", "prompt_ids"} dicts, or
-    bare token lists (qid auto-assigned from the cursor)."""
+    bare token lists; returns ``(qid, prompt_ids, task)``.
+
+    Auto-assigned qids are replay dedup keys, so they must stay unique
+    across everything one trial can feed through the controller.  A
+    bare ``prompt{cursor}`` collides the moment two task streams share
+    a controller, or a cycled dataset rewinds its cursor — so an item
+    carrying task metadata (the mixture scheduler stamps ``task`` /
+    ``epoch`` / per-task ``index`` on every draw) gets a namespaced
+    ``{task}:e{epoch}:p{index}`` qid instead: unique per task, per
+    dataset pass, per sample, and stable across recover fast-forwards.
+    Plain single-stream items keep the historical ``prompt{cursor}``."""
     if isinstance(item, dict):
-        return str(item.get("qid", f"prompt{cursor}")), list(
-            map(int, item["prompt_ids"])
-        )
+        task = str(item.get("task", "") or "")
+        ids = list(map(int, item["prompt_ids"]))
+        qid = item.get("qid")
+        if qid is not None:
+            return str(qid), ids, task
+        if task or "epoch" in item:
+            epoch = int(item.get("epoch", 0) or 0)
+            index = int(item.get("index", cursor))
+            return f"{task or 'task'}:e{epoch}:p{index}", ids, task
+        return f"prompt{cursor}", ids, task
     if (
         isinstance(item, (tuple, list))
         and len(item) == 2
         and isinstance(item[0], str)
     ):
-        return item[0], list(map(int, item[1]))
-    return f"prompt{cursor}", [int(t) for t in item]
+        return item[0], list(map(int, item[1])), ""
+    return f"prompt{cursor}", [int(t) for t in item], ""
 
 
 class RolloutController:
@@ -173,6 +190,13 @@ class RolloutController:
         # against it — the refcount lifecycle that lets a
         # breaker-open/mid-episode laggard still pull head-1.
         paramstore: Optional[Any] = None,
+        # Task-mixture curriculum (data/mixture.py).  When set, run()
+        # defaults its prompt source to the mixture stream, the
+        # mixture's per-task cursors ride in state_dict()["mixture"]
+        # (an old record holding only the scalar cursor is backfilled
+        # by replaying the deterministic schedule), and every dispatch
+        # is task-stamped through lineage and the trajectory.
+        mixture: Optional[Any] = None,
     ):
         if not clients and discovery is None:
             raise ValueError(
@@ -200,6 +224,7 @@ class RolloutController:
         self.breaker_cooldown_s = breaker_cooldown_s
         self.episode_runner = episode_runner
         self.paramstore = paramstore
+        self.mixture = mixture
         # Lineage: pass trace_id through to the runner only when its
         # signature can take it — external runners predating the causal
         # lineage plane keep working unchanged.
@@ -377,11 +402,14 @@ class RolloutController:
     # ---------------- recover ----------------
 
     def state_dict(self) -> Dict[str, Any]:
-        return {
+        sd = {
             "cursor": self.cursor,
             "stat": self.stat.as_dict(),
             "membership_epoch": self.membership_epoch,
         }
+        if self.mixture is not None:
+            sd["mixture"] = self.mixture.state_dict()
+        return sd
 
     def load_state_dict(self, sd: Dict[str, Any]) -> None:
         self.cursor = int(sd.get("cursor", 0))
@@ -391,6 +419,20 @@ class RolloutController:
             if hasattr(self.stat, k) and k != "in_flight":
                 setattr(self.stat, k, int(v))
         self.stat.in_flight = 0
+        if self.mixture is not None:
+            ms = sd.get("mixture")
+            if ms:
+                # Per-task cursors restore exactly; the stream resumes
+                # itself, so run() has nothing to skip.
+                self.mixture.load_state_dict(ms)
+            else:
+                # Old-pickle backfill: the record predates the mixture
+                # and only holds the scalar draw count — replaying that
+                # many draws of the deterministic schedule reconstructs
+                # the identical per-task positions.
+                self.mixture.fast_forward(self.cursor)
+            self._skip_on_run = 0
+            return
         # On the next run(), fast-forward the (restarted) prompt stream
         # past everything the pre-restart trial already consumed.
         self._skip_on_run = self.cursor
@@ -504,11 +546,19 @@ class RolloutController:
 
     async def run(
         self,
-        prompt_source: Iterable,
+        prompt_source: Optional[Iterable] = None,
         max_prompts: Optional[int] = None,
     ) -> RolloutStat:
         """Pump prompts until the source is exhausted, `max_prompts` are
-        dispatched, or stop() — then await all in-flight dispatches."""
+        dispatched, or stop() — then await all in-flight dispatches.
+        With no explicit source, the configured task-mixture stream is
+        pumped (infinite — bound it with ``max_prompts``)."""
+        if prompt_source is None:
+            prompt_source = self.mixture
+        if prompt_source is None:
+            raise ValueError(
+                "run() needs a prompt source (or a configured mixture)"
+            )
         it: Iterator = iter(prompt_source)
         while self._skip_on_run > 0:
             if next(it, None) is None:
@@ -535,10 +585,10 @@ class RolloutController:
             item = next(it, None)
             if item is None:
                 break
-            qid, prompt_ids = _normalize_prompt(item, self.cursor)
+            qid, prompt_ids, task = _normalize_prompt(item, self.cursor)
             self.cursor += 1
             dispatched += 1
-            t = asyncio.create_task(self._dispatch(qid, prompt_ids))
+            t = asyncio.create_task(self._dispatch(qid, prompt_ids, task))
             tasks.add(t)
             t.add_done_callback(tasks.discard)
             # Yield so dispatches start promptly even on a fast source.
@@ -684,10 +734,14 @@ class RolloutController:
                 backoff = min(backoff * 2, 2.0)
         return None
 
-    async def _dispatch(self, qid: str, prompt_ids: List[int]) -> None:
+    async def _dispatch(
+        self, qid: str, prompt_ids: List[int], task: str = ""
+    ) -> None:
         # Lineage root: every prompt's causal timeline starts here.  The
         # trace_id rides the request (HTTP header / ZMQ frame) through
-        # gen server, grader, replay admission, and train consumption.
+        # gen server, grader, replay admission, and train consumption;
+        # the task stamp lets trace_report attribute e2e latency per
+        # task stream.
         trace_id = tracer.new_trace_id()
         t_dispatch = time.monotonic()
         tracer.lineage(
@@ -697,6 +751,7 @@ class RolloutController:
             qid=qid,
             prompt_len=len(prompt_ids),
             trainer_version=self.replay.version,
+            **({"task": task} if task else {}),
         )
         async with self._sem:
             self.stat.submitted += 1
@@ -756,6 +811,7 @@ class RolloutController:
             )
         traj.trace_id = trace_id
         traj.t_dispatch = t_dispatch
+        traj.task = task
         # Lossless backpressure on the put side too: a completed response
         # holds until the trainer drains a slot rather than evicting an
         # unconsumed sample.  Too-stale responses fall through to put()

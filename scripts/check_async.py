@@ -110,6 +110,30 @@ applies v2; the victim's fault-kill flight dump exists and
 fleet push (v3) converges all five servers with no orphans,
 `areal_gen_weight_push_rejected_total` never moving.
 
+Part 9 (`--verifier-chaos`) is the verifier-service-fleet chaos leg
+(system/verifier_pool.py + data/mixture.py), three sub-legs: (a) THREE
+announced verifier workers grade continuous math batches through a
+VerifierPool while one worker is killed mid-grade by an injected
+`AREAL_FAULTS` kill — asserted: ZERO lost grades (every batch returns a
+full, correct result set), at least one batch redispatched to a
+different server, the victim's circuit breaker opening, the crashed
+announcement expiring by TTL, the supervisor's verifier lane REFILLING
+the pool back to its minimum size (bypassing the cooldown), the
+replacement re-closing the breaker via a half-open probe riding a live
+grade batch, and the victim's fault-kill flight dump existing.  (b) a
+mixed-task rollout smoke: a TaskMixtureStream (math 2 : code 1) feeds
+the RolloutController, graded asynchronously through a 2-worker pool by
+the RewardFabric (sandboxed code items included) — asserted: namespaced
+collision-free qids (`task:e{epoch}:p{index}`) across dataset wraps,
+per-task reward curves on the metrics plane
+(`areal_mixture_task_reward{task=…}` + the `task_reward_min` /
+`grade_latency_p99` / `verifier_queue_depth` fleet signals with SLO
+examples evaluated), per-task replay watermarks, and per-task e2e
+lineage attribution in `trace_report --lineage`.  (c) a slow-verifier
+A/B: the same smoke with one backend's grade latency inflated 10x via a
+`slow@point=grade` fault — asserted: rollout DISPATCH throughput is not
+degraded (grading is async), while the slow backend still grades.
+
 Exit 0 iff every check passes.  CI-friendly: CPU-only, tiny random
 model, a few minutes end to end.
 """
@@ -723,6 +747,516 @@ def check_chaos(n_prompts: int = 40, kill_after_s: float = 2.5) -> int:
         print()
         print("--- trace_report --flight (last 60s before the kill) ---")
         print(rendered)
+    return len(failures)
+
+
+def check_verifier_chaos(kill_after_s: float = 1.2) -> int:
+    """Verifier-service-fleet chaos leg (module docstring, Part 9):
+    killed worker -> zero lost grades + redispatch + breaker cycle +
+    lane refill; mixed-task mixture smoke with per-task reward curves
+    and lineage attribution; slow-verifier A/B."""
+    import json
+
+    from areal_tpu.apps import metrics_report, trace_report
+    from areal_tpu.base import faults as faults_mod
+    from areal_tpu.base import metrics, name_resolve, tracer
+    from areal_tpu.base.name_resolve import MemoryNameResolveRepository
+    from areal_tpu.system.fleet import CircuitBreaker, SupervisorLane
+    from areal_tpu.system.verifier_pool import (
+        VerifierPool,
+        VerifierWorker,
+        list_verifiers,
+        verifier_discovery,
+    )
+
+    name_resolve.set_default(MemoryNameResolveRepository())
+    failures = []
+    trace_dir = tempfile.mkdtemp(prefix="areal_tpu_vchaos_trace_")
+    os.environ["AREAL_TRACE_DIR"] = trace_dir
+    tracer.configure(
+        role="vchaos", rank=0, dir=trace_dir, enabled=True, force=True
+    )
+
+    def wait_until(cond, timeout, what) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.05)
+        failures.append(f"timeout waiting for {what}")
+        return False
+
+    # ---- (a) fleet chaos: kill one of three graders mid-grade --------
+    exp, trial = "vchaos", "t0"
+    workers = []
+    victim = None
+    for i in range(3):
+        injector = None
+        if i == 0:
+            # The slow fault keeps grades in flight when the kill lands;
+            # the SHORT TTL lets the reaper evict the crashed
+            # announcement so the supervisor lane sees the hole.
+            injector = faults_mod.FaultInjector.parse(
+                f"slow@ms=100&point=grade kill@t={kill_after_s}s"
+            )
+        w = VerifierWorker(port=0, faults=injector)
+        w.announce(exp, trial, ttl=(2.0 if i == 0 else 10.0))
+        workers.append(w)
+        if i == 0:
+            victim = w
+    victim_sid = f"v{victim.port}"
+    victim_port = victim.port
+
+    pool = VerifierPool(
+        discovery=verifier_discovery(exp, trial),
+        attempt_timeout_s=8.0,
+        max_attempts=3,
+        backoff_s=0.01,
+        refresh_s=0.05,
+        breaker_threshold=1,
+        breaker_cooldown_s=0.4,
+    )
+
+    stop_pump = threading.Event()
+    count_lock = threading.Lock()
+    counts = {"items": 0, "ok": 0}
+    pump_errors = []
+
+    def math_items(k=3):
+        return [
+            {
+                "task": "math",
+                "text": r"The answer is \boxed{4}.",
+                "payload": {"solutions": [r"\boxed{4}"]},
+            }
+            for _ in range(k)
+        ]
+
+    def pump():
+        while not stop_pump.is_set():
+            items = math_items()
+            try:
+                res = pool.verify_batch(items)
+            except Exception as e:  # noqa: BLE001 — a loss is a finding
+                pump_errors.append(repr(e))
+                return
+            if len(res) != len(items):
+                pump_errors.append(
+                    f"shape: sent {len(items)}, got {len(res)}"
+                )
+            with count_lock:
+                counts["items"] += len(items)
+                counts["ok"] += sum(map(bool, res))
+            time.sleep(0.01)
+
+    pumpers = [
+        threading.Thread(target=pump, daemon=True) for _ in range(3)
+    ]
+    for t in pumpers:
+        t.start()
+
+    # The supervisor's verifier lane: refill back to 3 when the TTL
+    # reaper evicts the crashed worker.  Spawn restarts on the SAME port
+    # so the replacement resumes the victim's fleet identity (and the
+    # pool's persisted breaker re-closes via a half-open probe).
+    respawned = []
+
+    def respawn():
+        w = VerifierWorker(port=victim_port)
+        w.announce(exp, trial, ttl=10.0)
+        respawned.append(w)
+
+    lane = SupervisorLane(
+        name="verifier",
+        list_servers=lambda: list_verifiers(exp, trial),
+        spawn=respawn,
+        drain=lambda sid: None,
+        min_servers=3,
+        max_servers=4,
+        action_cooldown_s=5.0,
+        idle_rounds=10**6,  # this leg proves refill, not scale-down
+    )
+
+    wait_until(lambda: victim._crashed, 30, "the verifier kill fault")
+    wait_until(
+        lambda: len(list_verifiers(exp, trial)) == 2,
+        30,
+        "TTL eviction of the crashed verifier",
+    )
+    wait_until(
+        lambda: (
+            victim_sid in pool.breakers
+            and pool.breakers[victim_sid].opens >= 1
+        ),
+        30,
+        "the victim's breaker to open",
+    )
+    refill = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        decision = lane.step([])
+        if decision.action == "spawn":
+            refill = decision
+            break
+        time.sleep(0.1)
+    if refill is None:
+        failures.append("supervisor lane never refilled the verifier pool")
+    elif "refill" not in refill.reason:
+        failures.append(f"unexpected refill reason {refill.reason!r}")
+    wait_until(
+        lambda: len(list_verifiers(exp, trial)) == 3,
+        30,
+        "the replacement verifier to announce",
+    )
+    wait_until(
+        lambda: (
+            pool.breakers[victim_sid].state == CircuitBreaker.CLOSED
+            and pool.breakers[victim_sid].closes >= 1
+        ),
+        30,
+        "the victim breaker to re-close on the replacement",
+    )
+    time.sleep(0.5)  # post-heal traffic rides the re-closed breaker
+    stop_pump.set()
+    for t in pumpers:
+        t.join(timeout=30)
+
+    for e in pump_errors:
+        failures.append(f"grade pump error: {e}")
+    if counts["ok"] != counts["items"] or counts["items"] == 0:
+        failures.append(
+            f"lost grades: {counts['ok']} of {counts['items']} items "
+            f"came back correct"
+        )
+    if pool.redispatches < 1:
+        failures.append(
+            "kill produced no redispatch (expected a failed grade batch "
+            "to retry on a different server)"
+        )
+    if pool.graded_local > 0:
+        failures.append(
+            f"pool degraded to local grading ({pool.graded_local} items) "
+            f"despite live backends"
+        )
+    if victim._faults is None or victim._faults.fired.get("kill", 0) < 1:
+        failures.append("the AREAL_FAULTS kill fault never fired")
+    br = pool.breakers.get(victim_sid)
+    if br is None:
+        failures.append(f"no breaker tracked for victim {victim_sid}")
+    else:
+        if br.opens < 1:
+            failures.append("victim breaker never opened")
+        if br.closes < 1 or br.state != CircuitBreaker.CLOSED:
+            failures.append(
+                f"victim breaker ended {br.state} "
+                f"(opens={br.opens} closes={br.closes}), not re-closed"
+            )
+    flight_path = os.path.join(
+        trace_dir, f"flightrec_verifier_{victim_port}.json"
+    )
+    if not os.path.exists(flight_path):
+        failures.append(
+            f"killed verifier left no flight dump at {flight_path}"
+        )
+    else:
+        with open(flight_path) as f:
+            dump = json.load(f)
+        if dump.get("reason") != "fault_kill":
+            failures.append(
+                f"flight dump reason {dump.get('reason')!r} != 'fault_kill'"
+            )
+    for w in workers[1:] + respawned:
+        w.close()
+    fleet_ok = not failures
+    if fleet_ok:
+        print(
+            f"OK[verifier-chaos]: {counts['items']} grade items, zero "
+            f"lost; victim {victim_sid} killed at t={kill_after_s}s, "
+            f"{pool.redispatches} batch(es) redispatched, breaker opened "
+            f"x{br.opens} and re-closed x{br.closes}; lane refilled the "
+            f"pool to 3 ({refill.reason}); flight dump at {flight_path}"
+        )
+
+    # ---- (b)+(c) mixed-task mixture smoke + slow-verifier A/B --------
+    import jax
+    import numpy as np
+
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.base.topology import ParallelConfig, make_mesh
+    from areal_tpu.data.mixture import TaskMixtureStream, TaskSource
+    from areal_tpu.engines.generator import GeneratorEngine
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.system.episode import RewardFabric
+    from areal_tpu.system.fleet import fleet_discovery
+    from areal_tpu.system.gen_server import GenerationServer
+    from areal_tpu.system.replay import ReplayBuffer
+    from areal_tpu.system.rollout import RolloutController
+
+    cfg = tiny_config()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(ParallelConfig.from_str("d1"), jax.devices()[:1])
+    rng = np.random.default_rng(0)
+
+    def make_prompts(n):
+        return [
+            [int(t) for t in rng.integers(8, cfg.vocab_size, size=6)]
+            for _ in range(n)
+        ]
+
+    code_text = "```python\nprint(input())\n```"
+    code_payload = {
+        "input_output": json.dumps({"inputs": ["5\n"], "outputs": ["5\n"]})
+    }
+
+    def mix_run(tag, slow_ms, n_mix=16):
+        """One mixed-task rollout graded through a 2-worker pool; returns
+        (dispatch_elapsed_s, mixture, consumed, replay, stat, vworkers)."""
+        exp2, trial2 = f"vmix_{tag}", "t0"
+        vworkers = []
+        for i in range(2):
+            # Both backends carry a base grade latency so the A/B has a
+            # real baseline; the B run inflates one backend 10x.
+            ms = slow_ms if i == 1 else 30
+            vw = VerifierWorker(
+                port=0,
+                faults=faults_mod.FaultInjector.parse(
+                    f"slow@ms={ms}&point=grade"
+                ),
+            )
+            vw.announce(exp2, trial2, ttl=30.0)
+            vworkers.append(vw)
+        pool2 = VerifierPool(
+            discovery=verifier_discovery(exp2, trial2),
+            attempt_timeout_s=30.0,
+            refresh_s=0.1,
+        )
+        mixture = TaskMixtureStream(
+            [
+                TaskSource("math", make_prompts(5), weight=2.0),
+                TaskSource("code", make_prompts(3), weight=1.0),
+            ]
+        )
+        fabric = RewardFabric(
+            remote=pool2, max_workers=4,
+            on_result=mixture.observe_reward,
+        )
+        srv = GenerationServer(
+            GeneratorEngine(
+                cfg, params, mesh, eos_token_id=cfg.vocab_size + 7,
+                max_decode_batch=2,
+            ),
+            max_wait_ms=20.0,
+            zmq_port=None,
+        )
+        srv.announce(exp2, trial2, ttl=30.0)
+        replay = ReplayBuffer(capacity=4, max_head_offpolicyness=2)
+        ctl = RolloutController(
+            replay=replay,
+            gconfig=GenerationHyperparameters(n=1, max_new_tokens=16),
+            discovery=fleet_discovery(exp2, trial2),
+            mixture=mixture,
+            max_concurrency=4,
+            health_refresh_s=0.3,
+            backpressure_poll_s=0.01,
+            autosize_inflight=False,
+            dispatch_timeout_s=60.0,
+        )
+        consumed = []
+        futs = []
+
+        async def consume(pump_task):
+            loop = asyncio.get_running_loop()
+            while not pump_task.done() or len(replay) > 0:
+                try:
+                    trajs = await loop.run_in_executor(
+                        None, replay.get_batch, 1, 0.2
+                    )
+                except TimeoutError:
+                    trajs = []
+                for t in trajs:
+                    consumed.append(t)
+                    # Canned grade texts (the tiny random model emits
+                    # gibberish): math alternates pass/fail so the
+                    # reward EMA curve moves; code runs the sandbox.
+                    if t.task == "code":
+                        text, payload = code_text, code_payload
+                    else:
+                        passing = len(consumed) % 3 != 0
+                        text = r"\boxed{4}" if passing else r"\boxed{5}"
+                        payload = {"solutions": [r"\boxed{4}"]}
+                    futs.append(
+                        fabric.submit(
+                            t.task, text, payload, trace_id=t.trace_id
+                        )
+                    )
+                await asyncio.sleep(0.01)
+
+        async def drive():
+            t0 = time.monotonic()
+            pump_task = asyncio.create_task(ctl.run(max_prompts=n_mix))
+            consumer = asyncio.create_task(consume(pump_task))
+            await pump_task
+            elapsed = time.monotonic() - t0
+            await consumer
+            return elapsed
+
+        try:
+            elapsed = asyncio.run(drive())
+            for f in futs:
+                f.result(timeout=120)
+        finally:
+            srv.close()
+        return elapsed, mixture, consumed, replay, ctl.stat, vworkers
+
+    elapsed_a, mix_a, consumed_a, replay_a, stat_a, vws_a = mix_run(
+        "a", slow_ms=30
+    )
+    for w in vws_a:
+        w.close()
+    elapsed_b, mix_b, consumed_b, replay_b, stat_b, vws_b = mix_run(
+        "b", slow_ms=300
+    )
+
+    for tag, stat, consumed in (
+        ("a", stat_a, consumed_a), ("b", stat_b, consumed_b),
+    ):
+        if stat.accepted + stat.rejected != 16 or stat.failed != 0:
+            failures.append(
+                f"[mix {tag}] prompt accounting broken: "
+                f"accepted {stat.accepted} + rejected {stat.rejected} "
+                f"!= 16 (failed={stat.failed})"
+            )
+        qids = [t.qid for t in consumed]
+        if len(set(qids)) != len(qids):
+            failures.append(f"[mix {tag}] duplicate qids: {sorted(qids)}")
+        bad = [
+            q for q in qids
+            if not (q.startswith("math:e") or q.startswith("code:e"))
+        ]
+        if bad:
+            failures.append(
+                f"[mix {tag}] qids not task-namespaced: {bad[:4]}"
+            )
+        tasks_consumed = {t.task for t in consumed}
+        if tasks_consumed != {"math", "code"}:
+            failures.append(
+                f"[mix {tag}] consumed tasks {tasks_consumed} != both"
+            )
+    # The mixture cycled its datasets: epoch-stamped qids keep replay
+    # dedup keys unique across wraps (the old prompt{cursor} scheme
+    # collides here).
+    if mix_a.state_dict()["epochs"]["math"] < 1:
+        failures.append(
+            "math dataset never wrapped — the epoch-stamp leg is vacuous"
+        )
+    for mix in (mix_a, mix_b):
+        if mix.reward_ema("math") is None or mix.reward_ema("code") is None:
+            failures.append("a task's reward EMA never updated")
+            break
+    wm = replay_a.task_watermarks()
+    if set(wm) != {"math", "code"}:
+        failures.append(f"replay task watermarks {sorted(wm)} != both tasks")
+    else:
+        mix_a.sync_replay(wm)  # curriculum <- replay plumbing holds
+        if sum(v["consumed"] for v in wm.values()) != len(consumed_a):
+            failures.append("per-task consumed counts do not add up")
+
+    # (c) slow-verifier A/B: grading is async, so a 10x-slower backend
+    # must not degrade rollout dispatch throughput.
+    if elapsed_b > 2.0 * elapsed_a + 1.0:
+        failures.append(
+            f"dispatch throughput degraded under the slow verifier: "
+            f"{elapsed_b:.2f}s vs baseline {elapsed_a:.2f}s"
+        )
+    slow_graded = vws_b[1].graded
+    if slow_graded < 1:
+        failures.append("the slow backend never graded anything")
+    for w in vws_b:
+        w.close()
+
+    # Per-task reward curves + fleet signals on the metrics plane, with
+    # the SLO examples from the metrics_report docstring evaluated.
+    samples, _ = metrics_report.parse_prometheus_text(
+        metrics.default_registry().expose()
+    )
+    task_rewards = {
+        labels.get("task"): v
+        for n, labels, v in samples
+        if n == "areal_mixture_task_reward"
+    }
+    if not {"math", "code"} <= set(task_rewards):
+        failures.append(
+            f"per-task reward gauges missing: have {sorted(task_rewards)}"
+        )
+    scrape = metrics_report.RoleScrape("local", time.monotonic(), samples)
+    signals, _rows = metrics_report.fleet_signals([scrape], None)
+    for sig in ("grade_latency_p99", "verifier_queue_depth",
+                "task_reward_min"):
+        if sig not in signals:
+            failures.append(f"fleet signal {sig!r} missing: {signals}")
+    slo_lines = []
+    for text in (
+        "crit: grade_latency_p99 <= 5",
+        "crit: verifier_queue_depth <= 64",
+        "warn: task_reward_min >= 0.05",
+    ):
+        rule = metrics_report.parse_slo_rule(text)
+        msg = rule.evaluate([signals])
+        slo_lines.append(f"  {text!r}: {'VIOLATED: ' + msg if msg else 'holds'}")
+        if msg is not None and rule.signal != "task_reward_min":
+            failures.append(f"SLO example unexpectedly violated: {msg}")
+
+    # Per-task e2e lineage attribution through trace_report --lineage.
+    tracer.flush()
+    trace = tracer.merge_shards(
+        trace_dir, out_path=os.path.join(trace_dir, "trace.json")
+    )
+    os.environ.pop("AREAL_TRACE_DIR", None)
+    summary = trace_report.lineage_summary(trace)
+    by_task = {b["task"]: b for b in summary["by_task"]}
+    if not {"math", "code"} <= set(by_task):
+        failures.append(
+            f"lineage by_task missing tasks: have {sorted(by_task)}"
+        )
+    else:
+        for task in ("math", "code"):
+            if by_task[task]["complete"] < 1:
+                failures.append(
+                    f"no complete {task} lineage timeline "
+                    f"(n={by_task[task]['n']})"
+                )
+    rendered = trace_report.format_lineage(trace)
+    if "task=math" not in rendered or "task=code" not in rendered:
+        failures.append("trace_report --lineage renders no per-task rows")
+
+    for f in failures:
+        print(f"FAIL[verifier-chaos]: {f}")
+    if not failures:
+        print(
+            f"OK[verifier-mix]: 2x16 mixed-task prompts "
+            f"(math:code = 2:1), namespaced qids across dataset wraps, "
+            f"reward EMAs math={mix_b.reward_ema('math'):.2f} "
+            f"code={mix_b.reward_ema('code'):.2f}; dispatch elapsed "
+            f"{elapsed_a:.2f}s baseline vs {elapsed_b:.2f}s with one "
+            f"10x-slow backend ({slow_graded} items on it); signals "
+            + ", ".join(
+                f"{k}={signals[k]:.3g}"
+                for k in (
+                    "grade_latency_p99", "verifier_queue_depth",
+                    "task_reward_min",
+                )
+            )
+        )
+        print()
+        print("--- SLO examples over the scraped signals ---")
+        for ln in slo_lines:
+            print(ln)
+        print()
+        print("--- trace_report --lineage (per-task attribution) ---")
+        for ln in rendered.splitlines():
+            if ln.startswith("  task=") or "traces:" in ln:
+                print(ln)
     return len(failures)
 
 
@@ -2422,6 +2956,13 @@ def main() -> int:
                         "chaos leg (5 servers, broadcast-tree push, "
                         "first relay killed mid-broadcast; zero torn "
                         "versions + v-1 staleness bound asserted)")
+    p.add_argument("--verifier-chaos", action="store_true",
+                   help="run ONLY the verifier-service-fleet chaos leg "
+                        "(3 graders, one killed mid-grade; zero lost "
+                        "grades, redispatch, breaker cycle, lane "
+                        "refill; mixed-task mixture smoke with "
+                        "per-task reward curves + lineage; "
+                        "slow-verifier A/B)")
     args = p.parse_args()
 
     if args.trainer_chaos_victim:
@@ -2456,6 +2997,14 @@ def main() -> int:
             print(f"FAIL: {n_fail} agent check(s) failed")
             return 1
         print("OK: agent-serving runtime verified end to end")
+        return 0
+
+    if args.verifier_chaos:
+        n_fail = check_verifier_chaos()
+        if n_fail:
+            print(f"FAIL: {n_fail} verifier-chaos check(s) failed")
+            return 1
+        print("OK: verifier service fleet survived the injected kill")
         return 0
 
     if args.push_chaos:

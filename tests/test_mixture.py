@@ -1,0 +1,309 @@
+"""Task-mixture curriculum scheduler (data/mixture.py): weight
+normalization and deterministic proportions, per-task cursor persistence
+round-trips (including the old-pickle scalar-cursor backfill via
+fast_forward), adaptive watermark-driven upweighting, the bounded
+starvation window, the namespaced qids the rollout controller mints for
+mixture items, and the controller-level mixture recover path."""
+
+import math
+
+import pytest
+
+from areal_tpu.api.model_api import GenerationHyperparameters
+from areal_tpu.data.mixture import (
+    TaskMixtureStream,
+    TaskSource,
+    build_mixture,
+)
+from areal_tpu.system.replay import ReplayBuffer
+from areal_tpu.system.rollout import RolloutController, _normalize_prompt
+
+
+def _src(name, n=4, weight=1.0, wm=0.5):
+    return TaskSource(
+        name=name,
+        prompts=[[i, i + 1] for i in range(n)],
+        weight=weight,
+        reward_watermark=wm,
+    )
+
+
+def _schedule(stream, n):
+    """(task, epoch, index) of the next n draws."""
+    out = []
+    for _ in range(n):
+        it = next(stream)
+        out.append((it["task"], it["epoch"], it["index"]))
+    return out
+
+
+class TestWeights:
+    def test_weights_normalize_to_one(self):
+        mix = TaskMixtureStream(
+            [_src("a", weight=2.0), _src("b", weight=1.0),
+             _src("c", weight=1.0)]
+        )
+        assert mix.weights == {"a": 0.5, "b": 0.25, "c": 0.25}
+        assert math.isclose(sum(mix.weights.values()), 1.0)
+
+    def test_draw_proportions_match_weights_exactly(self):
+        # Smooth weighted round-robin is deterministic: over any window
+        # of 400 draws the counts are exactly proportional.
+        mix = TaskMixtureStream(
+            [_src("a", weight=2.0), _src("b", weight=1.0),
+             _src("c", weight=1.0)]
+        )
+        counts = {"a": 0, "b": 0, "c": 0}
+        for _ in range(400):
+            counts[next(mix)["task"]] += 1
+        assert counts == {"a": 200, "b": 100, "c": 100}
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            [],
+            [_src("a"), _src("a")],
+            [_src("a", weight=0.0)],
+            [_src("a", weight=-1.0)],
+            [TaskSource(name="a", prompts=[])],
+        ],
+    )
+    def test_invalid_mixtures_fail_loudly(self, bad):
+        with pytest.raises(ValueError):
+            TaskMixtureStream(bad)
+
+
+class TestEmittedItems:
+    def test_items_carry_task_epoch_index_and_ids(self):
+        mix = TaskMixtureStream([_src("a", n=2)])
+        assert _schedule(mix, 4) == [
+            ("a", 0, 0), ("a", 0, 1), ("a", 1, 0), ("a", 1, 1)
+        ]
+
+    def test_dict_sources_merge_through(self):
+        mix = TaskMixtureStream(
+            [TaskSource("a", [{"qid": "q7", "prompt_ids": [1, 2],
+                               "meta": "x"}])]
+        )
+        it = next(mix)
+        assert it["qid"] == "q7" and it["meta"] == "x"
+        assert it["prompt_ids"] == [1, 2] and it["task"] == "a"
+
+    def test_pair_sources_keep_their_qids(self):
+        mix = TaskMixtureStream([TaskSource("a", [("q0", [3, 4])])])
+        it = next(mix)
+        assert it["qid"] == "q0" and it["prompt_ids"] == [3, 4]
+
+
+class TestPersistence:
+    def _mix(self):
+        return TaskMixtureStream(
+            [_src("a", n=3, weight=2.0), _src("b", n=2, weight=1.0)]
+        )
+
+    def test_state_dict_round_trip_resumes_exactly(self):
+        ref = self._mix()
+        _schedule(ref, 7)
+        sd = ref.state_dict()
+        expected = _schedule(ref, 10)
+        fresh = self._mix()
+        fresh.load_state_dict(sd)
+        assert _schedule(fresh, 10) == expected
+        assert fresh.drawn == ref.drawn
+
+    def test_old_pickle_backfill_via_fast_forward(self):
+        # A pre-mixture recover record only holds the scalar draw count;
+        # replaying the deterministic schedule reconstructs the exact
+        # per-task positions.
+        ref = self._mix()
+        _schedule(ref, 7)
+        fresh = self._mix()
+        fresh.fast_forward(7)
+        assert _schedule(fresh, 10) == _schedule(ref, 10)
+
+    def test_shrunk_dataset_wraps_the_restored_cursor(self):
+        big = TaskMixtureStream([_src("a", n=10)])
+        _schedule(big, 7)
+        sd = big.state_dict()
+        small = TaskMixtureStream([_src("a", n=3)])
+        small.load_state_dict(sd)
+        assert small._cursors["a"] == 7 % 3
+        next(small)  # still draws
+
+    def test_unknown_tasks_dropped_and_new_tasks_kept(self):
+        sd = self._mix().state_dict()
+        sd["cursors"]["gone"] = 99
+        other = TaskMixtureStream([_src("a", n=3), _src("new", n=2)])
+        other.load_state_dict(sd)
+        assert "gone" not in other._cursors
+        assert other._cursors["new"] == 0
+
+
+class TestAdaptiveCurriculum:
+    def test_below_watermark_task_is_upweighted(self):
+        mix = TaskMixtureStream(
+            [_src("a", wm=0.5), _src("b", wm=0.5)], adaptive=True
+        )
+        for _ in range(5):
+            mix.observe_reward("a", 0.0)
+            mix.observe_reward("b", 1.0)
+        w = mix.weights
+        assert w["a"] > w["b"]
+        assert math.isclose(sum(w.values()), 1.0)
+
+    def test_boost_is_capped(self):
+        mix = TaskMixtureStream(
+            [_src("a", wm=0.5), _src("b", wm=0.5)],
+            adaptive=True, adapt_gain=100.0, max_boost=3.0,
+        )
+        mix.observe_reward("a", 0.0)
+        mix.observe_reward("b", 1.0)
+        w = mix.weights
+        assert math.isclose(w["a"] / w["b"], 3.0)
+
+    def test_passing_tasks_keep_base_weights(self):
+        mix = TaskMixtureStream(
+            [_src("a", wm=0.5), _src("b", wm=0.5)], adaptive=True
+        )
+        mix.observe_reward("a", 0.9)
+        mix.observe_reward("b", 0.8)
+        assert mix.weights == {"a": 0.5, "b": 0.5}
+
+    def test_unobserved_task_stays_at_base(self):
+        mix = TaskMixtureStream(
+            [_src("a", wm=0.5), _src("b", wm=0.5)], adaptive=True
+        )
+        mix.observe_reward("b", 1.0)  # "a" never graded yet
+        assert mix.weights == {"a": 0.5, "b": 0.5}
+
+    def test_reward_ema_blends(self):
+        mix = TaskMixtureStream([_src("a")], ema_alpha=0.5)
+        assert mix.reward_ema("a") is None
+        mix.observe_reward("a", 1.0)
+        assert mix.reward_ema("a") == 1.0
+        mix.observe_reward("a", 0.0)
+        assert mix.reward_ema("a") == 0.5
+        mix.observe_reward("nope", 1.0)  # unknown task ignored
+        assert mix.reward_ema("nope") is None
+
+    def test_sync_replay_folds_staleness_watermarks(self):
+        mix = TaskMixtureStream([_src("a"), _src("b")])
+        mix.sync_replay({
+            "a": {"staleness_mean": 2.0},
+            "b": {"staleness_mean": 0.5},
+            "ghost": {"staleness_mean": 9.0},
+        })
+        assert mix._staleness_ema["a"] == 2.0
+        assert mix._staleness_ema["b"] == 0.5
+
+
+class TestStarvationBound:
+    def test_low_weight_task_is_never_starved_past_bound(self):
+        mix = TaskMixtureStream(
+            [_src("a", weight=10.0), _src("b", weight=1.0)]
+        )
+        bound = mix.starvation_bound("b")
+        assert bound == math.ceil(11.0) + 1
+        last_seen = 0
+        for i in range(1, 301):
+            if next(mix)["task"] == "b":
+                assert i - last_seen <= bound
+                last_seen = i
+        assert last_seen > 300 - bound  # and it keeps being drawn
+
+
+class TestBuildMixture:
+    def test_builds_from_config_weights(self):
+        mix = build_mixture(
+            {"math": 3.0, "code": 1.0},
+            {"math": [[1]], "code": [[2]]},
+            reward_watermarks={"code": 0.8},
+        )
+        assert mix.weights == {"math": 0.75, "code": 0.25}
+        assert mix.sources["code"].reward_watermark == 0.8
+        assert mix.sources["math"].reward_watermark == 0.5
+
+    def test_missing_prompts_fail_loudly(self):
+        with pytest.raises(ValueError):
+            build_mixture({"math": 1.0}, {})
+
+
+class TestMixtureQids:
+    """_normalize_prompt mints collision-free qids for mixture items
+    while keeping every pre-mixture calling convention intact."""
+
+    def test_mixture_items_get_namespaced_qids(self):
+        qid, ids, task = _normalize_prompt(
+            {"task": "math", "epoch": 1, "index": 3,
+             "prompt_ids": [1, 2]},
+            cursor=99,
+        )
+        assert qid == "math:e1:p3" and ids == [1, 2] and task == "math"
+
+    def test_epoch_disambiguates_cycled_datasets(self):
+        mix = TaskMixtureStream([_src("a", n=2)])
+        qids = [_normalize_prompt(next(mix), i)[0] for i in range(4)]
+        assert qids == ["a:e0:p0", "a:e0:p1", "a:e1:p0", "a:e1:p1"]
+        assert len(set(qids)) == 4
+
+    def test_explicit_qid_passes_through(self):
+        qid, _, task = _normalize_prompt(
+            {"qid": "mine", "task": "code", "epoch": 2, "index": 0,
+             "prompt_ids": [5]},
+            cursor=0,
+        )
+        assert qid == "mine" and task == "code"
+
+    def test_bare_items_keep_historical_qids(self):
+        assert _normalize_prompt([1, 2, 3], 5) == ("prompt5", [1, 2, 3], "")
+        assert _normalize_prompt(("q", [4]), 0) == ("q", [4], "")
+        assert _normalize_prompt({"prompt_ids": [7]}, 2)[0] == "prompt2"
+
+    def test_epoch_without_task_still_namespaces(self):
+        qid, _, task = _normalize_prompt(
+            {"epoch": 0, "index": 1, "prompt_ids": [1]}, cursor=8
+        )
+        assert qid == "task:e0:p1" and task == ""
+
+
+class TestControllerRecover:
+    def _ctl(self, mix):
+        return RolloutController(
+            replay=ReplayBuffer(capacity=4, max_head_offpolicyness=1),
+            gconfig=GenerationHyperparameters(n=1, max_new_tokens=4),
+            discovery=lambda: {},
+            mixture=mix,
+        )
+
+    def _mix(self):
+        return TaskMixtureStream(
+            [_src("a", n=3, weight=2.0), _src("b", n=2, weight=1.0)]
+        )
+
+    def test_mixture_state_rides_controller_state_dict(self):
+        ref = self._mix()
+        ctl = self._ctl(ref)
+        _schedule(ref, 5)
+        ctl.cursor = 5
+        sd = ctl.state_dict()
+        assert sd["mixture"]["drawn"] == 5
+        expected = _schedule(ref, 6)
+
+        fresh = self._mix()
+        ctl2 = self._ctl(fresh)
+        ctl2.load_state_dict(sd)
+        assert _schedule(fresh, 6) == expected
+        # The stream resumed itself — run() must not skip anything.
+        assert ctl2._skip_on_run == 0
+
+    def test_old_record_without_mixture_fast_forwards(self):
+        ref = self._mix()
+        _schedule(ref, 5)
+        expected = _schedule(ref, 6)
+
+        fresh = self._mix()
+        ctl = self._ctl(fresh)
+        # A pre-mixture pickle: scalar cursor only.
+        ctl.load_state_dict({"cursor": 5, "stat": {}})
+        assert _schedule(fresh, 6) == expected
+        assert ctl._skip_on_run == 0
